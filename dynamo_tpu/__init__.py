@@ -1,0 +1,9 @@
+"""dynamo_tpu: TPU-native distributed LLM inference serving framework.
+
+A ground-up JAX/XLA/Pallas implementation of the capabilities of NVIDIA
+Dynamo (the study reference): OpenAI-compatible frontend, KV-cache-aware
+routing, disaggregated prefill/decode, multi-tier KV block management, and a
+native JAX inference engine with TP/EP/SP parallelism over TPU meshes.
+"""
+
+__version__ = "0.1.0"

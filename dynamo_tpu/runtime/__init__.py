@@ -1,0 +1,46 @@
+"""dynamo_tpu.runtime — distributed runtime kernel.
+
+The hardware-agnostic core: control plane (discovery/leases/pub-sub/streams),
+component hierarchy, direct TCP streaming transport, engine + cancellation
+abstractions, metrics, status server.
+"""
+
+from .client import Client
+from .component import Component, Endpoint, Instance, Namespace, ServedEndpoint
+from .engine import AsyncEngine, Context, EngineStream
+from .metrics import MetricsScope
+from .runtime import DistributedRuntime
+from .status import SystemStatusServer
+from .transport.control_plane import (
+    ControlPlaneClient,
+    ControlPlaneServer,
+    WatchEvent,
+)
+from .transport.service import (
+    RemoteStreamError,
+    ServiceClient,
+    ServiceServer,
+    ServiceUnavailable,
+)
+
+__all__ = [
+    "AsyncEngine",
+    "Client",
+    "Component",
+    "Context",
+    "ControlPlaneClient",
+    "ControlPlaneServer",
+    "DistributedRuntime",
+    "Endpoint",
+    "EngineStream",
+    "Instance",
+    "MetricsScope",
+    "Namespace",
+    "RemoteStreamError",
+    "ServedEndpoint",
+    "ServiceClient",
+    "ServiceServer",
+    "ServiceUnavailable",
+    "SystemStatusServer",
+    "WatchEvent",
+]

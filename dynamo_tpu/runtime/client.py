@@ -1,0 +1,166 @@
+"""Endpoint Client: instance discovery + routed request dispatch.
+
+Reference: /root/reference/lib/runtime/src/component/client.rs:40 (`Client`,
+`InstanceSource::{Static,Dynamic}`) and pipeline/network/egress/push_router.rs:41
+(`PushRouter`, RouterMode Random/RoundRobin/Direct/KV).  One discovery watcher
+per endpoint is shared across client handles.  Routing modes here are
+client-side picks over the live instance list followed by a direct TCP stream
+to the chosen worker.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+from typing import Any, AsyncIterator
+
+from .component import Endpoint, Instance
+from .engine import Context
+from .transport.service import ServiceUnavailable
+
+logger = logging.getLogger(__name__)
+
+
+class Client:
+    """Client for one endpoint; resolves live instances via a discovery watch."""
+
+    def __init__(self, endpoint: Endpoint, static_instances: list[Instance] | None = None):
+        self.endpoint = endpoint
+        self.runtime = endpoint.runtime
+        self._static = static_instances
+        self._instances: dict[int, Instance] = {
+            i.instance_id: i for i in (static_instances or [])
+        }
+        self._watch_task: asyncio.Task | None = None
+        self._synced = asyncio.Event()
+        self._rr = 0
+        if static_instances is not None:
+            self._synced.set()
+
+    # -- discovery ---------------------------------------------------------- #
+
+    async def start(self) -> "Client":
+        if self._static is None and self._watch_task is None:
+            self._watch_task = asyncio.create_task(self._watch())
+        return self
+
+    async def _watch(self) -> None:
+        backoff = 0.2
+        while True:
+            try:
+                stream = await self.runtime.control.watch_prefix(
+                    self.endpoint.path_prefix
+                )
+                seen: set[int] = set()
+                async for ev in stream:
+                    if ev.type == "sync":
+                        # Drop instances that vanished while we were away.
+                        for iid in [i for i in self._instances if i not in seen]:
+                            self._instances.pop(iid, None)
+                        self._synced.set()
+                        backoff = 0.2
+                    elif ev.type == "put":
+                        inst = Instance.from_bytes(ev.value)
+                        self._instances[inst.instance_id] = inst
+                        seen.add(inst.instance_id)
+                    elif ev.type == "delete":
+                        iid = int(ev.key.rsplit("/", 1)[-1])
+                        self._instances.pop(iid, None)
+                # Stream ended: control-plane connection lost. Retry.
+                logger.warning(
+                    "discovery watch for %s lost; retrying in %.1fs",
+                    self.endpoint.wire_name, backoff,
+                )
+            except asyncio.CancelledError:
+                return
+            except (ConnectionError, RuntimeError) as e:
+                logger.warning(
+                    "discovery watch for %s failed (%s); retrying in %.1fs",
+                    self.endpoint.wire_name, e, backoff,
+                )
+            await asyncio.sleep(backoff)
+            backoff = min(backoff * 2, 5.0)
+
+    async def wait_for_instances(self, timeout: float = 10.0) -> list[Instance]:
+        """Block until at least one instance is live."""
+        await self.start()
+        deadline = asyncio.get_running_loop().time() + timeout
+        await asyncio.wait_for(self._synced.wait(), timeout)
+        while not self._instances:
+            if asyncio.get_running_loop().time() > deadline:
+                raise TimeoutError(
+                    f"no instances for {self.endpoint.wire_name} within {timeout}s"
+                )
+            await asyncio.sleep(0.05)
+        return self.instances()
+
+    def instances(self) -> list[Instance]:
+        return sorted(self._instances.values(), key=lambda i: i.instance_id)
+
+    def instance_ids(self) -> list[int]:
+        return sorted(self._instances)
+
+    async def stop(self) -> None:
+        if self._watch_task:
+            self._watch_task.cancel()
+
+    # -- routing ------------------------------------------------------------ #
+
+    def _pick_random(self) -> Instance:
+        insts = self.instances()
+        if not insts:
+            raise ServiceUnavailable(f"no instances for {self.endpoint.wire_name}")
+        return random.choice(insts)
+
+    def _pick_round_robin(self) -> Instance:
+        insts = self.instances()
+        if not insts:
+            raise ServiceUnavailable(f"no instances for {self.endpoint.wire_name}")
+        inst = insts[self._rr % len(insts)]
+        self._rr += 1
+        return inst
+
+    def _pick_direct(self, instance_id: int) -> Instance:
+        inst = self._instances.get(instance_id)
+        if inst is None:
+            raise ServiceUnavailable(
+                f"instance {instance_id} not live for {self.endpoint.wire_name}"
+            )
+        return inst
+
+    async def _routed(
+        self, pick, request: Any, context: Context | None
+    ) -> AsyncIterator[Any]:
+        # Lazily start discovery so `ep.client().generate(...)` works without
+        # an explicit start()/wait_for_instances() dance.
+        if self._static is None and self._watch_task is None:
+            await self.start()
+        if not self._instances and self._static is None:
+            try:
+                await self.wait_for_instances(timeout=5.0)
+            except TimeoutError as e:
+                raise ServiceUnavailable(str(e)) from e
+        inst = pick()
+        svc = self.runtime.service_client
+        async for item in svc.call_stream(
+            inst.address, inst.service_endpoint, request, context
+        ):
+            yield item
+
+    def direct(self, request: Any, instance_id: int,
+               context: Context | None = None) -> AsyncIterator[Any]:
+        return self._routed(lambda: self._pick_direct(instance_id), request, context)
+
+    def random(self, request: Any, context: Context | None = None) -> AsyncIterator[Any]:
+        return self._routed(self._pick_random, request, context)
+
+    def round_robin(self, request: Any,
+                    context: Context | None = None) -> AsyncIterator[Any]:
+        return self._routed(self._pick_round_robin, request, context)
+
+    async def generate(self, request: Any,
+                       context: Context | None = None) -> AsyncIterator[Any]:
+        """Default routing (round-robin) — AsyncEngine-compatible."""
+        async for item in self.round_robin(request, context):
+            yield item

@@ -1,0 +1,87 @@
+"""AsyncEngine protocol + cancellation Context.
+
+TPU-native counterpart of the reference's engine abstraction
+(/root/reference/lib/runtime/src/engine.rs:112 `AsyncEngineContext`,
+:201 `AsyncEngine`): an engine maps a single request to a stream of
+responses; a Context travels with the request and carries identity and
+two-level cancellation (`stop_generating` = graceful, finish current token;
+`kill` = drop everything now).  Contexts form a tree via `link_child` so
+cancelling an upstream request propagates into nested downstream calls
+(reference: docs/architecture/request_cancellation.md).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import uuid
+from typing import Any, AsyncIterator, Protocol, runtime_checkable
+
+
+class Context:
+    """Cancellation context for one in-flight request."""
+
+    def __init__(self, request_id: str | None = None):
+        self.id = request_id or uuid.uuid4().hex
+        self._stopped = asyncio.Event()
+        self._killed = asyncio.Event()
+        self._children: list[Context] = []
+
+    # -- state -------------------------------------------------------------- #
+
+    def is_stopped(self) -> bool:
+        return self._stopped.is_set()
+
+    def is_killed(self) -> bool:
+        return self._killed.is_set()
+
+    def stop_generating(self) -> None:
+        """Graceful: stop producing new tokens, let the stream finish."""
+        self._stopped.set()
+        for child in self._children:
+            child.stop_generating()
+
+    def kill(self) -> None:
+        """Hard cancel: abandon the stream immediately."""
+        self._killed.set()
+        self._stopped.set()
+        for child in self._children:
+            child.kill()
+
+    async def stopped(self) -> None:
+        await self._stopped.wait()
+
+    async def killed(self) -> None:
+        await self._killed.wait()
+
+    def link_child(self, child: "Context") -> "Context":
+        """Propagate this context's cancellation into `child`."""
+        self._children.append(child)
+        if self.is_killed():
+            child.kill()
+        elif self.is_stopped():
+            child.stop_generating()
+        return child
+
+    def child(self) -> "Context":
+        return self.link_child(Context())
+
+
+@runtime_checkable
+class AsyncEngine(Protocol):
+    """request in, response stream out. Implementations: the JAX engine,
+    the mocker, routed pipelines, remote clients."""
+
+    def generate(self, request: Any, context: Context) -> AsyncIterator[Any]:
+        ...
+
+
+class EngineStream:
+    """Helper wrapping an async generator with its context (the analog of the
+    reference's ResponseStream, engine.rs:213)."""
+
+    def __init__(self, stream: AsyncIterator[Any], context: Context):
+        self.stream = stream
+        self.context = context
+
+    def __aiter__(self):
+        return self.stream.__aiter__()

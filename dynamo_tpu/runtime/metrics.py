@@ -1,0 +1,103 @@
+"""Hierarchical Prometheus metrics.
+
+Reference: /root/reference/lib/runtime/src/metrics.rs — metrics created at
+runtime/namespace/component/endpoint level automatically carry
+``dynamo_namespace``/``dynamo_component``/``dynamo_endpoint`` labels.  We use
+``prometheus_client`` with per-process registries; a MetricsScope curries the
+hierarchy labels into every metric it creates.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+
+PREFIX = "dynamo_tpu"
+HIER_LABELS = ("dynamo_namespace", "dynamo_component", "dynamo_endpoint")
+
+
+class MetricsScope:
+    """A point in the namespace/component/endpoint hierarchy that can mint
+    metrics pre-labelled with its position."""
+
+    def __init__(
+        self,
+        registry: CollectorRegistry | None = None,
+        namespace: str = "",
+        component: str = "",
+        endpoint: str = "",
+    ):
+        self.registry = registry or CollectorRegistry()
+        self._labels = {
+            "dynamo_namespace": namespace,
+            "dynamo_component": component,
+            "dynamo_endpoint": endpoint,
+        }
+        self._metrics: dict[str, object] = {}
+
+    def child(self, **kw) -> "MetricsScope":
+        labels = {k.replace("dynamo_", ""): v for k, v in self._labels.items()}
+        labels.update(kw)
+        return MetricsScope(self.registry, **labels)
+
+    def _full(self, name: str) -> str:
+        return f"{PREFIX}_{name}"
+
+    def _get_or_make(self, cls, name: str, doc: str, extra_labels: Iterable[str],
+                     **kw):
+        key = self._full(name)
+        metric = self._metrics.get(key)
+        if metric is None:
+            try:
+                metric = cls(key, doc, tuple(HIER_LABELS) + tuple(extra_labels),
+                             registry=self.registry, **kw)
+            except ValueError:
+                # Already registered in this registry by a sibling scope.
+                collectors = {
+                    c._name if hasattr(c, "_name") else None: c
+                    for c in self.registry._collector_to_names  # noqa: SLF001
+                }
+                metric = collectors[key]
+            self._metrics[key] = metric
+        return metric
+
+    def counter(self, name: str, doc: str = "", labels: Iterable[str] = ()):
+        return self._get_or_make(Counter, name, doc or name, labels).labels(
+            **self._labels
+        ) if not labels else _Partial(
+            self._get_or_make(Counter, name, doc or name, labels), self._labels
+        )
+
+    def gauge(self, name: str, doc: str = "", labels: Iterable[str] = ()):
+        return self._get_or_make(Gauge, name, doc or name, labels).labels(
+            **self._labels
+        ) if not labels else _Partial(
+            self._get_or_make(Gauge, name, doc or name, labels), self._labels
+        )
+
+    def histogram(self, name: str, doc: str = "", labels: Iterable[str] = (),
+                  buckets=None):
+        kw = {"buckets": buckets} if buckets else {}
+        m = self._get_or_make(Histogram, name, doc or name, labels, **kw)
+        return m.labels(**self._labels) if not labels else _Partial(m, self._labels)
+
+    def render(self) -> bytes:
+        return generate_latest(self.registry)
+
+
+class _Partial:
+    """Metric with hierarchy labels bound, awaiting user labels."""
+
+    def __init__(self, metric, bound: dict):
+        self._metric = metric
+        self._bound = bound
+
+    def labels(self, **kw):
+        return self._metric.labels(**{**self._bound, **kw})

@@ -1,0 +1,63 @@
+"""System status server: /health, /live, /metrics per process.
+
+Reference: /root/reference/lib/runtime/src/system_status_server.rs:74.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Awaitable, Callable
+
+from aiohttp import web
+
+from .metrics import MetricsScope
+
+
+class SystemStatusServer:
+    def __init__(
+        self,
+        metrics: MetricsScope | None = None,
+        health_fn: Callable[[], Awaitable[dict]] | None = None,
+        host: str = "0.0.0.0",
+        port: int = 0,
+    ):
+        self.metrics = metrics
+        self.health_fn = health_fn
+        self.host = host
+        self.port = port
+        self._runner: web.AppRunner | None = None
+
+    async def start(self) -> "SystemStatusServer":
+        app = web.Application()
+        app.router.add_get("/health", self._health)
+        app.router.add_get("/live", self._live)
+        app.router.add_get("/metrics", self._metrics)
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]  # noqa: SLF001
+        return self
+
+    async def stop(self) -> None:
+        if self._runner:
+            await self._runner.cleanup()
+
+    async def _health(self, request: web.Request) -> web.Response:
+        body = {"status": "healthy"}
+        if self.health_fn:
+            body = await self.health_fn()
+        status = 200 if body.get("status") in ("healthy", "ready") else 503
+        return web.Response(
+            text=json.dumps(body), status=status, content_type="application/json"
+        )
+
+    async def _live(self, request: web.Request) -> web.Response:
+        return web.Response(
+            text=json.dumps({"status": "live"}), content_type="application/json"
+        )
+
+    async def _metrics(self, request: web.Request) -> web.Response:
+        data = self.metrics.render() if self.metrics else b""
+        return web.Response(body=data, content_type="text/plain")

@@ -1,0 +1,2 @@
+from .control_plane import ControlPlaneClient, ControlPlaneServer
+from .service import ServiceClient, ServiceServer

@@ -1,0 +1,75 @@
+"""Wire protocol: length-prefixed msgpack frames over asyncio streams.
+
+Design (TPU-native redesign of the reference's two-part codec,
+/root/reference/lib/runtime/src/pipeline/network/codec/two_part.rs): every
+frame is a (header, payload) pair. The header is a small msgpack map carrying
+routing/control metadata; the payload is opaque bytes (often itself msgpack).
+Framing is ``u32 header_len | u32 payload_len | header | payload`` which lets
+the hot path skip deserializing payloads it only forwards.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from dataclasses import dataclass
+from typing import Any
+
+import msgpack
+
+_LEN = struct.Struct("!II")
+
+# Frame kinds used by both the control plane and the service transport.
+K_REQ = 1  # open a request stream (header: stream_id, endpoint, ...)
+K_DATA = 2  # response/stream data
+K_END = 3  # end of stream (sentinel)
+K_ERR = 4  # error; payload = msgpack {message, code}
+K_CANCEL = 5  # client -> server: stop generating (graceful)
+K_KILL = 6  # client -> server: hard cancel
+K_PING = 7
+K_PONG = 8
+K_CTRL = 9  # control-plane RPC
+
+
+class WireError(Exception):
+    pass
+
+
+def pack(obj: Any) -> bytes:
+    return msgpack.packb(obj, use_bin_type=True)
+
+
+def unpack(data: bytes) -> Any:
+    return msgpack.unpackb(data, raw=False)
+
+
+@dataclass(slots=True)
+class Frame:
+    kind: int
+    stream_id: int
+    header: dict
+    payload: bytes
+
+    def encode(self) -> bytes:
+        hdr = msgpack.packb(
+            {"k": self.kind, "s": self.stream_id, **self.header}, use_bin_type=True
+        )
+        return _LEN.pack(len(hdr), len(self.payload)) + hdr + self.payload
+
+
+async def read_frame(reader: asyncio.StreamReader) -> Frame:
+    """Read one frame; raises IncompleteReadError at clean EOF."""
+    raw = await reader.readexactly(_LEN.size)
+    hlen, plen = _LEN.unpack(raw)
+    if hlen > 1 << 24 or plen > 1 << 31:
+        raise WireError(f"oversized frame header={hlen} payload={plen}")
+    hdr_raw = await reader.readexactly(hlen)
+    payload = await reader.readexactly(plen) if plen else b""
+    hdr = msgpack.unpackb(hdr_raw, raw=False)
+    kind = hdr.pop("k")
+    stream_id = hdr.pop("s", 0)
+    return Frame(kind=kind, stream_id=stream_id, header=hdr, payload=payload)
+
+
+def write_frame(writer: asyncio.StreamWriter, frame: Frame) -> None:
+    writer.write(frame.encode())

@@ -1,0 +1,49 @@
+"""Test helpers: local cluster context managers (the analog of the
+reference's EtcdServer/NatsServer ManagedProcess fixtures,
+/root/reference/tests/conftest.py:195-236 — here everything runs in-process
+on ephemeral ports)."""
+
+from __future__ import annotations
+
+import contextlib
+from typing import AsyncIterator
+
+from .runtime import (
+    ControlPlaneClient,
+    ControlPlaneServer,
+    DistributedRuntime,
+)
+
+
+@contextlib.asynccontextmanager
+async def local_control_plane() -> AsyncIterator[ControlPlaneServer]:
+    server = await ControlPlaneServer().start()
+    try:
+        yield server
+    finally:
+        await server.stop()
+
+
+@contextlib.asynccontextmanager
+async def local_runtime() -> AsyncIterator[DistributedRuntime]:
+    """One runtime with an embedded control plane."""
+    rt = await DistributedRuntime.detached()
+    try:
+        yield rt
+    finally:
+        await rt.shutdown(graceful=False)
+
+
+@contextlib.asynccontextmanager
+async def local_cluster(n: int = 1):
+    """A control plane + n runtimes (simulating n worker processes)."""
+    server = await ControlPlaneServer().start()
+    runtimes = []
+    try:
+        for _ in range(n):
+            runtimes.append(await DistributedRuntime.connect(server.address))
+        yield server, runtimes
+    finally:
+        for rt in runtimes:
+            await rt.shutdown(graceful=False)
+        await server.stop()

@@ -1,0 +1,46 @@
+"""Test fixtures.
+
+JAX tests run on a virtual 8-device CPU mesh (no TPU pod needed), mirroring
+the reference's strategy of testing distributed behavior with local
+subprocesses + simulators (reference tests/conftest.py:195
+EtcdServer/NatsServer fixtures and the mocker engine).
+
+pytest-asyncio is not available in this image, so `async def` tests are run
+via a pytest_pyfunc_call hook in a fresh event loop.  Use the async context
+managers in dynamo_tpu.testing instead of async fixtures.
+"""
+
+import asyncio
+import inspect
+import os
+
+# Must be set before jax imports anywhere in the test process.
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import pytest
+
+
+@pytest.hookimpl(tryfirst=True)
+def pytest_pyfunc_call(pyfuncitem):
+    fn = pyfuncitem.obj
+    if inspect.iscoroutinefunction(fn):
+        kwargs = {
+            name: pyfuncitem.funcargs[name]
+            for name in pyfuncitem._fixtureinfo.argnames  # noqa: SLF001
+        }
+        loop = asyncio.new_event_loop()
+        try:
+            loop.run_until_complete(asyncio.wait_for(fn(**kwargs), timeout=120))
+            # Cancel stragglers (watch loops etc.) so loop.close() is quiet.
+            pending = [t for t in asyncio.all_tasks(loop) if not t.done()]
+            for t in pending:
+                t.cancel()
+            if pending:
+                loop.run_until_complete(
+                    asyncio.gather(*pending, return_exceptions=True)
+                )
+        finally:
+            loop.close()
+        return True
+    return None

@@ -1,0 +1,184 @@
+"""Control plane: KV/lease/watch, pub/sub, streams, object store, queues."""
+
+import asyncio
+
+from dynamo_tpu.runtime import ControlPlaneClient
+from dynamo_tpu.testing import local_control_plane
+
+
+async def test_kv_put_get_delete():
+    async with local_control_plane() as srv:
+        c = await ControlPlaneClient(srv.address).connect()
+        await c.put("/a/b", b"1")
+        assert await c.get("/a/b") == b"1"
+        assert await c.get("/missing") is None
+        await c.put("/a/c", b"2")
+        kvs = await c.get_prefix("/a/")
+        assert [(k, v) for k, v in kvs] == [("/a/b", b"1"), ("/a/c", b"2")]
+        await c.delete("/a/b")
+        assert await c.get("/a/b") is None
+        await c.close()
+
+
+async def test_lease_expiry_removes_keys():
+    async with local_control_plane() as srv:
+        c = await ControlPlaneClient(srv.address).connect()
+        lease = await c.grant_lease(ttl=0.5)
+        await c.put("/svc/x", b"alive", lease=lease)
+        assert await c.get("/svc/x") == b"alive"
+        await asyncio.sleep(1.2)
+        assert await c.get("/svc/x") is None
+        await c.close()
+
+
+async def test_lease_keepalive_sustains():
+    async with local_control_plane() as srv:
+        c = await ControlPlaneClient(srv.address).connect()
+        lease = await c.grant_lease(ttl=0.6)
+        await c.put("/svc/y", b"alive", lease=lease)
+        for _ in range(4):
+            await asyncio.sleep(0.3)
+            assert await c.keepalive(lease)
+        assert await c.get("/svc/y") == b"alive"
+        await c.revoke(lease)
+        assert await c.get("/svc/y") is None
+        await c.close()
+
+
+async def test_watch_prefix_snapshot_and_live():
+    async with local_control_plane() as srv:
+        c = await ControlPlaneClient(srv.address).connect()
+        await c.put("/m/1", b"a")
+        watch = await c.watch_prefix("/m/")
+        events = []
+
+        async def consume():
+            async for ev in watch:
+                events.append((ev.type, ev.key, ev.value))
+                if len(events) >= 4:
+                    return
+
+        task = asyncio.create_task(consume())
+        await asyncio.sleep(0.1)
+        await c.put("/m/2", b"b")
+        await c.delete("/m/1")
+        await asyncio.wait_for(task, 5)
+        assert events[0] == ("put", "/m/1", b"a")
+        assert events[1][0] == "sync"
+        assert ("put", "/m/2", b"b") in events
+        assert ("delete", "/m/1", b"") in events
+        await watch.cancel()
+        await c.close()
+
+
+async def test_pubsub_wildcards_and_queue_groups():
+    async with local_control_plane() as srv:
+        a = await ControlPlaneClient(srv.address).connect()
+        b = await ControlPlaneClient(srv.address).connect()
+        pub = await ControlPlaneClient(srv.address).connect()
+
+        sub_a = await a.subscribe("events.kv.*")
+        got_a = []
+
+        async def drain(sub, out, n):
+            async for subject, data in sub:
+                out.append((subject, data))
+                if len(out) >= n:
+                    return
+
+        ta = asyncio.create_task(drain(sub_a, got_a, 2))
+        await asyncio.sleep(0.05)
+        assert await pub.publish("events.kv.stored", b"e1") == 1
+        assert await pub.publish("events.kv.removed", b"e2") == 1
+        assert await pub.publish("other.subject", b"e3") == 0
+        await asyncio.wait_for(ta, 5)
+        assert got_a == [("events.kv.stored", b"e1"), ("events.kv.removed", b"e2")]
+
+        # queue group: one member gets each message
+        sub_b1 = await a.subscribe("work.q", group="g")
+        sub_b2 = await b.subscribe("work.q", group="g")
+        got1, got2 = [], []
+        t1 = asyncio.create_task(drain(sub_b1, got1, 99))
+        t2 = asyncio.create_task(drain(sub_b2, got2, 99))
+        await asyncio.sleep(0.05)
+        for i in range(6):
+            assert await pub.publish("work.q", f"m{i}".encode()) == 1
+        await asyncio.sleep(0.2)
+        t1.cancel(), t2.cancel()
+        assert len(got1) + len(got2) == 6
+        assert len(got1) == 3 and len(got2) == 3  # round-robin
+        for c in (a, b, pub):
+            await c.close()
+
+
+async def test_durable_stream_fetch_and_block():
+    async with local_control_plane() as srv:
+        c = await ControlPlaneClient(srv.address).connect()
+        assert await c.stream_append("kvev", b"one") == 1
+        assert await c.stream_append("kvev", b"two") == 2
+        entries, last = await c.stream_fetch("kvev", after=0)
+        assert [e["data"] for e in entries] == [b"one", b"two"] and last == 2
+        entries, _ = await c.stream_fetch("kvev", after=1)
+        assert [e["data"] for e in entries] == [b"two"]
+
+        async def later():
+            await asyncio.sleep(0.1)
+            await c.stream_append("kvev", b"three")
+
+        asyncio.create_task(later())
+        entries, _ = await c.stream_fetch("kvev", after=2, timeout_ms=3000)
+        assert [e["data"] for e in entries] == [b"three"]
+        await c.close()
+
+
+async def test_object_store():
+    async with local_control_plane() as srv:
+        c = await ControlPlaneClient(srv.address).connect()
+        await c.obj_put("snaps", "radix-1", b"\x00" * 1024)
+        assert await c.obj_get("snaps", "radix-1") == b"\x00" * 1024
+        assert await c.obj_get("snaps", "nope") is None
+        assert await c.obj_list("snaps") == ["radix-1"]
+        await c.close()
+
+
+async def test_work_queue_fifo_and_blocking_pop():
+    async with local_control_plane() as srv:
+        c = await ControlPlaneClient(srv.address).connect()
+        await c.queue_push("prefill", b"r1")
+        await c.queue_push("prefill", b"r2")
+        assert await c.queue_depth("prefill") == 2
+        assert await c.queue_pop("prefill") == b"r1"
+        assert await c.queue_pop("prefill") == b"r2"
+        assert await c.queue_pop("prefill") is None
+
+        async def later():
+            await asyncio.sleep(0.1)
+            await c.queue_push("prefill", b"r3")
+
+        asyncio.create_task(later())
+        assert await c.queue_pop("prefill", timeout_ms=3000) == b"r3"
+        await c.close()
+
+
+async def test_lease_reassociation_on_reput():
+    """Re-putting a key under a new lease must detach it from the old lease
+    (etcd semantics) so old-lease expiry doesn't delete a live key."""
+    async with local_control_plane() as srv:
+        c = await ControlPlaneClient(srv.address).connect()
+        a = await c.grant_lease(ttl=0.5)
+        b = await c.grant_lease(ttl=30.0)
+        await c.put("/k", b"v1", lease=a)
+        await c.put("/k", b"v2", lease=b)
+        await asyncio.sleep(1.2)  # lease a expires
+        assert await c.get("/k") == b"v2"
+        await c.close()
+
+
+async def test_gt_wildcard_requires_one_token():
+    from dynamo_tpu.runtime.transport.control_plane import _subject_matches
+
+    assert _subject_matches("a.>", "a.b")
+    assert _subject_matches("a.>", "a.b.c")
+    assert not _subject_matches("a.>", "a")
+    assert _subject_matches("a.*", "a.b")
+    assert not _subject_matches("a.*", "a.b.c")

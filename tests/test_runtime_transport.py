@@ -1,0 +1,186 @@
+"""Runtime: endpoint serve/discover, streaming, cancellation, lease-death."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime import Context, ServiceUnavailable
+from dynamo_tpu.testing import local_cluster, local_runtime
+
+
+async def echo_handler(request, context: Context):
+    for i in range(request["n"]):
+        if context.is_stopped():
+            return
+        yield {"i": i, "msg": request["msg"]}
+        await asyncio.sleep(0)
+
+
+async def test_serve_and_stream_roundtrip():
+    async with local_runtime() as rt:
+        ep = rt.namespace("ns").component("comp").endpoint("generate")
+        await ep.serve_endpoint(echo_handler)
+        client = await ep.client().start()
+        await client.wait_for_instances()
+        items = [x async for x in client.round_robin({"n": 3, "msg": "hi"})]
+        assert items == [{"i": 0, "msg": "hi"}, {"i": 1, "msg": "hi"}, {"i": 2, "msg": "hi"}]
+
+
+async def test_multi_worker_round_robin_and_direct():
+    async with local_cluster(n=3) as (srv, rts):
+        seen = []
+
+        def make_handler(wid):
+            async def handler(request, context):
+                seen.append(wid)
+                yield {"worker": wid}
+
+            return handler
+
+        for i, rt in enumerate(rts):
+            ep = rt.namespace("ns").component("w").endpoint("gen")
+            await ep.serve_endpoint(make_handler(i))
+
+        client_rt = rts[0]
+        client = client_rt.namespace("ns").component("w").endpoint("gen").client()
+        await client.start()
+        await client.wait_for_instances()
+        while len(client.instances()) < 3:
+            await asyncio.sleep(0.05)
+
+        outs = set()
+        for _ in range(6):
+            async for item in client.round_robin({}):
+                outs.add(item["worker"])
+        assert outs == {0, 1, 2}
+
+        iid = client.instance_ids()[1]
+        async for item in client.direct({}, iid):
+            direct_worker = item["worker"]
+        # instance_ids are lease ids in registration order across runtimes
+        assert direct_worker in (0, 1, 2)
+
+
+async def test_cancellation_propagates_to_handler():
+    async with local_runtime() as rt:
+        started = asyncio.Event()
+        stopped_seen = asyncio.Event()
+
+        async def slow_handler(request, context: Context):
+            started.set()
+            for i in range(10_000):
+                if context.is_stopped():
+                    stopped_seen.set()
+                    return
+                yield {"i": i}
+                await asyncio.sleep(0.01)
+
+        ep = rt.namespace("ns").component("comp").endpoint("slow")
+        await ep.serve_endpoint(slow_handler)
+        client = await ep.client().start()
+        await client.wait_for_instances()
+
+        ctx = Context()
+        got = []
+        async for item in client.round_robin({}, context=ctx):
+            got.append(item)
+            if len(got) == 3:
+                ctx.stop_generating()
+        # handler observed the stop within a few iterations
+        await asyncio.wait_for(stopped_seen.wait(), 5)
+        assert len(got) < 100
+
+
+async def test_worker_death_removes_instance():
+    async with local_cluster(n=2) as (srv, rts):
+        async def handler(request, context):
+            yield {"ok": True}
+
+        for rt in rts:
+            ep = rt.namespace("ns").component("w").endpoint("gen")
+            await ep.serve_endpoint(handler)
+
+        watcher_rt = rts[1]
+        client = watcher_rt.namespace("ns").component("w").endpoint("gen").client()
+        await client.start()
+        await client.wait_for_instances()
+        while len(client.instances()) < 2:
+            await asyncio.sleep(0.05)
+
+        # Kill worker 0 abruptly (no deregistration): lease TTL reaps it.
+        dead = rts.pop(0)
+        await dead.shutdown(graceful=False)
+        # detached shutdown revokes the lease -> removal is fast
+        for _ in range(100):
+            if len(client.instances()) == 1:
+                break
+            await asyncio.sleep(0.05)
+        assert len(client.instances()) == 1
+
+
+async def test_unknown_endpoint_is_service_unavailable():
+    async with local_runtime() as rt:
+        ep = rt.namespace("ns").component("c").endpoint("real")
+        await ep.serve_endpoint(echo_handler)
+        client = await ep.client().start()
+        inst = (await client.wait_for_instances())[0]
+        with pytest.raises(ServiceUnavailable):
+            async for _ in rt.service_client.call_stream(inst.address, "ns.c.fake", {}):
+                pass
+
+
+async def test_handler_error_surfaces():
+    from dynamo_tpu.runtime import RemoteStreamError
+
+    async with local_runtime() as rt:
+        async def bad_handler(request, context):
+            yield {"ok": 1}
+            raise ValueError("boom")
+
+        ep = rt.namespace("ns").component("c").endpoint("bad")
+        await ep.serve_endpoint(bad_handler)
+        client = await ep.client().start()
+        await client.wait_for_instances()
+        got = []
+        with pytest.raises(RemoteStreamError, match="boom"):
+            async for item in client.round_robin({}):
+                got.append(item)
+        assert got == [{"ok": 1}]
+
+
+async def test_abandoned_stream_kills_worker_generation():
+    """Breaking out of a client stream must stop the worker handler
+    (disconnect -> kill semantics)."""
+    async with local_runtime() as rt:
+        cancelled = asyncio.Event()
+
+        async def endless(request, context: Context):
+            try:
+                i = 0
+                while True:
+                    if context.is_killed() or context.is_stopped():
+                        cancelled.set()
+                        return
+                    yield {"i": i}
+                    i += 1
+                    await asyncio.sleep(0.01)
+            finally:
+                cancelled.set()
+
+        ep = rt.namespace("ns").component("c").endpoint("endless")
+        await ep.serve_endpoint(endless)
+        client = await ep.client().start()
+        await client.wait_for_instances()
+        async for item in client.round_robin({}):
+            if item["i"] == 2:
+                break  # abandon without cancelling
+        await asyncio.wait_for(cancelled.wait(), 5)
+
+
+async def test_lazy_client_generate_without_start():
+    async with local_runtime() as rt:
+        ep = rt.namespace("ns").component("c").endpoint("gen")
+        await ep.serve_endpoint(echo_handler)
+        client = ep.client()  # no start(), no wait_for_instances()
+        items = [x async for x in client.generate({"n": 2, "msg": "m"})]
+        assert len(items) == 2
