@@ -1,0 +1,24 @@
+"""Model families for the JAX engine (llama dense + mixtral-style MoE)."""
+
+from .config import CONFIGS, ModelConfig, tiny_config, tiny_moe_config
+from .llama import (
+    KVCache,
+    forward_decode,
+    forward_prefill,
+    init_params,
+    kv_cache_pspec,
+    param_pspecs,
+)
+
+__all__ = [
+    "CONFIGS",
+    "KVCache",
+    "ModelConfig",
+    "forward_decode",
+    "forward_prefill",
+    "init_params",
+    "kv_cache_pspec",
+    "param_pspecs",
+    "tiny_config",
+    "tiny_moe_config",
+]

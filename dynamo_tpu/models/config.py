@@ -1,0 +1,218 @@
+"""Model configuration for the JAX engine's native model families.
+
+The reference framework delegates the model to external engines (vLLM /
+SGLang / TRT-LLM); the TPU build runs its own models, so the config lives
+here.  Shapes follow the HF `LlamaConfig` field names so checkpoints load
+without a translation table (reference consumes the same HF config when
+building its ModelDeploymentCard, /root/reference/lib/llm/src/model_card.rs:118).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """Architecture hyperparameters for a decoder-only transformer.
+
+    Covers the Llama family (Llama 2/3, TinyLlama, Mistral-style GQA) and
+    Mixtral/DeepSeek-style MoE variants via ``num_experts``.
+    """
+
+    vocab_size: int = 32000
+    hidden_size: int = 2048
+    intermediate_size: int = 5632
+    num_hidden_layers: int = 22
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 4
+    head_dim: Optional[int] = None
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    rope_scaling: Optional[dict] = None
+    tie_word_embeddings: bool = False
+    attention_bias: bool = False
+    # MoE (0 = dense)
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    moe_intermediate_size: Optional[int] = None
+    # identity
+    model_type: str = "llama"
+    name: str = "llama"
+    dtype: str = "bfloat16"
+
+    @property
+    def head_dim_(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_attention_heads
+
+    @property
+    def num_kv_groups(self) -> int:
+        return self.num_attention_heads // self.num_key_value_heads
+
+    @property
+    def is_moe(self) -> bool:
+        return self.num_experts > 0
+
+    def num_params(self) -> int:
+        """Approximate parameter count (for memory planning)."""
+        h, v, l = self.hidden_size, self.vocab_size, self.num_hidden_layers
+        hd = self.head_dim_
+        attn = h * (self.num_attention_heads * hd) + 2 * h * (
+            self.num_key_value_heads * hd
+        ) + (self.num_attention_heads * hd) * h
+        if self.is_moe:
+            ffn_inter = self.moe_intermediate_size or self.intermediate_size
+            mlp = self.num_experts * 3 * h * ffn_inter + h * self.num_experts
+        else:
+            mlp = 3 * h * self.intermediate_size
+        emb = v * h * (1 if self.tie_word_embeddings else 2)
+        return l * (attn + mlp + 2 * h) + emb + h
+
+    @staticmethod
+    def from_hf_config(d: dict, name: str = "") -> "ModelConfig":
+        """Build from a HF ``config.json`` dict (llama/mistral/mixtral/qwen2)."""
+        num_experts = d.get("num_local_experts", d.get("n_routed_experts", 0)) or 0
+        return ModelConfig(
+            vocab_size=d["vocab_size"],
+            hidden_size=d["hidden_size"],
+            intermediate_size=d.get("intermediate_size", 4 * d["hidden_size"]),
+            num_hidden_layers=d["num_hidden_layers"],
+            num_attention_heads=d["num_attention_heads"],
+            num_key_value_heads=d.get(
+                "num_key_value_heads", d["num_attention_heads"]
+            ),
+            head_dim=d.get("head_dim"),
+            max_position_embeddings=d.get("max_position_embeddings", 4096),
+            rms_norm_eps=d.get("rms_norm_eps", 1e-5),
+            rope_theta=d.get("rope_theta", 10000.0),
+            rope_scaling=d.get("rope_scaling"),
+            tie_word_embeddings=d.get("tie_word_embeddings", False),
+            attention_bias=d.get("attention_bias", False),
+            num_experts=num_experts,
+            num_experts_per_tok=d.get("num_experts_per_tok", 2),
+            moe_intermediate_size=d.get("moe_intermediate_size"),
+            model_type=d.get("model_type", "llama"),
+            name=name or d.get("_name_or_path", "llama"),
+        )
+
+    @staticmethod
+    def from_pretrained(path: str) -> "ModelConfig":
+        with open(os.path.join(path, "config.json")) as f:
+            return ModelConfig.from_hf_config(json.load(f), name=os.path.basename(path))
+
+
+# -- canned configs ---------------------------------------------------------- #
+
+def tiny_config(**over) -> ModelConfig:
+    """Tiny model for tests (runs on the CPU mesh in milliseconds)."""
+    base = dict(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=512,
+        name="tiny-llama-test",
+    )
+    base.update(over)
+    return ModelConfig(**base)
+
+
+def tiny_moe_config(**over) -> ModelConfig:
+    base = dict(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=512,
+        num_experts=4,
+        num_experts_per_tok=2,
+        name="tiny-moe-test",
+    )
+    base.update(over)
+    return ModelConfig(**base)
+
+
+LLAMA_3_2_1B = ModelConfig(
+    vocab_size=128256,
+    hidden_size=2048,
+    intermediate_size=8192,
+    num_hidden_layers=16,
+    num_attention_heads=32,
+    num_key_value_heads=8,
+    head_dim=64,
+    max_position_embeddings=131072,
+    rms_norm_eps=1e-5,
+    rope_theta=500000.0,
+    rope_scaling={
+        "factor": 32.0,
+        "high_freq_factor": 4.0,
+        "low_freq_factor": 1.0,
+        "original_max_position_embeddings": 8192,
+        "rope_type": "llama3",
+    },
+    tie_word_embeddings=True,
+    name="llama-3.2-1b",
+)
+
+LLAMA_3_1_8B = ModelConfig(
+    vocab_size=128256,
+    hidden_size=4096,
+    intermediate_size=14336,
+    num_hidden_layers=32,
+    num_attention_heads=32,
+    num_key_value_heads=8,
+    max_position_embeddings=131072,
+    rms_norm_eps=1e-5,
+    rope_theta=500000.0,
+    rope_scaling={
+        "factor": 8.0,
+        "high_freq_factor": 4.0,
+        "low_freq_factor": 1.0,
+        "original_max_position_embeddings": 8192,
+        "rope_type": "llama3",
+    },
+    name="llama-3.1-8b",
+)
+
+LLAMA_3_70B = ModelConfig(
+    vocab_size=128256,
+    hidden_size=8192,
+    intermediate_size=28672,
+    num_hidden_layers=80,
+    num_attention_heads=64,
+    num_key_value_heads=8,
+    max_position_embeddings=131072,
+    rms_norm_eps=1e-5,
+    rope_theta=500000.0,
+    name="llama-3-70b",
+)
+
+MIXTRAL_8X7B = ModelConfig(
+    vocab_size=32000,
+    hidden_size=4096,
+    intermediate_size=14336,
+    num_hidden_layers=32,
+    num_attention_heads=32,
+    num_key_value_heads=8,
+    max_position_embeddings=32768,
+    rms_norm_eps=1e-5,
+    rope_theta=1000000.0,
+    num_experts=8,
+    num_experts_per_tok=2,
+    model_type="mixtral",
+    name="mixtral-8x7b",
+)
+
+CONFIGS = {
+    c.name: c
+    for c in [LLAMA_3_2_1B, LLAMA_3_1_8B, LLAMA_3_70B, MIXTRAL_8X7B]
+}

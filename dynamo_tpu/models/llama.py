@@ -1,0 +1,338 @@
+"""Llama-family decoder in functional JAX with paged KV cache.
+
+Architecture (not a torch translation):
+
+- Params are a pytree of arrays with **per-layer weights stacked on axis 0**
+  so the layer loop is a single ``lax.scan`` — one compiled layer body
+  regardless of depth (80-layer 70B compiles as fast as a 2-layer test
+  model).
+- KV cache is the page pool from ``ops.paged_attention``, stacked per layer:
+  ``k_pages/v_pages: [L, P, page, n_kv, hd]`` — scanned alongside the
+  params, so cache updates ride the same scan.
+- All matmuls are bf16 with fp32 accumulation (``preferred_element_type``),
+  sized for the MXU; no data-dependent control flow anywhere.
+- MoE (Mixtral-style) uses one-hot dispatch einsums — expert-parallel
+  sharding is applied externally via the specs in `param_pspecs`.
+
+The reference delegates models to vLLM/TRT-LLM; this is the TPU-native
+engine-side model (SURVEY.md §7 M1).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..ops import (
+    apply_rope,
+    decode_attention,
+    prefill_attention,
+    rms_norm,
+    rope_frequencies,
+    write_kv_pages,
+)
+from .config import ModelConfig
+
+Params = dict
+
+
+class KVCache(NamedTuple):
+    """Paged KV pool for all layers: [L, P, page, n_kv, hd]."""
+
+    k: jax.Array
+    v: jax.Array
+
+    @property
+    def num_pages(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def page_size(self) -> int:
+        return self.k.shape[2]
+
+    @staticmethod
+    def create(
+        cfg: ModelConfig, num_pages: int, page_size: int, dtype=jnp.bfloat16
+    ) -> "KVCache":
+        shape = (
+            cfg.num_hidden_layers,
+            num_pages,
+            page_size,
+            cfg.num_key_value_heads,
+            cfg.head_dim_,
+        )
+        return KVCache(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+
+# --------------------------------------------------------------------------- #
+# init / sharding
+# --------------------------------------------------------------------------- #
+
+
+def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
+    """Random init (tests / benchmarks). Real weights come from the loader."""
+    h, hd = cfg.hidden_size, cfg.head_dim_
+    nh, nkv, L = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.num_hidden_layers
+    f = cfg.intermediate_size
+    ks = iter(jax.random.split(key, 20))
+
+    def w(k, *shape, scale=None):
+        scale = scale or (1.0 / jnp.sqrt(shape[-2] if len(shape) > 1 else h))
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
+
+    layers = {
+        "wq": w(next(ks), L, h, nh * hd),
+        "wk": w(next(ks), L, h, nkv * hd),
+        "wv": w(next(ks), L, h, nkv * hd),
+        "wo": w(next(ks), L, nh * hd, h),
+        "attn_norm": jnp.ones((L, h), dtype),
+        "mlp_norm": jnp.ones((L, h), dtype),
+    }
+    if cfg.is_moe:
+        fm = cfg.moe_intermediate_size or f
+        E = cfg.num_experts
+        layers.update(
+            {
+                "router": w(next(ks), L, h, E),
+                "w_gate": w(next(ks), L, E, h, fm),
+                "w_up": w(next(ks), L, E, h, fm),
+                "w_down": w(next(ks), L, E, fm, h),
+            }
+        )
+    else:
+        layers.update(
+            {
+                "w_gate": w(next(ks), L, h, f),
+                "w_up": w(next(ks), L, h, f),
+                "w_down": w(next(ks), L, f, h),
+            }
+        )
+    params = {
+        "embed": w(next(ks), cfg.vocab_size, h, scale=0.02),
+        "final_norm": jnp.ones((h,), dtype),
+        "layers": layers,
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = w(next(ks), h, cfg.vocab_size)
+    return params
+
+
+def param_pspecs(cfg: ModelConfig, tp_axis: str = "tp", ep_axis: str = "tp") -> Params:
+    """PartitionSpec tree matching `init_params` (megatron-style TP).
+
+    Head-dim projections shard on heads; MLP shards gate/up on the ffn dim
+    and down on its input; embeddings shard on vocab.  Layer-stacked arrays
+    keep axis 0 (layers) replicated.
+    """
+    layers = {
+        "wq": P(None, None, tp_axis),
+        "wk": P(None, None, tp_axis),
+        "wv": P(None, None, tp_axis),
+        "wo": P(None, tp_axis, None),
+        "attn_norm": P(None, None),
+        "mlp_norm": P(None, None),
+    }
+    if cfg.is_moe:
+        layers.update(
+            {
+                "router": P(None, None, None),
+                "w_gate": P(None, ep_axis, None, None),
+                "w_up": P(None, ep_axis, None, None),
+                "w_down": P(None, ep_axis, None, None),
+            }
+        )
+    else:
+        layers.update(
+            {
+                "w_gate": P(None, None, tp_axis),
+                "w_up": P(None, None, tp_axis),
+                "w_down": P(None, tp_axis, None),
+            }
+        )
+    specs = {
+        "embed": P(tp_axis, None),
+        "final_norm": P(None),
+        "layers": layers,
+    }
+    if not cfg.tie_word_embeddings:
+        specs["lm_head"] = P(None, tp_axis)
+    return specs
+
+
+def kv_cache_pspec(tp_axis: str = "tp") -> KVCache:
+    """KV pages shard on kv-heads (axis 3) under TP."""
+    spec = P(None, None, None, tp_axis, None)
+    return KVCache(spec, spec)
+
+
+# --------------------------------------------------------------------------- #
+# forward
+# --------------------------------------------------------------------------- #
+
+
+def _mlp(lp: Params, x: jax.Array) -> jax.Array:
+    gate = jnp.einsum("bsh,hf->bsf", x, lp["w_gate"], preferred_element_type=jnp.float32)
+    up = jnp.einsum("bsh,hf->bsf", x, lp["w_up"], preferred_element_type=jnp.float32)
+    act = jax.nn.silu(gate) * up
+    return jnp.einsum(
+        "bsf,fh->bsh", act.astype(x.dtype), lp["w_down"],
+        preferred_element_type=jnp.float32,
+    ).astype(x.dtype)
+
+
+def _moe(lp: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
+    """Top-k expert MLP via one-hot dispatch (EP sharding applied by caller)."""
+    B, S, h = x.shape
+    E, k = cfg.num_experts, cfg.num_experts_per_tok
+    router_logits = jnp.einsum(
+        "bsh,he->bse", x, lp["router"], preferred_element_type=jnp.float32
+    )
+    weights, selected = jax.lax.top_k(router_logits, k)  # [B,S,k]
+    weights = jax.nn.softmax(weights, axis=-1)
+    onehot = jax.nn.one_hot(selected, E, dtype=x.dtype)  # [B,S,k,E]
+    combine = jnp.einsum("bsk,bske->bse", weights.astype(x.dtype), onehot)  # [B,S,E]
+    # dispatch every token to its experts: xe [E,B,S,h] masked
+    gate = jnp.einsum("bsh,ehf->ebsf", x, lp["w_gate"], preferred_element_type=jnp.float32)
+    up = jnp.einsum("bsh,ehf->ebsf", x, lp["w_up"], preferred_element_type=jnp.float32)
+    act = (jax.nn.silu(gate) * up).astype(x.dtype)
+    out = jnp.einsum("ebsf,efh->ebsh", act, lp["w_down"], preferred_element_type=jnp.float32)
+    return jnp.einsum("ebsh,bse->bsh", out.astype(x.dtype), combine)
+
+
+def _layer_prefill(
+    lp: Params,
+    kv_layer: Tuple[jax.Array, jax.Array],
+    x: jax.Array,  # [B, S, h]
+    positions: jax.Array,  # [B, S]
+    page_table: jax.Array,
+    prefix_lens: jax.Array,
+    chunk_lens: jax.Array,
+    cfg: ModelConfig,
+    inv_freq: jax.Array,
+):
+    B, S, h = x.shape
+    nh, nkv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim_
+    k_pages, v_pages = kv_layer
+
+    attn_in = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+    q = jnp.einsum("bsh,hd->bsd", attn_in, lp["wq"]).reshape(B, S, nh, hd)
+    k = jnp.einsum("bsh,hd->bsd", attn_in, lp["wk"]).reshape(B, S, nkv, hd)
+    v = jnp.einsum("bsh,hd->bsd", attn_in, lp["wv"]).reshape(B, S, nkv, hd)
+    q = apply_rope(q, positions, inv_freq)
+    k = apply_rope(k, positions, inv_freq)
+
+    attn = prefill_attention(
+        q, k, v, k_pages, v_pages, page_table, prefix_lens, chunk_lens
+    )
+    k_pages, v_pages = write_kv_pages(
+        k_pages, v_pages, k, v, page_table, prefix_lens, chunk_lens
+    )
+    attn_out = jnp.einsum(
+        "bsd,dh->bsh", attn.reshape(B, S, nh * hd), lp["wo"]
+    ).astype(x.dtype)
+    x = x + attn_out
+
+    mlp_in = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+    mlp_out = _moe(lp, mlp_in, cfg) if cfg.is_moe else _mlp(lp, mlp_in)
+    return x + mlp_out, (k_pages, v_pages)
+
+
+def _layer_decode(
+    lp: Params,
+    kv_layer: Tuple[jax.Array, jax.Array],
+    x: jax.Array,  # [B, h] — one token per seq
+    positions: jax.Array,  # [B]
+    page_table: jax.Array,
+    seq_lens: jax.Array,  # [B] incl. new token
+    cfg: ModelConfig,
+    inv_freq: jax.Array,
+):
+    B, h = x.shape
+    nh, nkv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim_
+    k_pages, v_pages = kv_layer
+
+    attn_in = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+    q = (attn_in @ lp["wq"]).reshape(B, 1, nh, hd)
+    k = (attn_in @ lp["wk"]).reshape(B, 1, nkv, hd)
+    v = (attn_in @ lp["wv"]).reshape(B, 1, nkv, hd)
+    q = apply_rope(q, positions[:, None], inv_freq)[:, 0]
+    k = apply_rope(k, positions[:, None], inv_freq)
+
+    # write first, then attend over the full table (new token included)
+    k_pages, v_pages = write_kv_pages(
+        k_pages, v_pages, k, v, page_table, positions, jnp.ones_like(positions)
+    )
+    attn = decode_attention(q, k_pages, v_pages, page_table, seq_lens)
+    attn_out = (attn.reshape(B, nh * hd) @ lp["wo"]).astype(x.dtype)
+    x = x + attn_out
+
+    mlp_in = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+    if cfg.is_moe:
+        mlp_out = _moe(lp, mlp_in[:, None], cfg)[:, 0]
+    else:
+        mlp_out = _mlp(lp, mlp_in[:, None])[:, 0]
+    return x + mlp_out, (k_pages, v_pages)
+
+
+def _lm_logits(params: Params, cfg: ModelConfig, x: jax.Array) -> jax.Array:
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
+    return jnp.einsum("...h,hv->...v", x, head, preferred_element_type=jnp.float32)
+
+
+def forward_prefill(
+    params: Params,
+    cfg: ModelConfig,
+    kv: KVCache,
+    tokens: jax.Array,  # [B, S]
+    page_table: jax.Array,  # [B, max_pages]
+    prefix_lens: jax.Array,  # [B]
+    chunk_lens: jax.Array,  # [B]
+) -> Tuple[jax.Array, KVCache]:
+    """Run a prefill chunk; returns logits at the last valid position [B, V]."""
+    B, S = tokens.shape
+    inv_freq = rope_frequencies(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
+    positions = prefix_lens[:, None] + jnp.arange(S)[None, :]
+    x = params["embed"][tokens]  # [B, S, h]
+
+    def body(carry, xs):
+        h = carry
+        lp, k_pages, v_pages = xs
+        h, (k_pages, v_pages) = _layer_prefill(
+            lp, (k_pages, v_pages), h, positions, page_table,
+            prefix_lens, chunk_lens, cfg, inv_freq,
+        )
+        return h, (k_pages, v_pages)
+
+    x, (k_new, v_new) = jax.lax.scan(body, x, (params["layers"], kv.k, kv.v))
+    last = jnp.maximum(chunk_lens - 1, 0)
+    x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]  # [B, h]
+    return _lm_logits(params, cfg, x_last), KVCache(k_new, v_new)
+
+
+def forward_decode(
+    params: Params,
+    cfg: ModelConfig,
+    kv: KVCache,
+    tokens: jax.Array,  # [B]
+    positions: jax.Array,  # [B] — position of this token
+    page_table: jax.Array,  # [B, max_pages]
+) -> Tuple[jax.Array, KVCache]:
+    """One decode step for the whole batch; returns logits [B, V]."""
+    inv_freq = rope_frequencies(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
+    seq_lens = positions + 1
+    x = params["embed"][tokens]  # [B, h]
+
+    def body(carry, xs):
+        h = carry
+        lp, k_pages, v_pages = xs
+        h, (k_pages, v_pages) = _layer_decode(
+            lp, (k_pages, v_pages), h, positions, page_table, seq_lens, cfg, inv_freq
+        )
+        return h, (k_pages, v_pages)
+
+    x, (k_new, v_new) = jax.lax.scan(body, x, (params["layers"], kv.k, kv.v))
+    return _lm_logits(params, cfg, x), KVCache(k_new, v_new)

@@ -1,0 +1,29 @@
+"""TPU compute ops: attention over paged KV, RoPE, norms, sampling.
+
+Reference impls are pure jnp (XLA fuses them well); Pallas kernels live in
+``dynamo_tpu.ops.pallas`` and are selected at engine build time when running
+on real TPU hardware.
+"""
+
+from .norm import rms_norm
+from .paged_attention import (
+    decode_attention,
+    gather_kv,
+    prefill_attention,
+    write_kv_pages,
+)
+from .rotary import apply_rope, rope_frequencies
+from .sampling import SamplingParams, compute_logprobs, sample_tokens
+
+__all__ = [
+    "SamplingParams",
+    "apply_rope",
+    "compute_logprobs",
+    "decode_attention",
+    "gather_kv",
+    "prefill_attention",
+    "rms_norm",
+    "rope_frequencies",
+    "sample_tokens",
+    "write_kv_pages",
+]

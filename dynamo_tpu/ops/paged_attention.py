@@ -1,0 +1,160 @@
+"""Paged attention for continuous batching on TPU.
+
+Design (TPU-first, not a CUDA translation):
+
+- The KV cache is a pool of fixed-size *pages* per layer:
+  ``[num_pages, page_size, n_kv_heads, head_dim]``.  A sequence owns an
+  ordered list of page ids (its *page table* row).  Page id 0 is reserved as
+  the trash page: padding tokens scatter there, so every shape stays static
+  and no masking is needed on the write path.
+
+- Everything here is shape-static and jit-friendly: the engine buckets
+  ``pages_per_seq`` and chunk lengths to a handful of power-of-two sizes so
+  XLA compiles a few variants and reuses them (no dynamic shapes inside jit).
+
+- ``prefill_attention`` computes the general form "new chunk attends to
+  cached prefix pages + itself (causal)".  With ``prefix_len == 0`` it is
+  plain causal prefill; with a populated page table it covers chunked
+  prefill and prefix-cache hits.  ``decode_attention`` is the single-token
+  step over the page table.
+
+The reference framework never implements attention (it delegates to
+vLLM/TRT-LLM, see SURVEY.md §2.6); this module is the TPU-native equivalent
+of those engines' paged attention + vLLM's slot-mapping KV writes.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def write_kv_pages(
+    k_pages: jax.Array,  # [P, page, n_kv, hd]
+    v_pages: jax.Array,
+    k_new: jax.Array,  # [B, S, n_kv, hd]
+    v_new: jax.Array,
+    page_table: jax.Array,  # [B, max_pages] int32
+    write_pos: jax.Array,  # [B] int32 — seq offset where this chunk starts
+    chunk_lens: jax.Array,  # [B] int32 — valid tokens in this chunk
+) -> Tuple[jax.Array, jax.Array]:
+    """Scatter a new KV chunk into the page pool. Padding → trash page 0."""
+    P, page_size, n_kv, hd = k_pages.shape
+    B, S = k_new.shape[:2]
+    pos = write_pos[:, None] + jnp.arange(S)[None, :]  # [B, S]
+    valid = jnp.arange(S)[None, :] < chunk_lens[:, None]
+    page_idx = pos // page_size
+    page_off = pos % page_size
+    # page table lookup per token; invalid tokens → trash page 0
+    page_idx = jnp.clip(page_idx, 0, page_table.shape[1] - 1)
+    page_ids = jnp.take_along_axis(page_table, page_idx, axis=1)  # [B, S]
+    slot = jnp.where(valid, page_ids * page_size + page_off, 0)  # [B, S]
+    slot = slot.reshape(-1)
+    k_flat = k_pages.reshape(P * page_size, n_kv, hd)
+    v_flat = v_pages.reshape(P * page_size, n_kv, hd)
+    k_flat = k_flat.at[slot].set(
+        k_new.reshape(B * S, n_kv, hd), mode="drop", unique_indices=False
+    )
+    v_flat = v_flat.at[slot].set(
+        v_new.reshape(B * S, n_kv, hd), mode="drop", unique_indices=False
+    )
+    return (
+        k_flat.reshape(P, page_size, n_kv, hd),
+        v_flat.reshape(P, page_size, n_kv, hd),
+    )
+
+
+def gather_kv(
+    k_pages: jax.Array,  # [P, page, n_kv, hd]
+    v_pages: jax.Array,
+    page_table: jax.Array,  # [B, max_pages]
+) -> Tuple[jax.Array, jax.Array]:
+    """Materialize each sequence's KV: [B, max_pages*page, n_kv, hd]."""
+    k = k_pages[page_table]  # [B, max_pages, page, n_kv, hd]
+    v = v_pages[page_table]
+    B, mp, page, n_kv, hd = k.shape
+    return k.reshape(B, mp * page, n_kv, hd), v.reshape(B, mp * page, n_kv, hd)
+
+
+def _mqa_scores(q: jax.Array, k: jax.Array) -> jax.Array:
+    """q [B, Sq, n_heads, hd] x k [B, Sk, n_kv, hd] -> [B, n_heads, Sq, Sk]
+    with GQA head grouping."""
+    B, Sq, n_heads, hd = q.shape
+    n_kv = k.shape[2]
+    groups = n_heads // n_kv
+    qg = q.reshape(B, Sq, n_kv, groups, hd)
+    scores = jnp.einsum(
+        "bqkgd,bskd->bkgqs", qg, k, preferred_element_type=jnp.float32
+    )
+    return scores.reshape(B, n_kv * groups, Sq, k.shape[1])
+
+
+def _mqa_out(weights: jax.Array, v: jax.Array, dtype) -> jax.Array:
+    """weights [B, n_heads, Sq, Sk] x v [B, Sk, n_kv, hd] -> [B, Sq, n_heads, hd]."""
+    B, n_heads, Sq, Sk = weights.shape
+    n_kv = v.shape[2]
+    groups = n_heads // n_kv
+    wg = weights.reshape(B, n_kv, groups, Sq, Sk)
+    out = jnp.einsum("bkgqs,bskd->bqkgd", wg, v.astype(jnp.float32))
+    return out.reshape(B, Sq, n_heads, v.shape[3]).astype(dtype)
+
+
+def prefill_attention(
+    q: jax.Array,  # [B, S, n_heads, hd] — the new chunk
+    k_new: jax.Array,  # [B, S, n_kv, hd]
+    v_new: jax.Array,
+    k_pages: jax.Array,  # [P, page, n_kv, hd] — pool (already containing prefix)
+    v_pages: jax.Array,
+    page_table: jax.Array,  # [B, max_pages]
+    prefix_lens: jax.Array,  # [B] — tokens already in cache before this chunk
+    chunk_lens: jax.Array,  # [B] — valid tokens in this chunk
+) -> jax.Array:
+    """Chunk attends to cached prefix + itself (causal). Returns [B,S,H,hd]."""
+    B, S, n_heads, hd = q.shape
+    scale = 1.0 / jnp.sqrt(hd).astype(jnp.float32)
+
+    k_pre, v_pre = gather_kv(k_pages, v_pages, page_table)  # [B, Lp, n_kv, hd]
+    Lp = k_pre.shape[1]
+
+    # scores over prefix
+    s_pre = _mqa_scores(q, k_pre) * scale  # [B, H, S, Lp]
+    pre_valid = jnp.arange(Lp)[None, None, None, :] < prefix_lens[:, None, None, None]
+    s_pre = jnp.where(pre_valid, s_pre, NEG_INF)
+
+    # scores over the chunk itself (causal within chunk)
+    s_new = _mqa_scores(q, k_new) * scale  # [B, H, S, S]
+    i = jnp.arange(S)[None, None, :, None]
+    j = jnp.arange(S)[None, None, None, :]
+    causal = j <= i
+    new_valid = j < chunk_lens[:, None, None, None]
+    s_new = jnp.where(causal & new_valid, s_new, NEG_INF)
+
+    scores = jnp.concatenate([s_pre, s_new], axis=-1)  # [B, H, S, Lp+S]
+    weights = jax.nn.softmax(scores, axis=-1)
+    w_pre, w_new = weights[..., :Lp], weights[..., Lp:]
+    out = _mqa_out(w_pre, v_pre, q.dtype) + _mqa_out(w_new, v_new, q.dtype)
+    return out
+
+
+def decode_attention(
+    q: jax.Array,  # [B, n_heads, hd] — one new token per sequence
+    k_pages: jax.Array,  # [P, page, n_kv, hd] (new token already written)
+    v_pages: jax.Array,
+    page_table: jax.Array,  # [B, max_pages]
+    seq_lens: jax.Array,  # [B] — context length incl. the new token
+) -> jax.Array:
+    """Single-token attention over the page table. Returns [B, n_heads, hd]."""
+    B, n_heads, hd = q.shape
+    scale = 1.0 / jnp.sqrt(hd).astype(jnp.float32)
+    k, v = gather_kv(k_pages, v_pages, page_table)  # [B, L, n_kv, hd]
+    L = k.shape[1]
+    scores = _mqa_scores(q[:, None], k)[:, :, 0, :] * scale  # [B, H, L]
+    valid = jnp.arange(L)[None, None, :] < seq_lens[:, None, None]
+    scores = jnp.where(valid, scores, NEG_INF)
+    weights = jax.nn.softmax(scores, axis=-1)
+    out = _mqa_out(weights[:, :, None, :], v, q.dtype)  # [B, 1, H, hd]
+    return out[:, 0]

@@ -1,0 +1,53 @@
+"""Rotary position embeddings (RoPE), including Llama-3 frequency scaling.
+
+Functional, shape-polymorphic over leading dims; applied in float32 then cast
+back (precision matters for long context).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def rope_frequencies(
+    head_dim: int,
+    theta: float = 10000.0,
+    scaling: Optional[dict] = None,
+) -> jax.Array:
+    """Inverse frequencies [head_dim//2], with optional llama3-style scaling."""
+    inv_freq = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    if scaling and scaling.get("rope_type", scaling.get("type")) == "llama3":
+        factor = scaling["factor"]
+        low = scaling["low_freq_factor"]
+        high = scaling["high_freq_factor"]
+        orig = scaling["original_max_position_embeddings"]
+        wavelen = 2 * math.pi / inv_freq
+        # three bands: long wavelengths scaled by 1/factor, short kept,
+        # middle smoothly interpolated.
+        smooth = (orig / wavelen - low) / (high - low)
+        smooth = jnp.clip(smooth, 0.0, 1.0)
+        scaled = inv_freq / factor
+        inv_freq = (1 - smooth) * scaled + smooth * inv_freq
+    return inv_freq
+
+
+def apply_rope(
+    x: jax.Array,  # [..., seq, heads, head_dim]
+    positions: jax.Array,  # [..., seq]
+    inv_freq: jax.Array,  # [head_dim//2]
+) -> jax.Array:
+    """Rotate pairs (x[..., :d/2], x[..., d/2:]) — HF llama convention."""
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [..., seq, d/2]
+    cos = jnp.cos(angles)[..., None, :]  # [..., seq, 1, d/2]
+    sin = jnp.sin(angles)[..., None, :]
+    d2 = x.shape[-1] // 2
+    x1, x2 = x[..., :d2].astype(jnp.float32), x[..., d2:].astype(jnp.float32)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)

@@ -1,0 +1,74 @@
+"""Token sampling: greedy / temperature / top-k / top-p, fully vectorized.
+
+Per-sequence sampling parameters are carried as arrays so one jitted step
+serves a heterogeneous batch (mirrors the reference's per-request
+sampling-option mapping, /root/reference/lib/llm/src/preprocessor.rs sampling
+options → engine; here the engine is ours so the math lives here).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class SamplingParams(NamedTuple):
+    """Per-sequence sampling state, shape [B] each."""
+
+    temperature: jax.Array  # 0.0 → greedy
+    top_k: jax.Array  # 0 → disabled
+    top_p: jax.Array  # 1.0 → disabled
+
+    @staticmethod
+    def make(temperature, top_k, top_p):
+        return SamplingParams(
+            jnp.asarray(temperature, jnp.float32),
+            jnp.asarray(top_k, jnp.int32),
+            jnp.asarray(top_p, jnp.float32),
+        )
+
+
+def sample_tokens(
+    logits: jax.Array,  # [B, V] float
+    params: SamplingParams,
+    key: jax.Array,
+) -> jax.Array:
+    """Sample one token per row. Greedy rows (temperature==0) take argmax."""
+    B, V = logits.shape
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1)
+
+    temp = jnp.maximum(params.temperature, 1e-6)[:, None]
+    scaled = logits / temp
+
+    # top-k: mask everything below the k-th largest.
+    sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]  # desc
+    k = jnp.clip(params.top_k, 0, V)
+    kth_idx = jnp.where(k > 0, k - 1, V - 1)
+    kth_val = jnp.take_along_axis(sorted_logits, kth_idx[:, None], axis=1)
+    topk_mask = jnp.where(
+        (params.top_k > 0)[:, None], scaled < kth_val, False
+    )
+
+    # top-p: smallest prefix of the sorted distribution with mass >= p.
+    sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(sorted_probs, axis=-1)
+    # keep positions whose *previous* cumulative mass is < p
+    keep_sorted = (cum - sorted_probs) < params.top_p[:, None]
+    # threshold value = smallest kept logit per row
+    thresh = jnp.min(
+        jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
+    )
+    topp_mask = scaled < thresh
+
+    masked = jnp.where(topk_mask | topp_mask, -jnp.inf, scaled)
+    sampled = jax.random.categorical(key, masked, axis=-1)
+    return jnp.where(params.temperature <= 0.0, greedy, sampled)
+
+
+def compute_logprobs(logits: jax.Array, tokens: jax.Array) -> jax.Array:
+    """Log-probability of `tokens` [B] under `logits` [B, V]."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.take_along_axis(logp, tokens[:, None], axis=1)[:, 0]
