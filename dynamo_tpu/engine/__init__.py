@@ -1,0 +1,20 @@
+"""The JAX LLM engine: paged KV pool, continuous-batching scheduler, jitted
+prefill/decode steps, streaming AsyncEngine facade."""
+
+from .config import EngineConfig, bucket_for
+from .engine import ForwardPassMetrics, JaxEngine
+from .page_pool import KvEvent, NoPagesError, PagePool
+from .scheduler import SamplingOptions, Scheduler, Sequence
+
+__all__ = [
+    "EngineConfig",
+    "ForwardPassMetrics",
+    "JaxEngine",
+    "KvEvent",
+    "NoPagesError",
+    "PagePool",
+    "SamplingOptions",
+    "Scheduler",
+    "Sequence",
+    "bucket_for",
+]

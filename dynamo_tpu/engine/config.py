@@ -1,0 +1,68 @@
+"""Engine runtime configuration (the analog of vLLM's EngineArgs as consumed
+by the reference's workers, /root/reference/components/src/dynamo/vllm/args.py)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+
+@dataclass
+class EngineConfig:
+    # KV cache geometry
+    page_size: int = 16  # tokens per page (= kv block size in the MDC)
+    num_pages: int = 512  # pages in the device pool (incl. trash page 0)
+    max_pages_per_seq: int = 64  # cap on context pages per sequence
+
+    # batching
+    max_num_seqs: int = 8  # max concurrent sequences in decode
+    max_prefill_tokens: int = 256  # chunked-prefill chunk cap per step
+    prefill_batch_size: int = 1  # sequences prefilled per step
+    watermark: float = 0.05  # fraction of pages kept free at admission
+
+    # buckets (powers of two up to the caps) — static shapes for XLA
+    decode_batch_buckets: Optional[Sequence[int]] = None
+    chunk_buckets: Optional[Sequence[int]] = None
+
+    enable_prefix_caching: bool = True
+    block_hash_salt: str = ""
+
+    # model limits
+    max_model_len: int = 1024
+
+    table_width_buckets: Optional[Sequence[int]] = None
+
+    def __post_init__(self):
+        if self.decode_batch_buckets is None:
+            self.decode_batch_buckets = _pow2_buckets(self.max_num_seqs)
+        if self.chunk_buckets is None:
+            self.chunk_buckets = [
+                b for b in _pow2_buckets(self.max_prefill_tokens) if b >= self.page_size
+            ] or [self.max_prefill_tokens]
+        if self.max_pages_per_seq * self.page_size < self.max_model_len:
+            self.max_pages_per_seq = -(-self.max_model_len // self.page_size)
+        if self.table_width_buckets is None:
+            # attention cost scales with table width: size it to the longest
+            # sequence actually in the batch, bucketed so XLA compiles a few
+            # variants (coarser than pow2 to bound variant count)
+            self.table_width_buckets = _pow2_buckets(self.max_pages_per_seq)
+
+    @property
+    def usable_pages(self) -> int:
+        return self.num_pages - 1  # page 0 is the trash page
+
+
+def _pow2_buckets(cap: int) -> list:
+    out, b = [], 1
+    while b < cap:
+        out.append(b)
+        b *= 2
+    out.append(cap)
+    return sorted(set(out))
+
+
+def bucket_for(n: int, buckets: Sequence[int]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]

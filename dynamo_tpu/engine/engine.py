@@ -1,0 +1,421 @@
+"""JaxEngine — the TPU-native LLM engine (the component the reference
+delegates to vLLM/SGLang/TRT-LLM; here it is first-party).
+
+Structure:
+- jitted step functions (`_prefill_step`, `_decode_step`) fuse model forward
+  + sampling in one XLA program; the KV cache is donated through, so pages
+  update in place in HBM with no host round-trip;
+- a python-side `Scheduler` (continuous batching, chunked prefill, prefix
+  cache, preemption) plans statically-shaped batches;
+- an asyncio pump runs the device step in a worker thread and streams
+  sampled tokens into per-request queues (`generate` implements the
+  runtime's AsyncEngine protocol, so the engine drops straight into a
+  served endpoint).
+
+Emits KV events (stored/removed) and ForwardPassMetrics for the KV-aware
+router (reference: publisher.rs:92 KvEventPublisher, :691
+WorkerMetricsPublisher).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, AsyncIterator, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import KVCache, ModelConfig, forward_decode, forward_prefill
+from ..ops import SamplingParams, compute_logprobs, sample_tokens
+from ..runtime.engine import Context
+from .config import EngineConfig, bucket_for
+from .page_pool import KvEvent, PagePool
+from .scheduler import PrefillItem, SamplingOptions, Scheduler, Sequence, StepPlan
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class ForwardPassMetrics:
+    """Load snapshot published to the router (reference
+    kv_router/protocols.rs ForwardPassMetrics)."""
+
+    active_seqs: int = 0
+    waiting_seqs: int = 0
+    kv_usage: float = 0.0
+    kv_total_pages: int = 0
+    num_requests_total: int = 0
+
+
+def _build_prefill_step(cfg: ModelConfig):
+    @partial(jax.jit, donate_argnums=(1,))
+    def step(params, kv, tokens, page_table, prefix_lens, chunk_lens, samp, seeds, counters):
+        logits, kv = forward_prefill(
+            params, cfg, kv, tokens, page_table, prefix_lens, chunk_lens
+        )
+        out = sample_tokens(logits, samp, seeds, counters)
+        logp = compute_logprobs(logits, out)
+        return out, logp, kv
+
+    return step
+
+
+def _build_decode_step(cfg: ModelConfig):
+    @partial(jax.jit, donate_argnums=(1,))
+    def step(params, kv, tokens, positions, page_table, samp, seeds, counters):
+        logits, kv = forward_decode(params, cfg, kv, tokens, positions, page_table)
+        out = sample_tokens(logits, samp, seeds, counters)
+        logp = compute_logprobs(logits, out)
+        return out, logp, kv
+
+    return step
+
+
+class JaxEngine:
+    """Single-host continuous-batching engine over a paged KV cache."""
+
+    def __init__(
+        self,
+        model_cfg: ModelConfig,
+        params: Any,
+        engine_cfg: Optional[EngineConfig] = None,
+        eos_token_ids: Optional[List[int]] = None,
+        kv_dtype=jnp.bfloat16,
+        event_sink: Optional[Callable[[KvEvent], None]] = None,
+    ):
+        self.model_cfg = model_cfg
+        self.cfg = engine_cfg or EngineConfig()
+        self.params = params
+        self.eos_token_ids = eos_token_ids or []
+        self._kv_dtype = kv_dtype
+        self.kv = KVCache.create(
+            model_cfg, self.cfg.num_pages, self.cfg.page_size, kv_dtype
+        )
+        self._extra_event_sinks: List[Callable[[KvEvent], None]] = []
+        if event_sink:
+            self._extra_event_sinks.append(event_sink)
+        self.pool = PagePool(
+            self.cfg.num_pages, self.cfg.page_size, event_sink=self._emit_event
+        )
+        self.scheduler = Scheduler(self.cfg, self.pool)
+        self._prefill_step = _build_prefill_step(model_cfg)
+        self._decode_step = _build_decode_step(model_cfg)
+        import random as _random
+
+        self._py_rng = _random.Random(0xD1A)
+        self._queues: Dict[str, asyncio.Queue] = {}
+        self._contexts: Dict[str, Context] = {}
+        self._wake = asyncio.Event()
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._pump_task: Optional[asyncio.Task] = None
+        self._closed = False
+        self._lock = threading.Lock()
+        self._requests_total = 0
+        self._step_count = 0
+
+    # -- events -------------------------------------------------------------- #
+
+    def _emit_event(self, ev: KvEvent) -> None:
+        for sink in self._extra_event_sinks:
+            try:
+                sink(ev)
+            except Exception:  # noqa: BLE001 — sinks must not break the engine
+                logger.exception("kv event sink failed")
+
+    def add_event_sink(self, sink: Callable[[KvEvent], None]) -> None:
+        self._extra_event_sinks.append(sink)
+
+    # -- metrics ------------------------------------------------------------- #
+
+    def metrics(self) -> ForwardPassMetrics:
+        running, waiting = self.scheduler.num_requests()
+        return ForwardPassMetrics(
+            active_seqs=running,
+            waiting_seqs=waiting,
+            kv_usage=self.pool.usage(),
+            kv_total_pages=self.cfg.usable_pages,
+            num_requests_total=self._requests_total,
+        )
+
+    def clear_kv_blocks(self) -> int:
+        return self.pool.clear_cache()
+
+    # -- AsyncEngine protocol ------------------------------------------------ #
+
+    async def generate(
+        self, request: Dict[str, Any], context: Optional[Context] = None
+    ) -> AsyncIterator[Dict[str, Any]]:
+        """request: {"token_ids": [...], "sampling_options": {...},
+        "stop_conditions": {...}} → stream of {"token_ids": [...],
+        "finish_reason": str|None} (the wire protocol of the reference's
+        PreprocessedRequest → LLMEngineOutput,
+        /root/reference/lib/llm/src/protocols/common/llm_backend.rs)."""
+        context = context or Context()
+        self._ensure_pump()
+        opts = _opts_from_request(request)
+        prompt = list(request["token_ids"])
+        max_prompt = min(
+            self.cfg.max_model_len - 1,
+            self.cfg.max_pages_per_seq * self.cfg.page_size - 1,
+        )
+        if not prompt or len(prompt) > max_prompt:
+            yield {
+                "token_ids": [],
+                "finish_reason": "error",
+                "error": (
+                    f"prompt length {len(prompt)} outside [1, {max_prompt}]"
+                ),
+            }
+            return
+        if opts.max_tokens <= 0:
+            yield {"token_ids": [], "finish_reason": "length"}
+            return
+        seq = Sequence(context.id, prompt, opts)
+        seq.seed = opts.seed if opts.seed is not None else self._py_rng.getrandbits(31)
+        queue: asyncio.Queue = asyncio.Queue()
+        self._queues[context.id] = queue
+        self._contexts[context.id] = context
+        self._requests_total += 1
+        self.scheduler.add(seq)
+        self._wake.set()
+        killed = asyncio.create_task(context.killed())
+        try:
+            while True:
+                get = asyncio.create_task(queue.get())
+                done, _ = await asyncio.wait(
+                    {get, killed}, return_when=asyncio.FIRST_COMPLETED
+                )
+                if get not in done:
+                    get.cancel()
+                    self.scheduler.abort(context.id)
+                    return
+                out = get.result()
+                if out is None:
+                    return
+                yield out
+                if out.get("finish_reason"):
+                    return
+        finally:
+            killed.cancel()
+            self._queues.pop(context.id, None)
+            self._contexts.pop(context.id, None)
+
+    # -- pump ---------------------------------------------------------------- #
+
+    def _ensure_pump(self) -> None:
+        if self._pump_task is None or self._pump_task.done():
+            self._loop = asyncio.get_running_loop()
+            self._pump_task = self._loop.create_task(self._pump())
+
+    async def shutdown(self) -> None:
+        self._closed = True
+        self._wake.set()
+        if self._pump_task:
+            await asyncio.gather(self._pump_task, return_exceptions=True)
+
+    async def _pump(self) -> None:
+        loop = asyncio.get_running_loop()
+        while not self._closed:
+            # honor graceful stop requests before planning
+            for rid, ctx in list(self._contexts.items()):
+                if ctx.is_stopped() and not ctx.is_killed():
+                    for seq in self.scheduler.running:
+                        if seq.request_id == rid and seq.output_tokens:
+                            self.scheduler.finish(seq, "cancelled")
+                            self._deliver(seq, [], "cancelled")
+            plan = self.scheduler.schedule()
+            if plan.kind == "idle":
+                if not self.scheduler.has_work:
+                    self._wake.clear()
+                    await self._wake.wait()
+                else:
+                    await asyncio.sleep(0)
+                continue
+            try:
+                if plan.kind == "prefill":
+                    await loop.run_in_executor(None, self._run_prefill, plan.prefill)
+                else:
+                    await loop.run_in_executor(None, self._run_decode, plan.decode)
+            except Exception:  # noqa: BLE001
+                logger.exception("engine step failed; resetting KV state")
+                self._recover_after_error()
+            self._step_count += 1
+            await asyncio.sleep(0)
+
+    # -- device steps (worker thread) ---------------------------------------- #
+
+    def _seed_arrays(self, seqs: List[Sequence], pad_to: int):
+        pad = pad_to - len(seqs)
+        seeds = [getattr(s, "seed", 0) for s in seqs] + [0] * pad
+        counters = [len(s.output_tokens) for s in seqs] + [0] * pad
+        return (
+            jnp.asarray(np.asarray(seeds, np.uint32)),
+            jnp.asarray(np.asarray(counters, np.int32)),
+        )
+
+    def _table_array(self, seqs: List[Sequence], rows: Optional[int] = None) -> np.ndarray:
+        """Page-table batch, width bucketed to the longest sequence present
+        (attention/gather cost scales with width, so short-context batches
+        stay cheap)."""
+        need = max((len(s.pages) for s in seqs), default=1)
+        width = bucket_for(max(need, 1), self.cfg.table_width_buckets)
+        table = np.zeros((rows or len(seqs), width), np.int32)
+        for i, s in enumerate(seqs):
+            n = min(len(s.pages), width)
+            table[i, :n] = s.pages[:n]
+        return table
+
+    def _samp_arrays(self, seqs: List[Sequence]) -> SamplingParams:
+        return SamplingParams.make(
+            [s.opts.temperature for s in seqs],
+            [s.opts.top_k for s in seqs],
+            [s.opts.top_p for s in seqs],
+        )
+
+    def _run_prefill(self, items: List[PrefillItem]) -> None:
+        B = len(items)
+        chunk_bucket = bucket_for(
+            max(it.chunk_len for it in items), self.cfg.chunk_buckets
+        )
+        tokens = np.zeros((B, chunk_bucket), np.int32)
+        prefix = np.zeros((B,), np.int32)
+        chunk = np.zeros((B,), np.int32)
+        for i, it in enumerate(items):
+            s = it.seq
+            toks = s.prompt[it.chunk_start : it.chunk_start + it.chunk_len]
+            tokens[i, : len(toks)] = toks
+            prefix[i] = it.chunk_start
+            chunk[i] = it.chunk_len
+        table = self._table_array([it.seq for it in items])
+        seeds, counters = self._seed_arrays([it.seq for it in items], B)
+        out, logp, kv = self._prefill_step(
+            self.params,
+            self.kv,
+            jnp.asarray(tokens),
+            jnp.asarray(table),
+            jnp.asarray(prefix),
+            jnp.asarray(chunk),
+            self._samp_arrays([it.seq for it in items]),
+            seeds,
+            counters,
+        )
+        self.kv = kv
+        out = np.asarray(jax.device_get(out))
+        logp = np.asarray(jax.device_get(logp))
+        for i, it in enumerate(items):
+            s = it.seq
+            if s.status != "running":  # preempted after planning
+                continue
+            s.num_computed += it.chunk_len
+            self.scheduler.commit_full_pages(s)
+            if it.samples:
+                self._append_token(s, int(out[i]), float(logp[i]))
+
+    def _run_decode(self, seqs: List[Sequence]) -> None:
+        Bb = bucket_for(len(seqs), self.cfg.decode_batch_buckets)
+        tokens = np.zeros((Bb,), np.int32)
+        positions = np.zeros((Bb,), np.int32)
+        for i, s in enumerate(seqs):
+            tokens[i] = s.output_tokens[-1] if s.output_tokens else (
+                s.prompt[-1] if s.prompt else 0
+            )
+            positions[i] = s.num_computed
+        table = self._table_array(seqs, rows=Bb)
+        pad = Bb - len(seqs)
+        samp = SamplingParams.make(
+            [s.opts.temperature for s in seqs] + [0.0] * pad,
+            [s.opts.top_k for s in seqs] + [0] * pad,
+            [s.opts.top_p for s in seqs] + [1.0] * pad,
+        )
+        seeds, counters = self._seed_arrays(seqs, Bb)
+        out, logp, self.kv = self._decode_step(
+            self.params,
+            self.kv,
+            jnp.asarray(tokens),
+            jnp.asarray(positions),
+            jnp.asarray(table),
+            samp,
+            seeds,
+            counters,
+        )
+        out = np.asarray(jax.device_get(out))
+        logp = np.asarray(jax.device_get(logp))
+        for i, s in enumerate(seqs):
+            if s.status != "running":
+                continue
+            s.num_computed += 1
+            self.scheduler.commit_full_pages(s)
+            self._append_token(s, int(out[i]), float(logp[i]))
+
+    def _recover_after_error(self) -> None:
+        """A failed jitted step may have consumed the donated KV buffers;
+        rebuild device state so the engine survives (reference behavior:
+        engine death → watchdog restart; we recover in-process)."""
+        for seq in list(self.scheduler.running):
+            self.scheduler.finish(seq, "error")
+            self._deliver(seq, [], "error")
+        self.kv = KVCache.create(
+            self.model_cfg, self.cfg.num_pages, self.cfg.page_size, self._kv_dtype
+        )
+        self.pool = PagePool(
+            self.cfg.num_pages, self.cfg.page_size, event_sink=self._emit_event
+        )
+        self._emit_event(KvEvent("cleared", []))
+        self.scheduler.pool = self.pool
+        for seq in self.scheduler.waiting:
+            seq.pages = []
+            seq.num_cached = 0
+            seq.num_computed = 0
+            seq.committed_pages = 0
+            seq.block_hashes = []
+
+    def _append_token(self, seq: Sequence, token: int, logprob: float) -> None:
+        seq.output_tokens.append(token)
+        reason = self.scheduler.check_stop(seq, self.eos_token_ids)
+        if reason:
+            self.scheduler.finish(seq, reason)
+        self._deliver(seq, [token], reason, logprob)
+
+    def _deliver(
+        self,
+        seq: Sequence,
+        tokens: List[int],
+        finish_reason: Optional[str],
+        logprob: Optional[float] = None,
+    ) -> None:
+        queue = self._queues.get(seq.request_id)
+        if queue is None:
+            return
+        out = {
+            "token_ids": tokens,
+            "finish_reason": finish_reason,
+        }
+        if logprob is not None and seq.opts.logprobs:
+            out["log_probs"] = [logprob]
+        # may be called from the executor thread — hop back to the loop
+        self._loop.call_soon_threadsafe(queue.put_nowait, out)
+
+
+def _opts_from_request(request: Dict[str, Any]) -> SamplingOptions:
+    so = request.get("sampling_options", {}) or {}
+    sc = request.get("stop_conditions", {}) or {}
+    max_tokens = sc.get("max_tokens")
+    temperature = so.get("temperature")
+    return SamplingOptions(
+        # OpenAI default is 1.0 (sampled); explicit 0 means greedy
+        temperature=1.0 if temperature is None else temperature,
+        top_k=so.get("top_k") or 0,
+        top_p=so.get("top_p") if so.get("top_p") is not None else 1.0,
+        max_tokens=16 if max_tokens is None else max_tokens,
+        stop_token_ids=sc.get("stop_token_ids") or [],
+        stop_sequences=sc.get("stop_sequences") or [],
+        ignore_eos=sc.get("ignore_eos") or False,
+        logprobs=bool(so.get("logprobs")),
+        seed=so.get("seed"),
+    )

@@ -1,0 +1,299 @@
+"""Continuous-batching scheduler with chunked prefill, prefix caching and
+preemption.
+
+Modeled on the behavior the reference *simulates* in its mocker
+(/root/reference/lib/llm/src/mocker/scheduler.rs:240 watermark scheduler,
+chunked prefill, preemption) and vLLM's real scheduler — but designed for
+XLA: every step produces a statically-shaped batch (bucketed chunk lengths /
+batch sizes), so the jitted prefill/decode functions compile a handful of
+variants and then never retrace.
+
+Policy (vLLM-style):
+- prefills first: any running sequence with unprefilled prompt tokens gets
+  the next chunk (up to `max_prefill_tokens` across the step);
+- otherwise one decode step over all running sequences;
+- admission holds back `watermark` fraction of pages; allocation failure on
+  a running sequence preempts the youngest sequence (pages freed, sequence
+  returns to the head of the waiting queue and re-prefills — prefix cache
+  makes the recompute cheap).
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, List, Optional, Sequence as Seq, Tuple
+
+from ..tokens import chain_seed, compute_block_hash_for_seq, next_block_hash
+from .config import EngineConfig
+from .page_pool import NoPagesError, PagePool
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class SamplingOptions:
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    max_tokens: int = 16
+    stop_token_ids: List[int] = field(default_factory=list)
+    stop_sequences: List[List[int]] = field(default_factory=list)
+    ignore_eos: bool = False
+    logprobs: bool = False
+    seed: Optional[int] = None
+
+
+class Sequence:
+    """One in-flight request inside the engine."""
+
+    def __init__(self, request_id: str, prompt: List[int], opts: SamplingOptions):
+        self.request_id = request_id
+        self.prompt = list(prompt)
+        self.opts = opts
+        self.seed = 0  # per-request sampling seed (engine assigns)
+        self.pages: List[int] = []
+        self.num_cached = 0  # prompt tokens satisfied from prefix cache
+        self.num_computed = 0  # tokens whose KV is written
+        self.output_tokens: List[int] = []
+        self.block_hashes: List[int] = []  # chained, full blocks only
+        self.committed_pages = 0
+        self.status = "waiting"
+        self.finish_reason: Optional[str] = None
+        self.preemptions = 0
+
+    @property
+    def total_len(self) -> int:
+        return len(self.prompt) + len(self.output_tokens)
+
+    @property
+    def prompt_len(self) -> int:
+        return len(self.prompt)
+
+    @property
+    def prefill_done(self) -> bool:
+        return self.num_computed >= self.prompt_len
+
+    def all_tokens(self) -> List[int]:
+        return self.prompt + self.output_tokens
+
+    def pages_needed(self, upto_tokens: int, page_size: int) -> int:
+        return -(-upto_tokens // page_size)
+
+
+@dataclass
+class PrefillItem:
+    seq: Sequence
+    chunk_start: int
+    chunk_len: int
+    samples: bool  # True when this chunk completes the prompt
+
+
+@dataclass
+class StepPlan:
+    kind: str  # "prefill" | "decode" | "idle"
+    prefill: List[PrefillItem] = field(default_factory=list)
+    decode: List[Sequence] = field(default_factory=list)
+
+
+class Scheduler:
+    def __init__(self, cfg: EngineConfig, pool: PagePool):
+        self.cfg = cfg
+        self.pool = pool
+        self.waiting: Deque[Sequence] = deque()
+        self.running: List[Sequence] = []
+
+    # -- intake -------------------------------------------------------------- #
+
+    def add(self, seq: Sequence) -> None:
+        if seq.prompt_len + seq.opts.max_tokens > self.cfg.max_model_len:
+            # clamp generation budget to the model window
+            seq.opts.max_tokens = max(0, self.cfg.max_model_len - seq.prompt_len)
+        self.waiting.append(seq)
+
+    def abort(self, request_id: str) -> None:
+        for seq in list(self.waiting):
+            if seq.request_id == request_id:
+                self.waiting.remove(seq)
+                seq.status = "finished"
+                seq.finish_reason = "cancelled"
+        for seq in self.running:
+            if seq.request_id == request_id:
+                self._finish(seq, "cancelled")
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    def num_requests(self) -> Tuple[int, int]:
+        return len(self.running), len(self.waiting)
+
+    # -- admission ----------------------------------------------------------- #
+
+    def _watermark_pages(self) -> int:
+        return int(self.cfg.watermark * self.cfg.usable_pages)
+
+    def _try_admit(self) -> None:
+        while self.waiting and len(self.running) < self.cfg.max_num_seqs:
+            seq = self.waiting[0]
+            first_chunk = min(seq.prompt_len, self.cfg.max_prefill_tokens)
+            need = seq.pages_needed(first_chunk, self.cfg.page_size)
+            if self.pool.available_pages < need + self._watermark_pages():
+                break
+            self.waiting.popleft()
+            if self.cfg.enable_prefix_caching:
+                self._apply_prefix_cache(seq)
+            seq.status = "running"
+            self.running.append(seq)
+
+    def _apply_prefix_cache(self, seq: Sequence) -> None:
+        ps = self.cfg.page_size
+        # never cache-hit the *entire* prompt: the last token must be
+        # recomputed so prefill produces logits to sample from.
+        hashes = compute_block_hash_for_seq(
+            seq.prompt, ps, self.cfg.block_hash_salt
+        )
+        if seq.prompt_len % ps == 0 and hashes:
+            hashes = hashes[:-1]
+        hit_pages = self.pool.lookup(hashes)
+        if hit_pages:
+            seq.pages = list(hit_pages)
+            seq.num_cached = len(hit_pages) * ps
+            seq.num_computed = seq.num_cached
+            seq.block_hashes = hashes[: len(hit_pages)]
+            seq.committed_pages = len(hit_pages)
+
+    # -- planning ------------------------------------------------------------ #
+
+    def schedule(self) -> StepPlan:
+        self._try_admit()
+        if not self.running:
+            return StepPlan("idle")
+
+        # prefill pass
+        budget = self.cfg.max_prefill_tokens
+        items: List[PrefillItem] = []
+        for seq in self.running:
+            if seq.prefill_done or budget <= 0:
+                continue
+            if len(items) >= self.cfg.prefill_batch_size:
+                break
+            chunk = min(seq.prompt_len - seq.num_computed, budget)
+            if not self._ensure_pages(seq, seq.num_computed + chunk):
+                continue  # seq may have been preempted
+            items.append(
+                PrefillItem(
+                    seq,
+                    seq.num_computed,
+                    chunk,
+                    samples=(seq.num_computed + chunk >= seq.prompt_len),
+                )
+            )
+            budget -= chunk
+        if items:
+            return StepPlan("prefill", prefill=items)
+
+        # decode pass: every running sequence advances one token
+        decodable: List[Sequence] = []
+        for seq in list(self.running):
+            if seq.status != "running":
+                continue
+            if not self._ensure_pages(seq, seq.num_computed + 1):
+                continue
+            decodable.append(seq)
+        if decodable:
+            return StepPlan("decode", decode=decodable[: self.cfg.max_num_seqs])
+        return StepPlan("idle")
+
+    def _ensure_pages(self, seq: Sequence, upto_tokens: int) -> bool:
+        """Grow seq's page list to cover `upto_tokens`, preempting others
+        (youngest-first) if the pool is dry. Returns False if seq itself got
+        preempted."""
+        need = seq.pages_needed(upto_tokens, self.cfg.page_size) - len(seq.pages)
+        if need <= 0:
+            return True
+        while True:
+            try:
+                seq.pages.extend(self.pool.allocate(need))
+                return True
+            except NoPagesError:
+                victim = self._pick_victim(exclude=seq)
+                if victim is None:
+                    self._preempt(seq)
+                    return False
+                self._preempt(victim)
+
+    def _pick_victim(self, exclude: Sequence) -> Optional[Sequence]:
+        for seq in reversed(self.running):  # youngest first
+            if seq is not exclude:
+                return seq
+        return None
+
+    def _preempt(self, seq: Sequence) -> None:
+        logger.info("preempting %s", seq.request_id)
+        self.pool.free(seq.pages)
+        seq.pages = []
+        seq.num_cached = 0
+        seq.num_computed = 0
+        seq.committed_pages = 0
+        seq.block_hashes = seq.block_hashes[:0]
+        seq.status = "waiting"
+        seq.preemptions += 1
+        if seq in self.running:
+            self.running.remove(seq)
+        self.waiting.appendleft(seq)
+
+    # -- completion ---------------------------------------------------------- #
+
+    def commit_full_pages(self, seq: Sequence) -> None:
+        """Register newly-filled pages in the prefix cache (emits KV events)."""
+        if not self.cfg.enable_prefix_caching:
+            return
+        ps = self.cfg.page_size
+        full = seq.num_computed // ps
+        if full <= seq.committed_pages:
+            return
+        tokens = seq.all_tokens()
+        # extend the hash chain incrementally (O(new blocks), not O(n^2))
+        while len(seq.block_hashes) < full:
+            i = len(seq.block_hashes)
+            parent = (
+                seq.block_hashes[-1]
+                if seq.block_hashes
+                else chain_seed(self.cfg.block_hash_salt)
+            )
+            seq.block_hashes.append(
+                next_block_hash(parent, tokens[i * ps : (i + 1) * ps])
+            )
+        for i in range(seq.committed_pages, full):
+            parent = seq.block_hashes[i - 1] if i > 0 else None
+            self.pool.commit(seq.pages[i], seq.block_hashes[i], parent)
+        seq.committed_pages = full
+
+    def check_stop(self, seq: Sequence, eos_token_ids: Seq[int]) -> Optional[str]:
+        out = seq.output_tokens
+        if not seq.opts.ignore_eos and out and out[-1] in eos_token_ids:
+            return "stop"
+        if out and out[-1] in seq.opts.stop_token_ids:
+            return "stop"
+        for stop in seq.opts.stop_sequences:
+            if stop and out[-len(stop):] == stop:
+                return "stop"
+        if len(out) >= seq.opts.max_tokens:
+            return "length"
+        if seq.total_len >= self.cfg.max_model_len:
+            return "length"
+        return None
+
+    def _finish(self, seq: Sequence, reason: str) -> None:
+        seq.status = "finished"
+        seq.finish_reason = reason
+        self.pool.free(seq.pages)
+        seq.pages = []
+        if seq in self.running:
+            self.running.remove(seq)
+
+    def finish(self, seq: Sequence, reason: str) -> None:
+        self.commit_full_pages(seq)
+        self._finish(seq, reason)

@@ -1,0 +1,14 @@
+"""OpenAI frontend: HTTP service, model discovery, serving pipelines."""
+
+from .metrics import FrontendMetrics
+from .openai_http import HttpService
+from .service import ModelEntry, ModelManager, ModelWatcher, register_llm
+
+__all__ = [
+    "FrontendMetrics",
+    "HttpService",
+    "ModelEntry",
+    "ModelManager",
+    "ModelWatcher",
+    "register_llm",
+]

@@ -1,0 +1,59 @@
+"""Frontend CLI: `python -m dynamo_tpu.frontend --control HOST:PORT --port 8000`.
+
+The analog of the reference's `python -m dynamo.frontend`
+(/root/reference/components/src/dynamo/frontend/main.py): OpenAI HTTP
+server + model discovery + routed pipelines.
+"""
+
+import argparse
+import asyncio
+import logging
+import signal
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description="dynamo-tpu OpenAI frontend")
+    ap.add_argument("--control", required=True, help="control plane host:port")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument(
+        "--router-mode",
+        default="round_robin",
+        choices=["round_robin", "random", "kv"],
+    )
+    ap.add_argument("--log-level", default="info")
+    args = ap.parse_args()
+    logging.basicConfig(level=args.log_level.upper(),
+                        format="%(asctime)s %(levelname)s %(name)s %(message)s")
+    asyncio.run(_run(args))
+
+
+async def _run(args) -> None:
+    from ..runtime import DistributedRuntime
+    from . import HttpService, ModelManager, ModelWatcher
+
+    runtime = await DistributedRuntime.connect(args.control)
+    manager = ModelManager()
+    kv_factory = None
+    if args.router_mode == "kv":
+        from ..router import kv_chooser_factory
+
+        kv_factory = kv_chooser_factory(runtime)
+    watcher = await ModelWatcher(
+        runtime, manager, router_mode=args.router_mode,
+        kv_chooser_factory=kv_factory,
+    ).start()
+    http = await HttpService(manager, host=args.host, port=args.port).start()
+    print(f"READY http://{args.host}:{http.port}", flush=True)
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    await http.stop()
+    await watcher.stop()
+    await runtime.shutdown()
+
+
+if __name__ == "__main__":
+    main()

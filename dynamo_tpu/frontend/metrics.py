@@ -1,0 +1,63 @@
+"""Frontend Prometheus metrics (reference `dynamo_frontend_*` family,
+/root/reference/lib/llm/src/http/service/metrics.rs)."""
+
+from __future__ import annotations
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+
+_TTFT_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0
+)
+_ITL_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0)
+
+
+class FrontendMetrics:
+    def __init__(self, registry: CollectorRegistry | None = None):
+        self.registry = registry or CollectorRegistry()
+        self.requests = Counter(
+            "dynamo_frontend_requests_total",
+            "Completed HTTP requests",
+            ["model", "kind", "status"],
+            registry=self.registry,
+        )
+        self.inflight = Gauge(
+            "dynamo_frontend_inflight_requests",
+            "Requests currently being served",
+            ["model"],
+            registry=self.registry,
+        )
+        self.ttft = Histogram(
+            "dynamo_frontend_time_to_first_token_seconds",
+            "Time to first token",
+            ["model"],
+            buckets=_TTFT_BUCKETS,
+            registry=self.registry,
+        )
+        self.itl = Histogram(
+            "dynamo_frontend_inter_token_latency_seconds",
+            "Inter-token latency",
+            ["model"],
+            buckets=_ITL_BUCKETS,
+            registry=self.registry,
+        )
+        self.duration = Histogram(
+            "dynamo_frontend_request_duration_seconds",
+            "Whole-request duration",
+            ["model"],
+            registry=self.registry,
+        )
+        self.output_tokens = Counter(
+            "dynamo_frontend_output_tokens_total",
+            "Generated tokens",
+            ["model"],
+            registry=self.registry,
+        )
+
+    def exposition(self) -> bytes:
+        return generate_latest(self.registry)

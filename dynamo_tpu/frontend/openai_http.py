@@ -1,0 +1,311 @@
+"""OpenAI-compatible HTTP service (aiohttp).
+
+The analog of the reference's axum service
+(/root/reference/lib/llm/src/http/service/service_v2.rs:135 `HttpService`,
+openai.rs:504 `handler_chat_completions`, :280 completions, :1048 models):
+
+- POST /v1/chat/completions, /v1/completions — SSE streaming and unary
+- GET  /v1/models
+- GET  /health, /live, /metrics (prometheus exposition)
+- POST /clear_kv_blocks — broadcast cache clear to workers
+
+Client disconnects kill the request context so workers stop generating
+(reference http/service/disconnect.rs).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+import uuid
+from typing import Any, AsyncIterator, Dict, Optional
+
+from aiohttp import web
+
+from ..llm import RequestError
+from ..runtime import Context
+from ..runtime.transport.service import RemoteStreamError, ServiceUnavailable
+from .metrics import FrontendMetrics
+from .service import ModelManager, ModelWatcher
+
+logger = logging.getLogger(__name__)
+
+
+class HttpService:
+    def __init__(self, manager: ModelManager, host: str = "0.0.0.0",
+                 port: int = 8000, metrics: Optional[FrontendMetrics] = None):
+        self.manager = manager
+        self.host = host
+        self.port = port
+        self.metrics = metrics or FrontendMetrics()
+        self.app = web.Application()
+        self.app.add_routes(
+            [
+                web.post("/v1/chat/completions", self.chat_completions),
+                web.post("/v1/completions", self.completions),
+                web.get("/v1/models", self.list_models),
+                web.get("/health", self.health),
+                web.get("/live", self.live),
+                web.get("/metrics", self.prometheus),
+                web.post("/clear_kv_blocks", self.clear_kv_blocks),
+            ]
+        )
+        self._runner: Optional[web.AppRunner] = None
+
+    # -- lifecycle ----------------------------------------------------------- #
+
+    async def start(self) -> "HttpService":
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        # resolve the real port when 0 was requested
+        for s in site._server.sockets:  # noqa: SLF001
+            self.port = s.getsockname()[1]
+            break
+        logger.info("http service on %s:%d", self.host, self.port)
+        return self
+
+    async def stop(self) -> None:
+        if self._runner:
+            await self._runner.cleanup()
+
+    # -- handlers ------------------------------------------------------------ #
+
+    async def health(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {"status": "healthy", "models": self.manager.names()}
+        )
+
+    async def live(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "live"})
+
+    async def prometheus(self, request: web.Request) -> web.Response:
+        return web.Response(
+            body=self.metrics.exposition(),
+            content_type="text/plain",
+        )
+
+    async def list_models(self, request: web.Request) -> web.Response:
+        now = int(time.time())
+        data = [
+            {"id": name, "object": "model", "created": now, "owned_by": "dynamo-tpu"}
+            for name in self.manager.names()
+        ]
+        return web.json_response({"object": "list", "data": data})
+
+    async def clear_kv_blocks(self, request: web.Request) -> web.Response:
+        results = {}
+        for name in self.manager.names():
+            entry = self.manager.get(name)
+            try:
+                async for out in entry.route(
+                    {"control": "clear_kv_blocks"}, Context()
+                ):
+                    results[name] = out
+                    break
+            except (ServiceUnavailable, RemoteStreamError) as e:
+                results[name] = {"error": str(e)}
+        return web.json_response(results)
+
+    async def chat_completions(self, request: web.Request) -> web.StreamResponse:
+        return await self._serve(request, kind="chat")
+
+    async def completions(self, request: web.Request) -> web.StreamResponse:
+        return await self._serve(request, kind="completion")
+
+    # -- core serving path --------------------------------------------------- #
+
+    async def _serve(self, request: web.Request, kind: str) -> web.StreamResponse:
+        t0 = time.monotonic()
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return _error_response(400, "invalid JSON body")
+        model_name = body.get("model", "")
+        entry = self.manager.get(model_name)
+        if entry is None:
+            self.metrics.requests.labels(model_name or "?", kind, "404").inc()
+            return _error_response(
+                404, f"model '{model_name}' not found", code="model_not_found"
+            )
+        required = "chat" if kind == "chat" else "completions"
+        if not entry.mdc.supports(required):
+            return _error_response(
+                400, f"model '{model_name}' does not support {required}"
+            )
+        try:
+            if kind == "chat":
+                preprocessed = await asyncio.get_running_loop().run_in_executor(
+                    None, entry.preprocessor.preprocess_chat, body
+                )
+            else:
+                preprocessed = await asyncio.get_running_loop().run_in_executor(
+                    None, entry.preprocessor.preprocess_completion, body
+                )
+        except RequestError as e:
+            self.metrics.requests.labels(model_name, kind, "400").inc()
+            return _error_response(400, str(e))
+
+        context = Context()
+        rid = ("chatcmpl-" if kind == "chat" else "cmpl-") + uuid.uuid4().hex[:24]
+        streaming = bool(body.get("stream", False))
+        self.metrics.inflight.labels(model_name).inc()
+        try:
+            if streaming:
+                return await self._stream_response(
+                    request, entry, preprocessed, context, rid, kind, model_name, t0
+                )
+            return await self._unary_response(
+                entry, preprocessed, context, rid, kind, model_name, t0
+            )
+        finally:
+            self.metrics.inflight.labels(model_name).dec()
+
+    async def _stream_response(
+        self, request, entry, preprocessed, context, rid, kind, model_name, t0
+    ) -> web.StreamResponse:
+        resp = web.StreamResponse(
+            status=200,
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "Connection": "keep-alive",
+            },
+        )
+        await resp.prepare(request)
+        created = int(time.time())
+        first = True
+        finish_reason = None
+        ntokens = 0
+        last_t = t0
+        try:
+            async for out in entry.generate(preprocessed, context):
+                if out.get("finish_reason") == "error":
+                    chunk = _sse_error_chunk(rid, out.get("error", "engine error"))
+                    await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
+                    break
+                now = time.monotonic()
+                if first:
+                    self.metrics.ttft.labels(model_name).observe(now - t0)
+                    first = False
+                else:
+                    self.metrics.itl.labels(model_name).observe(now - last_t)
+                last_t = now
+                ntokens += len(out.get("token_ids", []))
+                finish_reason = out.get("finish_reason")
+                chunk = _make_chunk(rid, kind, model_name, created, out, finish_reason)
+                await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
+            await resp.write(b"data: [DONE]\n\n")
+        except (ConnectionResetError, asyncio.CancelledError):
+            logger.info("client disconnected; killing %s", context.id)
+            context.kill()
+            raise
+        except (ServiceUnavailable, RemoteStreamError) as e:
+            chunk = _sse_error_chunk(rid, str(e))
+            await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
+            await resp.write(b"data: [DONE]\n\n")
+        self.metrics.requests.labels(model_name, kind, "200").inc()
+        self.metrics.output_tokens.labels(model_name).inc(ntokens)
+        self.metrics.duration.labels(model_name).observe(time.monotonic() - t0)
+        await resp.write_eof()
+        return resp
+
+    async def _unary_response(
+        self, entry, preprocessed, context, rid, kind, model_name, t0
+    ) -> web.Response:
+        text_parts = []
+        token_count = 0
+        finish_reason = None
+        try:
+            async for out in entry.generate(preprocessed, context):
+                if out.get("finish_reason") == "error":
+                    return _error_response(500, out.get("error", "engine error"))
+                text_parts.append(out.get("text", ""))
+                token_count += len(out.get("token_ids", []))
+                finish_reason = out.get("finish_reason") or finish_reason
+        except ServiceUnavailable as e:
+            self.metrics.requests.labels(model_name, kind, "503").inc()
+            return _error_response(503, str(e))
+        except RemoteStreamError as e:
+            self.metrics.requests.labels(model_name, kind, "502").inc()
+            return _error_response(502, str(e))
+        text = "".join(text_parts)
+        created = int(time.time())
+        prompt_tokens = len(preprocessed.get("token_ids", []))
+        usage = {
+            "prompt_tokens": prompt_tokens,
+            "completion_tokens": token_count,
+            "total_tokens": prompt_tokens + token_count,
+        }
+        if kind == "chat":
+            payload = {
+                "id": rid,
+                "object": "chat.completion",
+                "created": created,
+                "model": model_name,
+                "choices": [
+                    {
+                        "index": 0,
+                        "message": {"role": "assistant", "content": text},
+                        "finish_reason": finish_reason or "stop",
+                    }
+                ],
+                "usage": usage,
+            }
+        else:
+            payload = {
+                "id": rid,
+                "object": "text_completion",
+                "created": created,
+                "model": model_name,
+                "choices": [
+                    {
+                        "index": 0,
+                        "text": text,
+                        "finish_reason": finish_reason or "stop",
+                    }
+                ],
+                "usage": usage,
+            }
+        self.metrics.requests.labels(model_name, kind, "200").inc()
+        self.metrics.output_tokens.labels(model_name).inc(token_count)
+        self.metrics.duration.labels(model_name).observe(time.monotonic() - t0)
+        return web.json_response(payload)
+
+
+def _make_chunk(rid, kind, model, created, out, finish_reason):
+    if kind == "chat":
+        delta = {"content": out.get("text", "")} if out.get("text") else {}
+        return {
+            "id": rid,
+            "object": "chat.completion.chunk",
+            "created": created,
+            "model": model,
+            "choices": [
+                {"index": 0, "delta": delta, "finish_reason": finish_reason}
+            ],
+        }
+    return {
+        "id": rid,
+        "object": "text_completion",
+        "created": created,
+        "model": model,
+        "choices": [
+            {"index": 0, "text": out.get("text", ""),
+             "finish_reason": finish_reason}
+        ],
+    }
+
+
+def _sse_error_chunk(rid, message):
+    return {"id": rid, "error": {"message": message, "type": "internal_error"}}
+
+
+def _error_response(status: int, message: str, code: str = "invalid_request_error"):
+    return web.json_response(
+        {"error": {"message": message, "type": code, "code": status}},
+        status=status,
+    )

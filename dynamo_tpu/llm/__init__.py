@@ -1,0 +1,18 @@
+"""LLM pipeline pieces: model cards, tokenization, pre/post-processing."""
+
+from .backend import StreamPostprocessor, postprocess_stream
+from .model_card import MODEL_ROOT, ModelDeploymentCard, RuntimeConfig
+from .preprocessor import OpenAIPreprocessor, RequestError
+from .tokenizer import HuggingFaceTokenizer, IncrementalDetokenizer
+
+__all__ = [
+    "MODEL_ROOT",
+    "HuggingFaceTokenizer",
+    "IncrementalDetokenizer",
+    "ModelDeploymentCard",
+    "OpenAIPreprocessor",
+    "RequestError",
+    "RuntimeConfig",
+    "StreamPostprocessor",
+    "postprocess_stream",
+]

@@ -1,0 +1,154 @@
+"""OpenAIPreprocessor — OpenAI request → PreprocessedRequest.
+
+Mirrors the reference preprocessor contract
+(/root/reference/lib/llm/src/preprocessor.rs:102 `OpenAIPreprocessor`:
+chat-template render → tokenize → sampling-option mapping) producing the
+engine wire request:
+
+    {"token_ids": [...], "sampling_options": {...}, "stop_conditions": {...},
+     "annotations": {...}}
+
+Tokenization is CPU work; callers run `preprocess` in an executor when on a
+hot path (the reference offloads to a Rayon pool, compute/pool.rs).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jinja2
+
+from .model_card import ModelDeploymentCard
+from .tokenizer import HuggingFaceTokenizer
+
+# minimal fallback when the checkpoint ships no chat template
+DEFAULT_CHAT_TEMPLATE = (
+    "{% for message in messages %}"
+    "<|{{ message['role'] }}|>\n{{ message['content'] }}\n"
+    "{% endfor %}"
+    "{% if add_generation_prompt %}<|assistant|>\n{% endif %}"
+)
+
+
+class RequestError(ValueError):
+    """Maps to HTTP 400."""
+
+
+class OpenAIPreprocessor:
+    def __init__(self, mdc: ModelDeploymentCard, tokenizer: HuggingFaceTokenizer):
+        self.mdc = mdc
+        self.tokenizer = tokenizer
+        template = (
+            mdc.chat_template or tokenizer.chat_template or DEFAULT_CHAT_TEMPLATE
+        )
+        env = jinja2.Environment(autoescape=False, keep_trailing_newline=True)
+        env.globals["raise_exception"] = _jinja_raise
+        self._template = env.from_string(template)
+
+    # -- chat ---------------------------------------------------------------- #
+
+    def apply_template(self, messages: List[Dict[str, Any]],
+                       tools: Optional[list] = None,
+                       add_generation_prompt: bool = True) -> str:
+        for m in messages:
+            if not isinstance(m, dict) or "role" not in m:
+                raise RequestError("each message needs a 'role'")
+        try:
+            return self._template.render(
+                messages=_normalize_messages(messages),
+                tools=tools,
+                add_generation_prompt=add_generation_prompt,
+                bos_token="",
+                eos_token="",
+            )
+        except jinja2.TemplateError as e:
+            raise RequestError(f"chat template failed: {e}") from e
+
+    def preprocess_chat(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        messages = request.get("messages")
+        if not messages:
+            raise RequestError("'messages' must be a non-empty list")
+        prompt = self.apply_template(messages, tools=request.get("tools"))
+        token_ids = self.tokenizer.encode(prompt)
+        if self.tokenizer.bos_token_id is not None and (
+            not token_ids or token_ids[0] != self.tokenizer.bos_token_id
+        ):
+            token_ids = [self.tokenizer.bos_token_id] + token_ids
+        return self._finish(request, token_ids, prompt)
+
+    # -- completions --------------------------------------------------------- #
+
+    def preprocess_completion(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        prompt = request.get("prompt")
+        if prompt is None:
+            raise RequestError("'prompt' is required")
+        if isinstance(prompt, list) and prompt and isinstance(prompt[0], int):
+            token_ids = list(prompt)  # pre-tokenized input
+            prompt = None
+        elif isinstance(prompt, str):
+            token_ids = self.tokenizer.encode(prompt)
+        else:
+            raise RequestError("'prompt' must be a string or token array")
+        return self._finish(request, token_ids, prompt)
+
+    # -- shared -------------------------------------------------------------- #
+
+    def _finish(self, request: Dict[str, Any], token_ids: List[int],
+                prompt: Optional[str]) -> Dict[str, Any]:
+        if len(token_ids) >= self.mdc.context_length:
+            raise RequestError(
+                f"prompt is {len(token_ids)} tokens; model context is "
+                f"{self.mdc.context_length}"
+            )
+        max_tokens = request.get("max_completion_tokens") or request.get("max_tokens")
+        stop = request.get("stop")
+        if isinstance(stop, str):
+            stop = [stop]
+        stop = stop or []
+        if len(stop) > 4:
+            raise RequestError("at most 4 stop sequences")
+        nvext = request.get("nvext", {}) or {}
+        return {
+            "token_ids": token_ids,
+            "sampling_options": {
+                "temperature": request.get("temperature"),
+                "top_p": request.get("top_p"),
+                "top_k": request.get("top_k"),
+                "seed": request.get("seed"),
+                "frequency_penalty": request.get("frequency_penalty"),
+                "presence_penalty": request.get("presence_penalty"),
+                "logprobs": bool(request.get("logprobs")),
+                "n": request.get("n", 1),
+            },
+            "stop_conditions": {
+                "max_tokens": max_tokens,
+                "stop_sequences_text": stop,
+                "stop_token_ids": list(self.tokenizer.eos_token_ids),
+                "ignore_eos": bool(nvext.get("ignore_eos", False)),
+            },
+            "annotations": {"prompt": prompt} if nvext.get("annotations") else {},
+        }
+
+
+def _normalize_messages(messages: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Flatten OpenAI content-part arrays to plain strings (text parts only;
+    multimodal parts are rejected until the vision path lands)."""
+    out = []
+    for m in messages:
+        content = m.get("content")
+        if isinstance(content, list):
+            texts = []
+            for part in content:
+                if isinstance(part, dict) and part.get("type") == "text":
+                    texts.append(part.get("text", ""))
+                else:
+                    raise RequestError(
+                        "only text content parts are supported"
+                    )
+            content = "".join(texts)
+        out.append({**m, "content": content or ""})
+    return out
+
+
+def _jinja_raise(msg):
+    raise jinja2.TemplateError(msg)

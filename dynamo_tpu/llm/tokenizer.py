@@ -1,0 +1,123 @@
+"""Tokenizer wrapper + incremental detokenization.
+
+Wraps the HF `tokenizers` runtime (same library the reference wraps from
+Rust, /root/reference/lib/llm/src/tokenizers/hf.rs).  The incremental
+decoder keeps a sliding (prefix_offset, read_offset) window so multi-token
+unicode graphemes and sentencepiece space markers emit correctly as text
+deltas — the engine streams token ids; this turns them into clean text.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional, Sequence, Tuple
+
+from tokenizers import Tokenizer
+
+
+class HuggingFaceTokenizer:
+    def __init__(self, tok: Tokenizer, eos_token_ids: Optional[List[int]] = None,
+                 bos_token_id: Optional[int] = None,
+                 chat_template: Optional[str] = None):
+        self._tok = tok
+        self.eos_token_ids = eos_token_ids or []
+        self.bos_token_id = bos_token_id
+        self.chat_template = chat_template
+
+    # -- construction -------------------------------------------------------- #
+
+    @staticmethod
+    def from_pretrained(path: str) -> "HuggingFaceTokenizer":
+        """Load from an HF checkpoint dir (tokenizer.json + configs)."""
+        tok = Tokenizer.from_file(os.path.join(path, "tokenizer.json"))
+        eos_ids: List[int] = []
+        bos_id: Optional[int] = None
+        chat_template: Optional[str] = None
+        cfg_path = os.path.join(path, "tokenizer_config.json")
+        if os.path.exists(cfg_path):
+            with open(cfg_path) as f:
+                cfg = json.load(f)
+            chat_template = cfg.get("chat_template")
+
+            def tok_id(entry):
+                if entry is None:
+                    return None
+                content = entry["content"] if isinstance(entry, dict) else entry
+                return tok.token_to_id(content)
+
+            eid = tok_id(cfg.get("eos_token"))
+            if eid is not None:
+                eos_ids.append(eid)
+            bos_id = tok_id(cfg.get("bos_token"))
+        gen_path = os.path.join(path, "generation_config.json")
+        if os.path.exists(gen_path):
+            with open(gen_path) as f:
+                gcfg = json.load(f)
+            g_eos = gcfg.get("eos_token_id")
+            if isinstance(g_eos, int):
+                g_eos = [g_eos]
+            for e in g_eos or []:
+                if e not in eos_ids:
+                    eos_ids.append(e)
+        return HuggingFaceTokenizer(tok, eos_ids, bos_id, chat_template)
+
+    @staticmethod
+    def from_json_str(data: str, **kw) -> "HuggingFaceTokenizer":
+        return HuggingFaceTokenizer(Tokenizer.from_str(data), **kw)
+
+    def to_json_str(self) -> str:
+        return self._tok.to_str()
+
+    # -- encode/decode ------------------------------------------------------- #
+
+    def encode(self, text: str, add_special_tokens: bool = False) -> List[int]:
+        return self._tok.encode(text, add_special_tokens=add_special_tokens).ids
+
+    def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str:
+        return self._tok.decode(list(ids), skip_special_tokens=skip_special_tokens)
+
+    @property
+    def vocab_size(self) -> int:
+        return self._tok.get_vocab_size()
+
+    def token_to_id(self, token: str) -> Optional[int]:
+        return self._tok.token_to_id(token)
+
+
+class IncrementalDetokenizer:
+    """Streaming token→text converter (reference backend.rs:55 `Backend`
+    incremental detokenization; algorithm follows vLLM's
+    detokenize_incrementally)."""
+
+    def __init__(self, tokenizer: HuggingFaceTokenizer,
+                 prompt_ids: Optional[Sequence[int]] = None):
+        self._tok = tokenizer
+        # keep a short tail of prompt ids so the first generated token
+        # detokenizes with correct left context (spaces etc.)
+        tail = list(prompt_ids or [])[-6:]
+        self.ids: List[int] = tail
+        self.prefix_offset = 0
+        self.read_offset = len(tail)
+        self._prev_text = (
+            tokenizer.decode(tail, skip_special_tokens=False) if tail else ""
+        )
+
+    def push(self, token_id: int) -> str:
+        """Add one token; return the new text delta ('' if incomplete)."""
+        self.ids.append(token_id)
+        prefix = self._tok.decode(
+            self.ids[self.prefix_offset : self.read_offset],
+            skip_special_tokens=True,
+        )
+        full = self._tok.decode(
+            self.ids[self.prefix_offset :], skip_special_tokens=True
+        )
+        if full.endswith("�"):
+            # incomplete utf-8 sequence — wait for more tokens
+            return ""
+        delta = full[len(prefix):]
+        if delta:
+            self.prefix_offset = self.read_offset
+            self.read_offset = len(self.ids)
+        return delta

@@ -1,0 +1,5 @@
+"""Engine simulator for hardware-free testing of routing/disagg/planner."""
+
+from .engine import MockEngine, MockEngineArgs
+
+__all__ = ["MockEngine", "MockEngineArgs"]

@@ -1,0 +1,246 @@
+"""MockEngine — a full engine simulator (no device).
+
+The reference treats its mocker as load-bearing infrastructure
+(/root/reference/lib/llm/src/mocker/: vLLM simulator with paged KV manager,
+watermark scheduler, chunked prefill, preemption, realistic timing, real KV
+events) because it is what makes router/disagg/planner logic testable at
+scale without hardware.  Ours reuses the *real* scheduler and page pool from
+the JAX engine — so the simulation exercises exactly the code that runs on
+TPU — and only fakes the device step with a timing model:
+
+    prefill_time = base + per_token * chunk + quadratic * chunk * context
+    decode_time  = base + per_seq * batch_size        (all / speedup_ratio)
+
+Generated tokens are a deterministic hash of (request seed, position), so
+tests can assert determinism across topologies.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import logging
+import struct
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Callable, Dict, List, Optional
+
+from ..engine.config import EngineConfig
+from ..engine.engine import ForwardPassMetrics, _opts_from_request
+from ..engine.page_pool import KvEvent, PagePool
+from ..engine.scheduler import PrefillItem, Scheduler, Sequence
+from ..runtime.engine import Context
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class MockEngineArgs:
+    """Timing + capacity knobs (reference mocker/protocols.rs MockEngineArgs)."""
+
+    num_pages: int = 512
+    page_size: int = 16
+    max_num_seqs: int = 16
+    max_prefill_tokens: int = 512
+    max_model_len: int = 4096
+    enable_prefix_caching: bool = True
+    watermark: float = 0.05
+    speedup_ratio: float = 1.0  # >1 → faster than real time
+    # timing model (seconds)
+    prefill_base: float = 0.002
+    prefill_per_token: float = 0.00005
+    prefill_quadratic: float = 1e-9
+    decode_base: float = 0.004
+    decode_per_seq: float = 0.0002
+    vocab_size: int = 32000
+    eos_token_id: int = 2
+    eos_probability: float = 0.0  # chance a generated token is EOS
+
+    def engine_config(self) -> EngineConfig:
+        return EngineConfig(
+            page_size=self.page_size,
+            num_pages=self.num_pages,
+            max_num_seqs=self.max_num_seqs,
+            max_prefill_tokens=self.max_prefill_tokens,
+            max_model_len=self.max_model_len,
+            enable_prefix_caching=self.enable_prefix_caching,
+            watermark=self.watermark,
+        )
+
+
+def _mock_token(seed: int, position: int, vocab: int, eos: int,
+                eos_prob: float) -> int:
+    h = hashlib.blake2b(struct.pack("<QQ", seed, position), digest_size=8)
+    v = struct.unpack("<Q", h.digest())[0]
+    if eos_prob > 0 and (v % 10_000) < eos_prob * 10_000:
+        return eos
+    tok = v % vocab
+    return tok if tok != eos else (tok + 1) % vocab
+
+
+class MockEngine:
+    """Drop-in AsyncEngine with the JaxEngine's exact scheduling behavior."""
+
+    def __init__(self, args: Optional[MockEngineArgs] = None,
+                 event_sink: Optional[Callable[[KvEvent], None]] = None):
+        self.args = args or MockEngineArgs()
+        self.cfg = self.args.engine_config()
+        self._event_sinks: List[Callable[[KvEvent], None]] = (
+            [event_sink] if event_sink else []
+        )
+        self.pool = PagePool(
+            self.cfg.num_pages, self.cfg.page_size, event_sink=self._emit
+        )
+        self.scheduler = Scheduler(self.cfg, self.pool)
+        self._queues: Dict[str, asyncio.Queue] = {}
+        self._contexts: Dict[str, Context] = {}
+        self._wake = asyncio.Event()
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._pump_task: Optional[asyncio.Task] = None
+        self._closed = False
+        self._requests_total = 0
+        self.step_log: List[str] = []  # for tests: sequence of step kinds
+
+    def _emit(self, ev: KvEvent) -> None:
+        for sink in self._event_sinks:
+            try:
+                sink(ev)
+            except Exception:  # noqa: BLE001
+                logger.exception("kv event sink failed")
+
+    def add_event_sink(self, sink: Callable[[KvEvent], None]) -> None:
+        self._event_sinks.append(sink)
+
+    def metrics(self) -> ForwardPassMetrics:
+        running, waiting = self.scheduler.num_requests()
+        return ForwardPassMetrics(
+            active_seqs=running,
+            waiting_seqs=waiting,
+            kv_usage=self.pool.usage(),
+            kv_total_pages=self.cfg.usable_pages,
+            num_requests_total=self._requests_total,
+        )
+
+    def clear_kv_blocks(self) -> int:
+        return self.pool.clear_cache()
+
+    # -- AsyncEngine --------------------------------------------------------- #
+
+    async def generate(self, request: Dict[str, Any],
+                       context: Optional[Context] = None
+                       ) -> AsyncIterator[Dict[str, Any]]:
+        context = context or Context()
+        if self._pump_task is None or self._pump_task.done():
+            self._loop = asyncio.get_running_loop()
+            self._pump_task = self._loop.create_task(self._pump())
+        opts = _opts_from_request(request)
+        prompt = list(request["token_ids"])
+        if not prompt:
+            yield {"token_ids": [], "finish_reason": "error",
+                   "error": "empty prompt"}
+            return
+        if opts.max_tokens <= 0:
+            yield {"token_ids": [], "finish_reason": "length"}
+            return
+        seq = Sequence(context.id, prompt, opts)
+        seq.seed = opts.seed if opts.seed is not None else (
+            struct.unpack("<Q", hashlib.blake2b(
+                context.id.encode(), digest_size=8).digest())[0]
+        )
+        queue: asyncio.Queue = asyncio.Queue()
+        self._queues[context.id] = queue
+        self._contexts[context.id] = context
+        self._requests_total += 1
+        self.scheduler.add(seq)
+        self._wake.set()
+        killed = asyncio.create_task(context.killed())
+        try:
+            while True:
+                get = asyncio.create_task(queue.get())
+                done, _ = await asyncio.wait(
+                    {get, killed}, return_when=asyncio.FIRST_COMPLETED
+                )
+                if get not in done:
+                    get.cancel()
+                    self.scheduler.abort(context.id)
+                    return
+                out = get.result()
+                if out is None:
+                    return
+                yield out
+                if out.get("finish_reason"):
+                    return
+        finally:
+            killed.cancel()
+            self._queues.pop(context.id, None)
+            self._contexts.pop(context.id, None)
+
+    async def shutdown(self) -> None:
+        self._closed = True
+        self._wake.set()
+        if self._pump_task:
+            await asyncio.gather(self._pump_task, return_exceptions=True)
+
+    # -- pump ---------------------------------------------------------------- #
+
+    async def _pump(self) -> None:
+        while not self._closed:
+            plan = self.scheduler.schedule()
+            if plan.kind == "idle":
+                if not self.scheduler.has_work:
+                    self._wake.clear()
+                    await self._wake.wait()
+                else:
+                    await asyncio.sleep(0.001)
+                continue
+            self.step_log.append(plan.kind)
+            if plan.kind == "prefill":
+                await self._run_prefill(plan.prefill)
+            else:
+                await self._run_decode(plan.decode)
+            await asyncio.sleep(0)
+
+    async def _run_prefill(self, items: List[PrefillItem]) -> None:
+        a = self.args
+        total = sum(it.chunk_len for it in items)
+        ctx_tokens = sum(it.seq.num_computed for it in items)
+        t = (
+            a.prefill_base
+            + a.prefill_per_token * total
+            + a.prefill_quadratic * total * ctx_tokens
+        ) / a.speedup_ratio
+        await asyncio.sleep(t)
+        for it in items:
+            s = it.seq
+            if s.status != "running":
+                continue
+            s.num_computed += it.chunk_len
+            self.scheduler.commit_full_pages(s)
+            if it.samples:
+                self._append(s, _mock_token(
+                    s.seed, len(s.output_tokens), a.vocab_size,
+                    a.eos_token_id, a.eos_probability,
+                ))
+
+    async def _run_decode(self, seqs: List[Sequence]) -> None:
+        a = self.args
+        t = (a.decode_base + a.decode_per_seq * len(seqs)) / a.speedup_ratio
+        await asyncio.sleep(t)
+        for s in seqs:
+            if s.status != "running":
+                continue
+            s.num_computed += 1
+            self.scheduler.commit_full_pages(s)
+            self._append(s, _mock_token(
+                s.seed, len(s.output_tokens), a.vocab_size,
+                a.eos_token_id, a.eos_probability,
+            ))
+
+    def _append(self, seq: Sequence, token: int) -> None:
+        seq.output_tokens.append(token)
+        eos = [] if seq.opts.ignore_eos else [self.args.eos_token_id]
+        reason = self.scheduler.check_stop(seq, eos)
+        if reason:
+            self.scheduler.finish(seq, reason)
+        queue = self._queues.get(seq.request_id)
+        if queue is not None:
+            queue.put_nowait({"token_ids": [token], "finish_reason": reason})

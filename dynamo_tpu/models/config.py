@@ -10,9 +10,8 @@ building its ModelDeploymentCard, /root/reference/lib/llm/src/model_card.rs:118)
 from __future__ import annotations
 
 import json
-import math
 import os
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional
 
 

@@ -1,0 +1,122 @@
+"""HF checkpoint loader: safetensors → the stacked-layer param pytree.
+
+Maps HF llama/mistral/mixtral weight names onto the scan-friendly layout of
+`llama.init_params` (per-layer arrays stacked on axis 0, projections stored
+input-major so forward einsums are transpose-free).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List
+
+import jax.numpy as jnp
+import numpy as np
+
+from .config import ModelConfig
+
+try:
+    from safetensors import safe_open
+except ImportError:  # pragma: no cover
+    safe_open = None
+
+
+def _index(path: str) -> Dict[str, str]:
+    """weight name → shard file."""
+    idx_path = os.path.join(path, "model.safetensors.index.json")
+    if os.path.exists(idx_path):
+        with open(idx_path) as f:
+            return json.load(f)["weight_map"]
+    single = os.path.join(path, "model.safetensors")
+    if not os.path.exists(single):
+        raise FileNotFoundError(f"no safetensors checkpoint in {path}")
+    # build the map lazily from the single file
+    with safe_open(single, framework="np") as f:
+        return {k: "model.safetensors" for k in f.keys()}
+
+
+class _ShardReader:
+    def __init__(self, path: str):
+        self.path = path
+        self.weight_map = _index(path)
+        self._open: Dict[str, object] = {}
+
+    def get(self, name: str) -> np.ndarray:
+        shard = self.weight_map[name]
+        if shard not in self._open:
+            self._open[shard] = safe_open(
+                os.path.join(self.path, shard), framework="np"
+            )
+        return self._open[shard].get_tensor(name)
+
+    def has(self, name: str) -> bool:
+        return name in self.weight_map
+
+
+def load_params(path: str, cfg: ModelConfig, dtype=jnp.bfloat16):
+    """Load HF weights into the stacked pytree (host RAM → device on first
+    use; callers shard with jax.device_put + NamedSharding)."""
+    if safe_open is None:
+        raise RuntimeError("safetensors not available")
+    r = _ShardReader(path)
+    L = cfg.num_hidden_layers
+
+    def stack(fmt: str, transpose: bool = True) -> jnp.ndarray:
+        mats: List[np.ndarray] = []
+        for i in range(L):
+            w = r.get(fmt.format(i=i))
+            mats.append(w.T if transpose else w)
+        return jnp.asarray(np.stack(mats), dtype)
+
+    p = "model.layers.{i}."
+    layers = {
+        "wq": stack(p + "self_attn.q_proj.weight"),
+        "wk": stack(p + "self_attn.k_proj.weight"),
+        "wv": stack(p + "self_attn.v_proj.weight"),
+        "wo": stack(p + "self_attn.o_proj.weight"),
+        "attn_norm": stack(p + "input_layernorm.weight", transpose=False),
+        "mlp_norm": stack(p + "post_attention_layernorm.weight", transpose=False),
+    }
+    if cfg.is_moe:
+        E = cfg.num_experts
+
+        def stack_experts(sub: str) -> jnp.ndarray:
+            out = []
+            for i in range(L):
+                per = [
+                    r.get(
+                        f"model.layers.{i}.block_sparse_moe.experts.{e}.{sub}.weight"
+                    ).T
+                    for e in range(E)
+                ]
+                out.append(np.stack(per))
+            return jnp.asarray(np.stack(out), dtype)
+
+        layers.update(
+            {
+                "router": stack(p + "block_sparse_moe.gate.weight"),
+                "w_gate": stack_experts("w1"),
+                "w_down": stack_experts("w2"),
+                "w_up": stack_experts("w3"),
+            }
+        )
+    else:
+        layers.update(
+            {
+                "w_gate": stack(p + "mlp.gate_proj.weight"),
+                "w_up": stack(p + "mlp.up_proj.weight"),
+                "w_down": stack(p + "mlp.down_proj.weight"),
+            }
+        )
+    params = {
+        "embed": jnp.asarray(r.get("model.embed_tokens.weight"), dtype),
+        "final_norm": jnp.asarray(r.get("model.norm.weight"), dtype),
+        "layers": layers,
+    }
+    if not cfg.tie_word_embeddings:
+        if r.has("lm_head.weight"):
+            params["lm_head"] = jnp.asarray(r.get("lm_head.weight").T, dtype)
+        else:
+            params["lm_head"] = params["embed"].T
+    return params

@@ -7,7 +7,6 @@ back (precision matters for long context).
 from __future__ import annotations
 
 import math
-from functools import partial
 from typing import Optional
 
 import jax

@@ -33,9 +33,15 @@ class SamplingParams(NamedTuple):
 def sample_tokens(
     logits: jax.Array,  # [B, V] float
     params: SamplingParams,
-    key: jax.Array,
+    seeds: jax.Array,  # [B] uint32 — per-request sampling seed
+    counters: jax.Array,  # [B] int32 — tokens generated so far (stream position)
 ) -> jax.Array:
-    """Sample one token per row. Greedy rows (temperature==0) take argmax."""
+    """Sample one token per row. Greedy rows (temperature==0) take argmax.
+
+    Each row draws from its own PRNG stream keyed by (seed, counter), so a
+    request with an explicit seed is reproducible regardless of how it was
+    batched with other requests.
+    """
     B, V = logits.shape
     logits = logits.astype(jnp.float32)
     greedy = jnp.argmax(logits, axis=-1)
@@ -55,8 +61,10 @@ def sample_tokens(
     # top-p: smallest prefix of the sorted distribution with mass >= p.
     sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
     cum = jnp.cumsum(sorted_probs, axis=-1)
-    # keep positions whose *previous* cumulative mass is < p
+    # keep positions whose *previous* cumulative mass is < p; always keep
+    # the argmax so top_p <= 0 degrades to greedy rather than masking all
     keep_sorted = (cum - sorted_probs) < params.top_p[:, None]
+    keep_sorted = keep_sorted.at[:, 0].set(True)
     # threshold value = smallest kept logit per row
     thresh = jnp.min(
         jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
@@ -64,7 +72,10 @@ def sample_tokens(
     topp_mask = scaled < thresh
 
     masked = jnp.where(topk_mask | topp_mask, -jnp.inf, scaled)
-    sampled = jax.random.categorical(key, masked, axis=-1)
+    keys = jax.vmap(
+        lambda s, c: jax.random.fold_in(jax.random.PRNGKey(s), c)
+    )(seeds, counters)
+    sampled = jax.vmap(jax.random.categorical)(keys, masked)
     return jnp.where(params.temperature <= 0.0, greedy, sampled)
 
 

@@ -1,0 +1,39 @@
+"""Control-plane server CLI: `python -m dynamo_tpu.runtime [--port N]`.
+
+The single infrastructure process of a deployment (plays the role of
+etcd + NATS in the reference stack: discovery/leases, pub/sub, durable
+streams, object store, work queues — SURVEY.md §2.6).
+"""
+
+import argparse
+import asyncio
+import logging
+import signal
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description="dynamo-tpu control plane")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=6380)
+    ap.add_argument("--log-level", default="info")
+    args = ap.parse_args()
+    logging.basicConfig(level=args.log_level.upper(),
+                        format="%(asctime)s %(levelname)s %(name)s %(message)s")
+    asyncio.run(_run(args))
+
+
+async def _run(args) -> None:
+    from .transport.control_plane import ControlPlaneServer
+
+    server = await ControlPlaneServer(host=args.host, port=args.port).start()
+    print(f"READY {server.address}", flush=True)
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    await server.stop()
+
+
+if __name__ == "__main__":
+    main()

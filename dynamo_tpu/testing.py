@@ -34,6 +34,37 @@ async def local_runtime() -> AsyncIterator[DistributedRuntime]:
         await rt.shutdown(graceful=False)
 
 
+def tiny_tokenizer():
+    """A real (trained) byte-level BPE tokenizer for tests — no downloads.
+
+    Trained on a fixed corpus so ids are stable across runs.  Vocab is the
+    260-symbol floor (256 byte alphabet + 4 specials); size the paired
+    model's vocab from ``tok.vocab_size``, never a constant.
+    """
+    from tokenizers import Tokenizer, models, pre_tokenizers, decoders, trainers
+
+    from .llm.tokenizer import HuggingFaceTokenizer
+
+    tok = Tokenizer(models.BPE(unk_token=None))
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tok.decoder = decoders.ByteLevel()
+    trainer = trainers.BpeTrainer(
+        vocab_size=260,
+        special_tokens=["<|endoftext|>", "<|user|>", "<|assistant|>", "<|system|>"],
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet(),
+        show_progress=False,
+    )
+    corpus = [
+        "the quick brown fox jumps over the lazy dog",
+        "hello world, how are you today?",
+        "paged attention on tpu with jax and pallas",
+        "0123456789 !@#$%^&*()",
+    ]
+    tok.train_from_iterator(corpus, trainer)
+    eos = tok.token_to_id("<|endoftext|>")
+    return HuggingFaceTokenizer(tok, eos_token_ids=[eos])
+
+
 @contextlib.asynccontextmanager
 async def local_cluster(n: int = 1):
     """A control plane + n runtimes (simulating n worker processes)."""

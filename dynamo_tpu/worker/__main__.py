@@ -1,0 +1,143 @@
+"""Worker CLI: `python -m dynamo_tpu.worker --control HOST:PORT --model ...`.
+
+The analog of `python -m dynamo.vllm`
+(/root/reference/components/src/dynamo/vllm/main.py), except the engine is
+first-party JAX.  `--model tiny` builds the deterministic test model +
+tokenizer in-process (no downloads); `--mock` runs the MockEngine simulator
+(the analog of `python -m dynamo.mocker`).
+"""
+
+import argparse
+import asyncio
+import logging
+import signal
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description="dynamo-tpu JAX worker")
+    ap.add_argument("--control", required=True)
+    ap.add_argument("--model", default="tiny",
+                    help="HF checkpoint dir, or 'tiny' for the test model")
+    ap.add_argument("--model-name", default=None)
+    ap.add_argument("--namespace", default="dynamo")
+    ap.add_argument("--component", default="backend")
+    ap.add_argument("--endpoint", default="generate")
+    ap.add_argument("--mock", action="store_true", help="MockEngine simulator")
+    ap.add_argument("--page-size", type=int, default=16)
+    ap.add_argument("--num-pages", type=int, default=2048)
+    ap.add_argument("--max-num-seqs", type=int, default=16)
+    ap.add_argument("--max-prefill-tokens", type=int, default=512)
+    ap.add_argument("--max-model-len", type=int, default=4096)
+    ap.add_argument("--dtype", default="bfloat16",
+                    choices=["bfloat16", "float32"])
+    ap.add_argument("--disagg-role", default="both",
+                    choices=["both", "prefill", "decode"])
+    ap.add_argument("--log-level", default="info")
+    args = ap.parse_args()
+    logging.basicConfig(level=args.log_level.upper(),
+                        format="%(asctime)s %(levelname)s %(name)s %(message)s")
+    asyncio.run(_run(args))
+
+
+async def _run(args) -> None:
+    from ..llm import ModelDeploymentCard
+    from ..runtime import DistributedRuntime
+    from . import serve_engine
+
+    # build the engine BEFORE taking a lease: model load / first compile can
+    # block for longer than the lease TTL
+    engine, mdc = _build_engine(args)
+    runtime = await DistributedRuntime.connect(args.control)
+    await serve_engine(
+        runtime, engine, mdc,
+        namespace=args.namespace, component=args.component,
+        endpoint=args.endpoint,
+    )
+    print(f"READY worker {mdc.name}", flush=True)
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    await runtime.shutdown()
+    if hasattr(engine, "shutdown"):
+        await engine.shutdown()
+
+
+def _build_engine(args):
+    from ..engine import EngineConfig
+    from ..llm import ModelDeploymentCard
+
+    ecfg = EngineConfig(
+        page_size=args.page_size,
+        num_pages=args.num_pages,
+        max_num_seqs=args.max_num_seqs,
+        max_prefill_tokens=args.max_prefill_tokens,
+        max_model_len=args.max_model_len,
+    )
+    if args.mock:
+        from ..mocker import MockEngine, MockEngineArgs
+
+        margs = MockEngineArgs(
+            num_pages=args.num_pages,
+            page_size=args.page_size,
+            max_num_seqs=args.max_num_seqs,
+            max_prefill_tokens=args.max_prefill_tokens,
+            max_model_len=args.max_model_len,
+            speedup_ratio=10.0,
+        )
+        engine = MockEngine(margs)
+        from ..testing import tiny_tokenizer
+
+        tok = tiny_tokenizer()
+        mdc = ModelDeploymentCard(
+            name=args.model_name or "mock-model",
+            tokenizer_json=tok.to_json_str(),
+            eos_token_ids=[margs.eos_token_id],
+            context_length=args.max_model_len,
+            disagg_role=args.disagg_role,
+        )
+        return engine, mdc
+
+    import jax.numpy as jnp
+
+    from ..engine import JaxEngine
+
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    if args.model == "tiny":
+        import jax
+
+        from ..models import init_params, tiny_config
+        from ..testing import tiny_tokenizer
+
+        tok = tiny_tokenizer()
+        cfg = tiny_config(vocab_size=tok.vocab_size)
+        params = init_params(cfg, jax.random.PRNGKey(0), dtype=dtype)
+        name = args.model_name or "tiny-chat"
+        tokenizer_json = tok.to_json_str()
+        eos = list(tok.eos_token_ids)
+    else:
+        from ..llm import HuggingFaceTokenizer
+        from ..models import ModelConfig
+        from ..models.loader import load_params
+
+        cfg = ModelConfig.from_pretrained(args.model)
+        params = load_params(args.model, cfg, dtype=dtype)
+        tok = HuggingFaceTokenizer.from_pretrained(args.model)
+        name = args.model_name or cfg.name
+        tokenizer_json = tok.to_json_str()
+        eos = list(tok.eos_token_ids)
+
+    engine = JaxEngine(cfg, params, ecfg, eos_token_ids=eos, kv_dtype=dtype)
+    mdc = ModelDeploymentCard(
+        name=name,
+        tokenizer_json=tokenizer_json,
+        eos_token_ids=eos,
+        context_length=args.max_model_len,
+        disagg_role=args.disagg_role,
+    )
+    return engine, mdc
+
+
+if __name__ == "__main__":
+    main()

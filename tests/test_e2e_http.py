@@ -1,0 +1,165 @@
+"""M1 end-to-end: HTTP frontend → discovery → routed pipeline → JAX engine.
+
+The full serving path with a real (tiny) model and a real tokenizer over
+real sockets, single process: the milestone the reference treats as
+"dynamo serve with one worker".
+"""
+
+import asyncio
+import json
+
+import aiohttp
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dynamo_tpu.engine import EngineConfig, JaxEngine
+from dynamo_tpu.frontend import HttpService, ModelManager, ModelWatcher
+from dynamo_tpu.llm import ModelDeploymentCard
+from dynamo_tpu.models import init_params, tiny_config
+from dynamo_tpu.runtime import ControlPlaneServer, DistributedRuntime
+from dynamo_tpu.testing import tiny_tokenizer
+from dynamo_tpu.worker import serve_engine
+
+
+@pytest.fixture(scope="module")
+def model_setup():
+    tok = tiny_tokenizer()
+    cfg = tiny_config(vocab_size=tok.vocab_size)
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    return tok, cfg, params
+
+
+async def start_stack(model_setup):
+    """standalone control plane + worker runtime + frontend runtime."""
+    tok, cfg, params = model_setup
+    control = await ControlPlaneServer().start()
+    worker_rt = await DistributedRuntime.connect(control.address)
+    engine = JaxEngine(
+        cfg,
+        params,
+        EngineConfig(page_size=8, num_pages=128, max_num_seqs=4,
+                     max_prefill_tokens=64, max_model_len=256),
+        eos_token_ids=list(tok.eos_token_ids),
+        kv_dtype=jnp.float32,
+    )
+    mdc = ModelDeploymentCard(
+        name="tiny-chat",
+        tokenizer_json=tok.to_json_str(),
+        eos_token_ids=list(tok.eos_token_ids),
+    )
+    await serve_engine(worker_rt, engine, mdc)
+
+    front_rt = await DistributedRuntime.connect(control.address)
+    manager = ModelManager()
+    watcher = await ModelWatcher(front_rt, manager).start()
+    await watcher.wait_for_model("tiny-chat")
+    http = await HttpService(manager, host="127.0.0.1", port=0).start()
+    return control, worker_rt, front_rt, engine, watcher, http
+
+
+async def stop_stack(control, worker_rt, front_rt, engine, watcher, http):
+    await http.stop()
+    await watcher.stop()
+    await engine.shutdown()
+    await front_rt.shutdown(graceful=False)
+    await worker_rt.shutdown(graceful=False)
+    await control.stop()
+
+
+async def test_e2e_chat_and_completion(model_setup):
+    control, worker_rt, front_rt, engine, watcher, http = await start_stack(model_setup)
+    base = f"http://127.0.0.1:{http.port}"
+    try:
+        async with aiohttp.ClientSession() as session:
+            # model listing
+            async with session.get(f"{base}/v1/models") as r:
+                models = await r.json()
+            assert [m["id"] for m in models["data"]] == ["tiny-chat"]
+
+            # unary chat
+            req = {
+                "model": "tiny-chat",
+                "messages": [{"role": "user", "content": "hello world"}],
+                "max_tokens": 8,
+                "temperature": 0,
+                "nvext": {"ignore_eos": True},
+            }
+            async with session.post(f"{base}/v1/chat/completions", json=req) as r:
+                assert r.status == 200, await r.text()
+                out = await r.json()
+            assert out["object"] == "chat.completion"
+            assert out["usage"]["completion_tokens"] == 8
+            assert out["choices"][0]["message"]["role"] == "assistant"
+            unary_text = out["choices"][0]["message"]["content"]
+
+            # streaming chat must produce the same greedy text
+            req["stream"] = True
+            chunks = []
+            async with session.post(f"{base}/v1/chat/completions", json=req) as r:
+                assert r.status == 200
+                async for line in r.content:
+                    line = line.decode().strip()
+                    if line.startswith("data: ") and line != "data: [DONE]":
+                        chunks.append(json.loads(line[6:]))
+            text = "".join(
+                c["choices"][0]["delta"].get("content", "")
+                for c in chunks
+                if "choices" in c
+            )
+            assert text == unary_text
+            assert chunks[-1]["choices"][0]["finish_reason"] == "length"
+
+            # completions endpoint
+            creq = {
+                "model": "tiny-chat",
+                "prompt": "the quick brown",
+                "max_tokens": 4,
+                "temperature": 0,
+                "nvext": {"ignore_eos": True},
+            }
+            async with session.post(f"{base}/v1/completions", json=creq) as r:
+                assert r.status == 200
+                cout = await r.json()
+            assert cout["object"] == "text_completion"
+            assert cout["usage"]["completion_tokens"] == 4
+
+            # error paths
+            async with session.post(
+                f"{base}/v1/chat/completions",
+                json={"model": "nope", "messages": [{"role": "user", "content": "x"}]},
+            ) as r:
+                assert r.status == 404
+            async with session.post(
+                f"{base}/v1/chat/completions",
+                json={"model": "tiny-chat", "messages": []},
+            ) as r:
+                assert r.status == 400
+
+            # metrics exposition
+            async with session.get(f"{base}/metrics") as r:
+                body = await r.text()
+            assert "dynamo_frontend_requests_total" in body
+            # health
+            async with session.get(f"{base}/health") as r:
+                h = await r.json()
+            assert h["models"] == ["tiny-chat"]
+    finally:
+        await stop_stack(control, worker_rt, front_rt, engine, watcher, http)
+
+
+async def test_e2e_worker_removal(model_setup):
+    """Killing the worker's lease must remove the model from the frontend."""
+    control, worker_rt, front_rt, engine, watcher, http = await start_stack(model_setup)
+    try:
+        await worker_rt.shutdown(graceful=False)
+        deadline = asyncio.get_running_loop().time() + 10
+        while watcher.manager.get("tiny-chat") is not None:
+            assert asyncio.get_running_loop().time() < deadline, "not removed"
+            await asyncio.sleep(0.1)
+    finally:
+        await http.stop()
+        await watcher.stop()
+        await engine.shutdown()
+        await front_rt.shutdown(graceful=False)
+        await control.stop()

@@ -1,0 +1,103 @@
+"""Preprocessor / detokenizer / postprocessor tests."""
+
+import pytest
+
+from dynamo_tpu.llm import (
+    ModelDeploymentCard,
+    OpenAIPreprocessor,
+    RequestError,
+    StreamPostprocessor,
+)
+from dynamo_tpu.llm.tokenizer import IncrementalDetokenizer
+from dynamo_tpu.testing import tiny_tokenizer
+
+
+@pytest.fixture(scope="module")
+def tok():
+    return tiny_tokenizer()
+
+
+@pytest.fixture(scope="module")
+def pre(tok):
+    mdc = ModelDeploymentCard(name="tiny", context_length=512)
+    return OpenAIPreprocessor(mdc, tok)
+
+
+def test_roundtrip(tok):
+    text = "hello world, how are you?"
+    ids = tok.encode(text)
+    assert tok.decode(ids) == text
+
+
+def test_incremental_detok_matches_full(tok):
+    text = "the quick brown fox jumps over the lazy dog!"
+    ids = tok.encode(text)
+    detok = IncrementalDetokenizer(tok)
+    out = "".join(detok.push(t) for t in ids)
+    assert out == text
+
+
+def test_chat_preprocess(pre, tok):
+    req = {
+        "messages": [
+            {"role": "system", "content": "be brief"},
+            {"role": "user", "content": "hello world"},
+        ],
+        "max_tokens": 5,
+        "temperature": 0.5,
+        "stop": "##",
+    }
+    out = pre.preprocess_chat(req)
+    assert out["stop_conditions"]["max_tokens"] == 5
+    assert out["stop_conditions"]["stop_sequences_text"] == ["##"]
+    assert out["sampling_options"]["temperature"] == 0.5
+    text = tok.decode(out["token_ids"], skip_special_tokens=False)
+    assert "hello world" in text
+    assert "be brief" in text
+
+
+def test_chat_content_parts(pre):
+    req = {
+        "messages": [
+            {"role": "user", "content": [{"type": "text", "text": "hi"}]}
+        ]
+    }
+    out = pre.preprocess_chat(req)
+    assert out["token_ids"]
+
+
+def test_chat_errors(pre):
+    with pytest.raises(RequestError):
+        pre.preprocess_chat({"messages": []})
+    with pytest.raises(RequestError):
+        pre.preprocess_chat({"messages": [{"content": "no role"}]})
+    with pytest.raises(RequestError):
+        pre.preprocess_completion({"prompt": "x", "stop": ["a"] * 5})
+
+
+def test_completion_token_array(pre):
+    out = pre.preprocess_completion({"prompt": [1, 2, 3]})
+    assert out["token_ids"] == [1, 2, 3]
+
+
+def test_prompt_too_long(pre):
+    with pytest.raises(RequestError):
+        pre.preprocess_completion({"prompt": "word " * 600})
+
+
+def test_stop_sequence_across_tokens(tok):
+    """Stop text straddling token boundaries must trim cleanly."""
+    ids = tok.encode("hello STOP world")
+    post = StreamPostprocessor(tok, stop_sequences=["STOP"])
+    out = "".join(post.push_tokens([t]) for t in ids)
+    out += post.flush()
+    assert out == "hello "
+    assert post.finished_by_stop == "STOP"
+
+
+def test_stop_holdback_released_when_not_matched(tok):
+    post = StreamPostprocessor(tok, stop_sequences=["XYZ"])
+    ids = tok.encode("abcX del")
+    out = "".join(post.push_tokens([t]) for t in ids) + post.flush()
+    assert out == "abcX del"
+    assert post.finished_by_stop is None

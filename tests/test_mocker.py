@@ -1,0 +1,94 @@
+"""MockEngine behavior: determinism, chunked prefill, KV events, preemption
+— the simulator the router/disagg/planner tests build on."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.mocker import MockEngine, MockEngineArgs
+
+
+def fast_args(**over):
+    base = dict(
+        num_pages=64,
+        page_size=8,
+        max_num_seqs=8,
+        max_prefill_tokens=32,
+        max_model_len=512,
+        speedup_ratio=100.0,
+    )
+    base.update(over)
+    return MockEngineArgs(**base)
+
+
+def req(tokens, max_tokens=8, rid_seed=None):
+    r = {
+        "token_ids": tokens,
+        "sampling_options": {},
+        "stop_conditions": {"max_tokens": max_tokens, "ignore_eos": True},
+    }
+    if rid_seed is not None:
+        r["sampling_options"]["seed"] = rid_seed
+    return r
+
+
+async def collect(engine, request):
+    out = []
+    async for delta in engine.generate(request):
+        out.extend(delta["token_ids"])
+        reason = delta["finish_reason"]
+    return out, reason
+
+
+async def test_deterministic_by_seed():
+    e = MockEngine(fast_args())
+    t1, r1 = await collect(e, req([1, 2, 3], max_tokens=6, rid_seed=7))
+    t2, _ = await collect(e, req([1, 2, 3], max_tokens=6, rid_seed=7))
+    t3, _ = await collect(e, req([1, 2, 3], max_tokens=6, rid_seed=8))
+    assert t1 == t2
+    assert t1 != t3
+    assert r1 == "length"
+    await e.shutdown()
+
+
+async def test_concurrent_load_and_events():
+    events = []
+    e = MockEngine(fast_args(), event_sink=events.append)
+    prompts = [[i] * 40 for i in range(1, 9)]
+    results = await asyncio.gather(
+        *[collect(e, req(p, max_tokens=16, rid_seed=i)) for i, p in enumerate(prompts)]
+    )
+    for toks, reason in results:
+        assert len(toks) == 16
+    stored = [ev for ev in events if ev.kind == "stored"]
+    assert stored, "prefix cache must emit stored events"
+    assert "prefill" in e.step_log and "decode" in e.step_log
+    m = e.metrics()
+    assert m.num_requests_total == 8
+    await e.shutdown()
+
+
+async def test_prefix_cache_speeds_up_second_request():
+    e = MockEngine(fast_args(speedup_ratio=1.0, prefill_per_token=0.002,
+                             decode_base=0.0005))
+    prompt = list(range(1, 33))
+    loop = asyncio.get_running_loop()
+    t0 = loop.time()
+    await collect(e, req(prompt, max_tokens=2, rid_seed=1))
+    cold = loop.time() - t0
+    t0 = loop.time()
+    await collect(e, req(prompt, max_tokens=2, rid_seed=1))
+    warm = loop.time() - t0
+    assert warm < cold * 0.7, (cold, warm)
+    await e.shutdown()
+
+
+async def test_eos_stops_generation():
+    e = MockEngine(fast_args(eos_probability=0.5))
+    r = req([1, 2, 3], max_tokens=64)
+    r["stop_conditions"]["ignore_eos"] = False
+    toks, reason = await collect(e, r)
+    assert reason in ("stop", "length")
+    if reason == "stop":
+        assert toks[-1] == e.args.eos_token_id
+    await e.shutdown()
