@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Benchmark: engine serving throughput on the flagship model (Llama-3.2-1B
+shapes, bf16, random weights) on the real chip.
+
+Protocol: 8 concurrent requests (prompt 128 tokens, 64 generated each)
+through the full JaxEngine (continuous batching, paged KV). One warmup
+round compiles; the measured round reports output tokens/second.
+
+Prints ONE JSON line {metric, value, unit, vs_baseline}. The reference
+publishes no absolute numbers (BASELINE.json.published is empty), so
+vs_baseline compares against the previous round's recording when present
+(BENCH_r*.json), else 1.0.
+"""
+
+import asyncio
+import glob
+import json
+import os
+import re
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BATCH = 8
+PROMPT_LEN = 128
+GEN_TOKENS = 64
+
+
+async def run_round(engine, seed_base):
+    async def one(i):
+        req = {
+            "token_ids": [((i * 7 + j) % 1000) + seed_base for j in range(PROMPT_LEN)],
+            "sampling_options": {"temperature": 0.0},
+            "stop_conditions": {"max_tokens": GEN_TOKENS, "ignore_eos": True},
+        }
+        n = 0
+        async for out in engine.generate(req):
+            n += len(out["token_ids"])
+        return n
+
+    t0 = time.perf_counter()
+    counts = await asyncio.gather(*[one(i) for i in range(BATCH)])
+    dt = time.perf_counter() - t0
+    return sum(counts), dt
+
+
+async def main_async():
+    import jax.numpy as jnp
+    import jax
+
+    from dynamo_tpu.engine import EngineConfig, JaxEngine
+    from dynamo_tpu.models import init_params
+    from dynamo_tpu.models.config import LLAMA_3_2_1B
+
+    cfg = LLAMA_3_2_1B
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
+    pages_per_seq = (PROMPT_LEN + GEN_TOKENS) // 16 + 1
+    ecfg = EngineConfig(
+        page_size=16,
+        num_pages=1 + BATCH * pages_per_seq + 32,
+        max_num_seqs=BATCH,
+        max_prefill_tokens=PROMPT_LEN,
+        max_model_len=PROMPT_LEN + GEN_TOKENS + 16,
+        decode_batch_buckets=[BATCH],
+        chunk_buckets=[PROMPT_LEN],
+        enable_prefix_caching=False,  # measure raw compute, not cache hits
+    )
+    engine = JaxEngine(cfg, params, ecfg, eos_token_ids=[])
+
+    # warmup (compiles prefill + decode)
+    await run_round(engine, seed_base=0)
+    # measure
+    total, dt = await run_round(engine, seed_base=5000)
+    await engine.shutdown()
+    return total, dt
+
+
+def previous_round_value():
+    best = None
+    for path in sorted(glob.glob("BENCH_r*.json")):
+        try:
+            with open(path) as f:
+                d = json.load(f)
+            if d.get("unit") == "tok/s":
+                best = d.get("value")
+        except (OSError, ValueError):
+            pass
+    return best
+
+
+def main():
+    total, dt = asyncio.run(main_async())
+    value = round(total / dt, 2)
+    prev = previous_round_value()
+    vs = round(value / prev, 3) if prev else 1.0
+    print(json.dumps({
+        "metric": "llama1b_serve_decode_throughput",
+        "value": value,
+        "unit": "tok/s",
+        "vs_baseline": vs,
+    }))
+
+
+if __name__ == "__main__":
+    main()

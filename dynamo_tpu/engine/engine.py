@@ -21,7 +21,6 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import threading
 import time
 from dataclasses import dataclass, field
 from functools import partial
@@ -115,7 +114,10 @@ class JaxEngine:
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._pump_task: Optional[asyncio.Task] = None
         self._closed = False
-        self._lock = threading.Lock()
+        # aborts are deferred to the pump loop so all scheduler/pool
+        # mutation happens strictly between device steps (the executor
+        # thread and the event loop never touch them concurrently)
+        self._pending_aborts: set[str] = set()
         self._requests_total = 0
         self._step_count = 0
 
@@ -163,6 +165,8 @@ class JaxEngine:
         max_prompt = min(
             self.cfg.max_model_len - 1,
             self.cfg.max_pages_per_seq * self.cfg.page_size - 1,
+            # must fit the pool even with everything else evicted
+            self.cfg.usable_pages * self.cfg.page_size - 1,
         )
         if not prompt or len(prompt) > max_prompt:
             yield {
@@ -185,6 +189,7 @@ class JaxEngine:
         self.scheduler.add(seq)
         self._wake.set()
         killed = asyncio.create_task(context.killed())
+        finished = False
         try:
             while True:
                 get = asyncio.create_task(queue.get())
@@ -193,20 +198,28 @@ class JaxEngine:
                 )
                 if get not in done:
                     get.cancel()
-                    self.scheduler.abort(context.id)
                     return
                 out = get.result()
                 if out is None:
                     return
                 yield out
                 if out.get("finish_reason"):
+                    finished = True
                     return
         finally:
             killed.cancel()
             self._queues.pop(context.id, None)
             self._contexts.pop(context.id, None)
+            if not finished:
+                # consumer went away (kill, disconnect, stop-sequence close):
+                # make sure the scheduler drops the sequence
+                self._abort(context.id)
 
     # -- pump ---------------------------------------------------------------- #
+
+    def _abort(self, request_id: str) -> None:
+        self._pending_aborts.add(request_id)
+        self._wake.set()
 
     def _ensure_pump(self) -> None:
         if self._pump_task is None or self._pump_task.done():
@@ -222,14 +235,20 @@ class JaxEngine:
     async def _pump(self) -> None:
         loop = asyncio.get_running_loop()
         while not self._closed:
+            # apply deferred aborts (the only place scheduler state is
+            # mutated for cancellation — never concurrent with a step)
+            while self._pending_aborts:
+                self.scheduler.abort(self._pending_aborts.pop())
             # honor graceful stop requests before planning
             for rid, ctx in list(self._contexts.items()):
                 if ctx.is_stopped() and not ctx.is_killed():
-                    for seq in self.scheduler.running:
+                    for seq in list(self.scheduler.running):
                         if seq.request_id == rid and seq.output_tokens:
                             self.scheduler.finish(seq, "cancelled")
                             self._deliver(seq, [], "cancelled")
             plan = self.scheduler.schedule()
+            for seq in self.scheduler.drain_errored():
+                self._deliver(seq, [], "error")
             if plan.kind == "idle":
                 if not self.scheduler.has_work:
                     self._wake.clear()
@@ -412,7 +431,9 @@ def _opts_from_request(request: Dict[str, Any]) -> SamplingOptions:
         temperature=1.0 if temperature is None else temperature,
         top_k=so.get("top_k") or 0,
         top_p=so.get("top_p") if so.get("top_p") is not None else 1.0,
-        max_tokens=16 if max_tokens is None else max_tokens,
+        # None → generate to the context window (Scheduler.add clamps);
+        # the legacy-completions 16-token default is the preprocessor's job
+        max_tokens=(1 << 30) if max_tokens is None else max_tokens,
         stop_token_ids=sc.get("stop_token_ids") or [],
         stop_sequences=sc.get("stop_sequences") or [],
         ignore_eos=sc.get("ignore_eos") or False,

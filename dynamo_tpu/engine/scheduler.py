@@ -103,6 +103,13 @@ class Scheduler:
         self.pool = pool
         self.waiting: Deque[Sequence] = deque()
         self.running: List[Sequence] = []
+        # sequences errored inside planning (e.g. out of KV capacity with
+        # nothing left to evict) — the engine drains and notifies
+        self.errored: List[Sequence] = []
+
+    def drain_errored(self) -> List[Sequence]:
+        out, self.errored = self.errored, []
+        return out
 
     # -- intake -------------------------------------------------------------- #
 
@@ -171,10 +178,10 @@ class Scheduler:
         if not self.running:
             return StepPlan("idle")
 
-        # prefill pass
+        # prefill pass (iterate a copy: _ensure_pages may preempt members)
         budget = self.cfg.max_prefill_tokens
         items: List[PrefillItem] = []
-        for seq in self.running:
+        for seq in list(self.running):
             if seq.prefill_done or budget <= 0:
                 continue
             if len(items) >= self.cfg.prefill_batch_size:
@@ -220,7 +227,11 @@ class Scheduler:
             except NoPagesError:
                 victim = self._pick_victim(exclude=seq)
                 if victim is None:
-                    self._preempt(seq)
+                    # nothing left to evict: with the pool to itself the
+                    # sequence can never fit — error it out instead of the
+                    # preempt/re-admit livelock
+                    self._finish(seq, "error")
+                    self.errored.append(seq)
                     return False
                 self._preempt(victim)
 

@@ -89,7 +89,10 @@ class OpenAIPreprocessor:
             token_ids = self.tokenizer.encode(prompt)
         else:
             raise RequestError("'prompt' must be a string or token array")
-        return self._finish(request, token_ids, prompt)
+        out = self._finish(request, token_ids, prompt)
+        if out["stop_conditions"]["max_tokens"] is None:
+            out["stop_conditions"]["max_tokens"] = 16  # legacy OpenAI default
+        return out
 
     # -- shared -------------------------------------------------------------- #
 
@@ -122,8 +125,11 @@ class OpenAIPreprocessor:
             },
             "stop_conditions": {
                 "max_tokens": max_tokens,
+                # text-level stops are matched by the frontend postprocessor
+                # (may straddle token boundaries); EOS handling is engine-side
+                # via its own eos_token_ids so ignore_eos works
                 "stop_sequences_text": stop,
-                "stop_token_ids": list(self.tokenizer.eos_token_ids),
+                "stop_token_ids": list(request.get("stop_token_ids") or []),
                 "ignore_eos": bool(nvext.get("ignore_eos", False)),
             },
             "annotations": {"prompt": prompt} if nvext.get("annotations") else {},

@@ -99,9 +99,6 @@ class IncrementalDetokenizer:
         self.ids: List[int] = tail
         self.prefix_offset = 0
         self.read_offset = len(tail)
-        self._prev_text = (
-            tokenizer.decode(tail, skip_special_tokens=False) if tail else ""
-        )
 
     def push(self, token_id: int) -> str:
         """Add one token; return the new text delta ('' if incomplete)."""

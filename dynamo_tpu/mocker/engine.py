@@ -134,9 +134,13 @@ class MockEngine:
             self._pump_task = self._loop.create_task(self._pump())
         opts = _opts_from_request(request)
         prompt = list(request["token_ids"])
-        if not prompt:
+        max_prompt = min(
+            self.cfg.max_model_len - 1,
+            self.cfg.usable_pages * self.cfg.page_size - 1,
+        )
+        if not prompt or len(prompt) > max_prompt:
             yield {"token_ids": [], "finish_reason": "error",
-                   "error": "empty prompt"}
+                   "error": f"prompt length {len(prompt)} outside [1, {max_prompt}]"}
             return
         if opts.max_tokens <= 0:
             yield {"token_ids": [], "finish_reason": "length"}
@@ -153,6 +157,7 @@ class MockEngine:
         self.scheduler.add(seq)
         self._wake.set()
         killed = asyncio.create_task(context.killed())
+        finished = False
         try:
             while True:
                 get = asyncio.create_task(queue.get())
@@ -161,18 +166,21 @@ class MockEngine:
                 )
                 if get not in done:
                     get.cancel()
-                    self.scheduler.abort(context.id)
                     return
                 out = get.result()
                 if out is None:
                     return
                 yield out
                 if out.get("finish_reason"):
+                    finished = True
                     return
         finally:
             killed.cancel()
             self._queues.pop(context.id, None)
             self._contexts.pop(context.id, None)
+            if not finished:
+                # mock steps run on the event loop, so direct abort is safe
+                self.scheduler.abort(context.id)
 
     async def shutdown(self) -> None:
         self._closed = True
@@ -192,6 +200,13 @@ class MockEngine:
                 else:
                     await asyncio.sleep(0.001)
                 continue
+            for seq in self.scheduler.drain_errored():
+                queue = self._queues.get(seq.request_id)
+                if queue is not None:
+                    queue.put_nowait(
+                        {"token_ids": [], "finish_reason": "error",
+                         "error": "out of kv capacity"}
+                    )
             self.step_log.append(plan.kind)
             if plan.kind == "prefill":
                 await self._run_prefill(plan.prefill)

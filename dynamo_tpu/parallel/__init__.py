@@ -1,0 +1,20 @@
+"""Parallelism: device meshes, sharding placement, ring attention."""
+
+from .mesh import (
+    ParallelConfig,
+    make_mesh,
+    replicated,
+    shard_kv_cache,
+    shard_params,
+)
+from .ring_attention import ring_attention, ring_attention_local
+
+__all__ = [
+    "ParallelConfig",
+    "make_mesh",
+    "replicated",
+    "ring_attention",
+    "ring_attention_local",
+    "shard_kv_cache",
+    "shard_params",
+]

@@ -1,0 +1,65 @@
+"""Device mesh + sharding placement.
+
+The TPU-native replacement for the reference's delegated parallelism
+(SURVEY.md §2.6: the reference passes `--tp/--ep/--dp` flags into vLLM /
+SGLang whose NCCL does the work; here the mesh and shardings ARE the
+mechanism — XLA inserts the collectives over ICI).
+
+Axes: `dp` (data/replica), `tp` (tensor), `sp` (sequence/context),
+`ep` (expert — aliases onto tp's devices by default, the common TPU MoE
+layout).  Pipeline stages are separate meshes handled in pipeline.py.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models import ModelConfig, kv_cache_pspec, param_pspecs
+
+
+@dataclass(frozen=True)
+class ParallelConfig:
+    dp: int = 1
+    tp: int = 1
+    sp: int = 1  # sequence parallelism degree (within tp group for prefill)
+
+    @property
+    def world(self) -> int:
+        return self.dp * self.tp
+
+    def validate(self, n_devices: int) -> None:
+        if self.world != n_devices:
+            raise ValueError(
+                f"dp*tp = {self.world} != available devices {n_devices}"
+            )
+
+
+def make_mesh(pcfg: ParallelConfig, devices: Optional[Sequence] = None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    pcfg.validate(len(devices))
+    arr = np.array(devices).reshape(pcfg.dp, pcfg.tp)
+    return Mesh(arr, axis_names=("dp", "tp"))
+
+
+def shard_params(params, cfg: ModelConfig, mesh: Mesh):
+    """Place a param pytree onto the mesh per the model's TP specs."""
+    specs = param_pspecs(cfg)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
+    )
+
+
+def shard_kv_cache(kv, mesh: Mesh):
+    spec = kv_cache_pspec()
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), kv, spec
+    )
+
+
+def replicated(mesh: Mesh):
+    return NamedSharding(mesh, P())

@@ -1,0 +1,123 @@
+"""Ring attention — sequence-parallel exact attention for long context.
+
+The reference has NO sequence/context parallelism (SURVEY.md §2.6: absent;
+long context is delegated to engines).  On TPU this is first-class: shard
+the sequence over the `sp` mesh axis, keep Q local, and rotate K/V blocks
+around the ring with `ppermute` while accumulating flash-attention style
+(running max + weighted sums), so memory per device is O(seq/devices) and
+the K/V transfer overlaps compute on ICI.
+
+Use inside shard_map with q/k/v sharded on their sequence axis:
+
+    out = shard_map(
+        partial(ring_attention_local, axis_name="sp", causal=True),
+        mesh=mesh,
+        in_specs=(P(None, "sp", None, None),) * 3,
+        out_specs=P(None, "sp", None, None),
+    )(q, k, v)
+
+Shapes (per device): q [B, Sq_local, H, D], k/v [B, Sk_local, Hkv, D].
+GQA is supported (H a multiple of Hkv).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, mask):
+    """Unnormalized flash block: returns (scores_max, exp_sums, weighted_v).
+
+    q [B,Sq,H,D], k/v [B,Sk,Hkv,D], mask broadcastable [B,1,Sq,Sk] bool.
+    """
+    B, Sq, H, D = q.shape
+    Hkv = k.shape[2]
+    g = H // Hkv
+    qg = q.reshape(B, Sq, Hkv, g, D)
+    s = jnp.einsum("bqkgd,bskd->bkgqs", qg, k,
+                   preferred_element_type=jnp.float32)
+    s = s.reshape(B, H, Sq, k.shape[1]) * (1.0 / jnp.sqrt(D))
+    s = jnp.where(mask, s, NEG_INF)
+    m = jnp.max(s, axis=-1)  # [B,H,Sq]
+    # guard fully-masked rows (m = -inf → exp overflow)
+    m_safe = jnp.maximum(m, -1e29)
+    p = jnp.exp(s - m_safe[..., None])  # [B,H,Sq,Sk]
+    l = jnp.sum(p, axis=-1)  # [B,H,Sq]
+    pg = p.reshape(B, Hkv, g, Sq, k.shape[1])
+    o = jnp.einsum("bkgqs,bskd->bkgqd", pg, v.astype(jnp.float32))
+    o = o.reshape(B, H, Sq, D)
+    return m_safe, l, o
+
+
+def ring_attention_local(
+    q: jax.Array,  # [B, Sq_local, H, D] — this device's query block
+    k: jax.Array,  # [B, Sk_local, Hkv, D]
+    v: jax.Array,
+    axis_name: str = "sp",
+    causal: bool = True,
+) -> jax.Array:
+    """Per-device body (call under shard_map). Returns [B, Sq_local, H, D]."""
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+
+    # global token positions of my queries and the current K/V block
+    q_pos = my * Sq + jnp.arange(Sq)  # [Sq]
+
+    def step(carry, r):
+        k_blk, v_blk, m_acc, l_acc, o_acc = carry
+        src = (my - r) % n  # whose K/V block we hold at round r
+        k_pos = src * Sk + jnp.arange(Sk)
+        if causal:
+            mask = (k_pos[None, :] <= q_pos[:, None])[None, None]
+        else:
+            mask = jnp.ones((1, 1, Sq, Sk), bool)
+        m_blk, l_blk, o_blk = _block_attn(q, k_blk, v_blk, mask)
+        # flash accumulation
+        m_new = jnp.maximum(m_acc, m_blk)
+        a = jnp.exp(m_acc - m_new)
+        b = jnp.exp(m_blk - m_new)
+        l_new = l_acc * a + l_blk * b
+        o_new = o_acc * a[..., None] + o_blk * b[..., None]
+        # rotate K/V to the next device (overlaps with next compute)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_nxt = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (k_nxt, v_nxt, m_new, l_new, o_new), None
+
+    m0 = jnp.full((B, H, Sq), -1e29, jnp.float32)
+    l0 = jnp.zeros((B, H, Sq), jnp.float32)
+    o0 = jnp.zeros((B, H, Sq, D), jnp.float32)
+    (k, v, m, l, o), _ = jax.lax.scan(
+        step, (k, v, m0, l0, o0), jnp.arange(n)
+    )
+    out = o / jnp.maximum(l, 1e-20)[..., None]  # [B,H,Sq,D]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,  # [B, S, H, D] — global (sharded on S by the caller's jit)
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    axis_name: str = "sp",
+    causal: bool = True,
+) -> jax.Array:
+    """Convenience wrapper applying shard_map over `axis_name`."""
+    spec = P(None, axis_name, None, None)
+    return shard_map(
+        partial(ring_attention_local, axis_name=axis_name, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_rep=False,
+    )(q, k, v)

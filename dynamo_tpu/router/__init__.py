@@ -1,0 +1,33 @@
+"""KV-cache-aware routing: indexers, cost-based scheduler, publishers."""
+
+from .indexer import ApproxKvIndexer, RadixIndex
+from .kv_router import KvRouter, kv_chooser_factory
+from .publisher import (
+    KvEventPublisher,
+    WorkerMetricsPublisher,
+    kv_stream_name,
+    metrics_subject,
+)
+from .scheduler import (
+    KvWorkerSelector,
+    SchedulingDecision,
+    WorkerSelector,
+    WorkerState,
+)
+from .sequence import ActiveSequences
+
+__all__ = [
+    "ActiveSequences",
+    "ApproxKvIndexer",
+    "KvEventPublisher",
+    "KvRouter",
+    "KvWorkerSelector",
+    "RadixIndex",
+    "SchedulingDecision",
+    "WorkerMetricsPublisher",
+    "WorkerSelector",
+    "WorkerState",
+    "kv_chooser_factory",
+    "kv_stream_name",
+    "metrics_subject",
+]

@@ -1,0 +1,234 @@
+"""KvRouter — KV-cache-aware worker selection for one model endpoint.
+
+Frontend-side composition of the M2 pieces (reference
+/root/reference/lib/llm/src/kv_router/kv_router.rs:204 `KvRouter` and
+subscriber.rs:142 `start_kv_router_background`):
+
+- consumes the component's durable KV-event stream into a RadixIndex,
+  resuming from a radix snapshot in the object store when present (and
+  writing one each `snapshot_threshold` events);
+- consumes worker ForwardPassMetrics from pub/sub;
+- tracks its own routing decisions in ActiveSequences (and, when engines
+  emit no events, in the ApproxKvIndexer);
+- `choose(request)` runs the cost-based selector over live instances.
+
+Multiple router replicas converge because they read the same event stream
+and snapshots.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict, Optional, Sequence
+
+from ..runtime import Client, DistributedRuntime
+from ..runtime.transport.wire import pack, unpack
+from ..tokens import compute_block_hash_for_seq
+from .indexer import ApproxKvIndexer, RadixIndex
+from .publisher import kv_stream_name, metrics_subject
+from .scheduler import KvWorkerSelector, SchedulingDecision, WorkerState
+from .sequence import ActiveSequences
+
+logger = logging.getLogger(__name__)
+
+SNAPSHOT_BUCKET = "kv-router-snapshots"
+
+
+class KvRouter:
+    def __init__(
+        self,
+        runtime: DistributedRuntime,
+        namespace: str,
+        component: str,
+        client: Client,
+        block_size: int = 16,
+        overlap_score_weight: float = 1.0,
+        temperature: float = 0.0,
+        use_approx: bool = False,
+        snapshot_threshold: int = 1000,
+        salt: str = "",
+    ):
+        self.runtime = runtime
+        self.client = client
+        self.block_size = block_size
+        self.salt = salt
+        self.stream = kv_stream_name(namespace, component)
+        self.metrics_subject = metrics_subject(namespace, component)
+        self.snapshot_name = f"{namespace}.{component}"
+        self.snapshot_threshold = snapshot_threshold
+        self.index = RadixIndex()
+        self.approx = ApproxKvIndexer() if use_approx else None
+        self.active = ActiveSequences()
+        self.selector = KvWorkerSelector(overlap_score_weight, temperature)
+        self.worker_states: Dict[int, WorkerState] = {}
+        self._tasks: list[asyncio.Task] = []
+        self._events_seen = 0
+        self._last_snapshot_at = 0
+        self._event_offset = 0
+
+    # -- lifecycle ----------------------------------------------------------- #
+
+    async def start(self) -> "KvRouter":
+        await self._load_snapshot()
+        loop = asyncio.get_running_loop()
+        self._tasks = [
+            loop.create_task(self._event_loop()),
+            loop.create_task(self._metrics_loop()),
+        ]
+        return self
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+
+    # -- background sync ----------------------------------------------------- #
+
+    async def _load_snapshot(self) -> None:
+        try:
+            data = await self.runtime.control.obj_get(
+                SNAPSHOT_BUCKET, self.snapshot_name
+            )
+        except (ConnectionError, RuntimeError):
+            return
+        if not data:
+            return
+        snap = unpack(data)
+        self.index = RadixIndex.from_snapshot(
+            {int(w): hs for w, hs in snap["workers"].items()}
+        )
+        self._event_offset = snap.get("offset", 0)
+        logger.info(
+            "kv router resumed from snapshot at offset %d", self._event_offset
+        )
+
+    async def _maybe_snapshot(self) -> None:
+        if self._events_seen - self._last_snapshot_at < self.snapshot_threshold:
+            return
+        self._last_snapshot_at = self._events_seen
+        snap = pack(
+            {"workers": self.index.snapshot(), "offset": self._event_offset}
+        )
+        try:
+            await self.runtime.control.obj_put(
+                SNAPSHOT_BUCKET, self.snapshot_name, snap
+            )
+        except (ConnectionError, RuntimeError) as e:
+            logger.warning("snapshot write failed: %s", e)
+
+    async def _event_loop(self) -> None:
+        while True:
+            try:
+                entries, _last = await self.runtime.control.stream_fetch(
+                    self.stream, after=self._event_offset, timeout_ms=1000
+                )
+                for entry in entries:
+                    self._event_offset = entry["seq"]
+                    self._apply_event(unpack(entry["data"]))
+                    self._events_seen += 1
+                await self._maybe_snapshot()
+            except asyncio.CancelledError:
+                return
+            except (ConnectionError, RuntimeError) as e:
+                logger.warning("kv event fetch failed: %s", e)
+                await asyncio.sleep(0.5)
+
+    def _apply_event(self, ev: dict) -> None:
+        wid = ev["worker_id"]
+        kind = ev["kind"]
+        if kind == "stored":
+            self.index.apply_stored(wid, ev["block_hashes"])
+        elif kind == "removed":
+            self.index.apply_removed(wid, ev["block_hashes"])
+        elif kind == "cleared":
+            self.index.clear_worker(wid)
+
+    async def _metrics_loop(self) -> None:
+        while True:
+            try:
+                sub = await self.runtime.control.subscribe(self.metrics_subject)
+                async for _subject, msg in sub:
+                    m = unpack(msg)
+                    wid = m.pop("worker_id")
+                    self.worker_states[wid] = WorkerState(
+                        worker_id=wid,
+                        active_seqs=m.get("active_seqs", 0),
+                        waiting_seqs=m.get("waiting_seqs", 0),
+                        kv_usage=m.get("kv_usage", 0.0),
+                        kv_total_pages=m.get("kv_total_pages", 0),
+                    )
+            except asyncio.CancelledError:
+                return
+            except (ConnectionError, RuntimeError) as e:
+                logger.warning("metrics subscribe failed: %s", e)
+                await asyncio.sleep(0.5)
+
+    # -- the routing decision ------------------------------------------------ #
+
+    def _live_workers(self) -> Dict[int, WorkerState]:
+        """Live instances from discovery joined with last-published state."""
+        live = {}
+        for inst in self.client.instances():
+            wid = inst.instance_id
+            live[wid] = self.worker_states.get(wid, WorkerState(worker_id=wid))
+        # drop state/index entries for dead workers
+        for wid in list(self.worker_states):
+            if wid not in live:
+                del self.worker_states[wid]
+                self.index.remove_worker(wid)
+                self.active.remove_worker(wid)
+                if self.approx:
+                    self.approx.remove_worker(wid)
+        return live
+
+    async def choose(self, request: dict) -> int:
+        """Pick a worker for a preprocessed request; updates load tracking.
+        The caller routes with `client.direct(request, worker_id)`."""
+        token_ids: Sequence[int] = request.get("token_ids", [])
+        hashes = compute_block_hash_for_seq(token_ids, self.block_size, self.salt)
+        await self.client.wait_for_instances(timeout=5.0)
+        workers = self._live_workers()
+        overlaps = self.index.find_matches(hashes)
+        if self.approx:
+            a = self.approx.find_matches(hashes)
+            for w, o in a.items():
+                overlaps[w] = max(overlaps.get(w, 0), o)
+        request_blocks = max(len(hashes), 1)
+        decision = self.selector.select(
+            workers, overlaps, request_blocks, self.active
+        )
+        rid = request.get("request_id") or request.get("id") or str(id(request))
+        self.active.add_request(
+            rid,
+            decision.worker_id,
+            prefill_blocks=request_blocks - decision.overlap_blocks,
+            decode_blocks=request_blocks,
+        )
+        if self.approx:
+            self.approx.process_routing_decision(decision.worker_id, hashes)
+        logger.debug(
+            "kv route %s -> worker %d (overlap %d/%d)",
+            rid, decision.worker_id, decision.overlap_blocks, request_blocks,
+        )
+        return decision.worker_id
+
+    def mark_finished(self, request_id: str) -> None:
+        self.active.free(request_id)
+
+
+def kv_chooser_factory(runtime: DistributedRuntime, **kw):
+    """Factory handed to ModelWatcher: builds one KvRouter per model."""
+
+    async def factory(mdc, client) -> KvRouter:
+        router = KvRouter(
+            runtime,
+            mdc.namespace,
+            mdc.component,
+            client,
+            block_size=mdc.kv_cache_block_size,
+            **kw,
+        )
+        return await router.start()
+
+    return factory

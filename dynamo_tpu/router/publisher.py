@@ -1,0 +1,116 @@
+"""Worker-side publishers: KV events and load metrics.
+
+KvEventPublisher bridges the engine's synchronous event sink (called from
+the device-step thread) into the control plane's durable stream — the
+analog of the reference's engine→NATS-JetStream publisher
+(/root/reference/lib/llm/src/kv_router/publisher.rs:92).
+WorkerMetricsPublisher periodically publishes ForwardPassMetrics on a
+pub/sub subject (publisher.rs:691).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Optional
+
+from ..engine.page_pool import KvEvent
+from ..runtime import DistributedRuntime
+from ..runtime.transport.wire import pack, unpack
+
+logger = logging.getLogger(__name__)
+
+
+def kv_stream_name(namespace: str, component: str) -> str:
+    return f"kv-events.{namespace}.{component}"
+
+
+def metrics_subject(namespace: str, component: str) -> str:
+    return f"metrics.{namespace}.{component}"
+
+
+class KvEventPublisher:
+    """Engine event sink → durable control-plane stream."""
+
+    def __init__(self, runtime: DistributedRuntime, namespace: str,
+                 component: str, worker_id: int):
+        self.runtime = runtime
+        self.stream = kv_stream_name(namespace, component)
+        self.worker_id = worker_id
+        self._queue: asyncio.Queue = asyncio.Queue()
+        self._task: Optional[asyncio.Task] = None
+        self._loop = asyncio.get_event_loop()
+
+    def start(self) -> "KvEventPublisher":
+        self._loop = asyncio.get_running_loop()
+        self._task = self._loop.create_task(self._drain())
+        return self
+
+    def sink(self, ev: KvEvent) -> None:
+        """Thread-safe: callable from the engine's device-step thread."""
+        payload = pack(
+            {
+                "worker_id": self.worker_id,
+                "kind": ev.kind,
+                "block_hashes": ev.block_hashes,
+                "parent_hash": ev.parent_hash,
+            }
+        )
+        self._loop.call_soon_threadsafe(self._queue.put_nowait, payload)
+
+    async def _drain(self) -> None:
+        payload: Optional[bytes] = None
+        while True:
+            try:
+                if payload is None:
+                    payload = await self._queue.get()
+                await self.runtime.control.stream_append(self.stream, payload)
+                payload = None  # only drop after a successful append
+            except asyncio.CancelledError:
+                return
+            except (ConnectionError, RuntimeError) as e:
+                logger.warning("kv event publish failed (will retry): %s", e)
+                await asyncio.sleep(0.5)
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            await asyncio.gather(self._task, return_exceptions=True)
+
+
+class WorkerMetricsPublisher:
+    """Periodic ForwardPassMetrics → pub/sub subject."""
+
+    def __init__(self, runtime: DistributedRuntime, engine: Any,
+                 namespace: str, component: str, worker_id: int,
+                 interval: float = 0.5):
+        self.runtime = runtime
+        self.engine = engine
+        self.subject = metrics_subject(namespace, component)
+        self.worker_id = worker_id
+        self.interval = interval
+        self._task: Optional[asyncio.Task] = None
+
+    def start(self) -> "WorkerMetricsPublisher":
+        self._task = asyncio.get_running_loop().create_task(self._publish_loop())
+        return self
+
+    async def _publish_loop(self) -> None:
+        while True:
+            try:
+                m = self.engine.metrics()
+                await self.runtime.control.publish(
+                    self.subject,
+                    pack({"worker_id": self.worker_id, **vars(m)}),
+                )
+                await asyncio.sleep(self.interval)
+            except asyncio.CancelledError:
+                return
+            except (ConnectionError, RuntimeError) as e:
+                logger.warning("metrics publish failed: %s", e)
+                await asyncio.sleep(1.0)
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            await asyncio.gather(self._task, return_exceptions=True)

@@ -1,0 +1,96 @@
+"""KvScheduler — worker selection.
+
+Cost formula (reference docs/architecture/kv_cache_routing.md:254-270 and
+kv_router/scheduler.rs:90):
+
+    potential_prefill_blocks = request_blocks - overlap_blocks[worker]
+    potential_decode_blocks  = worker's active decode blocks + request_blocks
+    cost = overlap_score_weight * potential_prefill_blocks
+           + potential_decode_blocks
+
+Lowest cost wins; with router_temperature > 0 the choice is sampled from
+softmax(-cost/temperature) for load spreading.  A pluggable WorkerSelector
+mirrors the reference's custom-selector trait (kv_router.rs:78).
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Protocol, Sequence
+
+from .sequence import ActiveSequences
+
+
+@dataclass
+class WorkerState:
+    """Latest published load for one worker (from ForwardPassMetrics)."""
+
+    worker_id: int
+    active_seqs: int = 0
+    waiting_seqs: int = 0
+    kv_usage: float = 0.0
+    kv_total_pages: int = 0
+
+
+@dataclass
+class SchedulingDecision:
+    worker_id: int
+    overlap_blocks: int
+    costs: Dict[int, float] = field(default_factory=dict)
+
+
+class WorkerSelector(Protocol):
+    def select(
+        self,
+        workers: Dict[int, WorkerState],
+        overlaps: Dict[int, int],
+        request_blocks: int,
+        active: ActiveSequences,
+    ) -> SchedulingDecision: ...
+
+
+class KvWorkerSelector:
+    """The default cost-based selector."""
+
+    def __init__(self, overlap_score_weight: float = 1.0,
+                 temperature: float = 0.0, rng: Optional[random.Random] = None):
+        self.overlap_score_weight = overlap_score_weight
+        self.temperature = temperature
+        self._rng = rng or random.Random(0x5EED)
+
+    def select(self, workers, overlaps, request_blocks, active):
+        costs: Dict[int, float] = {}
+        for wid, st in workers.items():
+            overlap = overlaps.get(wid, 0)
+            pending_prefill, resident_decode = active.load(wid)
+            prefill = (request_blocks - overlap) + pending_prefill
+            decode = resident_decode + request_blocks
+            # worker-published load joins the estimate: kv_usage scales the
+            # decode pressure (full workers get costlier)
+            decode += st.kv_usage * st.kv_total_pages
+            costs[wid] = self.overlap_score_weight * prefill + decode
+        if not costs:
+            raise RuntimeError("no workers to select from")
+        if self.temperature <= 0:
+            # deterministic: min cost, ties → highest overlap then lowest id
+            wid = min(
+                costs,
+                key=lambda w: (costs[w], -overlaps.get(w, 0), w),
+            )
+        else:
+            wids = list(costs)
+            logits = [-costs[w] / self.temperature for w in wids]
+            mx = max(logits)
+            probs = [math.exp(l - mx) for l in logits]
+            total = sum(probs)
+            r = self._rng.random() * total
+            acc = 0.0
+            wid = wids[-1]
+            for w, p in zip(wids, probs):
+                acc += p
+                if r <= acc:
+                    wid = w
+                    break
+        return SchedulingDecision(wid, overlaps.get(wid, 0), costs)

@@ -32,10 +32,18 @@ def main() -> None:
                     choices=["bfloat16", "float32"])
     ap.add_argument("--disagg-role", default="both",
                     choices=["both", "prefill", "decode"])
+    ap.add_argument("--platform", default="default",
+                    choices=["default", "cpu"],
+                    help="force the JAX backend (cpu for tests/CI)")
     ap.add_argument("--log-level", default="info")
     args = ap.parse_args()
     logging.basicConfig(level=args.log_level.upper(),
                         format="%(asctime)s %(levelname)s %(name)s %(message)s")
+    if args.platform == "cpu":
+        # the axon TPU plugin ignores the env var; the config update wins
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     asyncio.run(_run(args))
 
 

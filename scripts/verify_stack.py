@@ -89,16 +89,13 @@ def main():
         spawn(["-m", "dynamo_tpu.runtime", "--port", str(cp_port),
                "--host", "127.0.0.1"], "control")
         control = f"127.0.0.1:{cp_port}"
-        w1 = spawn(["-m", "dynamo_tpu.worker", "--control", control,
-                    "--model", "tiny", "--dtype", "float32",
-                    "--page-size", "8", "--num-pages", "128",
-                    "--max-prefill-tokens", "64", "--max-model-len", "256"],
-                   "worker1")
-        w2 = spawn(["-m", "dynamo_tpu.worker", "--control", control,
-                    "--model", "tiny", "--dtype", "float32",
-                    "--page-size", "8", "--num-pages", "128",
-                    "--max-prefill-tokens", "64", "--max-model-len", "256"],
-                   "worker2")
+        worker_args = ["-m", "dynamo_tpu.worker", "--control", control,
+                       "--model", "tiny", "--dtype", "float32",
+                       "--platform", "cpu",
+                       "--page-size", "8", "--num-pages", "128",
+                       "--max-prefill-tokens", "64", "--max-model-len", "256"]
+        w1 = spawn(worker_args, "worker1")
+        w2 = spawn(worker_args, "worker2")
         http_port = free_port()
         spawn(["-m", "dynamo_tpu.frontend", "--control", control,
                "--host", "127.0.0.1", "--port", str(http_port)], "frontend")

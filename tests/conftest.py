@@ -14,11 +14,24 @@ import asyncio
 import inspect
 import os
 
-# Must be set before jax imports anywhere in the test process.
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must be set before jax initializes anywhere in the test process.  NB the
+# axon TPU plugin in this image force-registers itself and ignores the
+# JAX_PLATFORMS *env var* — only the config update below actually wins.
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _assert_cpu_mesh():
+    assert jax.default_backend() == "cpu" and len(jax.devices()) == 8
 
 
 @pytest.hookimpl(tryfirst=True)

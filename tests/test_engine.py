@@ -155,6 +155,32 @@ async def test_seeded_sampling_reproducible(engine_setup):
     await engine.shutdown()
 
 
+async def test_generation_beyond_pool_errors_not_hangs(engine_setup):
+    """Prompt fits but prompt+generation exceeds the whole pool: the engine
+    must error the request out, not livelock on self-preemption."""
+    engine = make_engine(engine_setup, num_pages=7, max_model_len=200)
+    # pool: 6 usable pages * 8 = 48 tokens; request wants 20 + 100
+    out = []
+    async for delta in engine.generate(req([1] * 20, max_tokens=100)):
+        out.append(delta)
+    assert out[-1]["finish_reason"] == "error"
+    # a small request afterwards must still work
+    toks, reason = await collect(engine, req([1, 2, 3], max_tokens=4))
+    assert len(toks) == 4
+    await engine.shutdown()
+
+
+async def test_default_max_tokens_generates_to_window(engine_setup):
+    """No max_tokens → clamp to context window, not 16."""
+    engine = make_engine(engine_setup, max_model_len=64)
+    r = {"token_ids": [1, 2, 3], "sampling_options": {"temperature": 0.0},
+         "stop_conditions": {"ignore_eos": True}}
+    toks, reason = await collect(engine, r)
+    assert len(toks) == 64 - 3
+    assert reason == "length"
+    await engine.shutdown()
+
+
 async def test_prompt_too_long_rejected(engine_setup):
     engine = make_engine(engine_setup, max_model_len=64)
     out = []

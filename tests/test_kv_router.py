@@ -1,0 +1,210 @@
+"""KV router: indexer/selector units + mocker-fleet integration.
+
+The integration test mirrors the reference's key testing trick
+(/root/reference/tests/router/test_router_e2e_with_mockers.py): N mock
+engines with real KV events + a KvRouter, no accelerators.
+"""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.llm import ModelDeploymentCard
+from dynamo_tpu.mocker import MockEngine, MockEngineArgs
+from dynamo_tpu.router import (
+    ActiveSequences,
+    ApproxKvIndexer,
+    KvRouter,
+    KvWorkerSelector,
+    RadixIndex,
+    WorkerState,
+)
+from dynamo_tpu.runtime import ControlPlaneServer, DistributedRuntime
+from dynamo_tpu.tokens import compute_block_hash_for_seq
+from dynamo_tpu.worker import serve_engine
+
+# -- units ------------------------------------------------------------------- #
+
+
+def test_radix_index_overlap():
+    idx = RadixIndex()
+    h = compute_block_hash_for_seq(list(range(64)), 16)  # 4 blocks
+    idx.apply_stored(1, h[:2])
+    idx.apply_stored(2, h[:4])
+    m = idx.find_matches(h)
+    assert m == {1: 2, 2: 4}
+    # removal breaks the chain at the removed block
+    idx.apply_removed(2, [h[1]])
+    m = idx.find_matches(h)
+    assert m[1] == 2
+    assert m[2] == 1  # only the first block still chains
+    idx.remove_worker(1)
+    assert idx.find_matches(h).get(1) is None
+
+
+def test_radix_snapshot_roundtrip():
+    idx = RadixIndex()
+    h = compute_block_hash_for_seq(list(range(48)), 16)
+    idx.apply_stored(7, h)
+    idx2 = RadixIndex.from_snapshot(idx.snapshot())
+    assert idx2.find_matches(h) == {7: 3}
+
+
+def test_approx_indexer_ttl():
+    now = [0.0]
+    ap = ApproxKvIndexer(ttl_secs=10, clock=lambda: now[0])
+    h = compute_block_hash_for_seq(list(range(32)), 16)
+    ap.process_routing_decision(3, h)
+    assert ap.find_matches(h) == {3: 2}
+    now[0] = 11.0
+    assert ap.find_matches(h) == {}
+
+
+def test_selector_prefers_overlap_then_load():
+    sel = KvWorkerSelector(overlap_score_weight=1.0, temperature=0.0)
+    workers = {1: WorkerState(1), 2: WorkerState(2)}
+    active = ActiveSequences()
+    # worker 2 has 8 of 10 blocks cached
+    d = sel.select(workers, {2: 8}, 10, active)
+    assert d.worker_id == 2
+    # but if worker 2 is drowning in decode load, worker 1 wins
+    for i in range(6):
+        active.add_request(f"r{i}", 2, prefill_blocks=0, decode_blocks=10)
+    d = sel.select(workers, {2: 8}, 10, active)
+    assert d.worker_id == 1
+
+
+def test_selector_softmax_spreads():
+    sel = KvWorkerSelector(temperature=10.0)
+    workers = {i: WorkerState(i) for i in range(4)}
+    active = ActiveSequences()
+    chosen = {sel.select(workers, {}, 4, active).worker_id for _ in range(100)}
+    assert len(chosen) > 1  # high temperature → not deterministic
+
+
+# -- integration with mock fleet --------------------------------------------- #
+
+
+def fleet_args():
+    return MockEngineArgs(
+        num_pages=128, page_size=16, max_num_seqs=8,
+        max_prefill_tokens=256, max_model_len=2048, speedup_ratio=50.0,
+    )
+
+
+async def start_fleet(n=3):
+    control = await ControlPlaneServer().start()
+    runtimes, engines, workers = [], [], []
+    for _ in range(n):
+        rt = await DistributedRuntime.connect(control.address)
+        engine = MockEngine(fleet_args())
+        served = await serve_engine(
+            rt, engine, ModelDeploymentCard(name="mock", context_length=2048)
+        )
+        runtimes.append(rt)
+        engines.append(engine)
+        workers.append(served.instance.instance_id)
+    front = await DistributedRuntime.connect(control.address)
+    ep = front.namespace("dynamo").component("backend").endpoint("generate")
+    client = await ep.client().start()
+    await client.wait_for_instances()
+    router = await KvRouter(
+        front, "dynamo", "backend", client, block_size=16
+    ).start()
+    return control, runtimes, engines, front, client, router
+
+
+async def stop_fleet(control, runtimes, engines, front, client, router):
+    await router.stop()
+    await client.stop()
+    for e in engines:
+        await e.shutdown()
+    for rt in runtimes:
+        await rt.shutdown(graceful=False)
+    await front.shutdown(graceful=False)
+    await control.stop()
+
+
+def req(tokens, max_tokens=4, rid=None):
+    r = {
+        "token_ids": tokens,
+        "sampling_options": {"seed": 1},
+        "stop_conditions": {"max_tokens": max_tokens, "ignore_eos": True},
+    }
+    if rid:
+        r["request_id"] = rid
+    return r
+
+
+async def test_kv_routing_prefers_cached_worker():
+    stack = await start_fleet(3)
+    control, runtimes, engines, front, client, router = stack
+    try:
+        prompt = list(range(100, 164))  # 4 blocks
+        # first request lands somewhere; stream it fully
+        r1 = req(prompt, rid="r1")
+        w1 = await router.choose(r1)
+        async for _ in client.direct(r1, w1):
+            pass
+        router.mark_finished("r1")
+        # wait for KV events to arrive at the router
+        deadline = asyncio.get_running_loop().time() + 5
+        hashes = compute_block_hash_for_seq(prompt, 16)
+        while not router.index.find_matches(hashes):
+            assert asyncio.get_running_loop().time() < deadline, "no events"
+            await asyncio.sleep(0.05)
+        # same prefix again → must go to the same worker
+        r2 = req(prompt, rid="r2")
+        w2 = await router.choose(r2)
+        assert w2 == w1
+        router.mark_finished("r2")
+        # a totally different prompt should avoid the loaded/cached worker
+        # (no overlap anywhere → pure load balance; all idle → any is fine)
+        r3 = req(list(range(500, 564)), rid="r3")
+        w3 = await router.choose(r3)
+        assert w3 in [s.instance_id for s in client.instances()]
+    finally:
+        await stop_fleet(*stack)
+
+
+async def test_kv_router_replica_sync():
+    """A second router started later must converge via the event stream."""
+    stack = await start_fleet(2)
+    control, runtimes, engines, front, client, router = stack
+    try:
+        prompt = list(range(0, 64))
+        r1 = req(prompt, rid="a")
+        w1 = await router.choose(r1)
+        async for _ in client.direct(r1, w1):
+            pass
+        hashes = compute_block_hash_for_seq(prompt, 16)
+        deadline = asyncio.get_running_loop().time() + 5
+        while not router.index.find_matches(hashes):
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.05)
+        # replica
+        router2 = await KvRouter(
+            front, "dynamo", "backend", client, block_size=16
+        ).start()
+        deadline = asyncio.get_running_loop().time() + 5
+        while not router2.index.find_matches(hashes):
+            assert asyncio.get_running_loop().time() < deadline, "replica sync"
+            await asyncio.sleep(0.05)
+        assert (await router2.choose(req(prompt, rid="b"))) == w1
+        await router2.stop()
+    finally:
+        await stop_fleet(*stack)
+
+
+async def test_metrics_flow_to_router():
+    stack = await start_fleet(2)
+    control, runtimes, engines, front, client, router = stack
+    try:
+        deadline = asyncio.get_running_loop().time() + 5
+        while len(router.worker_states) < 2:
+            assert asyncio.get_running_loop().time() < deadline, "no metrics"
+            await asyncio.sleep(0.05)
+        for st in router.worker_states.values():
+            assert st.kv_total_pages == 127  # 128 pages minus trash page
+    finally:
+        await stop_fleet(*stack)
