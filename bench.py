@@ -64,6 +64,7 @@ async def main_async():
         max_model_len=PROMPT_LEN + GEN_TOKENS + 16,
         decode_batch_buckets=[BATCH],
         chunk_buckets=[PROMPT_LEN],
+        decode_steps=16,  # one dispatch per 16 tokens (axon dispatch ~250ms)
         enable_prefix_caching=False,  # measure raw compute, not cache hits
     )
     engine = JaxEngine(cfg, params, ecfg, eos_token_ids=[])
@@ -78,7 +79,12 @@ async def main_async():
 
 def previous_round_value():
     best = None
-    for path in sorted(glob.glob("BENCH_r*.json")):
+
+    def round_num(p):
+        m = re.search(r"BENCH_r(\d+)\.json", p)
+        return int(m.group(1)) if m else -1
+
+    for path in sorted(glob.glob("BENCH_r*.json"), key=round_num):
         try:
             with open(path) as f:
                 d = json.load(f)

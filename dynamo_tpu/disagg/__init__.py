@@ -1,0 +1,24 @@
+"""Disaggregated prefill/decode serving.
+
+Flow (reference /root/reference/docs/architecture/disagg_serving.md and
+components/src/dynamo/vllm/handlers.py:140-231, redesigned for our
+runtime):
+
+- prefill workers serve `{ns}.prefill.generate` — their handler runs
+  `JaxEngine.prefill_remote` (prompt compute + first token + KV page
+  export);
+- decode workers wrap their engine in `DisaggDecodeHandler`: per request,
+  a `DisaggRouter` decides local vs remote by prefill length and prefill
+  worker availability (disagg_router.rs:135 decides by length + queue
+  depth); remote path pulls the KV blob from a prefill worker (KV-aware
+  routed when a prefill router is present, else round-robin) and injects
+  it via `generate_with_kv`;
+- the KV blob travels host-staged over the direct worker↔worker TCP
+  stream — the DCN path.  Same-slice ICI device-to-device transfer slots
+  in behind the same interface later.
+"""
+
+from .handler import DisaggDecodeHandler, serve_prefill_worker
+from .router import DisaggRouter
+
+__all__ = ["DisaggDecodeHandler", "DisaggRouter", "serve_prefill_worker"]

@@ -1,0 +1,171 @@
+"""Decode-side orchestration + prefill worker serving."""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, AsyncIterator, Dict, Optional
+
+from ..engine import JaxEngine
+from ..llm import ModelDeploymentCard
+from ..runtime import Client, Context, DistributedRuntime
+from ..runtime.transport.service import RemoteStreamError, ServiceUnavailable
+from .router import DisaggRouter
+
+logger = logging.getLogger(__name__)
+
+PREFILL_COMPONENT = "prefill"
+
+
+async def serve_prefill_worker(
+    runtime: DistributedRuntime,
+    engine: JaxEngine,
+    mdc: ModelDeploymentCard,
+    namespace: str = "dynamo",
+):
+    """Serve the engine as a prefill-only worker at {ns}.prefill.generate.
+    Publishes its card with disagg_role=prefill (frontends skip it)."""
+    from ..worker import serve_engine
+
+    class PrefillFacade:
+        """AsyncEngine facade: every request is a remote-prefill request."""
+
+        def __init__(self, engine):
+            self.engine = engine
+
+        async def generate(self, request, context):
+            yield await self.engine.prefill_remote(request, context)
+
+        def metrics(self):
+            return self.engine.metrics()
+
+        def clear_kv_blocks(self):
+            return self.engine.clear_kv_blocks()
+
+        def add_event_sink(self, sink):
+            self.engine.add_event_sink(sink)
+
+    mdc.disagg_role = "prefill"
+    return await serve_engine(
+        runtime, PrefillFacade(engine), mdc,
+        namespace=namespace, component=PREFILL_COMPONENT,
+    )
+
+
+class DisaggDecodeHandler:
+    """Wraps a decode engine; remote-prefills long prompts through the
+    prefill component (the reference decode handler,
+    vllm/handlers.py:140-231)."""
+
+    def __init__(
+        self,
+        engine: JaxEngine,
+        runtime: DistributedRuntime,
+        namespace: str = "dynamo",
+        router: Optional[DisaggRouter] = None,
+        prefill_router=None,  # optional KvRouter over prefill workers
+    ):
+        self.engine = engine
+        self.runtime = runtime
+        self.router = router or DisaggRouter()
+        self.prefill_router = prefill_router
+        ep = (
+            runtime.namespace(namespace)
+            .component(PREFILL_COMPONENT)
+            .endpoint("generate")
+        )
+        self.prefill_client: Client = ep.client()
+        self._started = False
+
+    async def _prefill_available(self) -> bool:
+        if not self._started:
+            await self.prefill_client.start()
+            self._started = True
+            # give discovery one beat on first use
+            try:
+                await self.prefill_client.wait_for_instances(timeout=1.0)
+            except TimeoutError:
+                pass
+        return bool(self.prefill_client.instances())
+
+    # AsyncEngine protocol
+    async def generate(self, request: Dict[str, Any], context: Context
+                       ) -> AsyncIterator[Dict[str, Any]]:
+        if isinstance(request, dict) and "control" in request:
+            async for out in self._control(request):
+                yield out
+            return
+        prompt = request.get("token_ids") or []
+        remote = self.router.should_prefill_remotely(
+            len(prompt),
+            cached_prefix_len=0,
+            prefill_workers_available=await self._prefill_available(),
+        )
+        if not remote:
+            async for out in self.engine.generate(request, context):
+                yield out
+            return
+        # -- remote prefill ------------------------------------------------- #
+        prefill_ctx = context.child()
+        try:
+            if self.prefill_router is not None:
+                wid = await self.prefill_router.choose(
+                    {**request, "request_id": prefill_ctx.id}
+                )
+                stream = self.prefill_client.direct(request, wid, prefill_ctx)
+            else:
+                stream = self.prefill_client.round_robin(request, prefill_ctx)
+            result = None
+            async for item in stream:
+                result = item
+                break
+        except (ServiceUnavailable, RemoteStreamError) as e:
+            logger.warning("remote prefill failed (%s); prefilling locally", e)
+            async for out in self.engine.generate(request, context):
+                yield out
+            return
+        finally:
+            if self.prefill_router is not None:
+                self.prefill_router.mark_finished(prefill_ctx.id)
+        if not result or "error" in result or "kv" not in result:
+            logger.warning("remote prefill rejected (%s); local fallback",
+                           (result or {}).get("error"))
+            async for out in self.engine.generate(request, context):
+                yield out
+            return
+        first_token = result["token_ids"][0]
+        import_failed = False
+        async for out in self.engine.generate_with_kv(
+            request, first_token, result["kv"], context
+        ):
+            if out.get("finish_reason") == "error" and "kv import rejected" in (
+                out.get("error") or ""
+            ):
+                import_failed = True
+                break
+            yield out
+        if import_failed:
+            logger.warning("kv import rejected; prefilling locally")
+            async for out in self.engine.generate(request, context):
+                yield out
+
+    async def _control(self, request: dict) -> AsyncIterator[Any]:
+        op = request["control"]
+        if op == "clear_kv_blocks":
+            yield {"status": "ok", "pages_cleared": self.engine.clear_kv_blocks()}
+        elif op == "metrics":
+            yield vars(self.engine.metrics())
+        else:
+            yield {"status": "error", "error": f"unknown control op {op}"}
+
+    def metrics(self):
+        return self.engine.metrics()
+
+    def clear_kv_blocks(self):
+        return self.engine.clear_kv_blocks()
+
+    def add_event_sink(self, sink):
+        self.engine.add_event_sink(sink)
+
+    async def shutdown(self):
+        await self.prefill_client.stop()
+        await self.engine.shutdown()

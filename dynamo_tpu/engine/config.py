@@ -24,6 +24,12 @@ class EngineConfig:
     decode_batch_buckets: Optional[Sequence[int]] = None
     chunk_buckets: Optional[Sequence[int]] = None
 
+    # tokens decoded per device dispatch (lax.scan inside one jit call) —
+    # amortizes host→TPU dispatch latency; stop conditions are applied
+    # host-side afterwards, so a request may compute up to N-1 tokens past
+    # its stop (discarded, never delivered)
+    decode_steps: int = 1
+
     enable_prefix_caching: bool = True
     block_hash_salt: str = ""
 

@@ -34,7 +34,7 @@ from ..models import KVCache, ModelConfig, forward_decode, forward_prefill
 from ..ops import SamplingParams, compute_logprobs, sample_tokens
 from ..runtime.engine import Context
 from .config import EngineConfig, bucket_for
-from .page_pool import KvEvent, PagePool
+from .page_pool import KvEvent, NoPagesError, PagePool
 from .scheduler import PrefillItem, SamplingOptions, Scheduler, Sequence, StepPlan
 
 logger = logging.getLogger(__name__)
@@ -65,13 +65,51 @@ def _build_prefill_step(cfg: ModelConfig):
     return step
 
 
-def _build_decode_step(cfg: ModelConfig):
+def _build_export_fn():
+    @jax.jit
+    def export(kv, pages):  # pages [N] int32 → (k,v) [L, N, page, n_kv, hd]
+        return kv.k[:, pages], kv.v[:, pages]
+
+    return export
+
+
+def _build_import_fn():
+    @partial(jax.jit, donate_argnums=(0,))
+    def imp(kv, k_blob, v_blob, pages):
+        # padding rows point at trash page 0 — harmless overwrite
+        return type(kv)(
+            kv.k.at[:, pages].set(k_blob), kv.v.at[:, pages].set(v_blob)
+        )
+
+    return imp
+
+
+def _build_decode_step(cfg: ModelConfig, n_steps: int, max_valid_pos: int):
+    """Decode `n_steps` tokens per dispatch: lax.scan keeps the whole block
+    on-device, so host→device latency is paid once per block, not per
+    token (the TPU analog of multi-step scheduling).
+
+    Steps whose position reaches `max_valid_pos` (the model window) write
+    to the trash page instead of clamping into a real page — those tokens
+    are discarded host-side anyway.
+    """
     @partial(jax.jit, donate_argnums=(1,))
     def step(params, kv, tokens, positions, page_table, samp, seeds, counters):
-        logits, kv = forward_decode(params, cfg, kv, tokens, positions, page_table)
-        out = sample_tokens(logits, samp, seeds, counters)
-        logp = compute_logprobs(logits, out)
-        return out, logp, kv
+        def body(carry, _):
+            kv, tok, pos, ctr = carry
+            ok = pos < max_valid_pos
+            safe_pos = jnp.where(ok, pos, 0)
+            # out-of-window rows use an all-trash table row
+            table = jnp.where(ok[:, None], page_table, 0)
+            logits, kv = forward_decode(params, cfg, kv, tok, safe_pos, table)
+            out = sample_tokens(logits, samp, seeds, ctr)
+            logp = compute_logprobs(logits, out)
+            return (kv, out, pos + 1, ctr + 1), (out, logp)
+
+        (kv, _, _, _), (toks, logps) = jax.lax.scan(
+            body, (kv, tokens, positions, counters), None, length=n_steps
+        )
+        return toks, logps, kv  # [T, B]
 
     return step
 
@@ -87,6 +125,7 @@ class JaxEngine:
         eos_token_ids: Optional[List[int]] = None,
         kv_dtype=jnp.bfloat16,
         event_sink: Optional[Callable[[KvEvent], None]] = None,
+        tiered=None,  # kvbm.TieredKvCache — host/disk KV tiers
     ):
         self.model_cfg = model_cfg
         self.cfg = engine_cfg or EngineConfig()
@@ -104,12 +143,29 @@ class JaxEngine:
         )
         self.scheduler = Scheduler(self.cfg, self.pool)
         self._prefill_step = _build_prefill_step(model_cfg)
-        self._decode_step = _build_decode_step(model_cfg)
+        self._decode_step = _build_decode_step(
+            model_cfg,
+            self.cfg.decode_steps,
+            min(self.cfg.max_model_len,
+                self.cfg.max_pages_per_seq * self.cfg.page_size),
+        )
+        self._export_fn = _build_export_fn()
+        self._import_fn = _build_import_fn()
+        # device ops queued by the loop thread, executed by the pump between
+        # steps (self.kv is only ever touched between steps)
+        self._pending_ops: List = []
+        self.tiered = tiered
+        if tiered is not None:
+            self.add_event_sink(tiered.on_event)
+            # onboarding runs inside admission (pump loop thread, between
+            # steps) — blocking device work, small and batched
+            self.scheduler.onboard_fn = lambda hashes: tiered.onboard(self, hashes)
         import random as _random
 
         self._py_rng = _random.Random(0xD1A)
         self._queues: Dict[str, asyncio.Queue] = {}
         self._contexts: Dict[str, Context] = {}
+        self._seq_by_rid: Dict[str, Sequence] = {}
         self._wake = asyncio.Event()
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._pump_task: Optional[asyncio.Task] = None
@@ -182,9 +238,11 @@ class JaxEngine:
             return
         seq = Sequence(context.id, prompt, opts)
         seq.seed = opts.seed if opts.seed is not None else self._py_rng.getrandbits(31)
+        seq.hold_pages = bool(request.get("_hold_pages"))
         queue: asyncio.Queue = asyncio.Queue()
         self._queues[context.id] = queue
         self._contexts[context.id] = context
+        self._seq_by_rid[context.id] = seq
         self._requests_total += 1
         self.scheduler.add(seq)
         self._wake.set()
@@ -210,6 +268,7 @@ class JaxEngine:
             killed.cancel()
             self._queues.pop(context.id, None)
             self._contexts.pop(context.id, None)
+            self._seq_by_rid.pop(context.id, None)
             if not finished:
                 # consumer went away (kill, disconnect, stop-sequence close):
                 # make sure the scheduler drops the sequence
@@ -239,6 +298,24 @@ class JaxEngine:
             # mutated for cancellation — never concurrent with a step)
             while self._pending_aborts:
                 self.scheduler.abort(self._pending_aborts.pop())
+            # drain offload queue (device→host copies, KVBM)
+            if self.tiered is not None and self.tiered.pending_offloads:
+                try:
+                    await loop.run_in_executor(
+                        None, self.tiered.pump_offloads, self
+                    )
+                except Exception:  # noqa: BLE001
+                    logger.exception("kv offload failed")
+            # run queued device ops (KV export/import for disagg)
+            while self._pending_ops:
+                op, fut = self._pending_ops.pop(0)
+                try:
+                    result = await loop.run_in_executor(None, op)
+                    if not fut.done():
+                        fut.set_result(result)
+                except Exception as e:  # noqa: BLE001
+                    if not fut.done():
+                        fut.set_exception(e)
             # honor graceful stop requests before planning
             for rid, ctx in list(self._contexts.items()):
                 if ctx.is_stopped() and not ctx.is_killed():
@@ -363,14 +440,184 @@ class JaxEngine:
             seeds,
             counters,
         )
-        out = np.asarray(jax.device_get(out))
+        out = np.asarray(jax.device_get(out))  # [T, B]
         logp = np.asarray(jax.device_get(logp))
+        T = out.shape[0]
         for i, s in enumerate(seqs):
             if s.status != "running":
                 continue
-            s.num_computed += 1
-            self.scheduler.commit_full_pages(s)
-            self._append_token(s, int(out[i]), float(logp[i]))
+            for t in range(T):
+                s.num_computed += 1
+                self.scheduler.commit_full_pages(s)
+                self._append_token(s, int(out[t, i]), float(logp[t, i]))
+                if s.status != "running":
+                    break  # stop hit mid-block; rest of the block discarded
+
+    # -- disaggregation: KV export / import ---------------------------------- #
+
+    async def _device_op(self, op):
+        """Run a device op between pump steps (never concurrent with them)."""
+        self._ensure_pump()
+        fut = self._loop.create_future()
+        self._pending_ops.append((op, fut))
+        self._wake.set()
+        return await fut
+
+    async def _release_held(self, seq) -> None:
+        """Free pages a failed/cancelled remote prefill left held (pool
+        mutation goes through the pump like every other page op)."""
+        if seq is None or not seq.pages:
+            return
+        pages, seq.pages = list(seq.pages), []
+
+        def op():
+            self.pool.free(pages)
+
+        try:
+            await self._device_op(op)
+        except Exception:  # noqa: BLE001
+            logger.exception("failed to release held pages")
+
+    async def prefill_remote(self, request: Dict[str, Any],
+                             context: Optional[Context] = None) -> Dict[str, Any]:
+        """Prefill-only: compute the prompt, sample the first token, export
+        the KV pages.  The prefill-worker side of disaggregation (the
+        reference's remote-prefill handler,
+        /root/reference/components/src/dynamo/vllm/handlers.py:236)."""
+        request = dict(request)
+        request["stop_conditions"] = {
+            **(request.get("stop_conditions") or {}), "max_tokens": 1,
+        }
+        request["_hold_pages"] = True
+        context = context or Context()
+        first_token = None
+        seq = None
+        async for out in self.generate(request, context):
+            seq = self._seq_by_rid.get(context.id) or seq
+            if out.get("finish_reason") == "error":
+                await self._release_held(seq)
+                return {"error": out.get("error", "prefill failed")}
+            if out.get("token_ids"):
+                first_token = out["token_ids"][0]
+        if seq is None or first_token is None:
+            await self._release_held(seq)
+            return {"error": "prefill produced no token"}
+        pages = list(seq.pages)
+        width = bucket_for(max(len(pages), 1), self.cfg.table_width_buckets)
+        padded = np.zeros((width,), np.int32)
+        padded[: len(pages)] = pages
+
+        def export_op():
+            k, v = self._export_fn(self.kv, jnp.asarray(padded))
+            k = np.asarray(jax.device_get(k))[:, : len(pages)]
+            v = np.asarray(jax.device_get(v))[:, : len(pages)]
+            # release the held pages now that the copy is out
+            self.pool.free(pages)
+            seq.pages = []
+            return k, v
+
+        k, v = await self._device_op(export_op)
+        return {
+            "token_ids": [first_token],
+            "kv": {
+                "k": k.tobytes(),
+                "v": v.tobytes(),
+                "dtype": str(k.dtype),
+                "shape": list(k.shape),
+                "prompt_len": seq.prompt_len,
+                "page_size": self.cfg.page_size,
+            },
+        }
+
+    async def generate_with_kv(
+        self, request: Dict[str, Any], first_token: int, kv_blob: Dict[str, Any],
+        context: Optional[Context] = None,
+    ) -> AsyncIterator[Dict[str, Any]]:
+        """Decode-side: inject remotely-prefilled KV pages and continue
+        decoding (the reference decode handler's post-remote-prefill path,
+        handlers.py:221-231)."""
+        context = context or Context()
+        self._ensure_pump()
+        opts = _opts_from_request(request)
+        prompt = list(request["token_ids"])
+        shape = kv_blob["shape"]
+        dtype = np.dtype(kv_blob["dtype"])
+        k = np.frombuffer(kv_blob["k"], dtype).reshape(shape)
+        v = np.frombuffer(kv_blob["v"], dtype).reshape(shape)
+        if kv_blob["page_size"] != self.cfg.page_size:
+            raise ValueError(
+                f"page_size mismatch: remote {kv_blob['page_size']} vs "
+                f"local {self.cfg.page_size} (layout transpose TBD)"
+            )
+        n_pages = shape[1]
+        width = bucket_for(max(n_pages, 1), self.cfg.table_width_buckets)
+
+        def import_op():
+            pages = self.pool.allocate(n_pages)
+            padded = np.zeros((width,), np.int32)
+            padded[:n_pages] = pages
+            kpad = np.zeros((shape[0], width, *shape[2:]), dtype)
+            vpad = np.zeros_like(kpad)
+            kpad[:, :n_pages] = k
+            vpad[:, :n_pages] = v
+            self.kv = self._import_fn(
+                self.kv, jnp.asarray(kpad), jnp.asarray(vpad),
+                jnp.asarray(padded),
+            )
+            return pages
+
+        try:
+            pages = await self._device_op(import_op)
+        except NoPagesError as e:
+            # pool too full to accept the imported prefix right now — the
+            # caller falls back to local prefill (which queues normally)
+            yield {"token_ids": [], "finish_reason": "error",
+                   "error": f"kv import rejected: {e}"}
+            return
+        seq = Sequence(context.id, prompt, opts)
+        seq.seed = opts.seed if opts.seed is not None else self._py_rng.getrandbits(31)
+        seq.pages = pages
+        seq.num_computed = len(prompt)
+        seq.num_cached = len(prompt)
+        seq.output_tokens = [first_token]
+        queue: asyncio.Queue = asyncio.Queue()
+        self._queues[context.id] = queue
+        self._contexts[context.id] = context
+        self._requests_total += 1
+        # the remote first token counts toward stop conditions
+        reason = self.scheduler.check_stop(seq, self.eos_token_ids)
+        yield {"token_ids": [first_token], "finish_reason": reason}
+        if reason:
+            self.pool.free(seq.pages)
+            self._queues.pop(context.id, None)
+            self._contexts.pop(context.id, None)
+            return
+        self.scheduler.add_imported(seq)
+        self._wake.set()
+        killed = asyncio.create_task(context.killed())
+        finished = False
+        try:
+            while True:
+                get = asyncio.create_task(queue.get())
+                done, _ = await asyncio.wait(
+                    {get, killed}, return_when=asyncio.FIRST_COMPLETED
+                )
+                if get not in done:
+                    get.cancel()
+                    return
+                out = get.result()
+                if out is None:
+                    return
+                yield out
+                if out.get("finish_reason"):
+                    finished = True
+                    return
+        finally:
+            killed.cancel()
+            self._queues.pop(context.id, None)
+            self._contexts.pop(context.id, None)
+            if not finished:
+                self._abort(context.id)
 
     def _recover_after_error(self) -> None:
         """A failed jitted step may have consumed the donated KV buffers;

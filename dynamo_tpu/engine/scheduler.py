@@ -53,6 +53,7 @@ class Sequence:
         self.prompt = list(prompt)
         self.opts = opts
         self.seed = 0  # per-request sampling seed (engine assigns)
+        self.hold_pages = False  # finish() keeps pages (disagg KV export)
         self.pages: List[int] = []
         self.num_cached = 0  # prompt tokens satisfied from prefix cache
         self.num_computed = 0  # tokens whose KV is written
@@ -106,6 +107,9 @@ class Scheduler:
         # sequences errored inside planning (e.g. out of KV capacity with
         # nothing left to evict) — the engine drains and notifies
         self.errored: List[Sequence] = []
+        # optional multi-tier onboarding hook (KVBM): called with the hash
+        # run missed by the device cache, returns onboarded page ids
+        self.onboard_fn = None
 
     def drain_errored(self) -> List[Sequence]:
         out, self.errored = self.errored, []
@@ -154,7 +158,14 @@ class Scheduler:
             seq.status = "running"
             self.running.append(seq)
 
+    def add_imported(self, seq: Sequence) -> None:
+        """Admit a sequence whose KV was injected externally (disagg decode
+        side): pages and num_computed are already set; skip prefix cache."""
+        self.waiting.append(seq)
+
     def _apply_prefix_cache(self, seq: Sequence) -> None:
+        if seq.num_computed > 0:  # imported KV — already placed
+            return
         ps = self.cfg.page_size
         # never cache-hit the *entire* prompt: the last token must be
         # recomputed so prefill produces logits to sample from.
@@ -164,6 +175,9 @@ class Scheduler:
         if seq.prompt_len % ps == 0 and hashes:
             hashes = hashes[:-1]
         hit_pages = self.pool.lookup(hashes)
+        if self.onboard_fn is not None and len(hit_pages) < len(hashes):
+            # onboard() returns pages already holding this sequence's ref
+            hit_pages.extend(self.onboard_fn(hashes[len(hit_pages):]))
         if hit_pages:
             seq.pages = list(hit_pages)
             seq.num_cached = len(hit_pages) * ps
@@ -201,12 +215,19 @@ class Scheduler:
         if items:
             return StepPlan("prefill", prefill=items)
 
-        # decode pass: every running sequence advances one token
+        # decode pass: every running sequence advances decode_steps tokens
+        # (page reservation clamped to the model window so the table never
+        # outgrows its largest bucket)
+        hard_cap = min(
+            self.cfg.max_model_len,
+            self.cfg.max_pages_per_seq * self.cfg.page_size,
+        )
         decodable: List[Sequence] = []
         for seq in list(self.running):
             if seq.status != "running":
                 continue
-            if not self._ensure_pages(seq, seq.num_computed + 1):
+            target = min(seq.num_computed + self.cfg.decode_steps, hard_cap)
+            if not self._ensure_pages(seq, target):
                 continue
             decodable.append(seq)
         if decodable:
@@ -300,8 +321,9 @@ class Scheduler:
     def _finish(self, seq: Sequence, reason: str) -> None:
         seq.status = "finished"
         seq.finish_reason = reason
-        self.pool.free(seq.pages)
-        seq.pages = []
+        if not seq.hold_pages:
+            self.pool.free(seq.pages)
+            seq.pages = []
         if seq in self.running:
             self.running.remove(seq)
 

@@ -158,6 +158,8 @@ class ModelWatcher:
         except (ValueError, TypeError, KeyError) as e:
             logger.error("bad model card at %s: %s", key, e)
             return
+        if mdc.disagg_role == "prefill":
+            return  # prefill-only workers are not client-facing models
         entry = self.manager.get(mdc.name)
         if entry is None:
             tokenizer = self._load_tokenizer(mdc)

@@ -1,0 +1,7 @@
+"""KVBM — multi-tier KV block management (device HBM → host DRAM → disk)."""
+
+from .disk import DiskTier
+from .host_pool import HostBlock, HostBlockPool
+from .offload import TieredKvCache
+
+__all__ = ["DiskTier", "HostBlock", "HostBlockPool", "TieredKvCache"]

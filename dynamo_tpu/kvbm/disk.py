@@ -1,0 +1,85 @@
+"""Local-disk KV block tier (G3) — one .npz per block hash, byte-capped LRU
+(the reference's DiskTransferManager + NVMe tier,
+/root/reference/lib/llm/src/block_manager/offload.rs)."""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class DiskTier:
+    def __init__(self, root: str, capacity_bytes: int = 32 << 30):
+        self.root = root
+        self.capacity_bytes = capacity_bytes
+        os.makedirs(root, exist_ok=True)
+        self._lock = threading.Lock()
+        self._index: "OrderedDict[int, int]" = OrderedDict()  # hash → nbytes
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+        for name in os.listdir(root):
+            if name.endswith(".npz"):
+                try:
+                    h = int(name[:-4], 16)
+                except ValueError:
+                    continue
+                sz = os.path.getsize(os.path.join(root, name))
+                self._index[h] = sz
+                self._bytes += sz
+
+    def _path(self, block_hash: int) -> str:
+        return os.path.join(self.root, f"{block_hash:016x}.npz")
+
+    def put(self, block_hash: int, parent_hash: Optional[int],
+            k: np.ndarray, v: np.ndarray) -> None:
+        with self._lock:
+            if block_hash in self._index:
+                self._index.move_to_end(block_hash)
+                return
+            path = self._path(block_hash)
+            # hashes are u64; sentinel 2^64-1 = "no parent"
+            np.savez(
+                path, k=k, v=v,
+                parent=np.uint64(
+                    parent_hash if parent_hash is not None else (1 << 64) - 1
+                ),
+            )
+            sz = os.path.getsize(path)
+            self._index[block_hash] = sz
+            self._bytes += sz
+            while self._bytes > self.capacity_bytes and len(self._index) > 1:
+                old, old_sz = self._index.popitem(last=False)
+                self._bytes -= old_sz
+                try:
+                    os.remove(self._path(old))
+                except OSError:
+                    pass
+
+    def get(self, block_hash: int) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        with self._lock:
+            if block_hash not in self._index:
+                self.misses += 1
+                return None
+            self._index.move_to_end(block_hash)
+        try:
+            with np.load(self._path(block_hash)) as z:
+                self.hits += 1
+                return z["k"], z["v"]
+        except (OSError, KeyError):
+            with self._lock:
+                sz = self._index.pop(block_hash, 0)
+                self._bytes -= sz
+            self.misses += 1
+            return None
+
+    def __contains__(self, block_hash: int) -> bool:
+        with self._lock:
+            return block_hash in self._index
+
+    def __len__(self) -> int:
+        return len(self._index)

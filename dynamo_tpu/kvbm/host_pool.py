@@ -1,0 +1,105 @@
+"""Host-DRAM KV block tier (G2).
+
+The reference's KVBM pins host memory and runs CUDA copies
+(/root/reference/lib/llm/src/block_manager/, offload.rs, block_copy.cu);
+on TPU the device↔host path is jax device_get/device_put (DMA under the
+hood), and the host tier is plain numpy storage addressed by block hash.
+
+Capacity-bounded with LRU eviction; lookups refresh recency.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclass
+class HostBlock:
+    block_hash: int
+    parent_hash: Optional[int]
+    k: np.ndarray  # [L, page, n_kv, hd]
+    v: np.ndarray
+    stored_at: float
+
+    @property
+    def nbytes(self) -> int:
+        return self.k.nbytes + self.v.nbytes
+
+
+class HostBlockPool:
+    """hash-addressed host KV store with byte-budget LRU."""
+
+    def __init__(self, capacity_bytes: int = 4 << 30, on_evict=None):
+        self.capacity_bytes = capacity_bytes
+        self._blocks: "OrderedDict[int, HostBlock]" = OrderedDict()
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self.on_evict = on_evict  # callback(HostBlock) — demote to next tier
+        self.hits = 0
+        self.misses = 0
+        self.offloaded = 0
+        self.evicted = 0
+
+    def put(self, block_hash: int, parent_hash: Optional[int],
+            k: np.ndarray, v: np.ndarray) -> None:
+        demoted = []
+        with self._lock:
+            if block_hash in self._blocks:
+                self._blocks.move_to_end(block_hash)
+                return
+            blk = HostBlock(block_hash, parent_hash, k, v, time.monotonic())
+            self._blocks[block_hash] = blk
+            self._bytes += blk.nbytes
+            self.offloaded += 1
+            while self._bytes > self.capacity_bytes and len(self._blocks) > 1:
+                _, old = self._blocks.popitem(last=False)
+                self._bytes -= old.nbytes
+                self.evicted += 1
+                demoted.append(old)
+        if self.on_evict:
+            for old in demoted:
+                self.on_evict(old)
+
+    def get(self, block_hash: int) -> Optional[HostBlock]:
+        with self._lock:
+            blk = self._blocks.get(block_hash)
+            if blk is None:
+                self.misses += 1
+                return None
+            self._blocks.move_to_end(block_hash)
+            self.hits += 1
+            return blk
+
+    def pop(self, block_hash: int) -> Optional[HostBlock]:
+        with self._lock:
+            blk = self._blocks.pop(block_hash, None)
+            if blk is not None:
+                self._bytes -= blk.nbytes
+            return blk
+
+    def lookup_run(self, hashes: Sequence[int]) -> List[HostBlock]:
+        """Leading run of consecutive hashes present in this tier."""
+        out = []
+        for h in hashes:
+            blk = self.get(h)
+            if blk is None:
+                break
+            out.append(blk)
+        return out
+
+    def __contains__(self, block_hash: int) -> bool:
+        with self._lock:
+            return block_hash in self._blocks
+
+    def __len__(self) -> int:
+        return len(self._blocks)
+
+    @property
+    def bytes_used(self) -> int:
+        return self._bytes

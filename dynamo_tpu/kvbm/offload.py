@@ -1,0 +1,152 @@
+"""TieredKvCache — the offload/onboard manager gluing the engine's device
+page pool (G1) to host DRAM (G2) and disk (G3).
+
+Reference: /root/reference/lib/llm/src/block_manager/offload.rs:86
+`OffloadManager` (priority-queued G1→G2 copies via the block_copy.cu
+kernel, G2→G3 via DiskTransferManager, onboarding on schedule-time cache
+miss).  TPU design differences:
+
+- G1→G2 copies are jitted gathers + device_get, batched per engine step
+  (the pump drains the offload queue between steps, so copies never race
+  the donated KV buffers);
+- demotion G2→G3 happens on host-LRU eviction (write-back, not
+  write-through);
+- onboarding runs inside admission: after the device prefix-cache lookup,
+  the remaining hash run is looked up host-first then disk (promoting to
+  host), imported into freshly-allocated device pages, and committed so
+  the device cache (and KV-event subscribers) see them.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .disk import DiskTier
+from .host_pool import HostBlock, HostBlockPool
+
+logger = logging.getLogger(__name__)
+
+
+class TieredKvCache:
+    def __init__(self, host: HostBlockPool, disk: Optional[DiskTier] = None,
+                 max_offload_batch: int = 16):
+        self.host = host
+        self.disk = disk
+        self.max_offload_batch = max_offload_batch
+        self._pending: List[Tuple[int, Optional[int]]] = []  # (hash, parent)
+        self._lock = threading.Lock()
+        self.onboarded_blocks = 0
+        if disk is not None:
+            host.on_evict = self._demote
+
+    def _demote(self, blk: HostBlock) -> None:
+        try:
+            self.disk.put(blk.block_hash, blk.parent_hash, blk.k, blk.v)
+        except OSError as e:
+            logger.warning("disk demotion failed: %s", e)
+
+    # -- engine event sink (any thread) -------------------------------------- #
+
+    def on_event(self, ev) -> None:
+        if ev.kind != "stored":
+            return
+        parent = ev.parent_hash
+        with self._lock:
+            for h in ev.block_hashes:
+                self._pending.append((h, parent))
+                parent = h
+
+    # -- offload pump (called by the engine between steps) ------------------- #
+
+    def pump_offloads(self, engine) -> int:
+        """Copy queued blocks device→host. Returns blocks offloaded."""
+        import jax
+        import jax.numpy as jnp
+
+        with self._lock:
+            batch = self._pending[: self.max_offload_batch]
+            self._pending = self._pending[self.max_offload_batch:]
+        todo = [
+            (h, p) for h, p in batch
+            if h not in self.host and (self.disk is None or h not in self.disk)
+        ]
+        # resolve hashes to live device pages (skip already-evicted)
+        pages, meta = [], []
+        for h, p in todo:
+            page = engine.pool._cached.get(h)  # noqa: SLF001 — engine-internal glue
+            if page is not None:
+                pages.append(page)
+                meta.append((h, p))
+        if not pages:
+            return 0
+        from ..engine.config import bucket_for
+
+        width = bucket_for(len(pages), engine.cfg.table_width_buckets)
+        padded = np.zeros((width,), np.int32)
+        padded[: len(pages)] = pages
+        k, v = engine._export_fn(engine.kv, jnp.asarray(padded))  # noqa: SLF001
+        k = np.asarray(jax.device_get(k))
+        v = np.asarray(jax.device_get(v))
+        for i, (h, p) in enumerate(meta):
+            self.host.put(h, p, k[:, i].copy(), v[:, i].copy())
+        return len(meta)
+
+    @property
+    def pending_offloads(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    # -- onboarding (admission path) ----------------------------------------- #
+
+    def lookup_run(self, hashes: Sequence[int]) -> List[HostBlock]:
+        """Leading run across host+disk; disk hits are promoted to host."""
+        out: List[HostBlock] = []
+        for h in hashes:
+            blk = self.host.get(h)
+            if blk is None and self.disk is not None:
+                kv = self.disk.get(h)
+                if kv is not None:
+                    parent = out[-1].block_hash if out else None
+                    self.host.put(h, parent, kv[0], kv[1])
+                    blk = self.host.get(h)
+            if blk is None:
+                break
+            out.append(blk)
+        return out
+
+    def onboard(self, engine, hashes: Sequence[int]) -> List[int]:
+        """Import the leading cached run into device pages; returns page ids
+        (committed to the device prefix cache)."""
+        import jax.numpy as jnp
+
+        run = self.lookup_run(hashes)
+        if not run:
+            return []
+        # leave headroom: don't onboard into the last free pages
+        max_blocks = max(0, engine.pool.available_pages - 2)
+        run = run[:max_blocks]
+        if not run:
+            return []
+        from ..engine.config import bucket_for
+
+        pages = engine.pool.allocate(len(run))
+        width = bucket_for(len(pages), engine.cfg.table_width_buckets)
+        padded = np.zeros((width,), np.int32)
+        padded[: len(pages)] = pages
+        L = run[0].k.shape[0]
+        kpad = np.zeros((L, width, *run[0].k.shape[1:]), run[0].k.dtype)
+        vpad = np.zeros_like(kpad)
+        for i, blk in enumerate(run):
+            kpad[:, i] = blk.k
+            vpad[:, i] = blk.v
+        engine.kv = engine._import_fn(  # noqa: SLF001
+            engine.kv, jnp.asarray(kpad), jnp.asarray(vpad), jnp.asarray(padded)
+        )
+        for blk, page in zip(run, pages):
+            engine.pool.commit(page, blk.block_hash, blk.parent_hash)
+        self.onboarded_blocks += len(run)
+        return pages

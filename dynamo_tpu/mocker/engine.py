@@ -193,6 +193,15 @@ class MockEngine:
     async def _pump(self) -> None:
         while not self._closed:
             plan = self.scheduler.schedule()
+            # deliver planning-time errors BEFORE the idle park, or an
+            # out-of-capacity request hangs forever
+            for seq in self.scheduler.drain_errored():
+                q = self._queues.get(seq.request_id)
+                if q is not None:
+                    q.put_nowait(
+                        {"token_ids": [], "finish_reason": "error",
+                         "error": "out of kv capacity"}
+                    )
             if plan.kind == "idle":
                 if not self.scheduler.has_work:
                     self._wake.clear()
@@ -200,13 +209,6 @@ class MockEngine:
                 else:
                     await asyncio.sleep(0.001)
                 continue
-            for seq in self.scheduler.drain_errored():
-                queue = self._queues.get(seq.request_id)
-                if queue is not None:
-                    queue.put_nowait(
-                        {"token_ids": [], "finish_reason": "error",
-                         "error": "out of kv capacity"}
-                    )
             self.step_log.append(plan.kind)
             if plan.kind == "prefill":
                 await self._run_prefill(plan.prefill)

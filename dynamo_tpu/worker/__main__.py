@@ -56,11 +56,25 @@ async def _run(args) -> None:
     # block for longer than the lease TTL
     engine, mdc = _build_engine(args)
     runtime = await DistributedRuntime.connect(args.control)
-    await serve_engine(
-        runtime, engine, mdc,
-        namespace=args.namespace, component=args.component,
-        endpoint=args.endpoint,
-    )
+    if args.disagg_role == "prefill":
+        from ..disagg import serve_prefill_worker
+
+        await serve_prefill_worker(runtime, engine, mdc, namespace=args.namespace)
+    elif args.disagg_role == "decode":
+        from ..disagg import DisaggDecodeHandler
+
+        engine = DisaggDecodeHandler(engine, runtime, namespace=args.namespace)
+        await serve_engine(
+            runtime, engine, mdc,
+            namespace=args.namespace, component=args.component,
+            endpoint=args.endpoint,
+        )
+    else:
+        await serve_engine(
+            runtime, engine, mdc,
+            namespace=args.namespace, component=args.component,
+            endpoint=args.endpoint,
+        )
     print(f"READY worker {mdc.name}", flush=True)
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()

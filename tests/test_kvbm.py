@@ -1,0 +1,114 @@
+"""KVBM tiering: offload to host, eviction-demotion to disk, onboarding
+restores exact KV (greedy output invariance after device-cache clear)."""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine import EngineConfig, JaxEngine
+from dynamo_tpu.kvbm import DiskTier, HostBlockPool, TieredKvCache
+from dynamo_tpu.models import init_params, tiny_config
+
+
+@pytest.fixture(scope="module")
+def model_setup():
+    cfg = tiny_config()
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    return cfg, params
+
+
+def make_engine(model_setup, tiered=None, **over):
+    cfg, params = model_setup
+    defaults = dict(page_size=8, num_pages=64, max_num_seqs=4,
+                    max_prefill_tokens=64, max_model_len=256)
+    defaults.update(over)
+    return JaxEngine(cfg, params, EngineConfig(**defaults),
+                     eos_token_ids=[], kv_dtype=jnp.float32, tiered=tiered)
+
+
+def req(tokens, max_tokens=4):
+    return {
+        "token_ids": tokens,
+        "sampling_options": {"temperature": 0.0},
+        "stop_conditions": {"max_tokens": max_tokens, "ignore_eos": True},
+    }
+
+
+async def collect(engine, request):
+    out = []
+    async for d in engine.generate(request):
+        out.extend(d["token_ids"])
+    return out
+
+
+def test_host_pool_lru_and_bytes():
+    evicted = []
+    pool = HostBlockPool(capacity_bytes=4 * 1024, on_evict=evicted.append)
+    k = np.zeros((2, 8, 2, 4), np.float32)  # 512B each; block = 1KiB
+    for h in range(100, 106):
+        pool.put(h, h - 1, k, k)
+    assert len(pool) <= 4
+    assert evicted and evicted[0].block_hash == 100
+    assert pool.get(105) is not None
+    assert pool.get(100) is None
+
+
+def test_disk_tier_roundtrip(tmp_path):
+    disk = DiskTier(str(tmp_path), capacity_bytes=1 << 20)
+    k = np.arange(64, dtype=np.float32).reshape(2, 8, 2, 2)
+    disk.put(0xABC, None, k, k * 2)
+    got = disk.get(0xABC)
+    np.testing.assert_array_equal(got[0], k)
+    np.testing.assert_array_equal(got[1], k * 2)
+    # restart survives
+    disk2 = DiskTier(str(tmp_path))
+    assert 0xABC in disk2
+
+
+async def test_offload_and_onboard_preserves_output(model_setup, tmp_path):
+    tiered = TieredKvCache(
+        HostBlockPool(capacity_bytes=64 << 20), DiskTier(str(tmp_path))
+    )
+    engine = make_engine(model_setup, tiered=tiered)
+    prompt = list(range(1, 41))  # 5 full pages
+    want = await collect(engine, req(prompt))
+
+    # wait for offloads to drain to host
+    deadline = asyncio.get_running_loop().time() + 5
+    while tiered.pending_offloads or len(tiered.host) == 0:
+        assert asyncio.get_running_loop().time() < deadline, "no offload"
+        await asyncio.sleep(0.05)
+    assert len(tiered.host) >= 5
+
+    # nuke the device cache: the only KV copy is now host-side
+    engine.clear_kv_blocks()
+    assert engine.pool.evictable_pages == 0
+
+    got = await collect(engine, req(prompt))
+    assert got == want
+    # the last prompt block is never cache-hit (logits must be recomputed),
+    # so 4 of the 5 full blocks onboard
+    assert tiered.onboarded_blocks >= 4
+    await engine.shutdown()
+
+
+async def test_disk_promotion_path(model_setup, tmp_path):
+    """Host tier too small to hold everything → blocks demote to disk and
+    still onboard correctly."""
+    tiny_host = HostBlockPool(capacity_bytes=2 << 10)  # ~1 block
+    tiered = TieredKvCache(tiny_host, DiskTier(str(tmp_path)))
+    engine = make_engine(model_setup, tiered=tiered)
+    prompt = list(range(50, 90))  # 5 pages
+    want = await collect(engine, req(prompt))
+    deadline = asyncio.get_running_loop().time() + 5
+    while tiered.pending_offloads:
+        assert asyncio.get_running_loop().time() < deadline
+        await asyncio.sleep(0.05)
+    assert len(tiered.disk) >= 1  # demoted under host pressure
+    engine.clear_kv_blocks()
+    got = await collect(engine, req(prompt))
+    assert got == want
+    await engine.shutdown()
