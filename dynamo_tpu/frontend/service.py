@@ -24,6 +24,7 @@ from ..llm import (
     OpenAIPreprocessor,
     postprocess_stream,
 )
+from ..llm.migration import migrating_stream
 from ..runtime import Client, Context, DistributedRuntime
 from ..runtime.transport.wire import pack, unpack
 
@@ -65,9 +66,12 @@ class ModelEntry:
 
     def generate(self, request: Dict[str, Any], context: Context
                  ) -> AsyncIterator[Dict[str, Any]]:
-        """Preprocessed-request in, postprocessed text deltas out."""
+        """Preprocessed-request in, postprocessed text deltas out (with
+        transparent migration on worker loss)."""
         return postprocess_stream(
-            self.route(request, context),
+            migrating_stream(
+                request, context, self.route, self.mdc.migration_limit
+            ),
             self.tokenizer,
             prompt_ids=request.get("token_ids"),
             stop_sequences=request.get("stop_conditions", {}).get(

@@ -1,0 +1,84 @@
+"""Request migration — seamless retry on worker death.
+
+The reference's Migration stage (/root/reference/lib/llm/src/migration.rs:26,
+docs/architecture/request_migration.md): the frontend accumulates generated
+tokens into the request; when the worker stream dies mid-generation, the
+request is re-issued to another worker with `prompt + generated` as the new
+prompt and the generation budget reduced — the client sees an uninterrupted
+token stream.  Works because engines treat any token prefix as a prompt
+(and the prefix cache usually makes the re-prefill cheap).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, AsyncIterator, Callable, Dict
+
+from ..runtime import Context
+from ..runtime.transport.service import RemoteStreamError, ServiceUnavailable
+
+logger = logging.getLogger(__name__)
+
+# engine stream factory: (request, context) -> async iterator
+StreamFactory = Callable[[Dict[str, Any], Context], AsyncIterator[Dict[str, Any]]]
+
+
+async def migrating_stream(
+    request: Dict[str, Any],
+    context: Context,
+    stream_factory: StreamFactory,
+    migration_limit: int = 3,
+) -> AsyncIterator[Dict[str, Any]]:
+    """Stream engine outputs, transparently migrating on transport failure."""
+    prompt = list(request.get("token_ids") or [])
+    generated: list[int] = []
+    budget = (request.get("stop_conditions") or {}).get("max_tokens")
+    attempts = 0
+    while True:
+        attempt_request = request
+        if generated:
+            if isinstance(budget, int) and budget - len(generated) <= 0:
+                # the worker died after delivering the full budget but
+                # before the finish chunk — the stream is complete
+                yield {"token_ids": [], "finish_reason": "length"}
+                return
+            sc = dict(request.get("stop_conditions") or {})
+            if isinstance(budget, int):
+                sc["max_tokens"] = budget - len(generated)
+            attempt_request = {
+                **request,
+                "token_ids": prompt + generated,
+                "stop_conditions": sc,
+            }
+        progressed = False
+        try:
+            async for out in stream_factory(attempt_request, context):
+                toks = out.get("token_ids") or []
+                generated.extend(toks)
+                progressed = progressed or bool(toks)
+                yield out
+                if out.get("finish_reason"):
+                    return
+            # stream ended without finish_reason: treat as worker loss
+            raise RemoteStreamError("stream ended without finish")
+        except (ServiceUnavailable, RemoteStreamError, ConnectionError) as e:
+            if context.is_killed() or context.is_stopped():
+                return
+            if progressed:
+                # progress means this failure is a fresh incident, not a
+                # deterministic rejection looping — reset the budget
+                attempts = 0
+            attempts += 1
+            if attempts > migration_limit:
+                logger.error(
+                    "request %s: migration limit (%d) exhausted: %s",
+                    context.id, migration_limit, e,
+                )
+                yield {"token_ids": [], "finish_reason": "error",
+                       "error": f"migration exhausted after {attempts - 1} "
+                                f"retries; last error: {e}"}
+                return
+            logger.info(
+                "request %s: migrating after %d tokens (attempt %d): %s",
+                context.id, len(generated), attempts, e,
+            )

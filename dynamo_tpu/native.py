@@ -1,0 +1,50 @@
+"""Loader for the native (C++) components under native/build/.
+
+Falls back silently when the libs aren't built — every native component
+has a pure-Python twin.  Build with `make -C native`.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_BUILD = os.path.join(_ROOT, "native", "build")
+
+_radix_lib: Optional[ctypes.CDLL] = None
+
+
+def radix_lib() -> Optional[ctypes.CDLL]:
+    """The libdynamo_radix.so handle, or None when not built."""
+    global _radix_lib
+    if _radix_lib is not None:
+        return _radix_lib
+    path = os.path.join(_BUILD, "libdynamo_radix.so")
+    if not os.path.exists(path):
+        return None
+    lib = ctypes.CDLL(path)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    lib.radix_create.restype = ctypes.c_void_p
+    lib.radix_destroy.argtypes = [ctypes.c_void_p]
+    lib.radix_apply_stored.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, u64p, ctypes.c_int64]
+    lib.radix_apply_removed.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, u64p, ctypes.c_int64]
+    lib.radix_remove_worker.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.radix_num_blocks.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.radix_num_blocks.restype = ctypes.c_int64
+    lib.radix_num_workers.argtypes = [ctypes.c_void_p]
+    lib.radix_num_workers.restype = ctypes.c_int64
+    lib.radix_find_matches.argtypes = [
+        ctypes.c_void_p, u64p, ctypes.c_int64, i64p, i64p, ctypes.c_int64]
+    lib.radix_find_matches.restype = ctypes.c_int64
+    lib.radix_worker_hashes.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, u64p, ctypes.c_int64]
+    lib.radix_worker_hashes.restype = ctypes.c_int64
+    lib.radix_workers.argtypes = [ctypes.c_void_p, i64p, ctypes.c_int64]
+    lib.radix_workers.restype = ctypes.c_int64
+    _radix_lib = lib
+    return lib

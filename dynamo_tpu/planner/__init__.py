@@ -1,0 +1,26 @@
+"""SLA planner: load prediction → perf interpolation → replica targets."""
+
+from .connectors import LocalProcessConnector, VirtualConnector
+from .core import LoadSample, Planner, PlannerConfig, SLO
+from .load_predictor import (
+    ARPredictor,
+    ConstantPredictor,
+    MovingAveragePredictor,
+    make_predictor,
+)
+from .perf_model import PerfProfile, synthetic_profile
+
+__all__ = [
+    "ARPredictor",
+    "ConstantPredictor",
+    "LoadSample",
+    "LocalProcessConnector",
+    "MovingAveragePredictor",
+    "PerfProfile",
+    "Planner",
+    "PlannerConfig",
+    "SLO",
+    "VirtualConnector",
+    "make_predictor",
+    "synthetic_profile",
+]

@@ -1,0 +1,144 @@
+"""SLA planner core (reference
+/root/reference/components/src/dynamo/planner/utils/planner_core.py:61
+`Planner`): observe load → predict next interval → size prefill/decode
+replica counts from the perf profile → apply through a connector."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import math
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from .load_predictor import BasePredictor, make_predictor
+from .perf_model import PerfProfile, synthetic_profile
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class SLO:
+    ttft_s: float = 0.5
+    itl_s: float = 0.05
+
+
+@dataclass
+class LoadSample:
+    """One observation interval of offered load."""
+
+    requests_per_s: float = 0.0
+    prefill_tokens_per_s: float = 0.0
+    concurrent_decodes: float = 0.0
+
+
+@dataclass
+class PlannerConfig:
+    slo: SLO = field(default_factory=SLO)
+    adjustment_interval_s: float = 30.0
+    min_replicas: int = 1
+    max_replicas: int = 64
+    predictor: str = "arima"
+    # scale down only after N consecutive intervals suggest it (hysteresis)
+    scale_down_patience: int = 3
+
+
+class Planner:
+    def __init__(
+        self,
+        connector,
+        prefill_profile: Optional[PerfProfile] = None,
+        decode_profile: Optional[PerfProfile] = None,
+        config: Optional[PlannerConfig] = None,
+    ):
+        self.connector = connector
+        self.cfg = config or PlannerConfig()
+        self.prefill_profile = prefill_profile or synthetic_profile()
+        self.decode_profile = decode_profile or synthetic_profile()
+        self._prefill_pred: BasePredictor = make_predictor(self.cfg.predictor)
+        self._decode_pred: BasePredictor = make_predictor(self.cfg.predictor)
+        self._task: Optional[asyncio.Task] = None
+        self._below_count: Dict[str, int] = {"prefill": 0, "decode": 0}
+        self.current: Dict[str, int] = {"prefill": 0, "decode": 0}
+        self.last_decision: Dict[str, int] = {}
+
+    # -- observation --------------------------------------------------------- #
+
+    def observe(self, sample: LoadSample) -> None:
+        self._prefill_pred.observe(sample.prefill_tokens_per_s)
+        self._decode_pred.observe(sample.concurrent_decodes)
+
+    # -- sizing -------------------------------------------------------------- #
+
+    def _replicas_for(self, kind: str, predicted_load: float) -> int:
+        if kind == "prefill":
+            per_worker = self.prefill_profile.max_prefill_load_under(
+                self.cfg.slo.ttft_s
+            )
+        else:
+            per_worker = self.decode_profile.max_decode_concurrency_under(
+                self.cfg.slo.itl_s
+            )
+        if per_worker <= 0:
+            logger.warning(
+                "%s profile cannot meet SLO at any load; pinning max replicas",
+                kind,
+            )
+            return self.cfg.max_replicas
+        need = math.ceil(predicted_load / per_worker) if predicted_load > 0 else 0
+        return max(self.cfg.min_replicas,
+                   min(self.cfg.max_replicas, need))
+
+    def plan_once(self) -> Dict[str, int]:
+        """Compute targets from predictions, with scale-down hysteresis."""
+        targets = {
+            "prefill": self._replicas_for("prefill", self._prefill_pred.predict()),
+            "decode": self._replicas_for("decode", self._decode_pred.predict()),
+        }
+        out = {}
+        for kind, want in targets.items():
+            have = self.current.get(kind, 0)
+            if want < have:
+                self._below_count[kind] += 1
+                if self._below_count[kind] < self.cfg.scale_down_patience:
+                    want = have  # hold
+                else:
+                    self._below_count[kind] = 0
+            else:
+                self._below_count[kind] = 0
+            out[kind] = want
+        self.last_decision = out
+        return out
+
+    async def apply(self) -> Dict[str, int]:
+        targets = self.plan_once()
+        for kind, n in targets.items():
+            if n != self.current.get(kind):
+                await self.connector.scale(kind, n)
+                self.current[kind] = n
+        return targets
+
+    # -- loop ---------------------------------------------------------------- #
+
+    def start(self) -> "Planner":
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+        return self
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            await asyncio.gather(self._task, return_exceptions=True)
+
+    async def _loop(self) -> None:
+        while True:
+            try:
+                await asyncio.sleep(self.cfg.adjustment_interval_s)
+                sample = await self.connector.collect_load()
+                if sample is not None:
+                    self.observe(sample)
+                await self.apply()
+            except asyncio.CancelledError:
+                return
+            except Exception:  # noqa: BLE001
+                logger.exception("planner loop error")

@@ -1,0 +1,80 @@
+"""Load predictors for the SLA planner (reference
+/root/reference/components/src/dynamo/planner/utils/load_predictor.py:
+constant / ARIMA / Prophet).  Prophet is a heavyweight dependency; the
+AR-with-trend predictor below covers the same short-horizon use."""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Deque, Optional
+
+import numpy as np
+
+
+class BasePredictor:
+    def __init__(self, window: int = 64):
+        self.history: Deque[float] = deque(maxlen=window)
+
+    def observe(self, value: float) -> None:
+        self.history.append(float(value))
+
+    def predict(self) -> float:
+        raise NotImplementedError
+
+
+class ConstantPredictor(BasePredictor):
+    """Next load = last observed."""
+
+    def predict(self) -> float:
+        return self.history[-1] if self.history else 0.0
+
+
+class MovingAveragePredictor(BasePredictor):
+    def __init__(self, window: int = 8):
+        super().__init__(window)
+
+    def predict(self) -> float:
+        return float(np.mean(self.history)) if self.history else 0.0
+
+
+class ARPredictor(BasePredictor):
+    """AR(p) with linear trend, least-squares fit over the window — the
+    dependency-free stand-in for the reference's ARIMA."""
+
+    def __init__(self, window: int = 64, order: int = 4):
+        super().__init__(window)
+        self.order = order
+
+    def predict(self) -> float:
+        h = np.asarray(self.history, np.float64)
+        n = len(h)
+        if n == 0:
+            return 0.0
+        if n <= self.order + 2:
+            return float(h[-1])
+        p = self.order
+        # design matrix: lagged values + time index + bias
+        rows = []
+        ys = []
+        for t in range(p, n):
+            rows.append(np.concatenate([h[t - p : t], [t, 1.0]]))
+            ys.append(h[t])
+        A = np.asarray(rows)
+        y = np.asarray(ys)
+        coef, *_ = np.linalg.lstsq(A, y, rcond=None)
+        x = np.concatenate([h[n - p :], [n, 1.0]])
+        pred = float(x @ coef)
+        lo, hi = float(h.min()), float(h.max())
+        spread = max(hi - lo, 1e-9)
+        return float(np.clip(pred, lo - spread, hi + spread))
+
+
+PREDICTORS = {
+    "constant": ConstantPredictor,
+    "moving_average": MovingAveragePredictor,
+    "arima": ARPredictor,
+}
+
+
+def make_predictor(kind: str, **kw) -> BasePredictor:
+    return PREDICTORS[kind](**kw)

@@ -17,8 +17,10 @@ import time
 from collections import defaultdict
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
+from ..native import radix_lib
 
-class RadixIndex:
+
+class PyRadixIndex:
     """block hash → workers holding it, with per-worker reverse sets."""
 
     def __init__(self):
@@ -81,11 +83,110 @@ class RadixIndex:
         return {w: sorted(hs) for w, hs in self._by_worker.items()}
 
     @staticmethod
-    def from_snapshot(data: Dict[int, List[int]]) -> "RadixIndex":
-        idx = RadixIndex()
+    def from_snapshot(data: Dict[int, List[int]]) -> "PyRadixIndex":
+        idx = PyRadixIndex()
         for w, hs in data.items():
             idx.apply_stored(int(w), hs)
         return idx
+
+
+class NativeRadixIndex:
+    """ctypes front for native/radix_index.cpp (C++), selected when
+    `make -C native` has been run.  Same interface/semantics as
+    PyRadixIndex (shared tests assert equivalence)."""
+
+    def __init__(self):
+        import ctypes
+
+        self._lib = radix_lib()
+        assert self._lib is not None
+        self._ptr = ctypes.c_void_p(self._lib.radix_create())
+        self._ct = ctypes
+
+    def __del__(self):
+        lib = getattr(self, "_lib", None)
+        ptr = getattr(self, "_ptr", None)
+        if lib is not None and ptr:
+            lib.radix_destroy(ptr)
+
+    def _u64(self, values):
+        n = len(values)
+        arr = (self._ct.c_uint64 * n)(*[v & 0xFFFFFFFFFFFFFFFF for v in values])
+        return arr, n
+
+    def apply_stored(self, worker_id: int, block_hashes: Iterable[int]) -> None:
+        hs = list(block_hashes)
+        if not hs:
+            return
+        arr, n = self._u64(hs)
+        self._lib.radix_apply_stored(self._ptr, worker_id, arr, n)
+
+    def apply_removed(self, worker_id: int, block_hashes: Iterable[int]) -> None:
+        hs = list(block_hashes)
+        if not hs:
+            return
+        arr, n = self._u64(hs)
+        self._lib.radix_apply_removed(self._ptr, worker_id, arr, n)
+
+    def remove_worker(self, worker_id: int) -> None:
+        self._lib.radix_remove_worker(self._ptr, worker_id)
+
+    clear_worker = remove_worker
+
+    def _worker_cap(self) -> int:
+        # size buffers from the live worker count — no silent truncation
+        return max(int(self._lib.radix_num_workers(self._ptr)), 1)
+
+    def find_matches(self, block_hashes: Sequence[int]) -> Dict[int, int]:
+        hs = list(block_hashes)
+        if not hs:
+            return {}
+        arr, n = self._u64(hs)
+        cap = self._worker_cap()
+        workers = (self._ct.c_int64 * cap)()
+        overlaps = (self._ct.c_int64 * cap)()
+        m = self._lib.radix_find_matches(self._ptr, arr, n, workers, overlaps, cap)
+        return {int(workers[i]): int(overlaps[i]) for i in range(m)}
+
+    def workers(self) -> List[int]:
+        cap = self._worker_cap()
+        out = (self._ct.c_int64 * cap)()
+        m = self._lib.radix_workers(self._ptr, out, cap)
+        return sorted(int(out[i]) for i in range(m))
+
+    def num_blocks(self, worker_id: int) -> int:
+        return int(self._lib.radix_num_blocks(self._ptr, worker_id))
+
+    def snapshot(self) -> Dict[int, List[int]]:
+        out = {}
+        for w in self.workers():
+            cap = max(self.num_blocks(w), 1)
+            buf = (self._ct.c_uint64 * cap)()
+            m = self._lib.radix_worker_hashes(self._ptr, w, buf, cap)
+            out[w] = sorted(int(buf[i]) for i in range(m))
+        return out
+
+    @staticmethod
+    def from_snapshot(data: Dict[int, List[int]]) -> "NativeRadixIndex":
+        idx = NativeRadixIndex()
+        for w, hs in data.items():
+            idx.apply_stored(int(w), hs)
+        return idx
+
+
+def _select_radix_cls():
+    return NativeRadixIndex if radix_lib() is not None else PyRadixIndex
+
+
+class RadixIndex:
+    """Facade picking the native C++ index when built, else pure Python."""
+
+    def __new__(cls):
+        return _select_radix_cls()()
+
+    @staticmethod
+    def from_snapshot(data: Dict[int, List[int]]):
+        return _select_radix_cls().from_snapshot(data)
 
 
 class ApproxKvIndexer:

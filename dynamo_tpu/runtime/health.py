@@ -1,0 +1,119 @@
+"""Health checking through the real request path.
+
+Reference: /root/reference/lib/runtime/src/health_check.rs:44
+`HealthCheckManager` — each endpoint declares a `health_check_payload`; the
+manager periodically sends it through the endpoint's actual handler (not a
+side channel), so a wedged engine fails its health check even while the
+process is alive.  `SystemHealth` aggregation feeds the status server's
+/health.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from .engine import Context
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class EndpointHealth:
+    healthy: bool = False
+    consecutive_failures: int = 0
+    last_ok: float = 0.0
+    last_latency_ms: float = 0.0
+    last_error: str = ""
+
+
+class HealthCheckManager:
+    def __init__(self, runtime, interval: float = 5.0, timeout: float = 10.0,
+                 failure_threshold: int = 3):
+        self.runtime = runtime
+        self.interval = interval
+        self.timeout = timeout
+        self.failure_threshold = failure_threshold
+        self.state: Dict[str, EndpointHealth] = {}
+        self._task: Optional[asyncio.Task] = None
+
+    def start(self) -> "HealthCheckManager":
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+        return self
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            await asyncio.gather(self._task, return_exceptions=True)
+
+    async def _loop(self) -> None:
+        while True:
+            try:
+                await asyncio.sleep(self.interval)
+                await self.check_all()
+            except asyncio.CancelledError:
+                return
+            except Exception:  # noqa: BLE001
+                logger.exception("health check loop error")
+
+    async def check_all(self) -> None:
+        for served in list(self.runtime._served):  # noqa: SLF001
+            payload = served.health_check_payload
+            if payload is None:
+                continue
+            name = served.endpoint.wire_name
+            st = self.state.setdefault(name, EndpointHealth())
+            handler = self.runtime.service_server._handlers.get(name)  # noqa: SLF001
+            if handler is None:
+                st.healthy = False
+                st.last_error = "handler not registered"
+                continue
+            t0 = time.monotonic()
+            try:
+                async def probe():
+                    gen = handler(payload, Context())
+                    try:
+                        async for _first in gen:
+                            return True
+                        return False
+                    finally:
+                        await gen.aclose()  # don't leave the probe running
+
+                ok = await asyncio.wait_for(probe(), self.timeout)
+                if ok:
+                    st.healthy = True
+                    st.consecutive_failures = 0
+                    st.last_ok = time.monotonic()
+                    st.last_latency_ms = (time.monotonic() - t0) * 1e3
+                    st.last_error = ""
+                else:
+                    raise RuntimeError("health probe yielded nothing")
+            except (asyncio.TimeoutError, Exception) as e:  # noqa: BLE001
+                st.consecutive_failures += 1
+                st.last_error = repr(e)
+                if st.consecutive_failures >= self.failure_threshold:
+                    st.healthy = False
+                logger.warning(
+                    "health check failed for %s (%d consecutive): %r",
+                    name, st.consecutive_failures, e,
+                )
+
+    def system_health(self) -> dict:
+        """Aggregate for the status server's /health."""
+        endpoints = {
+            name: {
+                "healthy": st.healthy,
+                "consecutive_failures": st.consecutive_failures,
+                "latency_ms": round(st.last_latency_ms, 2),
+                **({"error": st.last_error} if st.last_error else {}),
+            }
+            for name, st in self.state.items()
+        }
+        all_ok = all(st.healthy for st in self.state.values()) if self.state else True
+        return {
+            "status": "healthy" if all_ok else "unhealthy",
+            "endpoints": endpoints,
+        }

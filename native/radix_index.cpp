@@ -1,0 +1,160 @@
+// Native radix/prefix index for KV-cache routing.
+//
+// The reference keeps its RadixTree in Rust because find_matches runs on
+// every request against millions of cached blocks
+// (/root/reference/lib/llm/src/kv_router/indexer.rs:222).  This is the
+// C++ equivalent for the TPU build's router: hash → holder-set with
+// per-worker reverse indexes, exposed through a C ABI consumed via ctypes
+// (dynamo_tpu/router/indexer.py selects it at import when built).
+//
+// Build: make -C native   (g++ -O2 -shared -fPIC)
+
+#include <cstdint>
+#include <cstring>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+struct Index {
+    // block hash → workers holding it (small vectors: typically 1-4 holders)
+    std::unordered_map<uint64_t, std::vector<int64_t>> by_hash;
+    // worker → hashes it holds
+    std::unordered_map<int64_t, std::unordered_set<uint64_t>> by_worker;
+};
+
+void drop_holder(Index* idx, uint64_t h, int64_t worker) {
+    auto it = idx->by_hash.find(h);
+    if (it == idx->by_hash.end()) return;
+    auto& v = it->second;
+    for (size_t i = 0; i < v.size(); i++) {
+        if (v[i] == worker) {
+            v[i] = v.back();
+            v.pop_back();
+            break;
+        }
+    }
+    if (v.empty()) idx->by_hash.erase(it);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* radix_create() { return new Index(); }
+
+void radix_destroy(void* p) { delete static_cast<Index*>(p); }
+
+void radix_apply_stored(void* p, int64_t worker, const uint64_t* hashes,
+                        int64_t n) {
+    auto* idx = static_cast<Index*>(p);
+    auto& mine = idx->by_worker[worker];
+    for (int64_t i = 0; i < n; i++) {
+        uint64_t h = hashes[i];
+        if (mine.insert(h).second) {
+            idx->by_hash[h].push_back(worker);
+        }
+    }
+}
+
+void radix_apply_removed(void* p, int64_t worker, const uint64_t* hashes,
+                         int64_t n) {
+    auto* idx = static_cast<Index*>(p);
+    auto wit = idx->by_worker.find(worker);
+    for (int64_t i = 0; i < n; i++) {
+        uint64_t h = hashes[i];
+        if (wit != idx->by_worker.end()) wit->second.erase(h);
+        drop_holder(idx, h, worker);
+    }
+}
+
+void radix_remove_worker(void* p, int64_t worker) {
+    auto* idx = static_cast<Index*>(p);
+    auto wit = idx->by_worker.find(worker);
+    if (wit == idx->by_worker.end()) return;
+    for (uint64_t h : wit->second) drop_holder(idx, h, worker);
+    idx->by_worker.erase(wit);
+}
+
+int64_t radix_num_blocks(void* p, int64_t worker) {
+    auto* idx = static_cast<Index*>(p);
+    auto wit = idx->by_worker.find(worker);
+    return wit == idx->by_worker.end()
+               ? 0
+               : static_cast<int64_t>(wit->second.size());
+}
+
+int64_t radix_num_workers(void* p) {
+    return static_cast<int64_t>(static_cast<Index*>(p)->by_worker.size());
+}
+
+// workers_out[i] gets the ids; overlaps_out[i] the longest leading run.
+// Returns number of workers written (<= max_out).
+int64_t radix_find_matches(void* p, const uint64_t* hashes, int64_t n,
+                           int64_t* workers_out, int64_t* overlaps_out,
+                           int64_t max_out) {
+    auto* idx = static_cast<Index*>(p);
+    // longest leading run per worker: walk hashes; maintain the still-alive
+    // holder set (intersection semantics identical to the python impl)
+    std::unordered_map<int64_t, int64_t> overlap;
+    std::vector<int64_t> active;
+    bool first = true;
+    for (int64_t i = 0; i < n; i++) {
+        auto it = idx->by_hash.find(hashes[i]);
+        if (it == idx->by_hash.end()) break;
+        const auto& holders = it->second;
+        if (first) {
+            active.assign(holders.begin(), holders.end());
+            first = false;
+        } else {
+            std::vector<int64_t> next;
+            next.reserve(active.size());
+            for (int64_t w : active) {
+                for (int64_t h : holders) {
+                    if (h == w) {
+                        next.push_back(w);
+                        break;
+                    }
+                }
+            }
+            active.swap(next);
+        }
+        if (active.empty()) break;
+        for (int64_t w : active) overlap[w] = i + 1;
+    }
+    int64_t written = 0;
+    for (const auto& kv : overlap) {
+        if (written >= max_out) break;
+        workers_out[written] = kv.first;
+        overlaps_out[written] = kv.second;
+        written++;
+    }
+    return written;
+}
+
+// Snapshot support: iterate a worker's hashes into a caller buffer.
+int64_t radix_worker_hashes(void* p, int64_t worker, uint64_t* out,
+                            int64_t max_out) {
+    auto* idx = static_cast<Index*>(p);
+    auto wit = idx->by_worker.find(worker);
+    if (wit == idx->by_worker.end()) return 0;
+    int64_t written = 0;
+    for (uint64_t h : wit->second) {
+        if (written >= max_out) break;
+        out[written++] = h;
+    }
+    return written;
+}
+
+int64_t radix_workers(void* p, int64_t* out, int64_t max_out) {
+    auto* idx = static_cast<Index*>(p);
+    int64_t written = 0;
+    for (const auto& kv : idx->by_worker) {
+        if (written >= max_out) break;
+        out[written++] = kv.first;
+    }
+    return written;
+}
+
+}  // extern "C"

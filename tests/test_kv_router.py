@@ -25,6 +25,42 @@ from dynamo_tpu.worker import serve_engine
 
 # -- units ------------------------------------------------------------------- #
 
+from dynamo_tpu.native import radix_lib
+from dynamo_tpu.router.indexer import NativeRadixIndex, PyRadixIndex
+
+INDEX_IMPLS = [PyRadixIndex] + (
+    [NativeRadixIndex] if radix_lib() is not None else []
+)
+
+
+@pytest.mark.parametrize("impl", INDEX_IMPLS)
+def test_radix_impls_equivalent_randomized(impl):
+    """Both index implementations must agree op-for-op (the C++ one is a
+    drop-in for the Python one)."""
+    import random
+
+    rng = random.Random(7)
+    ref = PyRadixIndex()
+    idx = impl()
+    universe = [rng.getrandbits(64) for _ in range(200)]
+    for _ in range(500):
+        op = rng.random()
+        w = rng.randrange(6)
+        hs = rng.sample(universe, rng.randrange(1, 8))
+        if op < 0.5:
+            ref.apply_stored(w, hs)
+            idx.apply_stored(w, hs)
+        elif op < 0.8:
+            ref.apply_removed(w, hs)
+            idx.apply_removed(w, hs)
+        elif op < 0.9:
+            ref.remove_worker(w)
+            idx.remove_worker(w)
+        else:
+            probe = rng.sample(universe, 16)
+            assert ref.find_matches(probe) == idx.find_matches(probe)
+    assert ref.snapshot() == idx.snapshot()
+
 
 def test_radix_index_overlap():
     idx = RadixIndex()

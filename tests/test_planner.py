@@ -1,0 +1,102 @@
+"""Planner: predictors, perf interpolation, replica calculation, virtual
+connector (reference tests/planner/test_replica_calculation.py shape)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.planner import (
+    ARPredictor,
+    ConstantPredictor,
+    LoadSample,
+    MovingAveragePredictor,
+    Planner,
+    PlannerConfig,
+    SLO,
+    VirtualConnector,
+    synthetic_profile,
+)
+from dynamo_tpu.runtime import ControlPlaneServer, DistributedRuntime
+
+
+def test_predictors():
+    c = ConstantPredictor()
+    for v in [1, 2, 3]:
+        c.observe(v)
+    assert c.predict() == 3
+
+    m = MovingAveragePredictor(window=4)
+    for v in [2, 2, 4, 4]:
+        m.observe(v)
+    assert m.predict() == 3
+
+    a = ARPredictor(window=32, order=2)
+    for t in range(20):
+        a.observe(10 + 2 * t)  # rising trend
+    assert a.predict() > 44  # extrapolates beyond the last value (48±)
+
+
+def test_perf_profile_interpolation():
+    prof = synthetic_profile(prefill_capacity_tok_s=10_000, base_ttft_s=0.1)
+    # tighter SLO → less sustainable load
+    hi = prof.max_prefill_load_under(1.0)
+    lo = prof.max_prefill_load_under(0.15)
+    assert 0 < lo < hi <= 10_000
+    # ITL SLO below the floor → no sustainable concurrency
+    assert prof.max_decode_concurrency_under(1e-6) == 0.0
+    assert prof.ttft_at(0.0) >= 0.1
+
+
+class FakeConnector:
+    def __init__(self):
+        self.calls = []
+
+    async def scale(self, kind, n):
+        self.calls.append((kind, n))
+
+    async def collect_load(self):
+        return None
+
+
+async def test_replica_calculation_scales_up_and_down():
+    conn = FakeConnector()
+    planner = Planner(
+        conn,
+        config=PlannerConfig(
+            slo=SLO(ttft_s=0.2, itl_s=0.02),
+            min_replicas=1, max_replicas=16, scale_down_patience=2,
+            predictor="constant",
+        ),
+    )
+    # low load → min replicas
+    planner.observe(LoadSample(prefill_tokens_per_s=10, concurrent_decodes=1))
+    t1 = await planner.apply()
+    assert t1 == {"prefill": 1, "decode": 1}
+    # heavy load → scale up
+    planner.observe(LoadSample(prefill_tokens_per_s=50_000,
+                               concurrent_decodes=200))
+    t2 = await planner.apply()
+    assert t2["prefill"] > 1 and t2["decode"] > 1
+    # load drops: hysteresis holds, then scales down
+    planner.observe(LoadSample(prefill_tokens_per_s=10, concurrent_decodes=1))
+    t3 = await planner.apply()
+    assert t3 == t2  # held (patience=2)
+    t4 = await planner.apply()
+    assert t4 == {"prefill": 1, "decode": 1}
+    assert ("decode", t2["decode"]) in conn.calls
+
+
+async def test_virtual_connector_roundtrip():
+    control = await ControlPlaneServer().start()
+    rt = await DistributedRuntime.connect(control.address)
+    try:
+        conn = VirtualConnector(rt)
+        await conn.scale("decode", 5)
+        await conn.scale("prefill", 2)
+        targets = await conn.read_targets()
+        assert targets["decode"] == 5
+        assert targets["prefill"] == 2
+    finally:
+        await rt.shutdown(graceful=False)
+        await control.stop()

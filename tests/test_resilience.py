@@ -1,0 +1,117 @@
+"""M6 resilience: request migration on worker death, health checks."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.frontend import ModelManager, ModelWatcher
+from dynamo_tpu.llm import ModelDeploymentCard
+from dynamo_tpu.llm.migration import migrating_stream
+from dynamo_tpu.mocker import MockEngine, MockEngineArgs
+from dynamo_tpu.runtime import Context, ControlPlaneServer, DistributedRuntime
+from dynamo_tpu.runtime.health import HealthCheckManager
+from dynamo_tpu.runtime.transport.service import RemoteStreamError
+from dynamo_tpu.worker import serve_engine
+
+
+def margs(**over):
+    base = dict(num_pages=128, page_size=8, max_num_seqs=8,
+                max_prefill_tokens=128, max_model_len=1024,
+                speedup_ratio=2.0)  # slow enough to kill mid-stream
+    base.update(over)
+    return MockEngineArgs(**base)
+
+
+def req(tokens, max_tokens):
+    return {
+        "token_ids": tokens,
+        "sampling_options": {"seed": 3},
+        "stop_conditions": {"max_tokens": max_tokens, "ignore_eos": True},
+    }
+
+
+async def test_migration_on_worker_death():
+    """Kill the serving worker mid-stream; the stream must continue on the
+    surviving worker with no client-visible error (reference
+    tests/fault_tolerance/test_request_migration.py:293)."""
+    control = await ControlPlaneServer().start()
+    rt1 = await DistributedRuntime.connect(control.address)
+    rt2 = await DistributedRuntime.connect(control.address)
+    e1 = MockEngine(margs())
+    e2 = MockEngine(margs(speedup_ratio=100.0))
+    await serve_engine(rt1, e1, ModelDeploymentCard(name="m"), publish_kv_events=False)
+    await serve_engine(rt2, e2, ModelDeploymentCard(name="m"), publish_kv_events=False)
+
+    front = await DistributedRuntime.connect(control.address)
+    ep = front.namespace("dynamo").component("backend").endpoint("generate")
+    client = await ep.client().start()
+    insts = await client.wait_for_instances()
+    assert len(insts) == 2
+    first_id = insts[0].instance_id
+
+    ctx = Context()
+    # route directly to worker 1, then kill it after a few tokens
+    attempt = {"n": 0}
+
+    def factory(request, context):
+        attempt["n"] += 1
+        if attempt["n"] == 1:
+            return client.direct(request, first_id, context)
+        return client.round_robin(request, context)
+
+    tokens = []
+    killed = False
+    async for out in migrating_stream(req([1, 2, 3], 40), ctx, factory,
+                                      migration_limit=3):
+        assert out.get("finish_reason") != "error", out
+        tokens.extend(out.get("token_ids", []))
+        if len(tokens) >= 3 and not killed:
+            killed = True
+            await rt1.shutdown(graceful=False)  # hard kill worker 1
+    assert len(tokens) == 40
+    assert attempt["n"] >= 2  # actually migrated
+
+    await client.stop()
+    for rt in (rt2, front):
+        await rt.shutdown(graceful=False)
+    await e1.shutdown()
+    await e2.shutdown()
+    await control.stop()
+
+
+async def test_migration_limit_exhausted():
+    ctx = Context()
+
+    async def dead_factory(request, context):
+        raise RemoteStreamError("worker gone")
+        yield  # pragma: no cover
+
+    out = []
+    async for o in migrating_stream(req([1], 5), ctx, dead_factory,
+                                    migration_limit=2):
+        out.append(o)
+    assert out[-1]["finish_reason"] == "error"
+
+
+async def test_health_check_through_request_path():
+    control = await ControlPlaneServer().start()
+    rt = await DistributedRuntime.connect(control.address)
+    engine = MockEngine(margs(speedup_ratio=100.0))
+    await serve_engine(rt, engine, ModelDeploymentCard(name="m"),
+                       publish_kv_events=False)
+    hc = HealthCheckManager(rt, interval=0.1)
+    await hc.check_all()
+    health = hc.system_health()
+    assert health["status"] == "healthy"
+    ep = "dynamo.backend.generate"
+    assert health["endpoints"][ep]["healthy"]
+
+    # unregister the handler → checks fail → unhealthy after threshold
+    rt.service_server.unregister(ep)
+    for _ in range(3):
+        await hc.check_all()
+    assert not hc.system_health()["endpoints"][ep]["healthy"]
+
+    await engine.shutdown()
+    await rt.shutdown(graceful=False)
+    await control.stop()
