@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models import KVCache, ModelConfig, forward_decode, forward_prefill
+from ..models.llama import forward_embed
 from ..ops import SamplingParams, compute_logprobs, sample_tokens
 from ..runtime.engine import Context
 from .config import EngineConfig, bucket_for
@@ -454,6 +455,42 @@ class JaxEngine:
                     break  # stop hit mid-block; rest of the block discarded
 
     # -- disaggregation: KV export / import ---------------------------------- #
+
+    async def embed(self, request: Dict[str, Any],
+                    context: Optional[Context] = None) -> Dict[str, Any]:
+        """Embedding request: {"embed_token_ids": [[...], ...]} →
+        {"embeddings": [[...], ...], "prompt_tokens": N}. Runs between
+        engine steps on its own cache-free forward."""
+        batches = request.get("embed_token_ids") or []
+        if not batches:
+            return {"error": "no inputs"}
+        max_len = min(
+            max(len(t) for t in batches), self.cfg.max_model_len
+        )
+        S = bucket_for(max_len, self.cfg.chunk_buckets + [self.cfg.max_model_len])
+        B = len(batches)
+        tokens = np.zeros((B, S), np.int32)
+        lens = np.zeros((B,), np.int32)
+        for i, t in enumerate(batches):
+            t = t[:S]
+            tokens[i, : len(t)] = t
+            lens[i] = len(t)
+
+        if not hasattr(self, "_embed_fn"):
+            cfg = self.model_cfg
+            self._embed_fn = jax.jit(
+                lambda p, tok, ln: forward_embed(p, cfg, tok, ln)
+            )
+
+        def op():
+            out = self._embed_fn(self.params, jnp.asarray(tokens), jnp.asarray(lens))
+            return np.asarray(jax.device_get(out))
+
+        vecs = await self._device_op(op)
+        return {
+            "embeddings": [vecs[i].tolist() for i in range(B)],
+            "prompt_tokens": int(lens.sum()),
+        }
 
     async def _device_op(self, op):
         """Run a device op between pump steps (never concurrent with them)."""

@@ -313,6 +313,48 @@ def forward_prefill(
     return _lm_logits(params, cfg, x_last), KVCache(k_new, v_new)
 
 
+def forward_embed(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jax.Array,  # [B, S]
+    lens: jax.Array,  # [B] valid lengths
+) -> jax.Array:
+    """Sequence embeddings: mean-pooled final hidden states over valid
+    tokens (decoder-as-embedder, the common llama-embedding recipe).
+    Cache-free: attention runs over a throwaway in-call page pool."""
+    B, S = tokens.shape
+    page_size = min(S, 128)
+    pages_per_seq = -(-S // page_size)
+    kv = KVCache.create(cfg, 1 + B * pages_per_seq, page_size, jnp.float32)
+    table = (
+        jnp.arange(B * pages_per_seq, dtype=jnp.int32).reshape(B, pages_per_seq)
+        + 1
+    )
+    inv_freq = rope_frequencies(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
+    positions = jnp.arange(S)[None, :].repeat(B, 0)
+    prefix = jnp.zeros((B,), jnp.int32)
+    x = params["embed"][tokens]
+
+    def body(carry, xs):
+        h = carry
+        lp, k_pages, v_pages = xs
+        h, (k_pages, v_pages) = _layer_prefill(
+            lp, (k_pages, v_pages), h, positions, table, prefix, lens,
+            cfg, inv_freq,
+        )
+        return h, (k_pages, v_pages)
+
+    x, _ = jax.lax.scan(body, x, (params["layers"], kv.k, kv.v))
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    mask = (jnp.arange(S)[None, :] < lens[:, None]).astype(jnp.float32)
+    pooled = (x.astype(jnp.float32) * mask[..., None]).sum(1)
+    pooled = pooled / jnp.maximum(lens[:, None].astype(jnp.float32), 1.0)
+    # unit-normalize (cosine-ready, matches common embedding servers)
+    return pooled / jnp.maximum(
+        jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-9
+    )
+
+
 def forward_decode(
     params: Params,
     cfg: ModelConfig,
