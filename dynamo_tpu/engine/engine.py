@@ -20,6 +20,7 @@ WorkerMetricsPublisher).
 from __future__ import annotations
 
 import asyncio
+import dataclasses
 import logging
 import time
 from dataclasses import dataclass, field
@@ -29,6 +30,7 @@ from typing import Any, AsyncIterator, Callable, Dict, List, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..models import KVCache, ModelConfig, forward_decode, forward_prefill
 from ..models.llama import forward_embed
@@ -127,15 +129,35 @@ class JaxEngine:
         kv_dtype=jnp.bfloat16,
         event_sink: Optional[Callable[[KvEvent], None]] = None,
         tiered=None,  # kvbm.TieredKvCache — host/disk KV tiers
+        parallel=None,  # parallel.ParallelConfig — dp×tp serving mesh
+        devices=None,
     ):
         self.model_cfg = model_cfg
         self.cfg = engine_cfg or EngineConfig()
-        self.params = params
         self.eos_token_ids = eos_token_ids or []
         self._kv_dtype = kv_dtype
-        self.kv = KVCache.create(
-            model_cfg, self.cfg.num_pages, self.cfg.page_size, kv_dtype
-        )
+        # -- serving mesh (M3): params TP-sharded, KV sharded on kv-heads,
+        # batch sharded over dp.  XLA/GSPMD inserts the ICI collectives
+        # (the TPU-native replacement for the reference's engine-delegated
+        # `--tp/--dp` flags, SURVEY.md §2.6).
+        self.mesh = None
+        self._dp = 1
+        if parallel is not None and parallel.world > 1:
+            from ..parallel import make_mesh
+
+            self.mesh = make_mesh(parallel, devices)
+            self._dp = parallel.dp
+            # every batch shape must divide dp (rows beyond the real batch
+            # are trash-page padding)
+            self.cfg = dataclasses.replace(
+                self.cfg,
+                decode_batch_buckets=sorted(
+                    {-(-b // self._dp) * self._dp
+                     for b in self.cfg.decode_batch_buckets}
+                ),
+            )
+        self.params = self._shard_params(params)
+        self.kv = self._make_kv()
         self._extra_event_sinks: List[Callable[[KvEvent], None]] = []
         if event_sink:
             self._extra_event_sinks.append(event_sink)
@@ -177,6 +199,42 @@ class JaxEngine:
         self._pending_aborts: set[str] = set()
         self._requests_total = 0
         self._step_count = 0
+
+    # -- sharding helpers ---------------------------------------------------- #
+
+    def _shard_params(self, params):
+        if self.mesh is None:
+            return params
+        from ..parallel import shard_params
+
+        return shard_params(params, self.model_cfg, self.mesh)
+
+    def _make_kv(self) -> KVCache:
+        kv = KVCache.create(
+            self.model_cfg, self.cfg.num_pages, self.cfg.page_size,
+            self._kv_dtype,
+        )
+        if self.mesh is None:
+            return kv
+        from ..parallel import shard_kv_cache
+
+        return shard_kv_cache(kv, self.mesh)
+
+    def _put(self, arr, *axes):
+        """Host array → device, batch axis sharded over dp when meshed."""
+        if self.mesh is None:
+            return jnp.asarray(arr)
+        return jax.device_put(arr, NamedSharding(self.mesh, P(*axes)))
+
+    def _put_samp(self, samp: SamplingParams) -> SamplingParams:
+        if self.mesh is None:
+            return samp
+        return jax.device_put(samp, NamedSharding(self.mesh, P("dp")))
+
+    def _pad_batch(self, n: int) -> int:
+        """Round a batch size up to a dp multiple (pad rows hit the trash
+        page)."""
+        return -(-n // self._dp) * self._dp
 
     # -- events -------------------------------------------------------------- #
 
@@ -352,8 +410,8 @@ class JaxEngine:
         seeds = [getattr(s, "seed", 0) for s in seqs] + [0] * pad
         counters = [len(s.output_tokens) for s in seqs] + [0] * pad
         return (
-            jnp.asarray(np.asarray(seeds, np.uint32)),
-            jnp.asarray(np.asarray(counters, np.int32)),
+            np.asarray(seeds, np.uint32),
+            np.asarray(counters, np.int32),
         )
 
     def _table_array(self, seqs: List[Sequence], rows: Optional[int] = None) -> np.ndarray:
@@ -368,39 +426,43 @@ class JaxEngine:
             table[i, :n] = s.pages[:n]
         return table
 
-    def _samp_arrays(self, seqs: List[Sequence]) -> SamplingParams:
+    def _samp_arrays(self, seqs: List[Sequence], pad_to: Optional[int] = None) -> SamplingParams:
+        pad = (pad_to or len(seqs)) - len(seqs)
         return SamplingParams.make(
-            [s.opts.temperature for s in seqs],
-            [s.opts.top_k for s in seqs],
-            [s.opts.top_p for s in seqs],
+            [s.opts.temperature for s in seqs] + [0.0] * pad,
+            [s.opts.top_k for s in seqs] + [0] * pad,
+            [s.opts.top_p for s in seqs] + [1.0] * pad,
         )
 
     def _run_prefill(self, items: List[PrefillItem]) -> None:
-        B = len(items)
+        B = self._pad_batch(len(items))
         chunk_bucket = bucket_for(
             max(it.chunk_len for it in items), self.cfg.chunk_buckets
         )
         tokens = np.zeros((B, chunk_bucket), np.int32)
         prefix = np.zeros((B,), np.int32)
-        chunk = np.zeros((B,), np.int32)
+        # dp-pad rows run a 1-token chunk into the trash page (a fully
+        # masked row would softmax over -inf only)
+        chunk = np.ones((B,), np.int32)
         for i, it in enumerate(items):
             s = it.seq
             toks = s.prompt[it.chunk_start : it.chunk_start + it.chunk_len]
             tokens[i, : len(toks)] = toks
             prefix[i] = it.chunk_start
             chunk[i] = it.chunk_len
-        table = self._table_array([it.seq for it in items])
-        seeds, counters = self._seed_arrays([it.seq for it in items], B)
+        seqs = [it.seq for it in items]
+        table = self._table_array(seqs, rows=B)
+        seeds, counters = self._seed_arrays(seqs, B)
         out, logp, kv = self._prefill_step(
             self.params,
             self.kv,
-            jnp.asarray(tokens),
-            jnp.asarray(table),
-            jnp.asarray(prefix),
-            jnp.asarray(chunk),
-            self._samp_arrays([it.seq for it in items]),
-            seeds,
-            counters,
+            self._put(tokens, "dp", None),
+            self._put(table, "dp", None),
+            self._put(prefix, "dp"),
+            self._put(chunk, "dp"),
+            self._put_samp(self._samp_arrays(seqs, B)),
+            self._put(seeds, "dp"),
+            self._put(counters, "dp"),
         )
         self.kv = kv
         out = np.asarray(jax.device_get(out))
@@ -424,22 +486,17 @@ class JaxEngine:
             )
             positions[i] = s.num_computed
         table = self._table_array(seqs, rows=Bb)
-        pad = Bb - len(seqs)
-        samp = SamplingParams.make(
-            [s.opts.temperature for s in seqs] + [0.0] * pad,
-            [s.opts.top_k for s in seqs] + [0] * pad,
-            [s.opts.top_p for s in seqs] + [1.0] * pad,
-        )
+        samp = self._samp_arrays(seqs, Bb)
         seeds, counters = self._seed_arrays(seqs, Bb)
         out, logp, self.kv = self._decode_step(
             self.params,
             self.kv,
-            jnp.asarray(tokens),
-            jnp.asarray(positions),
-            jnp.asarray(table),
-            samp,
-            seeds,
-            counters,
+            self._put(tokens, "dp"),
+            self._put(positions, "dp"),
+            self._put(table, "dp", None),
+            self._put_samp(samp),
+            self._put(seeds, "dp"),
+            self._put(counters, "dp"),
         )
         out = np.asarray(jax.device_get(out))  # [T, B]
         logp = np.asarray(jax.device_get(logp))
@@ -663,9 +720,7 @@ class JaxEngine:
         for seq in list(self.scheduler.running):
             self.scheduler.finish(seq, "error")
             self._deliver(seq, [], "error")
-        self.kv = KVCache.create(
-            self.model_cfg, self.cfg.num_pages, self.cfg.page_size, self._kv_dtype
-        )
+        self.kv = self._make_kv()
         self.pool = PagePool(
             self.cfg.num_pages, self.cfg.page_size, event_sink=self._emit_event
         )

@@ -28,7 +28,19 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+
+try:  # jax >= 0.8 exposes shard_map at top level (check_vma kwarg)
+    from jax import shard_map as _shard_map
+
+    def shard_map(f, **kw):
+        kw.setdefault("check_vma", False)
+        return _shard_map(f, **kw)
+except ImportError:  # pragma: no cover — older jax
+    from jax.experimental.shard_map import shard_map as _shard_map_old
+
+    def shard_map(f, **kw):
+        kw.setdefault("check_rep", False)
+        return _shard_map_old(f, **kw)
 
 NEG_INF = -1e30
 
@@ -119,5 +131,4 @@ def ring_attention(
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        check_rep=False,
     )(q, k, v)

@@ -1,0 +1,106 @@
+"""Sharded serving engine: a dp×tp-meshed JaxEngine must produce the same
+greedy tokens as the single-device engine (the reference gets TP from vLLM's
+`tensor_parallel_size`, /root/reference/components/src/dynamo/vllm/args.py:250;
+here the engine itself shards over the serving mesh, SURVEY.md §7 M3)."""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.engine import EngineConfig, JaxEngine
+from dynamo_tpu.models import init_params, tiny_config, tiny_moe_config
+from dynamo_tpu.parallel import ParallelConfig
+
+
+def _ecfg(**over):
+    base = dict(
+        page_size=8,
+        num_pages=128,
+        max_num_seqs=8,
+        max_prefill_tokens=32,
+        max_model_len=128,
+    )
+    base.update(over)
+    return EngineConfig(**base)
+
+
+async def _collect(engine, prompts, max_tokens=8):
+    async def one(p):
+        req = {
+            "token_ids": p,
+            "sampling_options": {"temperature": 0.0},
+            "stop_conditions": {"max_tokens": max_tokens, "ignore_eos": True},
+        }
+        toks = []
+        async for out in engine.generate(req):
+            toks += out["token_ids"]
+        return toks
+
+    return await asyncio.gather(*[one(p) for p in prompts])
+
+
+def _prompts(cfg, n=5):
+    out = [[(i * 13 + j) % cfg.vocab_size for j in range(5 + 3 * i)]
+           for i in range(n)]
+    # one long prompt exercises chunked prefill (> max_prefill_tokens)
+    out.append([(j * 7) % cfg.vocab_size for j in range(70)])
+    return out
+
+
+async def test_engine_dp_tp_greedy_matches_single_device():
+    cfg = tiny_config()
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    prompts = _prompts(cfg)
+
+    ref = JaxEngine(cfg, params, _ecfg(), kv_dtype=jnp.float32)
+    out_ref = await _collect(ref, prompts)
+    await ref.shutdown()
+
+    par = JaxEngine(
+        cfg, params, _ecfg(), kv_dtype=jnp.float32,
+        parallel=ParallelConfig(dp=4, tp=2),
+    )
+    out_par = await _collect(par, prompts)
+    await par.shutdown()
+
+    assert out_par == out_ref
+
+
+async def test_engine_dp_only_matches():
+    cfg = tiny_config()
+    params = init_params(cfg, jax.random.PRNGKey(1), dtype=jnp.float32)
+    prompts = _prompts(cfg, n=3)
+
+    ref = JaxEngine(cfg, params, _ecfg(), kv_dtype=jnp.float32)
+    out_ref = await _collect(ref, prompts)
+    await ref.shutdown()
+
+    par = JaxEngine(
+        cfg, params, _ecfg(), kv_dtype=jnp.float32,
+        parallel=ParallelConfig(dp=8, tp=1),
+    )
+    out_par = await _collect(par, prompts)
+    await par.shutdown()
+
+    assert out_par == out_ref
+
+
+async def test_engine_moe_ep_sharded():
+    """MoE engine on the mesh: experts shard over the tp axis (EP)."""
+    cfg = tiny_moe_config()
+    params = init_params(cfg, jax.random.PRNGKey(2), dtype=jnp.float32)
+    prompts = _prompts(cfg, n=3)
+
+    ref = JaxEngine(cfg, params, _ecfg(), kv_dtype=jnp.float32)
+    out_ref = await _collect(ref, prompts)
+    await ref.shutdown()
+
+    par = JaxEngine(
+        cfg, params, _ecfg(), kv_dtype=jnp.float32,
+        parallel=ParallelConfig(dp=4, tp=2),
+    )
+    out_par = await _collect(par, prompts)
+    await par.shutdown()
+
+    assert out_par == out_ref
