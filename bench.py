@@ -35,14 +35,25 @@ async def run_round(engine, seed_base):
             "stop_conditions": {"max_tokens": GEN_TOKENS, "ignore_eos": True},
         }
         n = 0
+        t_submit = time.perf_counter()
+        t_first = t_last = None
         async for out in engine.generate(req):
-            n += len(out["token_ids"])
-        return n
+            if out["token_ids"]:
+                t_last = time.perf_counter()
+                if t_first is None:
+                    t_first = t_last
+                n += len(out["token_ids"])
+        ttft = (t_first - t_submit) if t_first else 0.0
+        itl = ((t_last - t_first) / max(n - 1, 1)) if t_first else 0.0
+        return n, ttft, itl
 
     t0 = time.perf_counter()
-    counts = await asyncio.gather(*[one(i) for i in range(BATCH)])
+    results = await asyncio.gather(*[one(i) for i in range(BATCH)])
     dt = time.perf_counter() - t0
-    return sum(counts), dt
+    total = sum(r[0] for r in results)
+    ttfts = sorted(r[1] for r in results)
+    itls = sorted(r[2] for r in results)
+    return total, dt, ttfts[len(ttfts) // 2], itls[len(itls) // 2]
 
 
 async def main_async():
@@ -60,11 +71,13 @@ async def main_async():
         page_size=16,
         num_pages=1 + BATCH * pages_per_seq + 32,
         max_num_seqs=BATCH,
-        max_prefill_tokens=PROMPT_LEN,
+        max_prefill_tokens=BATCH * PROMPT_LEN,  # all prompts in one dispatch
+        prefill_batch_size=BATCH,
         max_model_len=PROMPT_LEN + GEN_TOKENS + 16,
         decode_batch_buckets=[BATCH],
         chunk_buckets=[PROMPT_LEN],
-        decode_steps=16,  # one dispatch per 16 tokens (axon dispatch ~250ms)
+        decode_steps=16,
+        decode_chain=4,  # chained dispatches hide the ~83ms axon RTT
         enable_prefix_caching=False,  # measure raw compute, not cache hits
     )
     engine = JaxEngine(cfg, params, ecfg, eos_token_ids=[])
@@ -72,9 +85,9 @@ async def main_async():
     # warmup (compiles prefill + decode)
     await run_round(engine, seed_base=0)
     # measure
-    total, dt = await run_round(engine, seed_base=5000)
+    total, dt, ttft_p50, itl_p50 = await run_round(engine, seed_base=5000)
     await engine.shutdown()
-    return total, dt
+    return total, dt, ttft_p50, itl_p50
 
 
 def previous_round_value():
@@ -96,7 +109,7 @@ def previous_round_value():
 
 
 def main():
-    total, dt = asyncio.run(main_async())
+    total, dt, ttft_p50, itl_p50 = asyncio.run(main_async())
     value = round(total / dt, 2)
     prev = previous_round_value()
     vs = round(value / prev, 3) if prev else 1.0
@@ -105,6 +118,8 @@ def main():
         "value": value,
         "unit": "tok/s",
         "vs_baseline": vs,
+        "ttft_p50_ms": round(ttft_p50 * 1000, 1),
+        "itl_p50_ms": round(itl_p50 * 1000, 2),
     }))
 
 

@@ -30,6 +30,11 @@ class EngineConfig:
     # its stop (discarded, never delivered)
     decode_steps: int = 1
 
+    # decode dispatches issued back-to-back before fetching results: block
+    # k+1 takes block k's device-side outputs as inputs, so result fetch
+    # (host RTT) overlaps the next block's compute.  1 = no chaining.
+    decode_chain: int = 1
+
     enable_prefix_caching: bool = True
     block_hash_salt: str = ""
 
@@ -56,6 +61,12 @@ class EngineConfig:
     @property
     def usable_pages(self) -> int:
         return self.num_pages - 1  # page 0 is the trash page
+
+    @property
+    def hard_cap(self) -> int:
+        """Longest context any sequence may reach: model window clamped to
+        what its page-table row can address."""
+        return min(self.max_model_len, self.max_pages_per_seq * self.page_size)
 
 
 def _pow2_buckets(cap: int) -> list:

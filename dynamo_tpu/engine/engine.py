@@ -55,6 +55,21 @@ class ForwardPassMetrics:
     num_requests_total: int = 0
 
 
+def _pack_out(out: jax.Array, logp: jax.Array) -> jax.Array:
+    """Pack sampled tokens (int32) + logprobs (float32) into ONE float32
+    array along the batch axis: each host fetch round-trips the tunnel to a
+    remote-attached TPU (~100ms regardless of size), so results must come
+    back in a single transfer."""
+    return jnp.concatenate(
+        [jax.lax.bitcast_convert_type(out, jnp.float32), logp], axis=-1
+    )
+
+
+def _unpack_out(packed: np.ndarray, b: int):
+    toks = np.ascontiguousarray(packed[..., :b]).view(np.int32)
+    return toks, packed[..., b:]
+
+
 def _build_prefill_step(cfg: ModelConfig):
     @partial(jax.jit, donate_argnums=(1,))
     def step(params, kv, tokens, page_table, prefix_lens, chunk_lens, samp, seeds, counters):
@@ -63,7 +78,7 @@ def _build_prefill_step(cfg: ModelConfig):
         )
         out = sample_tokens(logits, samp, seeds, counters)
         logp = compute_logprobs(logits, out)
-        return out, logp, kv
+        return _pack_out(out, logp), kv
 
     return step
 
@@ -95,9 +110,14 @@ def _build_decode_step(cfg: ModelConfig, n_steps: int, max_valid_pos: int):
     Steps whose position reaches `max_valid_pos` (the model window) write
     to the trash page instead of clamping into a real page — those tokens
     are discarded host-side anyway.
+
+    The carry state (last token, positions, counters) is returned so a
+    chained dispatch can consume block k's device-side outputs directly —
+    introducing any fresh host buffer between chained dispatches serializes
+    the pipeline on remote-attached TPUs.
     """
     @partial(jax.jit, donate_argnums=(1,))
-    def step(params, kv, tokens, positions, page_table, samp, seeds, counters):
+    def step(params, kv, tokens, positions, counters, page_table, samp, seeds):
         def body(carry, _):
             kv, tok, pos, ctr = carry
             ok = pos < max_valid_pos
@@ -109,10 +129,10 @@ def _build_decode_step(cfg: ModelConfig, n_steps: int, max_valid_pos: int):
             logp = compute_logprobs(logits, out)
             return (kv, out, pos + 1, ctr + 1), (out, logp)
 
-        (kv, _, _, _), (toks, logps) = jax.lax.scan(
+        (kv, tok, pos, ctr), (toks, logps) = jax.lax.scan(
             body, (kv, tokens, positions, counters), None, length=n_steps
         )
-        return toks, logps, kv  # [T, B]
+        return _pack_out(toks, logps), tok, pos, ctr, kv  # packed [T, 2B]
 
     return step
 
@@ -167,10 +187,7 @@ class JaxEngine:
         self.scheduler = Scheduler(self.cfg, self.pool)
         self._prefill_step = _build_prefill_step(model_cfg)
         self._decode_step = _build_decode_step(
-            model_cfg,
-            self.cfg.decode_steps,
-            min(self.cfg.max_model_len,
-                self.cfg.max_pages_per_seq * self.cfg.page_size),
+            model_cfg, self.cfg.decode_steps, self.cfg.hard_cap
         )
         self._export_fn = _build_export_fn()
         self._import_fn = _build_import_fn()
@@ -453,7 +470,7 @@ class JaxEngine:
         seqs = [it.seq for it in items]
         table = self._table_array(seqs, rows=B)
         seeds, counters = self._seed_arrays(seqs, B)
-        out, logp, kv = self._prefill_step(
+        packed_d, kv = self._prefill_step(
             self.params,
             self.kv,
             self._put(tokens, "dp", None),
@@ -465,8 +482,7 @@ class JaxEngine:
             self._put(counters, "dp"),
         )
         self.kv = kv
-        out = np.asarray(jax.device_get(out))
-        logp = np.asarray(jax.device_get(logp))
+        out, logp = _unpack_out(np.asarray(jax.device_get(packed_d)), B)
         for i, it in enumerate(items):
             s = it.seq
             if s.status != "running":  # preempted after planning
@@ -476,7 +492,40 @@ class JaxEngine:
             if it.samples:
                 self._append_token(s, int(out[i]), float(logp[i]))
 
+    def _chain_ok(self, seqs: List[Sequence], k: int, T: int, hard_cap: int) -> bool:
+        """May decode block k be dispatched before block k-1's results are
+        fetched?  Only when nothing else needs the pump, at least one
+        sequence can still use the block, and every page can grow without
+        preemption (preempting would invalidate in-flight tables)."""
+        if self._pending_aborts or self._pending_ops or self.scheduler.waiting:
+            return False
+        if self.tiered is not None and self.tiered.pending_offloads:
+            return False
+        if all(
+            min(s.opts.max_tokens - len(s.output_tokens),
+                hard_cap - s.num_computed) <= k * T
+            for s in seqs
+        ):
+            return False
+        return all(
+            self.scheduler.try_extend_pages(
+                s, min(s.num_computed + (k + 1) * T, hard_cap)
+            )
+            for s in seqs
+        )
+
     def _run_decode(self, seqs: List[Sequence]) -> None:
+        T = self.cfg.decode_steps
+        hard_cap = self.cfg.hard_cap
+        # decide the chain length upfront and pre-reserve pages for the
+        # whole horizon, so ONE page table serves every block: chained
+        # dispatches pipeline only when block k+1's varying inputs are
+        # exactly block k's device-side outputs (any fresh host buffer
+        # mid-chain serializes on remote-attached TPUs)
+        chain_len = 1
+        while (chain_len < max(1, self.cfg.decode_chain)
+               and self._chain_ok(seqs, chain_len, T, hard_cap)):
+            chain_len += 1
         Bb = bucket_for(len(seqs), self.cfg.decode_batch_buckets)
         tokens = np.zeros((Bb,), np.int32)
         positions = np.zeros((Bb,), np.int32)
@@ -485,31 +534,49 @@ class JaxEngine:
                 s.prompt[-1] if s.prompt else 0
             )
             positions[i] = s.num_computed
-        table = self._table_array(seqs, rows=Bb)
-        samp = self._samp_arrays(seqs, Bb)
         seeds, counters = self._seed_arrays(seqs, Bb)
-        out, logp, self.kv = self._decode_step(
-            self.params,
-            self.kv,
-            self._put(tokens, "dp"),
-            self._put(positions, "dp"),
-            self._put(table, "dp", None),
-            self._put_samp(samp),
-            self._put(seeds, "dp"),
-            self._put(counters, "dp"),
-        )
-        out = np.asarray(jax.device_get(out))  # [T, B]
-        logp = np.asarray(jax.device_get(logp))
-        T = out.shape[0]
-        for i, s in enumerate(seqs):
-            if s.status != "running":
-                continue
-            for t in range(T):
-                s.num_computed += 1
-                self.scheduler.commit_full_pages(s)
-                self._append_token(s, int(out[t, i]), float(logp[t, i]))
-                if s.status != "running":
-                    break  # stop hit mid-block; rest of the block discarded
+        table = self._table_array(seqs, rows=Bb)
+        tok_d = self._put(tokens, "dp")
+        pos_d = self._put(positions, "dp")
+        ctr_d = self._put(counters, "dp")
+        table_d = self._put(table, "dp", None)
+        samp_d = self._put_samp(self._samp_arrays(seqs, Bb))
+        seeds_d = self._put(seeds, "dp")
+        dispatches = []
+        for _ in range(chain_len):
+            packed_d, tok_d, pos_d, ctr_d, self.kv = self._decode_step(
+                self.params, self.kv, tok_d, pos_d, ctr_d,
+                table_d, samp_d, seeds_d,
+            )
+            try:  # start the host copy early; overlaps later blocks' compute
+                packed_d.copy_to_host_async()
+            except Exception:  # noqa: BLE001 — sharded arrays may not support it
+                pass
+            dispatches.append(packed_d)
+        # page frees deferred until the whole chain drains: an in-flight
+        # dispatch must never see its table's pages reallocated (unchained
+        # decode keeps the synchronous free — consumers may observe pool
+        # state right after their finish_reason arrives)
+        deferred = [] if len(dispatches) > 1 else None
+        self.scheduler.deferred_free = deferred
+        try:
+            for packed_d in dispatches:
+                out, logp = _unpack_out(
+                    np.asarray(jax.device_get(packed_d)), Bb
+                )  # [T, B] each
+                for i, s in enumerate(seqs):
+                    if s.status != "running":
+                        continue
+                    for t in range(out.shape[0]):
+                        s.num_computed += 1
+                        self.scheduler.commit_full_pages(s)
+                        self._append_token(s, int(out[t, i]), float(logp[t, i]))
+                        if s.status != "running":
+                            break  # stop hit mid-block; rest discarded
+        finally:
+            self.scheduler.deferred_free = None
+            if deferred:
+                self.pool.free(deferred)
 
     # -- disaggregation: KV export / import ---------------------------------- #
 

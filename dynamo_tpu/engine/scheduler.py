@@ -107,6 +107,10 @@ class Scheduler:
         # sequences errored inside planning (e.g. out of KV capacity with
         # nothing left to evict) — the engine drains and notifies
         self.errored: List[Sequence] = []
+        # when set (decode-chain processing), _finish parks pages here
+        # instead of freeing — freed pages must not be reallocated while
+        # chained dispatches referencing them are still in flight
+        self.deferred_free: Optional[List[int]] = None
         # optional multi-tier onboarding hook (KVBM): called with the hash
         # run missed by the device cache, returns onboarded page ids
         self.onboard_fn = None
@@ -218,10 +222,7 @@ class Scheduler:
         # decode pass: every running sequence advances decode_steps tokens
         # (page reservation clamped to the model window so the table never
         # outgrows its largest bucket)
-        hard_cap = min(
-            self.cfg.max_model_len,
-            self.cfg.max_pages_per_seq * self.cfg.page_size,
-        )
+        hard_cap = self.cfg.hard_cap
         decodable: List[Sequence] = []
         for seq in list(self.running):
             if seq.status != "running":
@@ -255,6 +256,18 @@ class Scheduler:
                     self.errored.append(seq)
                     return False
                 self._preempt(victim)
+
+    def try_extend_pages(self, seq: Sequence, upto_tokens: int) -> bool:
+        """Grow seq's page list WITHOUT preemption (cached-page eviction is
+        fine).  Used by decode-chaining, where preempting a running sequence
+        would invalidate tables already captured by in-flight dispatches."""
+        need = seq.pages_needed(upto_tokens, self.cfg.page_size) - len(seq.pages)
+        if need <= 0:
+            return True
+        if self.pool.available_pages < need:
+            return False
+        seq.pages.extend(self.pool.allocate(need))
+        return True
 
     def _pick_victim(self, exclude: Sequence) -> Optional[Sequence]:
         for seq in reversed(self.running):  # youngest first
@@ -322,7 +335,10 @@ class Scheduler:
         seq.status = "finished"
         seq.finish_reason = reason
         if not seq.hold_pages:
-            self.pool.free(seq.pages)
+            if self.deferred_free is not None:
+                self.deferred_free.extend(seq.pages)
+            else:
+                self.pool.free(seq.pages)
             seq.pages = []
         if seq in self.running:
             self.running.remove(seq)

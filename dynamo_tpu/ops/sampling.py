@@ -4,6 +4,18 @@ Per-sequence sampling parameters are carried as arrays so one jitted step
 serves a heterogeneous batch (mirrors the reference's per-request
 sampling-option mapping, /root/reference/lib/llm/src/preprocessor.rs sampling
 options → engine; here the engine is ours so the math lives here).
+
+TPU-first design: no full-vocab sort (a 128k-row bitonic sort per token per
+sequence dominated decode time).  Instead:
+
+- greedy rows take ``argmax``;
+- unconstrained temperature rows sample via the Gumbel-argmax trick, one
+  O(V) pass;
+- top-k / top-p rows work on a static top-``TOP_K_CAP`` slice from
+  ``lax.top_k``.  Top-p mass is measured against the *full* softmax (one
+  logsumexp pass) conditioned on the slice, so truncation is exact whenever
+  the requested mass fits inside the slice; a wider-than-slice nucleus
+  (high-entropy row) truncates to the slice, never leaking the tail.
 """
 
 from __future__ import annotations
@@ -12,6 +24,10 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+
+# static width of the candidate slice for top-k/top-p rows; requests with
+# top_k > TOP_K_CAP are clamped (the standard engine-side cap)
+TOP_K_CAP = 64
 
 
 class SamplingParams(NamedTuple):
@@ -43,39 +59,56 @@ def sample_tokens(
     batched with other requests.
     """
     B, V = logits.shape
+    K = min(TOP_K_CAP, V)
     logits = logits.astype(jnp.float32)
     greedy = jnp.argmax(logits, axis=-1)
+    # all-greedy batches (common: benchmark + temperature-0 workloads) skip
+    # the sampling math entirely at runtime
+    return jax.lax.cond(
+        jnp.all(params.temperature <= 0.0),
+        lambda: greedy,
+        lambda: _sample_nongreedy(logits, greedy, params, seeds, counters, K),
+    )
 
+
+def _sample_nongreedy(logits, greedy, params, seeds, counters, K):
+    B, V = logits.shape
     temp = jnp.maximum(params.temperature, 1e-6)[:, None]
     scaled = logits / temp
 
-    # top-k: mask everything below the k-th largest.
-    sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]  # desc
-    k = jnp.clip(params.top_k, 0, V)
-    kth_idx = jnp.where(k > 0, k - 1, V - 1)
-    kth_val = jnp.take_along_axis(sorted_logits, kth_idx[:, None], axis=1)
-    topk_mask = jnp.where(
-        (params.top_k > 0)[:, None], scaled < kth_val, False
-    )
-
-    # top-p: smallest prefix of the sorted distribution with mass >= p.
-    sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
-    cum = jnp.cumsum(sorted_probs, axis=-1)
-    # keep positions whose *previous* cumulative mass is < p; always keep
-    # the argmax so top_p <= 0 degrades to greedy rather than masking all
-    keep_sorted = (cum - sorted_probs) < params.top_p[:, None]
-    keep_sorted = keep_sorted.at[:, 0].set(True)
-    # threshold value = smallest kept logit per row
-    thresh = jnp.min(
-        jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
-    )
-    topp_mask = scaled < thresh
-
-    masked = jnp.where(topk_mask | topp_mask, -jnp.inf, scaled)
     keys = jax.vmap(
         lambda s, c: jax.random.fold_in(jax.random.PRNGKey(s), c)
     )(seeds, counters)
-    sampled = jax.vmap(jax.random.categorical)(keys, masked)
+    k_full, k_sub = jnp.moveaxis(jax.vmap(jax.random.split)(keys), 1, 0)
+
+    # unconstrained temperature sampling: Gumbel-argmax over the full vocab
+    g_full = jax.vmap(lambda k: jax.random.gumbel(k, (V,), jnp.float32))(k_full)
+    full_sample = jnp.argmax(scaled + g_full, axis=-1)
+
+    # truncated rows: static top-K slice (sorted descending by lax.top_k)
+    vals, idx = jax.lax.top_k(scaled, K)  # [B, K]
+    j = jnp.arange(K)[None, :]
+    k_eff = jnp.where(params.top_k > 0, jnp.minimum(params.top_k, K), K)
+    topk_keep = j < k_eff[:, None]
+    # exact mass under the full softmax (one logsumexp over V)
+    lse = jax.nn.logsumexp(scaled, axis=-1, keepdims=True)
+    probs = jnp.exp(vals - lse)  # [B, K] true probabilities
+    cum = jnp.cumsum(probs, axis=-1)
+    # top-p threshold on mass *conditioned on the slice* (p · slice mass):
+    # exact whenever the nucleus fits inside the slice (slice mass ≈ 1 for
+    # peaked LLM rows); a wider-than-slice nucleus truncates to the slice
+    # rather than leaking to the full vocab.  Keep positions whose
+    # *previous* cumulative mass is below the threshold; position 0 always
+    # kept so top_p <= 0 degrades to greedy rather than masking all.
+    topp_keep = (cum - probs) < params.top_p[:, None] * cum[:, -1:]
+    keep = (topk_keep & topp_keep).at[:, 0].set(True)
+    masked = jnp.where(keep, vals, -jnp.inf)
+    g_sub = jax.vmap(lambda k: jax.random.gumbel(k, (K,), jnp.float32))(k_sub)
+    sub_pick = jnp.argmax(masked + g_sub, axis=-1)  # [B]
+    sub_sample = jnp.take_along_axis(idx, sub_pick[:, None], axis=1)[:, 0]
+
+    truncated = (params.top_k > 0) | (params.top_p < 1.0)
+    sampled = jnp.where(truncated, sub_sample, full_sample)
     return jnp.where(params.temperature <= 0.0, greedy, sampled)
 
 

@@ -209,3 +209,43 @@ def test_page_pool_lru_eviction():
     assert pool.lookup([100, 101, 102]) == []
     # ...but the youngest block survived eviction
     assert 102 in pool._cached
+
+
+async def test_decode_chain_matches_unchained(engine_setup):
+    """Chained decode dispatches (block k+1 issued before block k's results
+    are fetched) must produce the same greedy tokens as unchained decode."""
+    prompts = [[1, 2, 3, 4, 5], [9, 8, 7], [3, 3, 3, 3, 3, 3, 3, 3]]
+    plain = make_engine(engine_setup)
+    want = [await collect(plain, req(p, max_tokens=13)) for p in prompts]
+    await plain.shutdown()
+
+    chained = make_engine(engine_setup, decode_steps=4, decode_chain=3)
+    got = await asyncio.gather(
+        *[collect(chained, req(p, max_tokens=13)) for p in prompts]
+    )
+    await chained.shutdown()
+    assert [g[0] for g in got] == [w[0] for w in want]
+    assert all(g[1] == "length" for g in got)
+
+
+async def test_decode_chain_stop_token_mid_chain(engine_setup):
+    """A stop token hit inside an early chained block must end the request
+    and free its pages even though later blocks were already dispatched."""
+    chained = make_engine(engine_setup, decode_steps=2, decode_chain=4)
+    # discover the greedy continuation, then stop on its 3rd token
+    probe, _ = await collect(chained, req([5, 6, 7], max_tokens=10))
+    r = req([5, 6, 7], max_tokens=10)
+    r["stop_conditions"]["stop_token_ids"] = [probe[2]]
+    tokens, reason = await collect(chained, r)
+    assert tokens == probe[:3]
+    assert reason == "stop"
+    # pool fully released once the in-flight chain drains (frees are
+    # deferred past the last dispatched block, so poll briefly)
+    for _ in range(100):
+        if (chained.pool.free_pages + chained.pool.evictable_pages
+                == chained.pool.num_pages - 1):
+            break
+        await asyncio.sleep(0.05)
+    assert chained.pool.free_pages + chained.pool.evictable_pages == \
+        chained.pool.num_pages - 1
+    await chained.shutdown()

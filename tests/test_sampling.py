@@ -1,0 +1,91 @@
+"""Sampler semantics: greedy, top-k truncation, top-p truncation, seeded
+reproducibility, and temperature-sampling distribution sanity (the engine-side
+realization of the reference's sampling-option mapping, preprocessor.rs:102)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.ops import SamplingParams, sample_tokens
+from dynamo_tpu.ops.sampling import TOP_K_CAP
+
+
+def _draw(logits_row, temperature, top_k, top_p, n=512):
+    B = n
+    logits = jnp.tile(jnp.asarray(logits_row, jnp.float32)[None, :], (B, 1))
+    samp = SamplingParams.make([temperature] * B, [top_k] * B, [top_p] * B)
+    seeds = jnp.arange(B, dtype=jnp.uint32)
+    counters = jnp.zeros((B,), jnp.int32)
+    return np.asarray(sample_tokens(logits, samp, seeds, counters))
+
+
+def test_greedy_is_argmax():
+    logits = np.random.RandomState(0).randn(8, 100).astype(np.float32)
+    samp = SamplingParams.make([0.0] * 8, [0] * 8, [1.0] * 8)
+    out = sample_tokens(
+        jnp.asarray(logits), samp,
+        jnp.arange(8, dtype=jnp.uint32), jnp.zeros((8,), jnp.int32),
+    )
+    assert (np.asarray(out) == logits.argmax(-1)).all()
+
+
+def test_top_k_restricts_support():
+    row = np.zeros(100, np.float32)
+    row[:5] = [5.0, 4.0, 3.0, 2.0, 1.0]
+    out = _draw(row, temperature=1.0, top_k=2, top_p=1.0)
+    assert set(out.tolist()) <= {0, 1}
+    assert len(set(out.tolist())) == 2  # both actually drawn
+
+
+def test_top_p_restricts_support():
+    row = np.full(100, -10.0, np.float32)
+    row[:3] = [3.0, 2.9, -1.0]  # two dominant tokens carry ~all mass
+    out = _draw(row, temperature=1.0, top_k=0, top_p=0.9)
+    assert set(out.tolist()) <= {0, 1}
+
+
+def test_top_p_tiny_degrades_to_greedy():
+    row = np.random.RandomState(1).randn(100).astype(np.float32)
+    out = _draw(row, temperature=1.0, top_k=0, top_p=1e-6)
+    assert (out == row.argmax()).all()
+
+
+def test_temperature_sampling_matches_distribution():
+    """Unconstrained sampling (Gumbel path) tracks the softmax."""
+    row = np.array([2.0, 1.0, 0.0] + [-50.0] * 97, np.float32)
+    out = _draw(row, temperature=1.0, top_k=0, top_p=1.0, n=4096)
+    p = np.exp(row - row.max())
+    p /= p.sum()
+    freq = np.bincount(out, minlength=100) / len(out)
+    assert np.abs(freq[:3] - p[:3]).max() < 0.04
+    assert freq[3:].sum() == 0.0
+
+
+def test_top_k_above_cap_clamped_not_broken():
+    V = TOP_K_CAP * 4
+    row = np.random.RandomState(2).randn(V).astype(np.float32)
+    out = _draw(row, temperature=1.0, top_k=TOP_K_CAP + 50, top_p=1.0)
+    # every draw comes from the top-cap slice
+    top = set(np.argsort(row)[::-1][:TOP_K_CAP].tolist())
+    assert set(out.tolist()) <= top
+
+
+def test_seeded_rows_reproducible_and_stream_distinct():
+    logits = np.random.RandomState(3).randn(4, 50).astype(np.float32)
+    samp = SamplingParams.make([0.8] * 4, [0] * 4, [0.95] * 4)
+    seeds = jnp.asarray([7, 7, 9, 9], jnp.uint32)
+    counters = jnp.asarray([0, 0, 0, 1], jnp.int32)
+    a = np.asarray(sample_tokens(jnp.asarray(logits), samp, seeds, counters))
+    b = np.asarray(sample_tokens(jnp.asarray(logits), samp, seeds, counters))
+    assert (a == b).all()  # same (seed, counter) → same draw
+
+
+def test_top_p_high_entropy_stays_in_slice():
+    """A nucleus wider than the top-k slice must truncate to the slice,
+    never leak tail tokens (regression: the old fallback sampled the full
+    vocab unconstrained)."""
+    V = TOP_K_CAP * 4
+    row = np.random.RandomState(4).uniform(-0.1, 0.1, V).astype(np.float32)
+    out = _draw(row, temperature=1.0, top_k=0, top_p=0.95, n=2048)
+    top = set(np.argsort(row)[::-1][:TOP_K_CAP].tolist())
+    assert set(out.tolist()) <= top
