@@ -34,7 +34,13 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..models import KVCache, ModelConfig, forward_decode, forward_prefill
 from ..models.llama import forward_embed
-from ..ops import SamplingParams, compute_logprobs, sample_tokens
+from ..ops import (
+    SamplingParams,
+    apply_penalties,
+    compute_logprobs,
+    sample_tokens,
+    top_logprobs,
+)
 from ..runtime.engine import Context
 from .config import EngineConfig, bucket_for
 from .page_pool import KvEvent, NoPagesError, PagePool
@@ -55,22 +61,45 @@ class ForwardPassMetrics:
     num_requests_total: int = 0
 
 
-def _pack_out(out: jax.Array, logp: jax.Array) -> jax.Array:
-    """Pack sampled tokens (int32) + logprobs (float32) into ONE float32
-    array along the batch axis: each host fetch round-trips the tunnel to a
+# static top-k width for OpenAI `top_logprobs` responses (API max is 20)
+TOPLP = 20
+
+
+def _pack_out(out: jax.Array, logp: jax.Array, logits=None) -> jax.Array:
+    """Pack sampled tokens (int32) + logprobs (float32) — plus top-TOPLP
+    (ids, logprobs) when `logits` is given — into ONE float32 array along
+    the last axis: each host fetch round-trips the tunnel to a
     remote-attached TPU (~100ms regardless of size), so results must come
-    back in a single transfer."""
-    return jnp.concatenate(
-        [jax.lax.bitcast_convert_type(out, jnp.float32), logp], axis=-1
+    back in a single transfer.
+
+    Layout: [tok(B) | logp(B) | top_ids(B*TOPLP) | top_lps(B*TOPLP)].
+    """
+    parts = [jax.lax.bitcast_convert_type(out, jnp.float32), logp]
+    if logits is not None:
+        ids, lps = top_logprobs(logits, TOPLP)  # [B, TOPLP] each
+        parts.append(jax.lax.bitcast_convert_type(ids, jnp.float32).reshape(-1))
+        parts.append(lps.reshape(-1))
+    return jnp.concatenate(parts, axis=-1)
+
+
+def _unpack_out(packed: np.ndarray, b: int, with_top: bool = False):
+    """Inverse of `_pack_out`; returns (toks, logp, top_ids, top_lps)."""
+    toks = np.ascontiguousarray(packed[..., :b]).view(np.int32)
+    logp = packed[..., b : 2 * b]
+    if not with_top:
+        return toks, logp, None, None
+    ids = np.ascontiguousarray(
+        packed[..., 2 * b : 2 * b + b * TOPLP]
+    ).view(np.int32)
+    lps = packed[..., 2 * b + b * TOPLP :]
+    return (
+        toks, logp,
+        ids.reshape(*packed.shape[:-1], b, TOPLP),
+        lps.reshape(*packed.shape[:-1], b, TOPLP),
     )
 
 
-def _unpack_out(packed: np.ndarray, b: int):
-    toks = np.ascontiguousarray(packed[..., :b]).view(np.int32)
-    return toks, packed[..., b:]
-
-
-def _build_prefill_step(cfg: ModelConfig):
+def _build_prefill_step(cfg: ModelConfig, with_top: bool = False):
     @partial(jax.jit, donate_argnums=(1,))
     def step(params, kv, tokens, page_table, prefix_lens, chunk_lens, samp, seeds, counters):
         logits, kv = forward_prefill(
@@ -78,7 +107,7 @@ def _build_prefill_step(cfg: ModelConfig):
         )
         out = sample_tokens(logits, samp, seeds, counters)
         logp = compute_logprobs(logits, out)
-        return _pack_out(out, logp), kv
+        return _pack_out(out, logp, logits if with_top else None), kv
 
     return step
 
@@ -102,7 +131,8 @@ def _build_import_fn():
     return imp
 
 
-def _build_decode_step(cfg: ModelConfig, n_steps: int, max_valid_pos: int):
+def _build_decode_step(cfg: ModelConfig, n_steps: int, max_valid_pos: int,
+                       penalized: bool = False, with_top: bool = False):
     """Decode `n_steps` tokens per dispatch: lax.scan keeps the whole block
     on-device, so host→device latency is paid once per block, not per
     token (the TPU analog of multi-step scheduling).
@@ -111,28 +141,62 @@ def _build_decode_step(cfg: ModelConfig, n_steps: int, max_valid_pos: int):
     to the trash page instead of clamping into a real page — those tokens
     are discarded host-side anyway.
 
-    The carry state (last token, positions, counters) is returned so a
-    chained dispatch can consume block k's device-side outputs directly —
-    introducing any fresh host buffer between chained dispatches serializes
-    the pipeline on remote-attached TPUs.
-    """
-    @partial(jax.jit, donate_argnums=(1,))
-    def step(params, kv, tokens, positions, counters, page_table, samp, seeds):
-        def body(carry, _):
-            kv, tok, pos, ctr = carry
-            ok = pos < max_valid_pos
-            safe_pos = jnp.where(ok, pos, 0)
-            # out-of-window rows use an all-trash table row
-            table = jnp.where(ok[:, None], page_table, 0)
-            logits, kv = forward_decode(params, cfg, kv, tok, safe_pos, table)
-            out = sample_tokens(logits, samp, seeds, ctr)
-            logp = compute_logprobs(logits, out)
-            return (kv, out, pos + 1, ctr + 1), (out, logp)
+    The carry state (last token, positions, counters, penalty counts) is
+    returned so a chained dispatch can consume block k's device-side
+    outputs directly — introducing any fresh host buffer between chained
+    dispatches serializes the pipeline on remote-attached TPUs.
 
-        (kv, tok, pos, ctr), (toks, logps) = jax.lax.scan(
-            body, (kv, tokens, positions, counters), None, length=n_steps
-        )
-        return _pack_out(toks, logps), tok, pos, ctr, kv  # packed [T, 2B]
+    Variants (compiled lazily, cached per engine): `penalized` threads a
+    [B, V] output-token count array through the scan for frequency/
+    presence penalties; `with_top` packs top-TOPLP logprobs per step.
+    """
+    def body_common(kv, tok, pos, ctr, counts, page_table, samp, seeds, params):
+        ok = pos < max_valid_pos
+        safe_pos = jnp.where(ok, pos, 0)
+        # out-of-window rows use an all-trash table row
+        table = jnp.where(ok[:, None], page_table, 0)
+        logits, kv = forward_decode(params, cfg, kv, tok, safe_pos, table)
+        if penalized:
+            logits = apply_penalties(
+                logits, counts, samp.frequency_penalty, samp.presence_penalty
+            )
+        out = sample_tokens(logits, samp, seeds, ctr)
+        if penalized:
+            counts = counts.at[jnp.arange(out.shape[0]), out].add(1.0)
+        logp = compute_logprobs(logits, out)
+        packed = _pack_out(out, logp, logits if with_top else None)
+        return kv, out, counts, packed
+
+    if penalized:
+        @partial(jax.jit, donate_argnums=(1, 5))
+        def step(params, kv, tokens, positions, counters, counts,
+                 page_table, samp, seeds):
+            def body(carry, _):
+                kv, tok, pos, ctr, cts = carry
+                kv, out, cts, packed = body_common(
+                    kv, tok, pos, ctr, cts, page_table, samp, seeds, params
+                )
+                return (kv, out, pos + 1, ctr + 1, cts), packed
+
+            (kv, tok, pos, ctr, cts), packed = jax.lax.scan(
+                body, (kv, tokens, positions, counters, counts),
+                None, length=n_steps,
+            )
+            return packed, tok, pos, ctr, cts, kv
+    else:
+        @partial(jax.jit, donate_argnums=(1,))
+        def step(params, kv, tokens, positions, counters, page_table, samp, seeds):
+            def body(carry, _):
+                kv, tok, pos, ctr = carry
+                kv, out, _, packed = body_common(
+                    kv, tok, pos, ctr, None, page_table, samp, seeds, params
+                )
+                return (kv, out, pos + 1, ctr + 1), packed
+
+            (kv, tok, pos, ctr), packed = jax.lax.scan(
+                body, (kv, tokens, positions, counters), None, length=n_steps
+            )
+            return packed, tok, pos, ctr, kv
 
     return step
 
@@ -185,10 +249,10 @@ class JaxEngine:
             self.cfg.num_pages, self.cfg.page_size, event_sink=self._emit_event
         )
         self.scheduler = Scheduler(self.cfg, self.pool)
-        self._prefill_step = _build_prefill_step(model_cfg)
-        self._decode_step = _build_decode_step(
-            model_cfg, self.cfg.decode_steps, self.cfg.hard_cap
-        )
+        # step variants compiled lazily: (penalized, with_top) for decode,
+        # with_top for prefill
+        self._prefill_steps: Dict[bool, Callable] = {}
+        self._decode_steps: Dict[tuple, Callable] = {}
         self._export_fn = _build_export_fn()
         self._import_fn = _build_import_fn()
         # device ops queued by the loop thread, executed by the pump between
@@ -252,6 +316,24 @@ class JaxEngine:
         """Round a batch size up to a dp multiple (pad rows hit the trash
         page)."""
         return -(-n // self._dp) * self._dp
+
+    # -- step variants -------------------------------------------------------- #
+
+    def _get_prefill_step(self, with_top: bool):
+        if with_top not in self._prefill_steps:
+            self._prefill_steps[with_top] = _build_prefill_step(
+                self.model_cfg, with_top
+            )
+        return self._prefill_steps[with_top]
+
+    def _get_decode_step(self, penalized: bool, with_top: bool):
+        key = (penalized, with_top)
+        if key not in self._decode_steps:
+            self._decode_steps[key] = _build_decode_step(
+                self.model_cfg, self.cfg.decode_steps, self.cfg.hard_cap,
+                penalized=penalized, with_top=with_top,
+            )
+        return self._decode_steps[key]
 
     # -- events -------------------------------------------------------------- #
 
@@ -449,6 +531,8 @@ class JaxEngine:
             [s.opts.temperature for s in seqs] + [0.0] * pad,
             [s.opts.top_k for s in seqs] + [0] * pad,
             [s.opts.top_p for s in seqs] + [1.0] * pad,
+            [s.opts.frequency_penalty for s in seqs] + [0.0] * pad,
+            [s.opts.presence_penalty for s in seqs] + [0.0] * pad,
         )
 
     def _run_prefill(self, items: List[PrefillItem]) -> None:
@@ -468,9 +552,10 @@ class JaxEngine:
             prefix[i] = it.chunk_start
             chunk[i] = it.chunk_len
         seqs = [it.seq for it in items]
+        with_top = any(s.opts.top_logprobs > 0 for s in seqs)
         table = self._table_array(seqs, rows=B)
         seeds, counters = self._seed_arrays(seqs, B)
-        packed_d, kv = self._prefill_step(
+        packed_d, kv = self._get_prefill_step(with_top)(
             self.params,
             self.kv,
             self._put(tokens, "dp", None),
@@ -482,7 +567,9 @@ class JaxEngine:
             self._put(counters, "dp"),
         )
         self.kv = kv
-        out, logp = _unpack_out(np.asarray(jax.device_get(packed_d)), B)
+        out, logp, tids, tlps = _unpack_out(
+            np.asarray(jax.device_get(packed_d)), B, with_top
+        )
         for i, it in enumerate(items):
             s = it.seq
             if s.status != "running":  # preempted after planning
@@ -490,7 +577,10 @@ class JaxEngine:
             s.num_computed += it.chunk_len
             self.scheduler.commit_full_pages(s)
             if it.samples:
-                self._append_token(s, int(out[i]), float(logp[i]))
+                self._append_token(
+                    s, int(out[i]), float(logp[i]),
+                    _tops_for(s, tids, tlps, i),
+                )
 
     def _chain_ok(self, seqs: List[Sequence], k: int, T: int, hard_cap: int) -> bool:
         """May decode block k be dispatched before block k-1's results are
@@ -536,18 +626,35 @@ class JaxEngine:
             positions[i] = s.num_computed
         seeds, counters = self._seed_arrays(seqs, Bb)
         table = self._table_array(seqs, rows=Bb)
+        penalized = any(s.opts.penalized for s in seqs)
+        with_top = any(s.opts.top_logprobs > 0 for s in seqs)
+        step = self._get_decode_step(penalized, with_top)
         tok_d = self._put(tokens, "dp")
         pos_d = self._put(positions, "dp")
         ctr_d = self._put(counters, "dp")
         table_d = self._put(table, "dp", None)
         samp_d = self._put_samp(self._samp_arrays(seqs, Bb))
         seeds_d = self._put(seeds, "dp")
+        if penalized:
+            # output-token histograms (prompt tokens are not penalized);
+            # updated on-device within and across chained blocks
+            counts = np.zeros((Bb, self.model_cfg.vocab_size), np.float32)
+            for i, s in enumerate(seqs):
+                if s.output_tokens:
+                    np.add.at(counts[i], s.output_tokens, 1.0)
+            cts_d = self._put(counts, "dp", None)
         dispatches = []
         for _ in range(chain_len):
-            packed_d, tok_d, pos_d, ctr_d, self.kv = self._decode_step(
-                self.params, self.kv, tok_d, pos_d, ctr_d,
-                table_d, samp_d, seeds_d,
-            )
+            if penalized:
+                packed_d, tok_d, pos_d, ctr_d, cts_d, self.kv = step(
+                    self.params, self.kv, tok_d, pos_d, ctr_d, cts_d,
+                    table_d, samp_d, seeds_d,
+                )
+            else:
+                packed_d, tok_d, pos_d, ctr_d, self.kv = step(
+                    self.params, self.kv, tok_d, pos_d, ctr_d,
+                    table_d, samp_d, seeds_d,
+                )
             try:  # start the host copy early; overlaps later blocks' compute
                 packed_d.copy_to_host_async()
             except Exception:  # noqa: BLE001 — sharded arrays may not support it
@@ -561,8 +668,8 @@ class JaxEngine:
         self.scheduler.deferred_free = deferred
         try:
             for packed_d in dispatches:
-                out, logp = _unpack_out(
-                    np.asarray(jax.device_get(packed_d)), Bb
+                out, logp, tids, tlps = _unpack_out(
+                    np.asarray(jax.device_get(packed_d)), Bb, with_top
                 )  # [T, B] each
                 for i, s in enumerate(seqs):
                     if s.status != "running":
@@ -570,7 +677,10 @@ class JaxEngine:
                     for t in range(out.shape[0]):
                         s.num_computed += 1
                         self.scheduler.commit_full_pages(s)
-                        self._append_token(s, int(out[t, i]), float(logp[t, i]))
+                        self._append_token(
+                            s, int(out[t, i]), float(logp[t, i]),
+                            _tops_for(s, tids, tlps, (t, i)),
+                        )
                         if s.status != "running":
                             break  # stop hit mid-block; rest discarded
         finally:
@@ -800,12 +910,13 @@ class JaxEngine:
             seq.committed_pages = 0
             seq.block_hashes = []
 
-    def _append_token(self, seq: Sequence, token: int, logprob: float) -> None:
+    def _append_token(self, seq: Sequence, token: int, logprob: float,
+                      tops=None) -> None:
         seq.output_tokens.append(token)
         reason = self.scheduler.check_stop(seq, self.eos_token_ids)
         if reason:
             self.scheduler.finish(seq, reason)
-        self._deliver(seq, [token], reason, logprob)
+        self._deliver(seq, [token], reason, logprob, tops)
 
     def _deliver(
         self,
@@ -813,6 +924,7 @@ class JaxEngine:
         tokens: List[int],
         finish_reason: Optional[str],
         logprob: Optional[float] = None,
+        tops=None,
     ) -> None:
         queue = self._queues.get(seq.request_id)
         if queue is None:
@@ -823,8 +935,22 @@ class JaxEngine:
         }
         if logprob is not None and seq.opts.logprobs:
             out["log_probs"] = [logprob]
+        if tops is not None:
+            out["top_logprobs"] = [tops]  # aligned with token_ids
         # may be called from the executor thread — hop back to the loop
         self._loop.call_soon_threadsafe(queue.put_nowait, out)
+
+
+def _tops_for(seq: Sequence, tids, tlps, idx):
+    """Slice this sequence's requested top-k (id, logprob) pairs out of the
+    packed TOPLP-wide arrays; None when the request didn't ask."""
+    k = seq.opts.top_logprobs
+    if not k or tids is None:
+        return None
+    ids = tids[idx] if not isinstance(idx, tuple) else tids[idx[0], idx[1]]
+    lps = tlps[idx] if not isinstance(idx, tuple) else tlps[idx[0], idx[1]]
+    k = min(k, len(ids))
+    return [[int(ids[j]), float(lps[j])] for j in range(k)]
 
 
 def _opts_from_request(request: Dict[str, Any]) -> SamplingOptions:
@@ -837,6 +963,8 @@ def _opts_from_request(request: Dict[str, Any]) -> SamplingOptions:
         temperature=1.0 if temperature is None else temperature,
         top_k=so.get("top_k") or 0,
         top_p=so.get("top_p") if so.get("top_p") is not None else 1.0,
+        frequency_penalty=so.get("frequency_penalty") or 0.0,
+        presence_penalty=so.get("presence_penalty") or 0.0,
         # None → generate to the context window (Scheduler.add clamps);
         # the legacy-completions 16-token default is the preprocessor's job
         max_tokens=(1 << 30) if max_tokens is None else max_tokens,
@@ -844,5 +972,6 @@ def _opts_from_request(request: Dict[str, Any]) -> SamplingOptions:
         stop_sequences=sc.get("stop_sequences") or [],
         ignore_eos=sc.get("ignore_eos") or False,
         logprobs=bool(so.get("logprobs")),
+        top_logprobs=int(so.get("top_logprobs") or 0),
         seed=so.get("seed"),
     )

@@ -37,12 +37,19 @@ class SamplingOptions:
     temperature: float = 0.0
     top_k: int = 0
     top_p: float = 1.0
+    frequency_penalty: float = 0.0
+    presence_penalty: float = 0.0
     max_tokens: int = 16
     stop_token_ids: List[int] = field(default_factory=list)
     stop_sequences: List[List[int]] = field(default_factory=list)
     ignore_eos: bool = False
     logprobs: bool = False
+    top_logprobs: int = 0  # top-k logprobs per token (OpenAI max 20)
     seed: Optional[int] = None
+
+    @property
+    def penalized(self) -> bool:
+        return bool(self.frequency_penalty or self.presence_penalty)
 
 
 class Sequence:

@@ -21,6 +21,10 @@ def main() -> None:
         default="round_robin",
         choices=["round_robin", "random", "kv"],
     )
+    ap.add_argument("--status-port", type=int, default=-1,
+                    help="separate system status server port (0 = ephemeral,"
+                         " -1 = disabled; the main port already serves "
+                         "/health /live /metrics)")
     ap.add_argument("--log-level", default="info")
     args = ap.parse_args()
     logging.basicConfig(level=args.log_level.upper(),
@@ -44,12 +48,25 @@ async def _run(args) -> None:
         kv_chooser_factory=kv_factory,
     ).start()
     http = await HttpService(manager, host=args.host, port=args.port).start()
+    status = None
+    if args.status_port >= 0:
+        from ..runtime.status import SystemStatusServer
+
+        async def _health():
+            return {"status": "healthy", "models": manager.names()}
+
+        status = await SystemStatusServer(
+            health_fn=_health, port=args.status_port
+        ).start()
+        print(f"STATUS http://0.0.0.0:{status.port}", flush=True)
     print(f"READY http://{args.host}:{http.port}", flush=True)
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGINT, signal.SIGTERM):
         loop.add_signal_handler(sig, stop.set)
     await stop.wait()
+    if status:
+        await status.stop()
     await http.stop()
     await watcher.stop()
     await runtime.shutdown()

@@ -2,9 +2,13 @@
 
 The analog of the reference's axum service
 (/root/reference/lib/llm/src/http/service/service_v2.rs:135 `HttpService`,
-openai.rs:504 `handler_chat_completions`, :280 completions, :1048 models):
+openai.rs:504 `handler_chat_completions`, :280 completions, :434 embeddings,
+:767 responses, :1048 models):
 
-- POST /v1/chat/completions, /v1/completions — SSE streaming and unary
+- POST /v1/chat/completions, /v1/completions — SSE streaming and unary,
+  n>1 choices, OpenAI logprobs/top_logprobs shapes
+- POST /v1/embeddings — decoder-as-embedder path
+- POST /v1/responses — Responses API over the chat pipeline
 - GET  /v1/models
 - GET  /health, /live, /metrics (prometheus exposition)
 - POST /clear_kv_blocks — broadcast cache clear to workers
@@ -45,6 +49,8 @@ class HttpService:
             [
                 web.post("/v1/chat/completions", self.chat_completions),
                 web.post("/v1/completions", self.completions),
+                web.post("/v1/embeddings", self.embeddings),
+                web.post("/v1/responses", self.responses),
                 web.get("/v1/models", self.list_models),
                 web.get("/health", self.health),
                 web.get("/live", self.live),
@@ -116,6 +122,151 @@ class HttpService:
     async def completions(self, request: web.Request) -> web.StreamResponse:
         return await self._serve(request, kind="completion")
 
+    async def embeddings(self, request: web.Request) -> web.Response:
+        """OpenAI /v1/embeddings (reference openai.rs:434)."""
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return _error_response(400, "invalid JSON body")
+        model_name = body.get("model", "")
+        entry = self.manager.get(model_name)
+        if entry is None:
+            self.metrics.requests.labels(model_name or "?", "embedding", "404").inc()
+            return _error_response(
+                404, f"model '{model_name}' not found", code="model_not_found"
+            )
+        if not entry.mdc.supports("embedding"):
+            return _error_response(
+                400, f"model '{model_name}' does not support embeddings"
+            )
+        try:
+            preq = await asyncio.get_running_loop().run_in_executor(
+                None, entry.preprocessor.preprocess_embedding, body
+            )
+        except RequestError as e:
+            self.metrics.requests.labels(model_name, "embedding", "400").inc()
+            return _error_response(400, str(e))
+        try:
+            result = None
+            async for out in entry.route(preq, Context()):
+                result = out
+                break
+        except ServiceUnavailable as e:
+            self.metrics.requests.labels(model_name, "embedding", "503").inc()
+            return _error_response(503, str(e))
+        except RemoteStreamError as e:
+            self.metrics.requests.labels(model_name, "embedding", "502").inc()
+            return _error_response(502, str(e))
+        if not result or result.get("error"):
+            self.metrics.requests.labels(model_name, "embedding", "500").inc()
+            return _error_response(
+                500, (result or {}).get("error", "embedding failed")
+            )
+        self.metrics.requests.labels(model_name, "embedding", "200").inc()
+        data = [
+            {"object": "embedding", "index": i, "embedding": vec}
+            for i, vec in enumerate(result.get("embeddings", []))
+        ]
+        ptoks = int(result.get("prompt_tokens", 0))
+        return web.json_response({
+            "object": "list",
+            "data": data,
+            "model": model_name,
+            "usage": {"prompt_tokens": ptoks, "total_tokens": ptoks},
+        })
+
+    async def responses(self, request: web.Request) -> web.StreamResponse:
+        """OpenAI /v1/responses (reference openai.rs:767): adapt the
+        Responses request onto the chat pipeline."""
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return _error_response(400, "invalid JSON body")
+        messages = []
+        if body.get("instructions"):
+            messages.append({"role": "system", "content": body["instructions"]})
+        inp = body.get("input")
+        if isinstance(inp, str):
+            messages.append({"role": "user", "content": inp})
+        elif isinstance(inp, list):
+            for item in inp:
+                if isinstance(item, dict) and item.get("type") in (None, "message"):
+                    content = item.get("content", "")
+                    if isinstance(content, list):
+                        # Responses content parts use input_text/output_text;
+                        # map onto the chat template's plain-text parts
+                        content = [
+                            {"type": "text", "text": p.get("text", "")}
+                            if isinstance(p, dict)
+                            and p.get("type") in ("input_text", "output_text")
+                            else p
+                            for p in content
+                        ]
+                    messages.append({
+                        "role": item.get("role", "user"),
+                        "content": content,
+                    })
+        if not messages:
+            return _error_response(400, "'input' is required")
+        chat_body = {
+            "model": body.get("model", ""),
+            "messages": messages,
+            "stream": False,
+            "temperature": body.get("temperature"),
+            "top_p": body.get("top_p"),
+            "max_tokens": body.get("max_output_tokens"),
+        }
+        model_name = chat_body["model"]
+        entry = self.manager.get(model_name)
+        if entry is None:
+            return _error_response(
+                404, f"model '{model_name}' not found", code="model_not_found"
+            )
+        try:
+            preq = await asyncio.get_running_loop().run_in_executor(
+                None, entry.preprocessor.preprocess_chat, chat_body
+            )
+        except RequestError as e:
+            return _error_response(400, str(e))
+        try:
+            choice = await self._collect_choice(entry, preq, Context())
+        except ServiceUnavailable as e:
+            self.metrics.requests.labels(model_name, "responses", "503").inc()
+            return _error_response(503, str(e))
+        except RemoteStreamError as e:
+            self.metrics.requests.labels(model_name, "responses", "502").inc()
+            return _error_response(502, str(e))
+        if choice.get("error"):
+            self.metrics.requests.labels(model_name, "responses", "500").inc()
+            return _error_response(500, choice["error"])
+        rid = "resp_" + uuid.uuid4().hex[:24]
+        prompt_tokens = len(preq.get("token_ids", []))
+        self.metrics.requests.labels(model_name, "responses", "200").inc()
+        return web.json_response({
+            "id": rid,
+            "object": "response",
+            "created_at": int(time.time()),
+            "status": "completed",
+            "model": model_name,
+            "output": [{
+                "type": "message",
+                "id": "msg_" + uuid.uuid4().hex[:24],
+                "role": "assistant",
+                "status": "completed",
+                "content": [{
+                    "type": "output_text",
+                    "text": choice["text"],
+                    "annotations": [],
+                }],
+            }],
+            "output_text": choice["text"],
+            "usage": {
+                "input_tokens": prompt_tokens,
+                "output_tokens": choice["token_count"],
+                "total_tokens": prompt_tokens + choice["token_count"],
+            },
+        })
+
     # -- core serving path --------------------------------------------------- #
 
     async def _serve(self, request: web.Request, kind: str) -> web.StreamResponse:
@@ -149,23 +300,38 @@ class HttpService:
             self.metrics.requests.labels(model_name, kind, "400").inc()
             return _error_response(400, str(e))
 
-        context = Context()
+        n = preprocessed["sampling_options"].get("n", 1)
         rid = ("chatcmpl-" if kind == "chat" else "cmpl-") + uuid.uuid4().hex[:24]
         streaming = bool(body.get("stream", False))
         self.metrics.inflight.labels(model_name).inc()
         try:
             if streaming:
                 return await self._stream_response(
-                    request, entry, preprocessed, context, rid, kind, model_name, t0
+                    request, entry, preprocessed, n, rid, kind, model_name, t0
                 )
             return await self._unary_response(
-                entry, preprocessed, context, rid, kind, model_name, t0
+                entry, preprocessed, n, rid, kind, model_name, t0
             )
         finally:
             self.metrics.inflight.labels(model_name).dec()
 
+    def _choice_requests(self, preprocessed, n):
+        """n independent engine requests; explicit seeds offset per choice
+        so n>1 with a seed still yields distinct-but-reproducible choices."""
+        out = []
+        for i in range(n):
+            preq = {
+                **preprocessed,
+                "sampling_options": dict(preprocessed["sampling_options"]),
+            }
+            seed = preq["sampling_options"].get("seed")
+            if seed is not None and i:
+                preq["sampling_options"]["seed"] = seed + i
+            out.append(preq)
+        return out
+
     async def _stream_response(
-        self, request, entry, preprocessed, context, rid, kind, model_name, t0
+        self, request, entry, preprocessed, n, rid, kind, model_name, t0
     ) -> web.StreamResponse:
         resp = web.StreamResponse(
             status=200,
@@ -178,15 +344,44 @@ class HttpService:
         await resp.prepare(request)
         created = int(time.time())
         first = True
-        finish_reason = None
         ntokens = 0
         last_t = t0
+        status = "200"
+        contexts = [Context() for _ in range(n)]
+        queue: asyncio.Queue = asyncio.Queue()
+
+        async def pump_choice(i, preq, ctx):
+            try:
+                async for out in entry.generate(preq, ctx):
+                    await queue.put((i, out, None))
+            except (ServiceUnavailable, RemoteStreamError) as e:
+                await queue.put((i, None, e))
+            finally:
+                await queue.put((i, None, None))  # choice drained
+
+        tasks = [
+            asyncio.create_task(pump_choice(i, preq, ctx))
+            for i, (preq, ctx) in enumerate(
+                zip(self._choice_requests(preprocessed, n), contexts)
+            )
+        ]
+        live = n
         try:
-            async for out in entry.generate(preprocessed, context):
+            while live:
+                i, out, err = await queue.get()
+                if err is not None:
+                    status = "502"
+                    chunk = _sse_error_chunk(rid, str(err))
+                    await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
+                    continue
+                if out is None:
+                    live -= 1
+                    continue
                 if out.get("finish_reason") == "error":
+                    status = "500"
                     chunk = _sse_error_chunk(rid, out.get("error", "engine error"))
                     await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
-                    break
+                    continue
                 now = time.monotonic()
                 if first:
                     self.metrics.ttft.labels(model_name).observe(now - t0)
@@ -195,108 +390,207 @@ class HttpService:
                     self.metrics.itl.labels(model_name).observe(now - last_t)
                 last_t = now
                 ntokens += len(out.get("token_ids", []))
-                finish_reason = out.get("finish_reason")
-                chunk = _make_chunk(rid, kind, model_name, created, out, finish_reason)
+                chunk = _make_chunk(
+                    rid, kind, model_name, created, out,
+                    out.get("finish_reason"), index=i, entry=entry,
+                )
                 await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
             await resp.write(b"data: [DONE]\n\n")
         except (ConnectionResetError, asyncio.CancelledError):
-            logger.info("client disconnected; killing %s", context.id)
-            context.kill()
+            logger.info("client disconnected; killing %d choice(s)", n)
+            for ctx in contexts:
+                ctx.kill()
             raise
-        except (ServiceUnavailable, RemoteStreamError) as e:
-            chunk = _sse_error_chunk(rid, str(e))
-            await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
-            await resp.write(b"data: [DONE]\n\n")
-        self.metrics.requests.labels(model_name, kind, "200").inc()
+        finally:
+            for t in tasks:
+                t.cancel()
+        self.metrics.requests.labels(model_name, kind, status).inc()
         self.metrics.output_tokens.labels(model_name).inc(ntokens)
         self.metrics.duration.labels(model_name).observe(time.monotonic() - t0)
         await resp.write_eof()
         return resp
 
-    async def _unary_response(
-        self, entry, preprocessed, context, rid, kind, model_name, t0
-    ) -> web.Response:
+    async def _collect_choice(self, entry, preq, context) -> Dict[str, Any]:
+        """Drain one engine stream into an aggregated choice."""
         text_parts = []
-        token_count = 0
+        token_ids: list = []
+        logprobs: list = []
+        tops: list = []
         finish_reason = None
+        async for out in entry.generate(preq, context):
+            if out.get("finish_reason") == "error":
+                return {"error": out.get("error", "engine error")}
+            text_parts.append(out.get("text", ""))
+            token_ids.extend(out.get("token_ids", []))
+            logprobs.extend(out.get("log_probs", []))
+            tops.extend(out.get("top_logprobs", []))
+            finish_reason = out.get("finish_reason") or finish_reason
+        return {
+            "text": "".join(text_parts),
+            "token_ids": token_ids,
+            "token_count": len(token_ids),
+            "log_probs": logprobs,
+            "top_logprobs": tops,
+            "finish_reason": finish_reason or "stop",
+        }
+
+    async def _unary_response(
+        self, entry, preprocessed, n, rid, kind, model_name, t0
+    ) -> web.Response:
+        contexts = [Context() for _ in range(n)]
+        tasks = [
+            asyncio.ensure_future(self._collect_choice(entry, preq, ctx))
+            for preq, ctx in zip(
+                self._choice_requests(preprocessed, n), contexts
+            )
+        ]
         try:
-            async for out in entry.generate(preprocessed, context):
-                if out.get("finish_reason") == "error":
-                    return _error_response(500, out.get("error", "engine error"))
-                text_parts.append(out.get("text", ""))
-                token_count += len(out.get("token_ids", []))
-                finish_reason = out.get("finish_reason") or finish_reason
-        except ServiceUnavailable as e:
-            self.metrics.requests.labels(model_name, kind, "503").inc()
-            return _error_response(503, str(e))
-        except RemoteStreamError as e:
-            self.metrics.requests.labels(model_name, kind, "502").inc()
-            return _error_response(502, str(e))
-        text = "".join(text_parts)
+            results = await asyncio.gather(*tasks)
+        except (ServiceUnavailable, RemoteStreamError) as e:
+            # one choice failed: stop its siblings instead of letting them
+            # decode unattended to max_tokens
+            for ctx in contexts:
+                ctx.kill()
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+            status = "503" if isinstance(e, ServiceUnavailable) else "502"
+            self.metrics.requests.labels(model_name, kind, status).inc()
+            return _error_response(int(status), str(e))
+        for r in results:
+            if r.get("error"):
+                self.metrics.requests.labels(model_name, kind, "500").inc()
+                return _error_response(500, r["error"])
         created = int(time.time())
         prompt_tokens = len(preprocessed.get("token_ids", []))
+        token_count = sum(r["token_count"] for r in results)
         usage = {
             "prompt_tokens": prompt_tokens,
             "completion_tokens": token_count,
             "total_tokens": prompt_tokens + token_count,
         }
-        if kind == "chat":
-            payload = {
-                "id": rid,
-                "object": "chat.completion",
-                "created": created,
-                "model": model_name,
-                "choices": [
-                    {
-                        "index": 0,
-                        "message": {"role": "assistant", "content": text},
-                        "finish_reason": finish_reason or "stop",
-                    }
-                ],
-                "usage": usage,
-            }
-        else:
-            payload = {
-                "id": rid,
-                "object": "text_completion",
-                "created": created,
-                "model": model_name,
-                "choices": [
-                    {
-                        "index": 0,
-                        "text": text,
-                        "finish_reason": finish_reason or "stop",
-                    }
-                ],
-                "usage": usage,
-            }
+        want_lp = preprocessed["sampling_options"].get("logprobs")
+        choices = []
+        for i, r in enumerate(results):
+            if kind == "chat":
+                choice = {
+                    "index": i,
+                    "message": {"role": "assistant", "content": r["text"]},
+                    "finish_reason": r["finish_reason"],
+                }
+                if want_lp:
+                    choice["logprobs"] = _chat_logprobs(entry, r)
+            else:
+                choice = {
+                    "index": i,
+                    "text": r["text"],
+                    "finish_reason": r["finish_reason"],
+                }
+                if want_lp:
+                    choice["logprobs"] = _completions_logprobs(entry, r)
+            choices.append(choice)
+        payload = {
+            "id": rid,
+            "object": "chat.completion" if kind == "chat" else "text_completion",
+            "created": created,
+            "model": model_name,
+            "choices": choices,
+            "usage": usage,
+        }
         self.metrics.requests.labels(model_name, kind, "200").inc()
         self.metrics.output_tokens.labels(model_name).inc(token_count)
         self.metrics.duration.labels(model_name).observe(time.monotonic() - t0)
         return web.json_response(payload)
 
 
-def _make_chunk(rid, kind, model, created, out, finish_reason):
+def _token_str(entry, tid: int) -> str:
+    try:
+        return entry.tokenizer.decode([tid])
+    except Exception:  # noqa: BLE001
+        return ""
+
+
+def _chat_logprobs(entry, r) -> Dict[str, Any]:
+    """OpenAI chat `logprobs` shape: {"content": [{token, logprob, bytes,
+    top_logprobs: [...]}]} (reference perf/logprobs.rs + openai.rs)."""
+    content = []
+    tops = r.get("top_logprobs") or []
+    for j, tid in enumerate(r["token_ids"]):
+        lp = r["log_probs"][j] if j < len(r.get("log_probs", [])) else None
+        tok = _token_str(entry, tid)
+        item = {
+            "token": tok,
+            "logprob": lp,
+            "bytes": list(tok.encode()),
+        }
+        if j < len(tops) and tops[j]:
+            item["top_logprobs"] = [
+                {
+                    "token": _token_str(entry, t),
+                    "logprob": l,
+                    "bytes": list(_token_str(entry, t).encode()),
+                }
+                for t, l in tops[j]
+            ]
+        content.append(item)
+    return {"content": content}
+
+
+def _completions_logprobs(entry, r) -> Dict[str, Any]:
+    """Legacy completions `logprobs` shape: parallel arrays + top-k maps."""
+    tokens = [_token_str(entry, t) for t in r["token_ids"]]
+    offsets = []
+    pos = 0
+    for t in tokens:
+        offsets.append(pos)
+        pos += len(t)
+    tops = r.get("top_logprobs") or []
+    top_maps = []
+    for j in range(len(tokens)):
+        if j < len(tops) and tops[j]:
+            top_maps.append(
+                {_token_str(entry, t): l for t, l in tops[j]}
+            )
+        else:
+            top_maps.append(None)
+    return {
+        "tokens": tokens,
+        "token_logprobs": list(r.get("log_probs", [])),
+        "top_logprobs": top_maps,
+        "text_offset": offsets,
+    }
+
+
+def _make_chunk(rid, kind, model, created, out, finish_reason, index=0,
+                entry=None):
+    want_lp = entry is not None and out.get("log_probs")
+    lp_args = {
+        "token_ids": out.get("token_ids", []),
+        "log_probs": out.get("log_probs", []),
+        "top_logprobs": out.get("top_logprobs", []),
+    }
     if kind == "chat":
         delta = {"content": out.get("text", "")} if out.get("text") else {}
+        choice = {"index": index, "delta": delta, "finish_reason": finish_reason}
+        if want_lp:
+            choice["logprobs"] = _chat_logprobs(entry, lp_args)
         return {
             "id": rid,
             "object": "chat.completion.chunk",
             "created": created,
             "model": model,
-            "choices": [
-                {"index": 0, "delta": delta, "finish_reason": finish_reason}
-            ],
+            "choices": [choice],
         }
+    choice = {"index": index, "text": out.get("text", ""),
+              "finish_reason": finish_reason}
+    if want_lp:
+        choice["logprobs"] = _completions_logprobs(entry, lp_args)
     return {
         "id": rid,
         "object": "text_completion",
         "created": created,
         "model": model,
-        "choices": [
-            {"index": 0, "text": out.get("text", ""),
-             "finish_reason": finish_reason}
-        ],
+        "choices": [choice],
     }
 
 

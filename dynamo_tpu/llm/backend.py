@@ -77,19 +77,21 @@ async def postprocess_stream(
             return
         text = post.push_tokens(out.get("token_ids", []))
         reason = out.get("finish_reason")
+        passthrough = {
+            k: out[k] for k in ("log_probs", "top_logprobs") if k in out
+        }
         if post.finished_by_stop is not None:
             yield {"text": text, "finish_reason": "stop",
-                   "token_ids": out.get("token_ids", [])}
+                   "token_ids": out.get("token_ids", []), **passthrough}
             return
         if reason:
             text += post.flush()
             yield {"text": text, "finish_reason": reason,
-                   "token_ids": out.get("token_ids", [])}
+                   "token_ids": out.get("token_ids", []), **passthrough}
             return
         if text or out.get("token_ids"):
             yield {"text": text, "finish_reason": None,
-                   "token_ids": out.get("token_ids", []),
-                   **({"log_probs": out["log_probs"]} if "log_probs" in out else {})}
+                   "token_ids": out.get("token_ids", []), **passthrough}
     # engine stream ended without a finish reason (cancelled upstream)
     tail = post.flush()
     if tail:

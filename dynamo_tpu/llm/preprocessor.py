@@ -94,6 +94,42 @@ class OpenAIPreprocessor:
             out["stop_conditions"]["max_tokens"] = 16  # legacy OpenAI default
         return out
 
+    # -- embeddings ----------------------------------------------------------- #
+
+    def preprocess_embedding(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        """OpenAI embeddings request → engine embed request (the analog of
+        preprocessor.rs:372 `preprocess_embedding_request`)."""
+        inputs = request.get("input")
+        if inputs is None:
+            raise RequestError("'input' is required")
+        if isinstance(inputs, str):
+            inputs = [inputs]
+        if not isinstance(inputs, list) or not inputs:
+            raise RequestError("'input' must be a non-empty string or list")
+        if isinstance(inputs[0], int):  # single token array
+            inputs = [inputs]
+        if len(inputs) > 64:  # cap before tokenizing anything
+            raise RequestError("at most 64 inputs per embeddings request")
+        batches: List[List[int]] = []
+        for item in inputs:
+            if isinstance(item, str):
+                ids = self.tokenizer.encode(item)
+            elif isinstance(item, list) and all(isinstance(t, int) for t in item):
+                ids = list(item)
+            else:
+                raise RequestError(
+                    "'input' items must be strings or token arrays"
+                )
+            if not ids:
+                raise RequestError("'input' items must not be empty")
+            if len(ids) > self.mdc.context_length:
+                raise RequestError(
+                    f"input is {len(ids)} tokens; model context is "
+                    f"{self.mdc.context_length}"
+                )
+            batches.append(ids)
+        return {"embed_token_ids": batches}
+
     # -- shared -------------------------------------------------------------- #
 
     def _finish(self, request: Dict[str, Any], token_ids: List[int],
@@ -110,6 +146,16 @@ class OpenAIPreprocessor:
         stop = stop or []
         if len(stop) > 4:
             raise RequestError("at most 4 stop sequences")
+        _validate_sampling(request)
+        # chat: logprobs is a bool + top_logprobs int; legacy completions:
+        # logprobs is an int k meaning "top-k per token"
+        logprobs = request.get("logprobs")
+        if isinstance(logprobs, bool) or logprobs is None:
+            want_logprobs = bool(logprobs)
+            top_logprobs = int(request.get("top_logprobs") or 0)
+        else:
+            want_logprobs = True
+            top_logprobs = int(logprobs)
         nvext = request.get("nvext", {}) or {}
         return {
             "token_ids": token_ids,
@@ -120,8 +166,9 @@ class OpenAIPreprocessor:
                 "seed": request.get("seed"),
                 "frequency_penalty": request.get("frequency_penalty"),
                 "presence_penalty": request.get("presence_penalty"),
-                "logprobs": bool(request.get("logprobs")),
-                "n": request.get("n", 1),
+                "logprobs": want_logprobs,
+                "top_logprobs": top_logprobs,
+                "n": int(request.get("n") or 1),
             },
             "stop_conditions": {
                 "max_tokens": max_tokens,
@@ -134,6 +181,36 @@ class OpenAIPreprocessor:
             },
             "annotations": {"prompt": prompt} if nvext.get("annotations") else {},
         }
+
+
+_RANGES = {
+    "temperature": (0.0, 2.0),
+    "top_p": (0.0, 1.0),
+    "frequency_penalty": (-2.0, 2.0),
+    "presence_penalty": (-2.0, 2.0),
+}
+
+
+def _validate_sampling(request: Dict[str, Any]) -> None:
+    """Reject out-of-range sampling parameters with 400 instead of
+    silently accepting them (reference behavior: parameters map into engine
+    sampling options or fail validation, preprocessor.rs:102)."""
+    for key, (lo, hi) in _RANGES.items():
+        v = request.get(key)
+        if v is None:
+            continue
+        if not isinstance(v, (int, float)) or not lo <= v <= hi:
+            raise RequestError(f"'{key}' must be a number in [{lo}, {hi}]")
+    n = request.get("n")
+    if n is not None and (not isinstance(n, int) or not 1 <= n <= 16):
+        raise RequestError("'n' must be an integer in [1, 16]")
+    tl = request.get("top_logprobs")
+    if tl is not None and (not isinstance(tl, int) or not 0 <= tl <= 20):
+        raise RequestError("'top_logprobs' must be an integer in [0, 20]")
+    lp = request.get("logprobs")
+    if lp is not None and not isinstance(lp, bool):
+        if not isinstance(lp, int) or not 0 <= lp <= 20:
+            raise RequestError("'logprobs' must be a bool or an int in [0, 20]")
 
 
 def _normalize_messages(messages: List[Dict[str, Any]]) -> List[Dict[str, Any]]:

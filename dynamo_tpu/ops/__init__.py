@@ -13,10 +13,17 @@ from .paged_attention import (
     write_kv_pages,
 )
 from .rotary import apply_rope, rope_frequencies
-from .sampling import SamplingParams, compute_logprobs, sample_tokens
+from .sampling import (
+    SamplingParams,
+    apply_penalties,
+    compute_logprobs,
+    sample_tokens,
+    top_logprobs,
+)
 
 __all__ = [
     "SamplingParams",
+    "apply_penalties",
     "apply_rope",
     "compute_logprobs",
     "decode_attention",
@@ -25,5 +32,6 @@ __all__ = [
     "rms_norm",
     "rope_frequencies",
     "sample_tokens",
+    "top_logprobs",
     "write_kv_pages",
 ]

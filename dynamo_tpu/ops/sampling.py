@@ -36,13 +36,23 @@ class SamplingParams(NamedTuple):
     temperature: jax.Array  # 0.0 → greedy
     top_k: jax.Array  # 0 → disabled
     top_p: jax.Array  # 1.0 → disabled
+    frequency_penalty: jax.Array  # 0.0 → disabled
+    presence_penalty: jax.Array  # 0.0 → disabled
 
     @staticmethod
-    def make(temperature, top_k, top_p):
+    def make(temperature, top_k, top_p,
+             frequency_penalty=None, presence_penalty=None):
+        n = len(temperature)
         return SamplingParams(
             jnp.asarray(temperature, jnp.float32),
             jnp.asarray(top_k, jnp.int32),
             jnp.asarray(top_p, jnp.float32),
+            jnp.asarray(frequency_penalty
+                        if frequency_penalty is not None else [0.0] * n,
+                        jnp.float32),
+            jnp.asarray(presence_penalty
+                        if presence_penalty is not None else [0.0] * n,
+                        jnp.float32),
         )
 
 
@@ -116,3 +126,29 @@ def compute_logprobs(logits: jax.Array, tokens: jax.Array) -> jax.Array:
     """Log-probability of `tokens` [B] under `logits` [B, V]."""
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     return jnp.take_along_axis(logp, tokens[:, None], axis=1)[:, 0]
+
+
+def apply_penalties(
+    logits: jax.Array,  # [B, V]
+    counts: jax.Array,  # [B, V] float — output-token occurrence counts
+    frequency_penalty: jax.Array,  # [B]
+    presence_penalty: jax.Array,  # [B]
+) -> jax.Array:
+    """OpenAI frequency/presence penalties over generated tokens (vLLM
+    semantics: prompt tokens are not penalized; the engine builds `counts`
+    from output tokens only).  Applied before greedy argmax and sampling
+    alike (reference maps these into engine sampling options,
+    preprocessor.rs:102)."""
+    logits = logits.astype(jnp.float32)
+    return (
+        logits
+        - frequency_penalty[:, None] * counts
+        - presence_penalty[:, None] * (counts > 0).astype(jnp.float32)
+    )
+
+
+def top_logprobs(logits: jax.Array, k: int):
+    """Top-k (ids, logprobs) per row for OpenAI `top_logprobs` responses."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    vals, idx = jax.lax.top_k(logp, k)  # [B, k] each
+    return idx.astype(jnp.int32), vals

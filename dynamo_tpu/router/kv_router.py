@@ -94,11 +94,15 @@ class KvRouter:
             return
         if not data:
             return
-        snap = unpack(data)
-        self.index = RadixIndex.from_snapshot(
-            {int(w): hs for w, hs in snap["workers"].items()}
-        )
-        self._event_offset = snap.get("offset", 0)
+        try:
+            snap = unpack(data)
+            self.index = RadixIndex.from_snapshot(
+                {int(w): hs for w, hs in snap["workers"].items()}
+            )
+            self._event_offset = snap.get("offset", 0)
+        except (ValueError, KeyError, TypeError) as e:
+            logger.error("corrupt kv-router snapshot ignored: %s", e)
+            return
         logger.info(
             "kv router resumed from snapshot at offset %d", self._event_offset
         )
@@ -107,9 +111,11 @@ class KvRouter:
         if self._events_seen - self._last_snapshot_at < self.snapshot_threshold:
             return
         self._last_snapshot_at = self._events_seen
-        snap = pack(
-            {"workers": self.index.snapshot(), "offset": self._event_offset}
-        )
+        snap = pack({
+            # msgpack map keys must be strings (strict_map_key on unpack)
+            "workers": {str(w): hs for w, hs in self.index.snapshot().items()},
+            "offset": self._event_offset,
+        })
         try:
             await self.runtime.control.obj_put(
                 SNAPSHOT_BUCKET, self.snapshot_name, snap
@@ -120,9 +126,15 @@ class KvRouter:
     async def _event_loop(self) -> None:
         while True:
             try:
-                entries, _last = await self.runtime.control.stream_fetch(
+                entries, _last, first_avail = await self.runtime.control.stream_fetch(
                     self.stream, after=self._event_offset, timeout_ms=1000
                 )
+                if self._event_offset and self._event_offset < first_avail - 1:
+                    # gap: events between our offset and first_avail aged
+                    # out of retention — resync from snapshot (reference
+                    # kv_cache_routing.md:160-190)
+                    await self._resync_after_gap(first_avail)
+                    continue
                 for entry in entries:
                     self._event_offset = entry["seq"]
                     self._apply_event(unpack(entry["data"]))
@@ -133,6 +145,21 @@ class KvRouter:
             except (ConnectionError, RuntimeError) as e:
                 logger.warning("kv event fetch failed: %s", e)
                 await asyncio.sleep(0.5)
+
+    async def _resync_after_gap(self, first_avail: int) -> None:
+        """Events were lost past retention: reload the latest snapshot; if
+        it is still older than the gap, drop the stale index (engines keep
+        their caches — the router conservatively under-estimates overlap
+        until fresh events rebuild it)."""
+        old_offset = self._event_offset
+        await self._load_snapshot()
+        if self._event_offset < first_avail - 1:
+            self.index = RadixIndex()
+            self._event_offset = first_avail - 1
+        logger.warning(
+            "kv event gap (offset %d < first available %d); resynced to %d",
+            old_offset, first_avail, self._event_offset,
+        )
 
     def _apply_event(self, ev: dict) -> None:
         wid = ev["worker_id"]

@@ -121,9 +121,11 @@ class ControlPlaneServer:
     """In-process control-plane server. `await start()` binds; `.port` is the
     bound port (use port=0 for ephemeral)."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 stream_retention: int = DEFAULT_STREAM_RETENTION):
         self.host = host
         self.port = port
+        self.stream_retention = stream_retention
         self._server: asyncio.Server | None = None
         # KV
         self._kv: dict[str, tuple[bytes, int]] = {}  # key -> (value, lease_id)
@@ -374,7 +376,7 @@ class ControlPlaneServer:
         name = args["stream"]
         self._stream_seq[name] += 1
         seq = self._stream_seq[name]
-        q = self._streams.setdefault(name, deque(maxlen=DEFAULT_STREAM_RETENTION))
+        q = self._streams.setdefault(name, deque(maxlen=self.stream_retention))
         q.append(_StreamEntry(seq=seq, subject=args.get("subject", ""), data=args["data"]))
         for ev in self._stream_waiters.pop(name, []):
             ev.set()
@@ -384,7 +386,7 @@ class ControlPlaneServer:
         """Fetch entries with seq > after, blocking up to timeout_ms if empty."""
         name, after = args["stream"], args.get("after", 0)
         timeout = args.get("timeout_ms", 0) / 1000.0
-        q = self._streams.setdefault(name, deque(maxlen=DEFAULT_STREAM_RETENTION))
+        q = self._streams.setdefault(name, deque(maxlen=self.stream_retention))
         entries = [e for e in q if e.seq > after]
         if not entries and timeout > 0:
             ev = asyncio.Event()
@@ -405,6 +407,11 @@ class ControlPlaneServer:
                 {"seq": e.seq, "subject": e.subject, "data": e.data} for e in entries
             ],
             "last_seq": self._stream_seq[name],
+            # oldest retained seq — a consumer whose offset is older has a
+            # GAP (events aged out of retention) and must resync from a
+            # snapshot (reference: JetStream retention + radix snapshots,
+            # kv_cache_routing.md:160-190)
+            "first_available": q[0].seq if q else self._stream_seq[name] + 1,
         }
 
     async def _op_stream_len(self, conn, args, frame):
@@ -483,8 +490,13 @@ class WatchEvent:
 
 class ControlPlaneClient:
     """Async client; one multiplexed TCP connection, request/response matched
-    by stream id. Reconnects are the caller's concern (workers crash out and
-    re-register, mirroring the reference's lease semantics)."""
+    by stream id.
+
+    Reconnects transparently: when the connection drops, in-flight calls
+    fail with ConnectionError and live watch/sub streams end (yield None);
+    the NEXT `_call` re-opens the socket, so retry loops (ModelWatcher,
+    KvRouter, Client discovery) converge instead of spinning on a dead
+    socket.  Leases survive brief outages server-side via their TTL."""
 
     def __init__(self, address: str):
         self.address = address
@@ -498,10 +510,31 @@ class ControlPlaneClient:
         self._closed = False
 
     async def connect(self) -> "ControlPlaneClient":
+        await self._ensure_connection()
+        return self
+
+    async def _ensure_connection(self) -> None:
+        """(Re)open the socket if needed. Caller must hold no assumptions
+        about stream ids across reconnects — streams end on disconnect."""
+        if self._closed:
+            raise ConnectionError("control plane client closed")
+        if self._writer is not None and not self._writer.is_closing():
+            return
+        # anything still registered belongs to the dead connection: fail
+        # pending calls and end streams NOW — the old recv task may be
+        # superseded before its own cleanup runs
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(ConnectionError("control plane connection lost"))
+        self._pending.clear()
+        streams, self._streams = self._streams, {}
+        for q in streams.values():
+            await q.put(None)
         host, port = self.address.rsplit(":", 1)
         self._reader, self._writer = await asyncio.open_connection(host, int(port))
+        if self._recv_task is not None:
+            self._recv_task.cancel()
         self._recv_task = asyncio.create_task(self._recv_loop())
-        return self
 
     async def close(self) -> None:
         self._closed = True
@@ -511,9 +544,10 @@ class ControlPlaneClient:
             self._writer.close()
 
     async def _recv_loop(self) -> None:
+        reader = self._reader
         try:
             while True:
-                frame = await read_frame(self._reader)
+                frame = await read_frame(reader)
                 sid = frame.stream_id
                 if sid in self._streams:
                     await self._streams[sid].put(frame)
@@ -527,23 +561,31 @@ class ControlPlaneClient:
                         else:
                             fut.set_result(unpack(frame.payload))
         except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
+            if reader is not self._reader:
+                return  # superseded by a reconnect; new state isn't ours
             for fut in self._pending.values():
                 if not fut.done():
                     fut.set_exception(ConnectionError("control plane connection lost"))
             self._pending.clear()
-            for q in self._streams.values():
+            # end live streams; consumers re-watch/re-subscribe (which
+            # reconnects via _ensure_connection)
+            streams, self._streams = self._streams, {}
+            for q in streams.values():
                 await q.put(None)
+            if self._writer is not None:
+                self._writer.close()
 
     async def _call(self, op: str, args: dict, stream: bool = False) -> Any:
-        sid = next(self._ids)
-        frame = Frame(K_CTRL, sid, {"op": op}, pack(args))
-        if stream:
-            q: asyncio.Queue = asyncio.Queue()
-            self._streams[sid] = q
-        else:
-            fut = asyncio.get_running_loop().create_future()
-            self._pending[sid] = fut
         async with self._send_lock:
+            await self._ensure_connection()
+            sid = next(self._ids)
+            frame = Frame(K_CTRL, sid, {"op": op}, pack(args))
+            if stream:
+                q: asyncio.Queue = asyncio.Queue()
+                self._streams[sid] = q
+            else:
+                fut = asyncio.get_running_loop().create_future()
+                self._pending[sid] = fut
             self._writer.write(frame.encode())
             await self._writer.drain()
         if stream:
@@ -602,12 +644,15 @@ class ControlPlaneClient:
 
     async def stream_fetch(
         self, stream: str, after: int, timeout_ms: int = 0, limit: int = 1000
-    ) -> tuple[list[dict], int]:
+    ) -> tuple[list[dict], int, int]:
+        """Returns (entries, last_seq, first_available).  `after <
+        first_available - 1` means entries were lost to retention — resync
+        from a snapshot before applying."""
         r = await self._call(
             "stream_fetch",
             {"stream": stream, "after": after, "timeout_ms": timeout_ms, "limit": limit},
         )
-        return r["entries"], r["last_seq"]
+        return r["entries"], r["last_seq"], r.get("first_available", 1)
 
     # -- object store ------------------------------------------------------- #
 

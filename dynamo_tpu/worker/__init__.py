@@ -32,6 +32,12 @@ class EngineWorker:
             async for out in self._control(request):
                 yield out
             return
+        if isinstance(request, dict) and "embed_token_ids" in request:
+            if not hasattr(self.engine, "embed"):
+                yield {"error": "engine does not support embeddings"}
+                return
+            yield await self.engine.embed(request, context)
+            return
         async for out in self.engine.generate(request, context):
             yield out
 
@@ -82,6 +88,8 @@ async def serve_engine(
         served.kv_publisher = kv_pub
         served.metrics_publisher = metrics_pub
     if isinstance(engine, JaxEngine):
+        if "embedding" not in mdc.types:
+            mdc.model_type = mdc.model_type + ",embedding"
         mdc.kv_cache_block_size = engine.cfg.page_size
         mdc.context_length = engine.cfg.max_model_len
         mdc.runtime_config = RuntimeConfig(

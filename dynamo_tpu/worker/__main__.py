@@ -35,6 +35,9 @@ def main() -> None:
     ap.add_argument("--platform", default="default",
                     choices=["default", "cpu"],
                     help="force the JAX backend (cpu for tests/CI)")
+    ap.add_argument("--status-port", type=int, default=0,
+                    help="system status server port (0 = ephemeral, "
+                         "-1 = disabled); serves /health /live /metrics")
     ap.add_argument("--log-level", default="info")
     args = ap.parse_args()
     logging.basicConfig(level=args.log_level.upper(),
@@ -75,15 +78,36 @@ async def _run(args) -> None:
             namespace=args.namespace, component=args.component,
             endpoint=args.endpoint,
         )
+    # per-process observability: /health probes the engine through its real
+    # request path (reference system_status_server.rs:74, health_check.rs:353)
+    status = health = None
+    if args.status_port >= 0:
+        from ..runtime.health import HealthCheckManager
+        from ..runtime.status import SystemStatusServer
+
+        health = HealthCheckManager(runtime).start()
+        status = await SystemStatusServer(
+            health_fn=lambda: _async_health(health),
+            port=args.status_port,
+        ).start()
+        print(f"STATUS http://0.0.0.0:{status.port}", flush=True)
     print(f"READY worker {mdc.name}", flush=True)
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGINT, signal.SIGTERM):
         loop.add_signal_handler(sig, stop.set)
     await stop.wait()
+    if status:
+        await status.stop()
+    if health:
+        await health.stop()
     await runtime.shutdown()
     if hasattr(engine, "shutdown"):
         await engine.shutdown()
+
+
+async def _async_health(health) -> dict:
+    return health.system_health()
 
 
 def _build_engine(args):

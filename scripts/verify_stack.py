@@ -94,7 +94,8 @@ def main():
                        "--platform", "cpu",
                        "--page-size", "8", "--num-pages", "128",
                        "--max-prefill-tokens", "64", "--max-model-len", "256"]
-        w1 = spawn(worker_args, "worker1")
+        w1_status = free_port()
+        w1 = spawn([*worker_args, "--status-port", str(w1_status)], "worker1")
         w2 = spawn(worker_args, "worker2")
         http_port = free_port()
         spawn(["-m", "dynamo_tpu.frontend", "--control", control,
@@ -136,6 +137,18 @@ def main():
             out = http_json(f"{base}/v1/chat/completions", chat)
             assert out["choices"][0]["message"]["content"] == text1
         print("OK round-robin consistency")
+
+        # worker status server: /health probes the engine through the real
+        # request path (engine wedged → 503)
+        health = http_json(f"http://127.0.0.1:{w1_status}/health")
+        assert health["status"] == "healthy", health
+        print("OK worker status server healthy")
+
+        # embeddings path end-to-end
+        emb = http_json(f"{base}/v1/embeddings",
+                        {"model": "tiny-chat", "input": ["hello", "hello"]})
+        assert len(emb["data"]) == 2 and emb["data"][0]["embedding"], emb
+        print("OK embeddings route")
 
         # kill worker1 → requests keep working on worker2
         w1.send_signal(signal.SIGKILL)

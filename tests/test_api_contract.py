@@ -1,0 +1,192 @@
+"""API-contract e2e: penalties, n>1 choices, OpenAI logprobs shapes,
+/v1/embeddings, /v1/responses, and parameter validation — through the full
+HTTP → discovery → engine stack (reference surface: openai.rs:280,434,504,
+767; preprocessor.rs:102 sampling-option mapping)."""
+
+import asyncio
+import math
+
+import aiohttp
+import pytest
+
+from tests.test_e2e_http import model_setup, start_stack, stop_stack  # noqa: F401
+
+
+async def _stack(model_setup):
+    return await start_stack(model_setup)
+
+
+async def test_api_contract_surface(model_setup):  # noqa: F811
+    stack = await _stack(model_setup)
+    base = f"http://127.0.0.1:{stack[-1].port}"
+    try:
+        async with aiohttp.ClientSession() as session:
+            await _check_penalties(session, base)
+            await _check_n_choices(session, base)
+            await _check_logprobs_chat(session, base)
+            await _check_logprobs_completions(session, base)
+            await _check_embeddings(session, base)
+            await _check_responses(session, base)
+            await _check_validation(session, base)
+    finally:
+        await stop_stack(*stack)
+
+
+async def _check_penalties(session, base):
+    body = {
+        "model": "tiny-chat",
+        "prompt": "aaaa aaaa aaaa",
+        "max_tokens": 24,
+        "temperature": 0,
+        "nvext": {"ignore_eos": True},
+    }
+    async with session.post(f"{base}/v1/completions", json=body) as r:
+        assert r.status == 200
+        plain = (await r.json())["choices"][0]["text"]
+    async with session.post(
+        f"{base}/v1/completions", json={**body, "frequency_penalty": 2.0}
+    ) as r:
+        assert r.status == 200
+        penalized = (await r.json())["choices"][0]["text"]
+    assert penalized != plain  # penalties must reach the engine
+
+
+async def _check_n_choices(session, base):
+    body = {
+        "model": "tiny-chat",
+        "messages": [{"role": "user", "content": "hello"}],
+        "max_tokens": 6,
+        "temperature": 0.9,
+        "seed": 7,
+        "n": 3,
+        "nvext": {"ignore_eos": True},
+    }
+    async with session.post(f"{base}/v1/chat/completions", json=body) as r:
+        assert r.status == 200
+        data = await r.json()
+    choices = data["choices"]
+    assert [c["index"] for c in choices] == [0, 1, 2]
+    texts = [c["message"]["content"] for c in choices]
+    assert len(set(texts)) >= 2  # seed offset → distinct choices
+    # reproducible: same request, same choices
+    async with session.post(f"{base}/v1/chat/completions", json=body) as r:
+        again = [c["message"]["content"] for c in (await r.json())["choices"]]
+    assert again == texts
+
+    # streamed n>1: chunks must carry all three indices
+    async with session.post(
+        f"{base}/v1/chat/completions", json={**body, "stream": True}
+    ) as r:
+        assert r.status == 200
+        seen = set()
+        async for line in r.content:
+            line = line.decode().strip()
+            if line.startswith("data: ") and line != "data: [DONE]":
+                import json as _json
+
+                chunk = _json.loads(line[6:])
+                for c in chunk.get("choices", []):
+                    seen.add(c["index"])
+    assert seen == {0, 1, 2}
+
+
+async def _check_logprobs_chat(session, base):
+    body = {
+        "model": "tiny-chat",
+        "messages": [{"role": "user", "content": "hi"}],
+        "max_tokens": 4,
+        "temperature": 0,
+        "logprobs": True,
+        "top_logprobs": 3,
+        "nvext": {"ignore_eos": True},
+    }
+    async with session.post(f"{base}/v1/chat/completions", json=body) as r:
+        assert r.status == 200
+        data = await r.json()
+    lp = data["choices"][0]["logprobs"]
+    assert len(lp["content"]) == 4
+    for item in lp["content"]:
+        assert isinstance(item["token"], str)
+        assert item["logprob"] <= 0.0
+        assert isinstance(item["bytes"], list)
+        assert len(item["top_logprobs"]) == 3
+        # greedy sampled token = top-1
+        assert item["top_logprobs"][0]["logprob"] >= item["logprob"] - 1e-5
+
+
+async def _check_logprobs_completions(session, base):
+    body = {
+        "model": "tiny-chat",
+        "prompt": "hello world",
+        "max_tokens": 4,
+        "temperature": 0,
+        "logprobs": 2,  # legacy int form
+        "nvext": {"ignore_eos": True},
+    }
+    async with session.post(f"{base}/v1/completions", json=body) as r:
+        assert r.status == 200
+        data = await r.json()
+    lp = data["choices"][0]["logprobs"]
+    assert len(lp["tokens"]) == 4
+    assert len(lp["token_logprobs"]) == 4
+    # top-2 per token (string keys may collide when two ids decode alike)
+    assert all(m and 1 <= len(m) <= 2 for m in lp["top_logprobs"])
+    assert lp["text_offset"][0] == 0
+
+
+async def _check_embeddings(session, base):
+    body = {"model": "tiny-chat", "input": ["hello world", "hello world",
+                                            "completely different text 123"]}
+    async with session.post(f"{base}/v1/embeddings", json=body) as r:
+        assert r.status == 200, await r.text()
+        data = await r.json()
+    assert data["object"] == "list"
+    vecs = [d["embedding"] for d in data["data"]]
+    assert [d["index"] for d in data["data"]] == [0, 1, 2]
+    assert data["usage"]["prompt_tokens"] > 0
+
+    def cos(a, b):
+        dot = sum(x * y for x, y in zip(a, b))
+        na = math.sqrt(sum(x * x for x in a))
+        nb = math.sqrt(sum(x * x for x in b))
+        return dot / (na * nb)
+
+    assert cos(vecs[0], vecs[1]) > 0.999  # identical inputs
+    assert cos(vecs[0], vecs[2]) < cos(vecs[0], vecs[1])
+
+
+async def _check_responses(session, base):
+    body = {
+        "model": "tiny-chat",
+        "input": "say something",
+        "max_output_tokens": 6,
+        "temperature": 0,
+    }
+    async with session.post(f"{base}/v1/responses", json=body) as r:
+        assert r.status == 200, await r.text()
+        data = await r.json()
+    assert data["object"] == "response"
+    assert data["status"] == "completed"
+    assert data["output"][0]["content"][0]["type"] == "output_text"
+    assert data["output_text"] == data["output"][0]["content"][0]["text"]
+    assert data["usage"]["output_tokens"] > 0
+
+
+async def _check_validation(session, base):
+    cases = [
+        {"temperature": 9.0},
+        {"top_p": 1.5},
+        {"n": 0},
+        {"n": 99},
+        {"frequency_penalty": -3.0},
+        {"top_logprobs": 50},
+    ]
+    for extra in cases:
+        body = {
+            "model": "tiny-chat",
+            "messages": [{"role": "user", "content": "x"}],
+            "max_tokens": 2,
+            **extra,
+        }
+        async with session.post(f"{base}/v1/chat/completions", json=body) as r:
+            assert r.status == 400, (extra, r.status, await r.text())

@@ -116,9 +116,9 @@ async def test_durable_stream_fetch_and_block():
         c = await ControlPlaneClient(srv.address).connect()
         assert await c.stream_append("kvev", b"one") == 1
         assert await c.stream_append("kvev", b"two") == 2
-        entries, last = await c.stream_fetch("kvev", after=0)
+        entries, last, first = await c.stream_fetch("kvev", after=0)
         assert [e["data"] for e in entries] == [b"one", b"two"] and last == 2
-        entries, _ = await c.stream_fetch("kvev", after=1)
+        entries, _, _ = await c.stream_fetch("kvev", after=1)
         assert [e["data"] for e in entries] == [b"two"]
 
         async def later():
@@ -126,7 +126,7 @@ async def test_durable_stream_fetch_and_block():
             await c.stream_append("kvev", b"three")
 
         asyncio.create_task(later())
-        entries, _ = await c.stream_fetch("kvev", after=2, timeout_ms=3000)
+        entries, _, _ = await c.stream_fetch("kvev", after=2, timeout_ms=3000)
         assert [e["data"] for e in entries] == [b"three"]
         await c.close()
 

@@ -249,3 +249,44 @@ async def test_decode_chain_stop_token_mid_chain(engine_setup):
     assert chained.pool.free_pages + chained.pool.evictable_pages == \
         chained.pool.num_pages - 1
     await chained.shutdown()
+
+
+async def test_frequency_penalty_changes_output(engine_setup):
+    """A strong frequency penalty must suppress token repetition relative
+    to the unpenalized greedy continuation (reference maps penalties into
+    engine sampling options, preprocessor.rs:102)."""
+    engine = make_engine(engine_setup)
+    base = req([2, 2, 2, 2], max_tokens=16)
+    plain, _ = await collect(engine, base)
+
+    pen = req([2, 2, 2, 2], max_tokens=16)
+    pen["sampling_options"]["frequency_penalty"] = 2.0
+    penalized, _ = await collect(engine, pen)
+
+    assert penalized != plain
+    # penalty makes repeats strictly rarer
+    def max_repeat(toks):
+        from collections import Counter
+        return max(Counter(toks).values())
+    assert max_repeat(penalized) <= max_repeat(plain)
+    await engine.shutdown()
+
+
+async def test_top_logprobs_delivered(engine_setup):
+    engine = make_engine(engine_setup)
+    r = req([1, 2, 3], max_tokens=4)
+    r["sampling_options"]["logprobs"] = True
+    r["sampling_options"]["top_logprobs"] = 3
+    seen = []
+    async for out in engine.generate(r):
+        if out["token_ids"]:
+            assert "top_logprobs" in out, out
+            tops = out["top_logprobs"][0]
+            assert len(tops) == 3
+            # ranked descending, and the greedy token leads
+            lps = [lp for _, lp in tops]
+            assert lps == sorted(lps, reverse=True)
+            assert tops[0][0] == out["token_ids"][0]  # greedy = argmax
+            seen.append(tops)
+    assert len(seen) == 4
+    await engine.shutdown()

@@ -1,0 +1,132 @@
+"""Control-plane resilience: client reconnect across server restarts and
+KV-event stream gap detection/resync (reference behavior: etcd/NATS clients
+reconnect; routers resync from JetStream snapshots when behind retention,
+kv_cache_routing.md:160-190)."""
+
+import asyncio
+
+from dynamo_tpu.router.indexer import RadixIndex
+from dynamo_tpu.router.kv_router import SNAPSHOT_BUCKET, KvRouter
+from dynamo_tpu.router.publisher import kv_stream_name
+from dynamo_tpu.runtime import ControlPlaneServer, DistributedRuntime
+from dynamo_tpu.runtime.transport.control_plane import ControlPlaneClient
+from dynamo_tpu.runtime.transport.wire import pack
+
+
+async def test_client_reconnects_after_server_restart():
+    server = await ControlPlaneServer().start()
+    port = server.port
+    client = await ControlPlaneClient(server.address).connect()
+    await client.put("k", b"v1")
+    assert await client.get("k") == b"v1"
+
+    await server.stop()
+    # server state is gone; a NEW server binds the same port
+    server2 = await ControlPlaneServer(port=port).start()
+    try:
+        # first call(s) may fail while the socket notices; client must
+        # converge without being recreated
+        for _ in range(20):
+            try:
+                await client.put("k", b"v2")
+                break
+            except (ConnectionError, OSError):
+                await asyncio.sleep(0.1)
+        assert await client.get("k") == b"v2"
+    finally:
+        await client.close()
+        await server2.stop()
+
+
+async def test_watch_ends_and_rewatch_works_after_restart():
+    server = await ControlPlaneServer().start()
+    port = server.port
+    client = await ControlPlaneClient(server.address).connect()
+    await client.put("pfx/a", b"1")
+    watch = await client.watch_prefix("pfx/")
+    it = watch.__aiter__()
+    ev = await asyncio.wait_for(it.__anext__(), 5)
+    assert (ev.type, ev.key) == ("put", "pfx/a")
+    ev = await asyncio.wait_for(it.__anext__(), 5)
+    assert ev.type == "sync"
+
+    await server.stop()
+    server2 = await ControlPlaneServer(port=port).start()
+    try:
+        # the old watch stream must END (not hang) on disconnect
+        ended = False
+        try:
+            await asyncio.wait_for(it.__anext__(), 5)
+        except StopAsyncIteration:
+            ended = True
+        assert ended
+        # a fresh watch on the same client reconnects and sees new state
+        await asyncio.sleep(0.1)
+        for _ in range(20):
+            try:
+                await client.put("pfx/b", b"2")
+                break
+            except (ConnectionError, OSError):
+                await asyncio.sleep(0.1)
+        watch2 = await client.watch_prefix("pfx/")
+        it2 = watch2.__aiter__()
+        ev = await asyncio.wait_for(it2.__anext__(), 5)
+        assert (ev.type, ev.key) == ("put", "pfx/b")
+    finally:
+        await client.close()
+        await server2.stop()
+
+
+def _stored_event(wid, h):
+    return pack({"worker_id": wid, "kind": "stored", "block_hashes": [h]})
+
+
+async def test_kv_router_resyncs_after_stream_gap():
+    """Router whose offset fell behind stream retention must resync (from
+    snapshot when present, else reset) instead of silently skipping."""
+    server = await ControlPlaneServer(stream_retention=10).start()
+    runtime = await DistributedRuntime.connect(server.address)
+    stream = kv_stream_name("ns", "comp")
+    try:
+        for h in range(1, 31):  # retention keeps seqs 21..30
+            await runtime.control.stream_append(stream, _stored_event(1, h))
+
+        # case 1: stale offset, no snapshot → reset + jump to the gap edge
+        router = KvRouter(runtime, "ns", "comp", client=None)
+        router._event_offset = 5
+        task = asyncio.get_running_loop().create_task(router._event_loop())
+        for _ in range(100):
+            if router._event_offset >= 30:
+                break
+            await asyncio.sleep(0.05)
+        task.cancel()
+        assert router._event_offset == 30
+        # only post-gap events are in the index (hashes 21..30)
+        assert router.index.find_matches(list(range(21, 31))).get(1) == 10
+        assert router.index.find_matches([5]) == {}
+
+        # case 2: snapshot at offset 25 → resume from it, then catch up
+        snap_index = RadixIndex()
+        snap_index.apply_stored(1, list(range(1, 26)))
+        await runtime.control.obj_put(
+            SNAPSHOT_BUCKET, "ns.comp",
+            pack({
+                "workers": {str(w): hs
+                            for w, hs in snap_index.snapshot().items()},
+                "offset": 25,
+            }),
+        )
+        router2 = KvRouter(runtime, "ns", "comp", client=None)
+        router2._event_offset = 3  # behind retention again
+        task2 = asyncio.get_running_loop().create_task(router2._event_loop())
+        for _ in range(100):
+            if router2._event_offset >= 30:
+                break
+            await asyncio.sleep(0.05)
+        task2.cancel()
+        assert router2._event_offset == 30
+        # snapshot blocks 1..25 plus live 26..30 all present
+        assert router2.index.find_matches(list(range(1, 31))).get(1) == 30
+    finally:
+        await runtime.shutdown(graceful=False)
+        await server.stop()
