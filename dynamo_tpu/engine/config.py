@@ -38,12 +38,22 @@ class EngineConfig:
     enable_prefix_caching: bool = True
     block_hash_salt: str = ""
 
+    # attention implementation: "auto" resolves to the Pallas streaming
+    # kernels (ops/pallas_attention.py) on single-device TPU and the XLA
+    # einsum path otherwise; "pallas"/"xla" force one
+    attention_impl: str = "auto"
+
     # model limits
     max_model_len: int = 1024
 
     table_width_buckets: Optional[Sequence[int]] = None
 
     def __post_init__(self):
+        if self.attention_impl not in ("auto", "adaptive", "pallas", "xla"):
+            raise ValueError(
+                f"attention_impl must be auto|adaptive|pallas|xla, "
+                f"got {self.attention_impl!r}"
+            )
         if self.decode_batch_buckets is None:
             self.decode_batch_buckets = _pow2_buckets(self.max_num_seqs)
         if self.chunk_buckets is None:

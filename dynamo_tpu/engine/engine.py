@@ -41,6 +41,7 @@ from ..ops import (
     sample_tokens,
     top_logprobs,
 )
+from ..ops.paged_attention import resolve_attention_impl
 from ..runtime.engine import Context
 from .config import EngineConfig, bucket_for
 from .page_pool import KvEvent, NoPagesError, PagePool
@@ -99,11 +100,13 @@ def _unpack_out(packed: np.ndarray, b: int, with_top: bool = False):
     )
 
 
-def _build_prefill_step(cfg: ModelConfig, with_top: bool = False):
+def _build_prefill_step(cfg: ModelConfig, with_top: bool = False,
+                        attn_impl: str = "xla"):
     @partial(jax.jit, donate_argnums=(1,))
     def step(params, kv, tokens, page_table, prefix_lens, chunk_lens, samp, seeds, counters):
         logits, kv = forward_prefill(
-            params, cfg, kv, tokens, page_table, prefix_lens, chunk_lens
+            params, cfg, kv, tokens, page_table, prefix_lens, chunk_lens,
+            attn_impl=attn_impl,
         )
         out = sample_tokens(logits, samp, seeds, counters)
         logp = compute_logprobs(logits, out)
@@ -132,7 +135,8 @@ def _build_import_fn():
 
 
 def _build_decode_step(cfg: ModelConfig, n_steps: int, max_valid_pos: int,
-                       penalized: bool = False, with_top: bool = False):
+                       penalized: bool = False, with_top: bool = False,
+                       attn_impl: str = "xla"):
     """Decode `n_steps` tokens per dispatch: lax.scan keeps the whole block
     on-device, so host→device latency is paid once per block, not per
     token (the TPU analog of multi-step scheduling).
@@ -155,7 +159,9 @@ def _build_decode_step(cfg: ModelConfig, n_steps: int, max_valid_pos: int,
         safe_pos = jnp.where(ok, pos, 0)
         # out-of-window rows use an all-trash table row
         table = jnp.where(ok[:, None], page_table, 0)
-        logits, kv = forward_decode(params, cfg, kv, tok, safe_pos, table)
+        logits, kv = forward_decode(
+            params, cfg, kv, tok, safe_pos, table, attn_impl=attn_impl
+        )
         if penalized:
             logits = apply_penalties(
                 logits, counts, samp.frequency_penalty, samp.presence_penalty
@@ -240,6 +246,9 @@ class JaxEngine:
                      for b in self.cfg.decode_batch_buckets}
                 ),
             )
+        self._attn_impl = resolve_attention_impl(
+            self.cfg.attention_impl, meshed=self.mesh is not None
+        )
         self.params = self._shard_params(params)
         self.kv = self._make_kv()
         self._extra_event_sinks: List[Callable[[KvEvent], None]] = []
@@ -322,7 +331,7 @@ class JaxEngine:
     def _get_prefill_step(self, with_top: bool):
         if with_top not in self._prefill_steps:
             self._prefill_steps[with_top] = _build_prefill_step(
-                self.model_cfg, with_top
+                self.model_cfg, with_top, attn_impl=self._attn_impl
             )
         return self._prefill_steps[with_top]
 
@@ -332,6 +341,7 @@ class JaxEngine:
             self._decode_steps[key] = _build_decode_step(
                 self.model_cfg, self.cfg.decode_steps, self.cfg.hard_cap,
                 penalized=penalized, with_top=with_top,
+                attn_impl=self._attn_impl,
             )
         return self._decode_steps[key]
 

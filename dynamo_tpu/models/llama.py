@@ -212,6 +212,7 @@ def _layer_prefill(
     chunk_lens: jax.Array,
     cfg: ModelConfig,
     inv_freq: jax.Array,
+    attn_impl: str = "xla",
 ):
     B, S, h = x.shape
     nh, nkv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim_
@@ -225,7 +226,8 @@ def _layer_prefill(
     k = apply_rope(k, positions, inv_freq)
 
     attn = prefill_attention(
-        q, k, v, k_pages, v_pages, page_table, prefix_lens, chunk_lens
+        q, k, v, k_pages, v_pages, page_table, prefix_lens, chunk_lens,
+        impl=attn_impl,
     )
     k_pages, v_pages = write_kv_pages(
         k_pages, v_pages, k, v, page_table, prefix_lens, chunk_lens
@@ -249,6 +251,7 @@ def _layer_decode(
     seq_lens: jax.Array,  # [B] incl. new token
     cfg: ModelConfig,
     inv_freq: jax.Array,
+    attn_impl: str = "xla",
 ):
     B, h = x.shape
     nh, nkv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim_
@@ -265,7 +268,7 @@ def _layer_decode(
     k_pages, v_pages = write_kv_pages(
         k_pages, v_pages, k, v, page_table, positions, jnp.ones_like(positions)
     )
-    attn = decode_attention(q, k_pages, v_pages, page_table, seq_lens)
+    attn = decode_attention(q, k_pages, v_pages, page_table, seq_lens, impl=attn_impl)
     attn_out = (attn.reshape(B, nh * hd) @ lp["wo"]).astype(x.dtype)
     x = x + attn_out
 
@@ -291,6 +294,7 @@ def forward_prefill(
     page_table: jax.Array,  # [B, max_pages]
     prefix_lens: jax.Array,  # [B]
     chunk_lens: jax.Array,  # [B]
+    attn_impl: str = "xla",
 ) -> Tuple[jax.Array, KVCache]:
     """Run a prefill chunk; returns logits at the last valid position [B, V]."""
     B, S = tokens.shape
@@ -303,7 +307,7 @@ def forward_prefill(
         lp, k_pages, v_pages = xs
         h, (k_pages, v_pages) = _layer_prefill(
             lp, (k_pages, v_pages), h, positions, page_table,
-            prefix_lens, chunk_lens, cfg, inv_freq,
+            prefix_lens, chunk_lens, cfg, inv_freq, attn_impl,
         )
         return h, (k_pages, v_pages)
 
@@ -362,6 +366,7 @@ def forward_decode(
     tokens: jax.Array,  # [B]
     positions: jax.Array,  # [B] — position of this token
     page_table: jax.Array,  # [B, max_pages]
+    attn_impl: str = "xla",
 ) -> Tuple[jax.Array, KVCache]:
     """One decode step for the whole batch; returns logits [B, V]."""
     inv_freq = rope_frequencies(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
@@ -372,7 +377,8 @@ def forward_decode(
         h = carry
         lp, k_pages, v_pages = xs
         h, (k_pages, v_pages) = _layer_decode(
-            lp, (k_pages, v_pages), h, positions, page_table, seq_lens, cfg, inv_freq
+            lp, (k_pages, v_pages), h, positions, page_table, seq_lens, cfg,
+            inv_freq, attn_impl,
         )
         return h, (k_pages, v_pages)
 

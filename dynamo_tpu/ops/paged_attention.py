@@ -33,6 +33,56 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
+# contexts at least this wide use the Pallas kernels under "adaptive":
+# measured on v5e (ops microbench): gather/einsum wins below ~512 tokens
+# (kernel DMA-issue overhead dominates), the streaming kernel wins above
+# (3.2x at 4k, page 16); each table-width bucket is its own jit trace, so
+# the choice is static per compiled step
+PALLAS_MIN_CTX_TOKENS = 1024
+
+
+def resolve_attention_impl(impl: str = "auto", meshed: bool = False) -> str:
+    """Pick the attention implementation.
+
+    "adaptive" — per-trace choice: the Pallas streaming kernels
+    (``ops.pallas_attention``) when the page-table bucket addresses at
+    least ``PALLAS_MIN_CTX_TOKENS``, the einsum path for short contexts.
+    Chosen on real TPU when the engine is single-device (the kernels are
+    per-shard programs; under a GSPMD mesh the einsum path lets XLA
+    partition freely).
+    "xla" — the einsum path below (and everywhere in interpret-free CPU
+    tests). Kernel/einsum equivalence is covered by
+    tests/test_pallas_attention.py in interpret mode.
+    """
+    if impl not in ("auto", "adaptive", "pallas", "xla"):
+        raise ValueError(f"unknown attention impl {impl!r}")
+    if impl != "auto":
+        if meshed and impl != "xla":
+            raise ValueError(
+                "the Pallas attention kernels are per-shard programs; a "
+                "GSPMD-meshed engine must use attention_impl='xla'"
+            )
+        return impl
+    if meshed:
+        return "xla"
+    return "adaptive" if jax.default_backend() == "tpu" else "xla"
+
+
+# prefill kernel scratch is O(S * H * hd) f32 — cap what "adaptive" sends
+# to it so VMEM (~16MB) is never oversubscribed at big prefill chunks
+_PALLAS_PREFILL_VMEM_BUDGET = 8 * 1024 * 1024
+
+
+def _adapt(impl: str, page_table: jax.Array, page_size: int,
+           chunk_vmem_bytes: int = 0) -> str:
+    if impl == "adaptive":
+        ctx = page_table.shape[1] * page_size
+        if chunk_vmem_bytes > _PALLAS_PREFILL_VMEM_BUDGET:
+            return "xla"
+        return "pallas" if ctx >= PALLAS_MIN_CTX_TOKENS else "xla"
+    return impl
+
+
 def write_kv_pages(
     k_pages: jax.Array,  # [P, page, n_kv, hd]
     v_pages: jax.Array,
@@ -112,9 +162,18 @@ def prefill_attention(
     page_table: jax.Array,  # [B, max_pages]
     prefix_lens: jax.Array,  # [B] — tokens already in cache before this chunk
     chunk_lens: jax.Array,  # [B] — valid tokens in this chunk
+    impl: str = "xla",
 ) -> jax.Array:
     """Chunk attends to cached prefix + itself (causal). Returns [B,S,H,hd]."""
     B, S, n_heads, hd = q.shape
+    impl = _adapt(impl, page_table, k_pages.shape[1],
+                  chunk_vmem_bytes=S * n_heads * hd * 4)
+    if impl == "pallas":
+        from .pallas_attention import prefill_attention_pallas
+
+        return prefill_attention_pallas(
+            q, k_new, v_new, k_pages, v_pages, page_table, prefix_lens, chunk_lens
+        )
     scale = 1.0 / jnp.sqrt(hd).astype(jnp.float32)
 
     k_pre, v_pre = gather_kv(k_pages, v_pages, page_table)  # [B, Lp, n_kv, hd]
@@ -146,8 +205,14 @@ def decode_attention(
     v_pages: jax.Array,
     page_table: jax.Array,  # [B, max_pages]
     seq_lens: jax.Array,  # [B] — context length incl. the new token
+    impl: str = "xla",
 ) -> jax.Array:
     """Single-token attention over the page table. Returns [B, n_heads, hd]."""
+    impl = _adapt(impl, page_table, k_pages.shape[1])
+    if impl == "pallas":
+        from .pallas_attention import decode_attention_pallas
+
+        return decode_attention_pallas(q, k_pages, v_pages, page_table, seq_lens)
     B, n_heads, hd = q.shape
     scale = 1.0 / jnp.sqrt(hd).astype(jnp.float32)
     k, v = gather_kv(k_pages, v_pages, page_table)  # [B, L, n_kv, hd]

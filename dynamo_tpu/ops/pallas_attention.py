@@ -1,0 +1,419 @@
+"""Pallas TPU kernels for paged attention.
+
+Why a kernel at all: the XLA path (`paged_attention.gather_kv`) materializes
+each sequence's KV into a fresh ``[B, max_pages*page, n_kv, hd]`` array in
+HBM every step — the pool is read, written, and read again (3x traffic),
+and the intermediate grows with the page-table bucket, not the true context.
+The kernels here stream KV pages HBM→VMEM exactly once per step with
+double-buffered async DMA and accumulate flash-attention style (online
+softmax), so attention traffic is the true KV footprint and nothing else.
+
+Layout notes:
+- The page pool is ``[P, page, n_kv, hd]`` (see
+  ``paged_attention.write_kv_pages``).  In-kernel we view it as
+  ``[P, page, n_kv*hd]`` — for Llama-class shapes (n_kv*hd = 512..1024)
+  the VMEM scratch tile is then exactly (16, 128) for bf16 with zero
+  padding, whereas the 4-D view would pad n_kv up to the sublane count and
+  waste half of VMEM and DMA bandwidth.
+- Prefill flattens heads onto lanes the same way (``[S, H*hd]``) and keeps
+  the online-softmax scalars as ``[S, H]`` so scratch stays tile-exact at
+  any chunk size.
+
+The reference delegates attention kernels to vLLM/TRT-LLM (SURVEY.md §2.6);
+this module is the TPU-native equivalent of their CUDA paged-attention
+kernels.
+
+Tests run these with ``interpret=True`` on CPU against the einsum path;
+the engine selects them on real TPU (``EngineConfig.attention_impl``).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _page_dmas(pt_ref, b, chunk_idx, buf, k_hbm, v_hbm, k_scr, v_scr, sems, C):
+    """The 2C async copies bringing chunk `chunk_idx`'s pages into buffer
+    `buf`. Returned (not started) so callers can .start() or .wait()."""
+    copies = []
+    for i in range(C):
+        pid = pt_ref[b, chunk_idx * C + i]
+        copies.append(
+            pltpu.make_async_copy(k_hbm.at[pid], k_scr.at[buf, i], sems.at[buf, 0, i])
+        )
+        copies.append(
+            pltpu.make_async_copy(v_hbm.at[pid], v_scr.at[buf, i], sems.at[buf, 1, i])
+        )
+    return copies
+
+
+# --------------------------------------------------------------------------- #
+# decode: one query token per sequence over its page table
+# --------------------------------------------------------------------------- #
+
+
+def _decode_kernel(
+    # scalar prefetch
+    pt_ref,  # [B, padded_pages] int32 page table
+    len_ref,  # [B] int32 sequence lengths (incl. the new token)
+    # inputs
+    q_ref,  # [1, H, hd] VMEM — this sequence's query (pre-scaled)
+    k_hbm,  # [P, page, n_kv*hd] HBM
+    v_hbm,
+    # outputs
+    o_ref,  # [1, H, hd] VMEM
+    # scratch
+    k_scr,  # [2, C, page, n_kv*hd] VMEM — double-buffered chunk
+    v_scr,
+    m_scr,  # [H, 128] f32 — running max (lane-replicated scalar per head)
+    l_scr,  # [H, 128] f32 — running denominator
+    acc_scr,  # [H, hd] f32 — running numerator
+    sems,  # DMA sems [2 buf, 2 kv, C]
+    *,
+    C: int,
+    page: int,
+    n_kv: int,
+    groups: int,
+    hd: int,
+    nc: int,
+):
+    b = pl.program_id(0)
+    c = pl.program_id(1)
+    T = C * page
+    seq_len = len_ref[b]
+    chunk_start = c * T
+
+    def dmas(chunk_idx, buf):
+        return _page_dmas(
+            pt_ref, b, chunk_idx, buf, k_hbm, v_hbm, k_scr, v_scr, sems, C
+        )
+
+    @pl.when(c == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+        for cp in dmas(0, 0):
+            cp.start()
+
+    @pl.when(chunk_start < seq_len)
+    def _():
+        buf = jax.lax.rem(c, 2)
+
+        # overlap: start the next chunk's DMAs before waiting on this one
+        @pl.when((c + 1 < nc) & ((c + 1) * T < seq_len))
+        def _():
+            for cp in dmas(c + 1, 1 - buf):
+                cp.start()
+
+        for cp in dmas(c, buf):
+            cp.wait()
+
+        q = q_ref[0]  # [H, hd]
+        k = k_scr[buf].reshape(T, n_kv * hd)
+        v = v_scr[buf].reshape(T, n_kv * hd)
+        tpos = chunk_start + jax.lax.broadcasted_iota(jnp.int32, (1, T), 1)
+        valid = tpos < seq_len  # [1, T]
+
+        for kh in range(n_kv):
+            hs = slice(kh * groups, (kh + 1) * groups)
+            ds = slice(kh * hd, (kh + 1) * hd)
+            s = jax.lax.dot_general(
+                q[hs, :], k[:, ds],
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [g, T]
+            s = jnp.where(valid, s, NEG_INF)
+            m_prev = m_scr[hs, :1]  # [g, 1]
+            l_prev = l_scr[hs, :1]
+            m_cur = jnp.max(s, axis=1, keepdims=True)
+            m_new = jnp.maximum(m_prev, m_cur)
+            corr = jnp.exp(m_prev - m_new)
+            p = jnp.exp(s - m_new)  # [g, T]
+            l_new = l_prev * corr + jnp.sum(p, axis=1, keepdims=True)
+            pv = jax.lax.dot_general(
+                p.astype(v.dtype), v[:, ds],
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [g, hd]
+            acc_scr[hs, :] = acc_scr[hs, :] * corr + pv
+            m_scr[hs, :] = jnp.broadcast_to(m_new, (groups, m_scr.shape[1]))
+            l_scr[hs, :] = jnp.broadcast_to(l_new, (groups, l_scr.shape[1]))
+
+    @pl.when(c == nc - 1)
+    def _():
+        denom = jnp.maximum(l_scr[:, :1], 1e-30)
+        o_ref[0] = (acc_scr[:] / denom).astype(o_ref.dtype)
+
+
+def decode_attention_pallas(
+    q: jax.Array,  # [B, H, hd]
+    k_pages: jax.Array,  # [P, page, n_kv, hd]
+    v_pages: jax.Array,
+    page_table: jax.Array,  # [B, max_pages] int32
+    seq_lens: jax.Array,  # [B] int32 (incl. the new token)
+    *,
+    interpret: bool = False,
+) -> jax.Array:
+    """Flash paged-attention decode step. Returns [B, H, hd]."""
+    B, H, hd = q.shape
+    P, page, n_kv, _ = k_pages.shape
+    groups = H // n_kv
+    # ~128 tokens per streamed chunk keeps the score matmul MXU-sized
+    C = max(1, 128 // page)
+    maxp = page_table.shape[1]
+    padded = -(-maxp // C) * C
+    if padded != maxp:
+        page_table = jnp.pad(page_table, ((0, 0), (0, padded - maxp)))
+    nc = padded // C
+
+    scale = 1.0 / math.sqrt(hd)
+    qs = (q.astype(jnp.float32) * scale).astype(q.dtype)
+    k_r = k_pages.reshape(P, page, n_kv * hd)
+    v_r = v_pages.reshape(P, page, n_kv * hd)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, nc),
+        in_specs=[
+            pl.BlockSpec((1, H, hd), lambda b, c, *_: (b, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, H, hd), lambda b, c, *_: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, C, page, n_kv * hd), k_pages.dtype),
+            pltpu.VMEM((2, C, page, n_kv * hd), v_pages.dtype),
+            pltpu.VMEM((H, 128), jnp.float32),
+            pltpu.VMEM((H, 128), jnp.float32),
+            pltpu.VMEM((H, hd), jnp.float32),
+            pltpu.SemaphoreType.DMA((2, 2, C)),
+        ],
+    )
+    kernel = functools.partial(
+        _decode_kernel,
+        C=C, page=page, n_kv=n_kv, groups=groups, hd=hd, nc=nc,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, hd), q.dtype),
+        interpret=interpret,
+    )(page_table, seq_lens.astype(jnp.int32), qs, k_r, v_r)
+
+
+# --------------------------------------------------------------------------- #
+# prefill: a new chunk attends to cached prefix pages + itself (causal)
+# --------------------------------------------------------------------------- #
+
+
+def _prefill_kernel(
+    # scalar prefetch
+    pt_ref,  # [B, padded_pages] int32
+    pre_ref,  # [B] int32 prefix lengths (tokens already in cache)
+    cl_ref,  # [B] int32 chunk lengths (valid tokens in the new chunk)
+    # inputs (heads flattened onto lanes)
+    q_ref,  # [1, S, H*hd] VMEM (pre-scaled)
+    kn_ref,  # [1, S, n_kv*hd] VMEM — the chunk's own K
+    vn_ref,
+    k_hbm,  # [P, page, n_kv*hd] HBM
+    v_hbm,
+    # outputs
+    o_ref,  # [1, S, H*hd]
+    # scratch
+    k_scr,  # [2, C, page, n_kv*hd]
+    v_scr,
+    m_scr,  # [S, H] f32 — running max per (query row, head)
+    l_scr,  # [S, H] f32
+    acc_scr,  # [S, H*hd] f32
+    sems,
+    *,
+    C: int,
+    page: int,
+    n_kv: int,
+    groups: int,
+    hd: int,
+    nc: int,
+    S: int,
+):
+    b = pl.program_id(0)
+    c = pl.program_id(1)
+    T = C * page
+    prefix_len = pre_ref[b]
+    chunk_len = cl_ref[b]
+    chunk_start = c * T
+
+    def dmas(chunk_idx, buf):
+        return _page_dmas(
+            pt_ref, b, chunk_idx, buf, k_hbm, v_hbm, k_scr, v_scr, sems, C
+        )
+
+    @pl.when(c == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+        @pl.when(prefix_len > 0)
+        def _():
+            for cp in dmas(0, 0):
+                cp.start()
+
+    # ---- streamed prefix pages ---- #
+    @pl.when(chunk_start < prefix_len)
+    def _():
+        buf = jax.lax.rem(c, 2)
+
+        @pl.when((c + 1 < nc) & ((c + 1) * T < prefix_len))
+        def _():
+            for cp in dmas(c + 1, 1 - buf):
+                cp.start()
+
+        for cp in dmas(c, buf):
+            cp.wait()
+
+        k = k_scr[buf].reshape(T, n_kv * hd)
+        v = v_scr[buf].reshape(T, n_kv * hd)
+        tpos = chunk_start + jax.lax.broadcasted_iota(jnp.int32, (1, T), 1)
+        valid = tpos < prefix_len  # [1, T] — same mask for every query row
+
+        for kh in range(n_kv):
+            ds = slice(kh * hd, (kh + 1) * hd)
+            for g in range(groups):
+                h = kh * groups + g
+                qh = q_ref[0, :, h * hd:(h + 1) * hd]  # [S, hd]
+                s = jax.lax.dot_general(
+                    qh, k[:, ds],
+                    (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )  # [S, T]
+                s = jnp.where(valid, s, NEG_INF)
+                m_prev = m_scr[:, h:h + 1]  # [S, 1]
+                l_prev = l_scr[:, h:h + 1]
+                m_cur = jnp.max(s, axis=1, keepdims=True)
+                m_new = jnp.maximum(m_prev, m_cur)
+                corr = jnp.exp(m_prev - m_new)
+                p = jnp.exp(s - m_new)
+                l_new = l_prev * corr + jnp.sum(p, axis=1, keepdims=True)
+                pv = jax.lax.dot_general(
+                    p.astype(v.dtype), v[:, ds],
+                    (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )  # [S, hd]
+                acc_scr[:, h * hd:(h + 1) * hd] = (
+                    acc_scr[:, h * hd:(h + 1) * hd] * corr + pv
+                )
+                m_scr[:, h:h + 1] = m_new
+                l_scr[:, h:h + 1] = l_new
+
+    # ---- the chunk itself (causal), then finalize ---- #
+    @pl.when(c == nc - 1)
+    def _():
+        i = jax.lax.broadcasted_iota(jnp.int32, (S, S), 0)
+        j = jax.lax.broadcasted_iota(jnp.int32, (S, S), 1)
+        causal = (j <= i) & (j < chunk_len)
+
+        for kh in range(n_kv):
+            kn = kn_ref[0, :, kh * hd:(kh + 1) * hd]  # [S, hd]
+            vn = vn_ref[0, :, kh * hd:(kh + 1) * hd]
+            for g in range(groups):
+                h = kh * groups + g
+                qh = q_ref[0, :, h * hd:(h + 1) * hd]
+                s = jax.lax.dot_general(
+                    qh, kn,
+                    (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )  # [S, S]
+                s = jnp.where(causal, s, NEG_INF)
+                m_prev = m_scr[:, h:h + 1]
+                l_prev = l_scr[:, h:h + 1]
+                m_cur = jnp.max(s, axis=1, keepdims=True)
+                m_new = jnp.maximum(m_prev, m_cur)
+                corr = jnp.exp(m_prev - m_new)
+                p = jnp.exp(s - m_new)
+                l_new = l_prev * corr + jnp.sum(p, axis=1, keepdims=True)
+                pv = jax.lax.dot_general(
+                    p.astype(vn.dtype), vn,
+                    (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                num = acc_scr[:, h * hd:(h + 1) * hd] * corr + pv
+                denom = jnp.maximum(l_new, 1e-30)
+                o_ref[0, :, h * hd:(h + 1) * hd] = (num / denom).astype(o_ref.dtype)
+
+
+def prefill_attention_pallas(
+    q: jax.Array,  # [B, S, H, hd]
+    k_new: jax.Array,  # [B, S, n_kv, hd]
+    v_new: jax.Array,
+    k_pages: jax.Array,  # [P, page, n_kv, hd]
+    v_pages: jax.Array,
+    page_table: jax.Array,  # [B, max_pages]
+    prefix_lens: jax.Array,  # [B]
+    chunk_lens: jax.Array,  # [B]
+    *,
+    interpret: bool = False,
+) -> jax.Array:
+    """Chunked-prefill flash attention: streamed prefix pages + causal self
+    block. Returns [B, S, H, hd]."""
+    B, S, H, hd = q.shape
+    P, page, n_kv, _ = k_pages.shape
+    groups = H // n_kv
+    C = max(1, 128 // page)
+    maxp = page_table.shape[1]
+    padded = -(-maxp // C) * C
+    if padded != maxp:
+        page_table = jnp.pad(page_table, ((0, 0), (0, padded - maxp)))
+    nc = padded // C
+
+    scale = 1.0 / math.sqrt(hd)
+    qs = (q.astype(jnp.float32) * scale).astype(q.dtype).reshape(B, S, H * hd)
+    kn = k_new.reshape(B, S, n_kv * hd)
+    vn = v_new.reshape(B, S, n_kv * hd)
+    k_r = k_pages.reshape(P, page, n_kv * hd)
+    v_r = v_pages.reshape(P, page, n_kv * hd)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(B, nc),
+        in_specs=[
+            pl.BlockSpec((1, S, H * hd), lambda b, c, *_: (b, 0, 0)),
+            pl.BlockSpec((1, S, n_kv * hd), lambda b, c, *_: (b, 0, 0)),
+            pl.BlockSpec((1, S, n_kv * hd), lambda b, c, *_: (b, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, S, H * hd), lambda b, c, *_: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, C, page, n_kv * hd), k_pages.dtype),
+            pltpu.VMEM((2, C, page, n_kv * hd), v_pages.dtype),
+            pltpu.VMEM((S, H), jnp.float32),
+            pltpu.VMEM((S, H), jnp.float32),
+            pltpu.VMEM((S, H * hd), jnp.float32),
+            pltpu.SemaphoreType.DMA((2, 2, C)),
+        ],
+    )
+    kernel = functools.partial(
+        _prefill_kernel,
+        C=C, page=page, n_kv=n_kv, groups=groups, hd=hd, nc=nc, S=S,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, S, H * hd), q.dtype),
+        interpret=interpret,
+    )(
+        page_table,
+        prefix_lens.astype(jnp.int32),
+        chunk_lens.astype(jnp.int32),
+        qs, kn, vn, k_r, v_r,
+    )
+    return out.reshape(B, S, H, hd)
