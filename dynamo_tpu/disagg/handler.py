@@ -10,6 +10,7 @@ from ..llm import ModelDeploymentCard
 from ..runtime import Client, Context, DistributedRuntime
 from ..runtime.transport.service import RemoteStreamError, ServiceUnavailable
 from .router import DisaggRouter
+from .transfer import KvTransferClient, KvTransferSource
 
 logger = logging.getLogger(__name__)
 
@@ -23,17 +24,28 @@ async def serve_prefill_worker(
     namespace: str = "dynamo",
 ):
     """Serve the engine as a prefill-only worker at {ns}.prefill.generate.
-    Publishes its card with disagg_role=prefill (frontends skip it)."""
+    Publishes its card with disagg_role=prefill (frontends skip it), starts
+    the block-ID data plane (KvTransferSource) and registers its KV layout
+    once in the control plane."""
     from ..worker import serve_engine
+
+    source = await KvTransferSource(engine).start()
+    await source.register_layout(runtime, namespace, PREFILL_COMPONENT)
 
     class PrefillFacade:
         """AsyncEngine facade: every request is a remote-prefill request."""
 
         def __init__(self, engine):
             self.engine = engine
+            self.transfer_source = source
 
         async def generate(self, request, context):
-            yield await self.engine.prefill_remote(request, context)
+            yield await self.engine.prefill_remote(
+                request, context, transfer_source=self.transfer_source
+            )
+
+        async def shutdown(self):
+            await self.transfer_source.stop()
 
         def metrics(self):
             return self.engine.metrics()
@@ -45,10 +57,12 @@ async def serve_prefill_worker(
             self.engine.add_event_sink(sink)
 
     mdc.disagg_role = "prefill"
-    return await serve_engine(
+    served = await serve_engine(
         runtime, PrefillFacade(engine), mdc,
         namespace=namespace, component=PREFILL_COMPONENT,
     )
+    served.transfer_source = source  # stopped by deregister/runtime.shutdown
+    return served
 
 
 class DisaggDecodeHandler:
@@ -74,7 +88,13 @@ class DisaggDecodeHandler:
             .endpoint("generate")
         )
         self.prefill_client: Client = ep.client()
+        self.transfer_client = KvTransferClient(engine)
         self._started = False
+        # data-plane observability (the reference's NIXL transfer metrics)
+        self._inflight_prefills = 0
+        self.kv_transfer_count = 0
+        self.kv_transfer_ms_total = 0.0
+        self.kv_transfer_bytes_total = 0
 
     async def _prefill_available(self) -> bool:
         if not self._started:
@@ -97,8 +117,9 @@ class DisaggDecodeHandler:
         prompt = request.get("token_ids") or []
         remote = self.router.should_prefill_remotely(
             len(prompt),
-            cached_prefix_len=0,
+            cached_prefix_len=self.engine.cached_prefix_len(prompt),
             prefill_workers_available=await self._prefill_available(),
+            prefill_queue_depth=self._inflight_prefills,
         )
         if not remote:
             async for out in self.engine.generate(request, context):
@@ -106,6 +127,7 @@ class DisaggDecodeHandler:
             return
         # -- remote prefill ------------------------------------------------- #
         prefill_ctx = context.child()
+        self._inflight_prefills += 1
         try:
             if self.prefill_router is not None:
                 wid = await self.prefill_router.choose(
@@ -124,15 +146,41 @@ class DisaggDecodeHandler:
                 yield out
             return
         finally:
+            self._inflight_prefills -= 1
             if self.prefill_router is not None:
                 self.prefill_router.mark_finished(prefill_ctx.id)
-        if not result or "error" in result or "kv" not in result:
+        if not result or "error" in result or (
+            "kv" not in result and "kv_descriptor" not in result
+        ):
             logger.warning("remote prefill rejected (%s); local fallback",
                            (result or {}).get("error"))
             async for out in self.engine.generate(request, context):
                 yield out
             return
         first_token = result["token_ids"][0]
+        if "kv_descriptor" in result:
+            # block-ID data plane: fetch pages, then adopt them
+            try:
+                pages, stats = await self.transfer_client.fetch(
+                    result["kv_descriptor"]
+                )
+            except Exception as e:  # noqa: BLE001 — any failure → local
+                logger.warning("kv transfer failed (%s); prefilling locally", e)
+                async for out in self.engine.generate(request, context):
+                    yield out
+                return
+            self.kv_transfer_count += 1
+            self.kv_transfer_ms_total += stats.ms
+            self.kv_transfer_bytes_total += stats.bytes
+            logger.debug(
+                "kv transfer %d pages -> %d pages, %.1fKB in %.1fms",
+                stats.src_pages, stats.dest_pages, stats.bytes / 1024, stats.ms,
+            )
+            async for out in self.engine.generate_imported(
+                request, first_token, pages, context
+            ):
+                yield out
+            return
         import_failed = False
         async for out in self.engine.generate_with_kv(
             request, first_token, result["kv"], context
@@ -158,7 +206,11 @@ class DisaggDecodeHandler:
             yield {"status": "error", "error": f"unknown control op {op}"}
 
     def metrics(self):
-        return self.engine.metrics()
+        m = self.engine.metrics()
+        m.kv_transfer_count = self.kv_transfer_count
+        m.kv_transfer_ms_total = round(self.kv_transfer_ms_total, 3)
+        m.kv_transfer_bytes_total = self.kv_transfer_bytes_total
+        return m
 
     def clear_kv_blocks(self):
         return self.engine.clear_kv_blocks()

@@ -43,6 +43,7 @@ from ..ops import (
 )
 from ..ops.paged_attention import resolve_attention_impl
 from ..runtime.engine import Context
+from ..tokens import compute_block_hash_for_seq
 from .config import EngineConfig, bucket_for
 from .page_pool import KvEvent, NoPagesError, PagePool
 from .scheduler import PrefillItem, SamplingOptions, Scheduler, Sequence, StepPlan
@@ -759,11 +760,78 @@ class JaxEngine:
         except Exception:  # noqa: BLE001
             logger.exception("failed to release held pages")
 
+    # -- data-plane helpers (block-ID KV transfer, disagg/transfer.py) ------ #
+
+    @staticmethod
+    def _pow2_width(n: int) -> int:
+        return 1 << max(0, n - 1).bit_length()
+
+    async def export_pages(self, pages: List[int]):
+        """Copy the given pages device->host: ([L,n,page,kv,hd], same) —
+        one jit variant per pow2 width."""
+        def op():
+            width = self._pow2_width(len(pages))
+            padded = np.zeros((width,), np.int32)
+            padded[: len(pages)] = pages
+            k, v = self._export_fn(self.kv, jnp.asarray(padded))
+            return (
+                np.asarray(jax.device_get(k))[:, : len(pages)],
+                np.asarray(jax.device_get(v))[:, : len(pages)],
+            )
+
+        return await self._device_op(op)
+
+    async def alloc_pages(self, n: int) -> List[int]:
+        def op():
+            return self.pool.allocate(n)
+
+        return await self._device_op(op)
+
+    async def free_pages(self, pages: List[int]) -> None:
+        def op():
+            self.pool.free(pages)
+
+        await self._device_op(op)
+
+    async def import_page_chunk(self, pages: List[int], k_chunk, v_chunk) -> None:
+        """Write host KV pages into the pool at the given page ids (padding
+        rows go to trash page 0)."""
+        def op():
+            n = len(pages)
+            width = self._pow2_width(n)
+            padded = np.zeros((width,), np.int32)
+            padded[:n] = pages
+            kpad = np.zeros((k_chunk.shape[0], width, *k_chunk.shape[2:]),
+                            k_chunk.dtype)
+            vpad = np.zeros_like(kpad)
+            kpad[:, :n] = k_chunk
+            vpad[:, :n] = v_chunk
+            self.kv = self._import_fn(
+                self.kv, jnp.asarray(kpad), jnp.asarray(vpad), jnp.asarray(padded)
+            )
+
+        await self._device_op(op)
+
+    def cached_prefix_len(self, prompt: List[int]) -> int:
+        """Tokens of this prompt already in the device prefix cache (no
+        references taken) — feeds the disagg-router decision."""
+        if not self.cfg.enable_prefix_caching or not prompt:
+            return 0
+        ps = self.cfg.page_size
+        hashes = compute_block_hash_for_seq(prompt, ps, self.cfg.block_hash_salt)
+        if len(prompt) % ps == 0 and hashes:
+            hashes = hashes[:-1]
+        return self.pool.peek(hashes) * ps
+
     async def prefill_remote(self, request: Dict[str, Any],
-                             context: Optional[Context] = None) -> Dict[str, Any]:
-        """Prefill-only: compute the prompt, sample the first token, export
-        the KV pages.  The prefill-worker side of disaggregation (the
-        reference's remote-prefill handler,
+                             context: Optional[Context] = None,
+                             transfer_source=None) -> Dict[str, Any]:
+        """Prefill-only: compute the prompt, sample the first token, hand
+        the KV pages over.  With `transfer_source` (disagg/transfer.py
+        KvTransferSource) the response carries only a block-ID transfer
+        descriptor — the data plane moves the pages.  Without it, the KV
+        rides inline (legacy/fallback).  The prefill-worker side of
+        disaggregation (the reference's remote-prefill handler,
         /root/reference/components/src/dynamo/vllm/handlers.py:236)."""
         request = dict(request)
         request["stop_conditions"] = {
@@ -783,6 +851,13 @@ class JaxEngine:
         if seq is None or first_token is None:
             await self._release_held(seq)
             return {"error": "prefill produced no token"}
+        if transfer_source is not None:
+            pages, seq.pages = list(seq.pages), []
+            tid = transfer_source.register(pages, seq.prompt_len)
+            return {
+                "token_ids": [first_token],
+                "kv_descriptor": transfer_source.descriptor(tid),
+            }
         pages = list(seq.pages)
         width = bucket_for(max(len(pages), 1), self.cfg.table_width_buckets)
         padded = np.zeros((width,), np.int32)
@@ -814,22 +889,21 @@ class JaxEngine:
         self, request: Dict[str, Any], first_token: int, kv_blob: Dict[str, Any],
         context: Optional[Context] = None,
     ) -> AsyncIterator[Dict[str, Any]]:
-        """Decode-side: inject remotely-prefilled KV pages and continue
-        decoding (the reference decode handler's post-remote-prefill path,
-        handlers.py:221-231)."""
+        """Decode-side, inline-blob fallback: import a full KV blob then
+        continue decoding. The block-ID path is `generate_imported` fed by
+        disagg/transfer.py's KvTransferClient."""
         context = context or Context()
         self._ensure_pump()
-        opts = _opts_from_request(request)
         prompt = list(request["token_ids"])
         shape = kv_blob["shape"]
         dtype = np.dtype(kv_blob["dtype"])
         k = np.frombuffer(kv_blob["k"], dtype).reshape(shape)
         v = np.frombuffer(kv_blob["v"], dtype).reshape(shape)
         if kv_blob["page_size"] != self.cfg.page_size:
-            raise ValueError(
-                f"page_size mismatch: remote {kv_blob['page_size']} vs "
-                f"local {self.cfg.page_size} (layout transpose TBD)"
-            )
+            yield {"token_ids": [], "finish_reason": "error",
+                   "error": "kv import rejected: page_size mismatch on the "
+                            "inline path (use the transfer service)"}
+            return
         n_pages = shape[1]
         width = bucket_for(max(n_pages, 1), self.cfg.table_width_buckets)
 
@@ -855,6 +929,23 @@ class JaxEngine:
             yield {"token_ids": [], "finish_reason": "error",
                    "error": f"kv import rejected: {e}"}
             return
+        async for out in self.generate_imported(
+            request, first_token, pages, context
+        ):
+            yield out
+
+    async def generate_imported(
+        self, request: Dict[str, Any], first_token: int, pages: List[int],
+        context: Optional[Context] = None,
+    ) -> AsyncIterator[Dict[str, Any]]:
+        """Adopt pages already written into the pool (by the transfer
+        service or the blob path) as a decoded-elsewhere prompt and stream
+        the continuation (the reference decode handler's
+        post-remote-prefill path, handlers.py:221-231)."""
+        context = context or Context()
+        self._ensure_pump()
+        opts = _opts_from_request(request)
+        prompt = list(request["token_ids"])
         seq = Sequence(context.id, prompt, opts)
         seq.seed = opts.seed if opts.seed is not None else self._py_rng.getrandbits(31)
         seq.pages = pages

@@ -125,6 +125,16 @@ class PagePool:
             out.append(page)
         return out
 
+    def peek(self, block_hashes: Sequence[int]) -> int:
+        """Length of the leading cached run WITHOUT taking references
+        (disagg-router costing: `cached_prefix_len`)."""
+        n = 0
+        for h in block_hashes:
+            if h not in self._cached:
+                break
+            n += 1
+        return n
+
     def commit(self, page: int, block_hash: int, parent_hash: Optional[int]) -> int:
         """Register a now-full page under its chain hash.
 

@@ -68,9 +68,11 @@ def resolve_attention_impl(impl: str = "auto", meshed: bool = False) -> str:
     return "adaptive" if jax.default_backend() == "tpu" else "xla"
 
 
-# prefill kernel scratch is O(S * H * hd) f32 — cap what "adaptive" sends
-# to it so VMEM (~16MB) is never oversubscribed at big prefill chunks
-_PALLAS_PREFILL_VMEM_BUDGET = 8 * 1024 * 1024
+# cap what "adaptive" sends to the prefill kernel so VMEM (~16MB) is never
+# oversubscribed at big chunk sizes; the estimate below counts every VMEM
+# resident: q/o blocks, the chunk's own kn/vn, f32 accumulator + m/l
+# scalars, and the double-buffered KV page scratch
+_PALLAS_PREFILL_VMEM_BUDGET = 12 * 1024 * 1024
 
 
 def _adapt(impl: str, page_table: jax.Array, page_size: int,
@@ -166,8 +168,16 @@ def prefill_attention(
 ) -> jax.Array:
     """Chunk attends to cached prefix + itself (causal). Returns [B,S,H,hd]."""
     B, S, n_heads, hd = q.shape
-    impl = _adapt(impl, page_table, k_pages.shape[1],
-                  chunk_vmem_bytes=S * n_heads * hd * 4)
+    n_kv, page = k_pages.shape[2], k_pages.shape[1]
+    esize = jnp.dtype(q.dtype).itemsize
+    vmem = (
+        2 * S * n_heads * hd * esize        # q + o blocks
+        + 2 * S * n_kv * hd * esize         # kn + vn blocks
+        + S * n_heads * hd * 4              # f32 accumulator
+        + 2 * S * n_heads * 4               # m + l
+        + 4 * max(1, 128 // page) * page * n_kv * hd * esize  # 2x2 KV bufs
+    )
+    impl = _adapt(impl, page_table, page, chunk_vmem_bytes=vmem)
     if impl == "pallas":
         from .pallas_attention import prefill_attention_pallas
 

@@ -153,10 +153,10 @@ class ServedEndpoint:
 
     async def deregister(self) -> None:
         """Remove from discovery (stop receiving new requests)."""
-        # stop any attached publishers (kv events / metrics) first
-        for attr in ("kv_publisher", "metrics_publisher"):
-            pub = getattr(self, attr, None)
-            if pub is not None:
-                await pub.stop()
+        # stop any attached publishers / data-plane servers first
+        for attr in ("kv_publisher", "metrics_publisher", "transfer_source"):
+            svc = getattr(self, attr, None)
+            if svc is not None:
+                await svc.stop()
         await self.endpoint.runtime.control.delete(self.instance.path)
         self.endpoint.runtime.service_server.unregister(self.endpoint.wire_name)

@@ -19,11 +19,13 @@ class SystemStatusServer:
         self,
         metrics: MetricsScope | None = None,
         health_fn: Callable[[], Awaitable[dict]] | None = None,
+        stats_fn: Callable[[], dict] | None = None,
         host: str = "0.0.0.0",
         port: int = 0,
     ):
         self.metrics = metrics
         self.health_fn = health_fn
+        self.stats_fn = stats_fn
         self.host = host
         self.port = port
         self._runner: web.AppRunner | None = None
@@ -33,6 +35,7 @@ class SystemStatusServer:
         app.router.add_get("/health", self._health)
         app.router.add_get("/live", self._live)
         app.router.add_get("/metrics", self._metrics)
+        app.router.add_get("/metrics.json", self._metrics_json)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)
@@ -61,3 +64,11 @@ class SystemStatusServer:
     async def _metrics(self, request: web.Request) -> web.Response:
         data = self.metrics.render() if self.metrics else b""
         return web.Response(body=data, content_type="text/plain")
+
+    async def _metrics_json(self, request: web.Request) -> web.Response:
+        """Component stats as JSON (engine ForwardPassMetrics incl. KV
+        transfer counters on disagg decode workers)."""
+        body = self.stats_fn() if self.stats_fn else {}
+        return web.Response(
+            text=json.dumps(body), content_type="application/json"
+        )

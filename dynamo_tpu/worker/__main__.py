@@ -86,8 +86,17 @@ async def _run(args) -> None:
         from ..runtime.status import SystemStatusServer
 
         health = HealthCheckManager(runtime).start()
+
+        def _stats():
+            try:
+                return {k: v for k, v in vars(engine.metrics()).items()
+                        if isinstance(v, (int, float, str))}
+            except Exception:  # noqa: BLE001
+                return {}
+
         status = await SystemStatusServer(
             health_fn=lambda: _async_health(health),
+            stats_fn=_stats,
             port=args.status_port,
         ).start()
         print(f"STATUS http://0.0.0.0:{status.port}", flush=True)

@@ -150,6 +150,48 @@ def main():
         assert len(emb["data"]) == 2 and emb["data"][0]["embedding"], emb
         print("OK embeddings route")
 
+        # disaggregated pair with MISMATCHED page sizes: prefill (page 8)
+        # streams KV by block id over the data plane, decode (page 16)
+        # re-pages it; long prompt forces the remote-prefill path
+        spawn([*worker_args, "--disagg-role", "prefill"], "prefill-worker")
+        dw_status = free_port()
+        spawn(["-m", "dynamo_tpu.worker", "--control", control,
+               "--model", "tiny", "--dtype", "float32", "--platform", "cpu",
+               "--page-size", "16", "--num-pages", "128",
+               "--max-prefill-tokens", "64", "--max-model-len", "256",
+               "--disagg-role", "decode", "--status-port", str(dw_status)],
+              "decode-worker")
+        long_chat = {
+            "model": "tiny-chat",
+            "messages": [{"role": "user", "content": "count " * 30}],
+            "max_tokens": 8, "temperature": 0,
+            "nvext": {"ignore_eos": True},
+        }
+        deadline = time.time() + 30
+        while True:  # decode worker may still be registering
+            out = http_json(f"{base}/v1/chat/completions", long_chat)
+            if out.get("choices"):
+                break
+            assert time.time() < deadline, out
+            time.sleep(0.5)
+        long_text = out["choices"][0]["message"]["content"]
+        assert out["usage"]["completion_tokens"] == 8, out
+        # the transfer must actually have ridden the data plane: the decode
+        # worker's status server reports engine metrics incl. transfer count
+        for i in range(20):
+            m = http_json(f"http://127.0.0.1:{dw_status}/metrics.json")
+            if m.get("kv_transfer_count", 0) >= 1:
+                break
+            # vary the prompt: an identical one served locally once would be
+            # prefix-cached and routed locally forever after
+            varied = {**long_chat, "messages": [{
+                "role": "user", "content": f"retry {i} " + "count " * 30}]}
+            http_json(f"{base}/v1/chat/completions", varied)
+            time.sleep(0.3)
+        assert m.get("kv_transfer_count", 0) >= 1, m
+        print(f"OK disagg transfer: {m['kv_transfer_count']} transfers, "
+              f"{m['kv_transfer_ms_total']}ms total")
+
         # kill worker1 → requests keep working on worker2
         w1.send_signal(signal.SIGKILL)
         time.sleep(7)  # > lease TTL
