@@ -29,7 +29,11 @@ async def serve_prefill_worker(
     once in the control plane."""
     from ..worker import serve_engine
 
-    source = await KvTransferSource(engine).start()
+    # advertise the same host the runtime advertises for its endpoints —
+    # a loopback default would break cross-host disaggregation
+    source = await KvTransferSource(
+        engine, host=runtime._advertise_host  # noqa: SLF001
+    ).start()
     await source.register_layout(runtime, namespace, PREFILL_COMPONENT)
 
     class PrefillFacade:

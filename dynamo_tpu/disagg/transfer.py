@@ -287,10 +287,19 @@ class KvTransferClient:
         n_dest = -(-prompt_len // dst.page_size)
         dest_pages = await self.engine.alloc_pages(n_dest)
         stats = TransferStats(dest_pages=n_dest)
+        pending_box: List[Optional[asyncio.Task]] = [None]
         try:
             await self._fetch_into(descriptor, src, dst, prompt_len,
-                                   dest_pages, stats)
+                                   dest_pages, stats, pending_box)
         except BaseException:
+            # settle any in-flight import BEFORE freeing: its device op
+            # must not land after the pages are reallocated to someone else
+            task = pending_box[0]
+            if task is not None:
+                try:
+                    await task
+                except Exception:  # noqa: BLE001 — original error wins
+                    pass
             await self.engine.free_pages(dest_pages)
             await self._release_remote(descriptor)
             raise
@@ -318,7 +327,8 @@ class KvTransferClient:
 
     async def _fetch_into(self, descriptor, src: KvLayout, dst: KvLayout,
                           prompt_len: int, dest_pages: List[int],
-                          stats: TransferStats) -> None:
+                          stats: TransferStats,
+                          pending_box: List[Optional[asyncio.Task]]) -> None:
         host, port = descriptor["addr"]
         reader, writer = await asyncio.open_connection(host, port)
         sdtype = np.dtype(src.dtype)
@@ -333,13 +343,12 @@ class KvTransferClient:
 
             stage = _TokenStager(L, kvh, hd, ddtype)
             next_dest = 0  # index into dest_pages
-            pending: Optional[asyncio.Task] = None
 
             async def flush(final: bool) -> None:
                 """Cut whole destination pages off the stage and import
                 them; pipeline depth 1 so the import of chunk k overlaps
                 reading chunk k+1 off the wire."""
-                nonlocal next_dest, pending
+                nonlocal next_dest
                 n_whole = stage.tokens // dst.page_size
                 if final and stage.tokens % dst.page_size:
                     stage.pad_to(n_whole * dst.page_size + dst.page_size)
@@ -353,9 +362,9 @@ class KvTransferClient:
                 if len(ids) != n_whole:
                     raise RuntimeError("transfer longer than prompt_len")
                 next_dest += n_whole
-                if pending is not None:
-                    await pending
-                pending = asyncio.ensure_future(
+                if pending_box[0] is not None:
+                    await pending_box[0]
+                pending_box[0] = asyncio.ensure_future(
                     self.engine.import_page_chunk(ids, k_chunk, v_chunk)
                 )
 
@@ -383,8 +392,9 @@ class KvTransferClient:
 
             stage.truncate_total(prompt_len)
             await flush(final=True)
-            if pending is not None:
-                await pending
+            if pending_box[0] is not None:
+                await pending_box[0]
+                pending_box[0] = None
             if next_dest != len(dest_pages):
                 raise RuntimeError(
                     f"transfer filled {next_dest}/{len(dest_pages)} pages"
@@ -411,14 +421,12 @@ class _TokenStager:
         self._k: List[np.ndarray] = []
         self._v: List[np.ndarray] = []
         self.tokens = 0  # tokens currently staged
-        self.seen = 0  # tokens ever pushed (pre-truncation)
         self.popped = 0
 
     def push(self, k: np.ndarray, v: np.ndarray) -> None:
         self._k.append(k)
         self._v.append(v)
         self.tokens += k.shape[1]
-        self.seen += k.shape[1]
 
     def truncate_total(self, limit: int) -> None:
         """Drop staged tokens beyond stream position `limit`."""
@@ -432,7 +440,6 @@ class _TokenStager:
                 self._k[-1] = self._k[-1][:, :tail - cut]
                 self._v[-1] = self._v[-1][:, :tail - cut]
             self.tokens -= cut
-            self.seen -= cut
             excess -= cut
 
     def pad_to(self, n: int) -> None:

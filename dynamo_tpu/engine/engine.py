@@ -268,12 +268,9 @@ class JaxEngine:
         # device ops queued by the loop thread, executed by the pump between
         # steps (self.kv is only ever touched between steps)
         self._pending_ops: List = []
-        self.tiered = tiered
+        self.tiered = None
         if tiered is not None:
-            self.add_event_sink(tiered.on_event)
-            # onboarding runs inside admission (pump loop thread, between
-            # steps) — blocking device work, small and batched
-            self.scheduler.onboard_fn = lambda hashes: tiered.onboard(self, hashes)
+            self.attach_connector(tiered)
         import random as _random
 
         self._py_rng = _random.Random(0xD1A)
@@ -284,12 +281,70 @@ class JaxEngine:
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._pump_task: Optional[asyncio.Task] = None
         self._closed = False
-        # aborts are deferred to the pump loop so all scheduler/pool
-        # mutation happens strictly between device steps (the executor
-        # thread and the event loop never touch them concurrently)
+        # adds/aborts are deferred to the pump loop so ALL scheduler/pool
+        # mutation happens strictly between device steps, on the pump's
+        # executor thread (admission may touch disk/remote KV tiers, so
+        # planning runs off the event loop — see _plan_step)
         self._pending_aborts: set[str] = set()
+        self._pending_adds: List = []  # ("add"|"imported", Sequence)
         self._requests_total = 0
         self._step_count = 0
+
+    def attach_connector(self, connector) -> None:
+        """Attach a KVBM connector (kvbm.KvConnector shape: on_event /
+        pump_offloads / onboard).  The engine pumps its offload queue and
+        routes admission-time cache misses through it — the engine-facing
+        equivalent of the reference's KVConnector protocol
+        (block_manager/connector/protocol.rs)."""
+        self.tiered = connector
+        self.add_event_sink(connector.on_event)
+        # onboarding runs inside admission (pump loop thread, between
+        # steps) — blocking device work, small and batched
+        self.scheduler.onboard_fn = lambda hashes: connector.onboard(self, hashes)
+
+    def export_cached_blocks(self, hashes):
+        """SYNC device->host export of committed blocks (pump/executor
+        thread only — never concurrent with a step).  Returns
+        (resolved_hashes, k, v) with k/v shaped [L, n, page, kv, hd];
+        hashes no longer cached are skipped."""
+        resolved, pages = [], []
+        for h in hashes:
+            page = self.pool.cached_page(h)
+            if page is not None:
+                resolved.append(h)
+                pages.append(page)
+        if not pages:
+            return [], None, None
+        width = self._pow2_width(len(pages))
+        padded = np.zeros((width,), np.int32)
+        padded[: len(pages)] = pages
+        k, v = self._export_fn(self.kv, jnp.asarray(padded))
+        k = np.asarray(jax.device_get(k))[:, : len(pages)]
+        v = np.asarray(jax.device_get(v))[:, : len(pages)]
+        return resolved, k, v
+
+    def import_committed_blocks(self, blocks) -> List[int]:
+        """SYNC import of (hash, parent_hash, k, v) blocks into freshly
+        allocated pages, committed to the prefix cache (pump/executor
+        thread only).  Returns the page ids."""
+        if not blocks:
+            return []
+        pages = self.pool.allocate(len(blocks))
+        width = self._pow2_width(len(pages))
+        padded = np.zeros((width,), np.int32)
+        padded[: len(pages)] = pages
+        k0 = blocks[0][2]
+        kpad = np.zeros((k0.shape[0], width, *k0.shape[1:]), k0.dtype)
+        vpad = np.zeros_like(kpad)
+        for i, (_, _, k, v) in enumerate(blocks):
+            kpad[:, i] = k
+            vpad[:, i] = v
+        self.kv = self._import_fn(
+            self.kv, jnp.asarray(kpad), jnp.asarray(vpad), jnp.asarray(padded)
+        )
+        for (h, parent, _, _), page in zip(blocks, pages):
+            self.pool.commit(page, h, parent)
+        return pages
 
     # -- sharding helpers ---------------------------------------------------- #
 
@@ -413,7 +468,7 @@ class JaxEngine:
         self._contexts[context.id] = context
         self._seq_by_rid[context.id] = seq
         self._requests_total += 1
-        self.scheduler.add(seq)
+        self._pending_adds.append(("add", seq))
         self._wake.set()
         killed = asyncio.create_task(context.killed())
         finished = False
@@ -460,13 +515,38 @@ class JaxEngine:
         if self._pump_task:
             await asyncio.gather(self._pump_task, return_exceptions=True)
 
+    def _plan_step(self) -> StepPlan:
+        """Apply deferred scheduler mutations and plan the next step.
+
+        Runs on the pump's loop thread between device steps; deferring
+        adds/aborts here keeps every scheduler/pool mutation in one place.
+        Admission may touch the disk/remote KV tiers — those are bounded
+        by short tier timeouts rather than moved off-loop (planning on an
+        executor thread turned out to intermittently wedge XLA:CPU
+        compilation issued from rotating worker threads)."""
+        # adds strictly before aborts: an abort for a still-queued add must
+        # see the sequence in the scheduler or it becomes a silent no-op
+        # and the orphan decodes to max_tokens with no consumer
+        while self._pending_adds:
+            kind, seq = self._pending_adds.pop(0)
+            if kind == "imported":
+                self.scheduler.add_imported(seq)
+            else:
+                self.scheduler.add(seq)
+        while self._pending_aborts:
+            self.scheduler.abort(self._pending_aborts.pop())
+        # honor graceful stop requests before planning
+        for rid, ctx in list(self._contexts.items()):
+            if ctx.is_stopped() and not ctx.is_killed():
+                for seq in list(self.scheduler.running):
+                    if seq.request_id == rid and seq.output_tokens:
+                        self.scheduler.finish(seq, "cancelled")
+                        self._deliver(seq, [], "cancelled")
+        return self.scheduler.schedule()
+
     async def _pump(self) -> None:
         loop = asyncio.get_running_loop()
         while not self._closed:
-            # apply deferred aborts (the only place scheduler state is
-            # mutated for cancellation — never concurrent with a step)
-            while self._pending_aborts:
-                self.scheduler.abort(self._pending_aborts.pop())
             # drain offload queue (device→host copies, KVBM)
             if self.tiered is not None and self.tiered.pending_offloads:
                 try:
@@ -485,18 +565,12 @@ class JaxEngine:
                 except Exception as e:  # noqa: BLE001
                     if not fut.done():
                         fut.set_exception(e)
-            # honor graceful stop requests before planning
-            for rid, ctx in list(self._contexts.items()):
-                if ctx.is_stopped() and not ctx.is_killed():
-                    for seq in list(self.scheduler.running):
-                        if seq.request_id == rid and seq.output_tokens:
-                            self.scheduler.finish(seq, "cancelled")
-                            self._deliver(seq, [], "cancelled")
-            plan = self.scheduler.schedule()
+            plan = self._plan_step()
             for seq in self.scheduler.drain_errored():
                 self._deliver(seq, [], "error")
             if plan.kind == "idle":
-                if not self.scheduler.has_work:
+                if not (self.scheduler.has_work or self._pending_adds
+                        or self._pending_aborts):
                     self._wake.clear()
                     await self._wake.wait()
                 else:
@@ -964,7 +1038,7 @@ class JaxEngine:
             self._queues.pop(context.id, None)
             self._contexts.pop(context.id, None)
             return
-        self.scheduler.add_imported(seq)
+        self._pending_adds.append(("imported", seq))
         self._wake.set()
         killed = asyncio.create_task(context.killed())
         finished = False

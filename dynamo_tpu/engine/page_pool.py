@@ -125,6 +125,11 @@ class PagePool:
             out.append(page)
         return out
 
+    def cached_page(self, block_hash: int) -> Optional[int]:
+        """Page currently committed under this hash, or None — no reference
+        taken (KVBM offload resolves hashes to live pages through this)."""
+        return self._cached.get(block_hash)
+
     def peek(self, block_hashes: Sequence[int]) -> int:
         """Length of the leading cached run WITHOUT taking references
         (disagg-router costing: `cached_prefix_len`)."""

@@ -1,7 +1,20 @@
-"""KVBM — multi-tier KV block management (device HBM → host DRAM → disk)."""
+"""KVBM — multi-tier KV block management (device HBM → host DRAM → disk →
+remote object store), with a leader/worker bootstrap for multi-process
+deployments sharing the lower tiers."""
 
 from .disk import DiskTier
+from .distributed import KvbmConfig, KvbmLeader, KvbmWorker
 from .host_pool import HostBlock, HostBlockPool
 from .offload import TieredKvCache
+from .remote import ObjectStoreTier
 
-__all__ = ["DiskTier", "HostBlock", "HostBlockPool", "TieredKvCache"]
+__all__ = [
+    "DiskTier",
+    "HostBlock",
+    "HostBlockPool",
+    "KvbmConfig",
+    "KvbmLeader",
+    "KvbmWorker",
+    "ObjectStoreTier",
+    "TieredKvCache",
+]

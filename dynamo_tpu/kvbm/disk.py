@@ -60,9 +60,21 @@ class DiskTier:
                 except OSError:
                     pass
 
+    def _discover(self, block_hash: int) -> bool:
+        """Index miss → check the filesystem: the tier directory is SHARED
+        across workers (distributed KVBM), so another process may have
+        written the block after our directory scan. Caller holds the lock."""
+        try:
+            sz = os.path.getsize(self._path(block_hash))
+        except OSError:
+            return False
+        self._index[block_hash] = sz
+        self._bytes += sz
+        return True
+
     def get(self, block_hash: int) -> Optional[Tuple[np.ndarray, np.ndarray]]:
         with self._lock:
-            if block_hash not in self._index:
+            if block_hash not in self._index and not self._discover(block_hash):
                 self.misses += 1
                 return None
             self._index.move_to_end(block_hash)
@@ -79,7 +91,7 @@ class DiskTier:
 
     def __contains__(self, block_hash: int) -> bool:
         with self._lock:
-            return block_hash in self._index
+            return block_hash in self._index or self._discover(block_hash)
 
     def __len__(self) -> int:
         return len(self._index)

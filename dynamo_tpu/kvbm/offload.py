@@ -23,8 +23,6 @@ import logging
 import threading
 from typing import List, Optional, Sequence, Tuple
 
-import numpy as np
-
 from .disk import DiskTier
 from .host_pool import HostBlock, HostBlockPool
 
@@ -33,21 +31,25 @@ logger = logging.getLogger(__name__)
 
 class TieredKvCache:
     def __init__(self, host: HostBlockPool, disk: Optional[DiskTier] = None,
-                 max_offload_batch: int = 16):
+                 remote=None, max_offload_batch: int = 16):
         self.host = host
         self.disk = disk
+        self.remote = remote  # G4: kvbm.remote.ObjectStoreTier (shared)
         self.max_offload_batch = max_offload_batch
         self._pending: List[Tuple[int, Optional[int]]] = []  # (hash, parent)
         self._lock = threading.Lock()
         self.onboarded_blocks = 0
-        if disk is not None:
+        if disk is not None or remote is not None:
             host.on_evict = self._demote
 
     def _demote(self, blk: HostBlock) -> None:
+        """Write-back demotion: host-evicted blocks land on disk (G3) when
+        present, else the remote tier (G4)."""
+        tier = self.disk if self.disk is not None else self.remote
         try:
-            self.disk.put(blk.block_hash, blk.parent_hash, blk.k, blk.v)
+            tier.put(blk.block_hash, blk.parent_hash, blk.k, blk.v)
         except OSError as e:
-            logger.warning("disk demotion failed: %s", e)
+            logger.warning("tier demotion failed: %s", e)
 
     # -- engine event sink (any thread) -------------------------------------- #
 
@@ -64,36 +66,20 @@ class TieredKvCache:
 
     def pump_offloads(self, engine) -> int:
         """Copy queued blocks device→host. Returns blocks offloaded."""
-        import jax
-        import jax.numpy as jnp
-
         with self._lock:
             batch = self._pending[: self.max_offload_batch]
             self._pending = self._pending[self.max_offload_batch:]
         todo = [
             (h, p) for h, p in batch
-            if h not in self.host and (self.disk is None or h not in self.disk)
+            if h not in self.host
+            and (self.disk is None or h not in self.disk)
+            and (self.remote is None or h not in self.remote)
         ]
-        # resolve hashes to live device pages (skip already-evicted)
-        pages, meta = [], []
-        for h, p in todo:
-            page = engine.pool._cached.get(h)  # noqa: SLF001 — engine-internal glue
-            if page is not None:
-                pages.append(page)
-                meta.append((h, p))
-        if not pages:
-            return 0
-        from ..engine.config import bucket_for
-
-        width = bucket_for(len(pages), engine.cfg.table_width_buckets)
-        padded = np.zeros((width,), np.int32)
-        padded[: len(pages)] = pages
-        k, v = engine._export_fn(engine.kv, jnp.asarray(padded))  # noqa: SLF001
-        k = np.asarray(jax.device_get(k))
-        v = np.asarray(jax.device_get(v))
-        for i, (h, p) in enumerate(meta):
-            self.host.put(h, p, k[:, i].copy(), v[:, i].copy())
-        return len(meta)
+        parents = dict(todo)
+        resolved, k, v = engine.export_cached_blocks([h for h, _ in todo])
+        for i, h in enumerate(resolved):
+            self.host.put(h, parents[h], k[:, i].copy(), v[:, i].copy())
+        return len(resolved)
 
     @property
     def pending_offloads(self) -> int:
@@ -103,16 +89,21 @@ class TieredKvCache:
     # -- onboarding (admission path) ----------------------------------------- #
 
     def lookup_run(self, hashes: Sequence[int]) -> List[HostBlock]:
-        """Leading run across host+disk; disk hits are promoted to host."""
+        """Leading run across host → disk → remote (G2→G3→G4); lower-tier
+        hits are promoted to host."""
         out: List[HostBlock] = []
         for h in hashes:
             blk = self.host.get(h)
-            if blk is None and self.disk is not None:
-                kv = self.disk.get(h)
-                if kv is not None:
-                    parent = out[-1].block_hash if out else None
-                    self.host.put(h, parent, kv[0], kv[1])
-                    blk = self.host.get(h)
+            if blk is None:
+                for tier in (self.disk, self.remote):
+                    if tier is None:
+                        continue
+                    kv = tier.get(h)
+                    if kv is not None:
+                        parent = out[-1].block_hash if out else None
+                        self.host.put(h, parent, kv[0], kv[1])
+                        blk = self.host.get(h)
+                        break
             if blk is None:
                 break
             out.append(blk)
@@ -121,32 +112,11 @@ class TieredKvCache:
     def onboard(self, engine, hashes: Sequence[int]) -> List[int]:
         """Import the leading cached run into device pages; returns page ids
         (committed to the device prefix cache)."""
-        import jax.numpy as jnp
-
         run = self.lookup_run(hashes)
-        if not run:
-            return []
         # leave headroom: don't onboard into the last free pages
-        max_blocks = max(0, engine.pool.available_pages - 2)
-        run = run[:max_blocks]
-        if not run:
-            return []
-        from ..engine.config import bucket_for
-
-        pages = engine.pool.allocate(len(run))
-        width = bucket_for(len(pages), engine.cfg.table_width_buckets)
-        padded = np.zeros((width,), np.int32)
-        padded[: len(pages)] = pages
-        L = run[0].k.shape[0]
-        kpad = np.zeros((L, width, *run[0].k.shape[1:]), run[0].k.dtype)
-        vpad = np.zeros_like(kpad)
-        for i, blk in enumerate(run):
-            kpad[:, i] = blk.k
-            vpad[:, i] = blk.v
-        engine.kv = engine._import_fn(  # noqa: SLF001
-            engine.kv, jnp.asarray(kpad), jnp.asarray(vpad), jnp.asarray(padded)
+        run = run[: max(0, engine.pool.available_pages - 2)]
+        pages = engine.import_committed_blocks(
+            [(b.block_hash, b.parent_hash, b.k, b.v) for b in run]
         )
-        for blk, page in zip(run, pages):
-            engine.pool.commit(page, blk.block_hash, blk.parent_hash)
-        self.onboarded_blocks += len(run)
+        self.onboarded_blocks += len(pages)
         return pages

@@ -25,6 +25,42 @@ async def local_control_plane() -> AsyncIterator[ControlPlaneServer]:
 
 
 @contextlib.asynccontextmanager
+async def threaded_control_plane() -> AsyncIterator[str]:
+    """A ControlPlaneServer on its OWN thread + event loop, yielding its
+    address. Use when test code blocks the main loop while talking to the
+    control plane (e.g. admission-time G4 reads) — in production the
+    server is a separate process, so the main loop can never starve it."""
+    import asyncio as _a
+    import threading
+
+    started = threading.Event()
+    holder = {}
+
+    def run():
+        loop = _a.new_event_loop()
+        _a.set_event_loop(loop)
+        server = loop.run_until_complete(ControlPlaneServer().start())
+        holder["loop"], holder["server"] = loop, server
+        started.set()
+        loop.run_forever()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    started.wait(10)
+    try:
+        yield holder["server"].address
+    finally:
+        loop = holder["loop"]
+        fut = _a.run_coroutine_threadsafe(holder["server"].stop(), loop)
+        try:
+            fut.result(5)
+        except Exception:  # noqa: BLE001
+            pass
+        loop.call_soon_threadsafe(loop.stop)
+        t.join(5)
+
+
+@contextlib.asynccontextmanager
 async def local_runtime() -> AsyncIterator[DistributedRuntime]:
     """One runtime with an embedded control plane."""
     rt = await DistributedRuntime.detached()

@@ -32,6 +32,14 @@ def main() -> None:
                     choices=["bfloat16", "float32"])
     ap.add_argument("--disagg-role", default="both",
                     choices=["both", "prefill", "decode"])
+    # distributed KVBM: shared host/disk/object-store KV tiers
+    ap.add_argument("--kvbm", action="store_true",
+                    help="attach shared KV tiers via the kvbm bootstrap")
+    ap.add_argument("--kvbm-leader", type=int, default=0, metavar="WORLD",
+                    help="also run the kvbm leader, barriering WORLD workers")
+    ap.add_argument("--kvbm-disk-root", default=None)
+    ap.add_argument("--kvbm-g4-bucket", default=None)
+    ap.add_argument("--kvbm-host-bytes", type=int, default=1 << 30)
     ap.add_argument("--platform", default="default",
                     choices=["default", "cpu"],
                     help="force the JAX backend (cpu for tests/CI)")
@@ -40,6 +48,8 @@ def main() -> None:
                          "-1 = disabled); serves /health /live /metrics")
     ap.add_argument("--log-level", default="info")
     args = ap.parse_args()
+    if args.kvbm and getattr(args, "mock", False):
+        ap.error("--kvbm requires a real JAX engine (incompatible with --mock)")
     logging.basicConfig(level=args.log_level.upper(),
                         format="%(asctime)s %(levelname)s %(name)s %(message)s")
     if args.platform == "cpu":
@@ -59,6 +69,23 @@ async def _run(args) -> None:
     # block for longer than the lease TTL
     engine, mdc = _build_engine(args)
     runtime = await DistributedRuntime.connect(args.control)
+    if args.kvbm:
+        from ..kvbm import KvbmConfig, KvbmLeader, KvbmWorker
+
+        leader_task = None
+        if args.kvbm_leader > 0:
+            leader_task = asyncio.ensure_future(KvbmLeader(
+                runtime,
+                KvbmConfig(
+                    disk_root=args.kvbm_disk_root,
+                    g4_bucket=args.kvbm_g4_bucket,
+                    host_bytes=args.kvbm_host_bytes,
+                ),
+                world=args.kvbm_leader, namespace=args.namespace,
+            ).start())
+        await KvbmWorker(runtime, engine, namespace=args.namespace).start()
+        if leader_task is not None:
+            await leader_task
     if args.disagg_role == "prefill":
         from ..disagg import serve_prefill_worker
 
