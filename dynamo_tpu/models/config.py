@@ -40,6 +40,18 @@ class ModelConfig:
     num_experts: int = 0
     num_experts_per_tok: int = 2
     moe_intermediate_size: Optional[int] = None
+    # "ragged": dropless sort + ragged_dot (default — deterministic per
+    #   token, exactly O(T*k) FFN rows; MaxText's sparse-matmul pattern)
+    # "capacity": GShard capacity-bounded one-hot dispatch (einsum
+    #   all-to-all under GSPMD; tokens past capacity drop)
+    # "dense": all experts compute all tokens (equality oracle)
+    moe_impl: str = "ragged"
+    # capacity-dispatch headroom: C = ceil(G*k*factor/E);
+    # <= 0 selects the dense all-experts path (equality oracle / tiny tests)
+    moe_capacity_factor: float = 1.25
+    # dispatch group size: tokens are dispatched within groups of this many
+    # so the one-hot dispatch tensor stays O(T*G), not O(T^2)
+    moe_group_size: int = 256
     # identity
     model_type: str = "llama"
     name: str = "llama"

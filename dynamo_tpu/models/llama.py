@@ -183,8 +183,10 @@ def _mlp(lp: Params, x: jax.Array) -> jax.Array:
     ).astype(x.dtype)
 
 
-def _moe(lp: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
-    """Top-k expert MLP via one-hot dispatch (EP sharding applied by caller)."""
+def _moe_dense(lp: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
+    """Reference MoE: every expert computes every token, one-hot combine.
+    O(E) compute — kept as the equality oracle for the dispatched path and
+    for tiny test models where dispatch overhead dominates."""
     B, S, h = x.shape
     E, k = cfg.num_experts, cfg.num_experts_per_tok
     router_logits = jnp.einsum(
@@ -194,12 +196,138 @@ def _moe(lp: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
     weights = jax.nn.softmax(weights, axis=-1)
     onehot = jax.nn.one_hot(selected, E, dtype=x.dtype)  # [B,S,k,E]
     combine = jnp.einsum("bsk,bske->bse", weights.astype(x.dtype), onehot)  # [B,S,E]
-    # dispatch every token to its experts: xe [E,B,S,h] masked
     gate = jnp.einsum("bsh,ehf->ebsf", x, lp["w_gate"], preferred_element_type=jnp.float32)
     up = jnp.einsum("bsh,ehf->ebsf", x, lp["w_up"], preferred_element_type=jnp.float32)
     act = (jax.nn.silu(gate) * up).astype(x.dtype)
     out = jnp.einsum("ebsf,efh->ebsh", act, lp["w_down"], preferred_element_type=jnp.float32)
     return jnp.einsum("ebsh,bse->bsh", out.astype(x.dtype), combine)
+
+
+def _moe_ragged(lp: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
+    """Dropless top-k MoE via sort + `jax.lax.ragged_dot` (the
+    MaxText/Megablocks "sparse matmul" pattern).
+
+    Assignments are sorted by expert; each expert computes a ragged row
+    group of its tokens, so compute is exactly O(T*k) FFN rows, no token
+    is ever dropped, and every token's result is independent of what else
+    is in the batch — the determinism the serving engine's disagg /
+    migration / prefix-cache guarantees rely on."""
+    B, S, h = x.shape
+    E, k = cfg.num_experts, cfg.num_experts_per_tok
+    T = B * S
+    A = T * k
+
+    xf = x.reshape(T, h)
+    router_logits = jnp.einsum(
+        "th,he->te", xf, lp["router"], preferred_element_type=jnp.float32
+    )
+    weights, selected = jax.lax.top_k(router_logits, k)  # [T, k]
+    weights = jax.nn.softmax(weights, axis=-1)
+
+    expert_of = selected.reshape(A)  # assignment → expert
+    order = jnp.argsort(expert_of, stable=True)  # group assignments by expert
+    token_of = order // k  # assignment a (row-major [T, k]) is token a // k
+    xs = xf[token_of]  # [A, h] rows sorted by expert
+    group_sizes = jnp.bincount(expert_of, length=E)
+
+    gate = jax.lax.ragged_dot(
+        xs, lp["w_gate"], group_sizes,
+        preferred_element_type=jnp.float32,
+    )
+    up = jax.lax.ragged_dot(
+        xs, lp["w_up"], group_sizes,
+        preferred_element_type=jnp.float32,
+    )
+    act = (jax.nn.silu(gate) * up).astype(x.dtype)
+    ys = jax.lax.ragged_dot(
+        act, lp["w_down"], group_sizes,
+        preferred_element_type=jnp.float32,
+    )  # [A, h]
+
+    wf = weights.reshape(A)[order].astype(jnp.float32)
+    out = jnp.zeros((T, h), jnp.float32).at[token_of].add(ys * wf[:, None])
+    return out.reshape(B, S, h).astype(x.dtype)
+
+
+def _moe(lp: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
+    if cfg.moe_impl == "ragged":
+        return _moe_ragged(lp, x, cfg)
+    if cfg.moe_impl == "dense":
+        return _moe_dense(lp, x, cfg)
+    if cfg.moe_impl == "capacity":
+        return _moe_capacity(lp, x, cfg)
+    raise ValueError(
+        f"moe_impl must be ragged|capacity|dense, got {cfg.moe_impl!r}"
+    )
+
+
+def _moe_capacity(lp: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
+    """Top-k MoE via capacity-bounded expert dispatch (the GShard/Switch
+    pattern — the TPU-native expert-parallel form).
+
+    Tokens scatter into per-expert buffers ``[E, C, h]`` (C = capacity);
+    each expert runs its FFN on its buffer only, so compute scales with
+    ``k * capacity_factor``, not ``E`` (the reference reaches wide-EP via
+    SGLang ``--ep-size``/DeepEP, SURVEY.md §2.6).  Under GSPMD with
+    ``w_*`` sharded on E over the ep axis and tokens sharded over dp, XLA
+    lowers the dispatch/combine einsums to the expert all-to-all over ICI.
+    Tokens past an expert's capacity are dropped (standard GShard
+    behavior) — their residual stream passes through unchanged.
+    """
+    B, S, h = x.shape
+    E, k = cfg.num_experts, cfg.num_experts_per_tok
+    T = B * S
+    cap_f = cfg.moe_capacity_factor
+    if cap_f <= 0:  # dense fallback (tests / tiny models)
+        return _moe_dense(lp, x, cfg)
+
+    # group tokens so the one-hot dispatch stays O(T*G) not O(T^2):
+    # each group of G tokens gets its own capacity slice per expert
+    G = min(T, cfg.moe_group_size)
+    Tp = -(-T // G) * G
+    n_g = Tp // G
+    C = max(1, int(-(-G * k * cap_f // E)))
+
+    xf = x.reshape(T, h)
+    if Tp != T:
+        xf = jnp.pad(xf, ((0, Tp - T), (0, 0)))
+    xg = xf.reshape(n_g, G, h)
+    router_logits = jnp.einsum(
+        "gth,he->gte", xg, lp["router"], preferred_element_type=jnp.float32
+    )
+    weights, selected = jax.lax.top_k(router_logits, k)  # [n_g, G, k]
+    weights = jax.nn.softmax(weights, axis=-1)
+
+    # position of each (token, slot) assignment within its expert's buffer
+    oh = jax.nn.one_hot(selected, E, dtype=jnp.int32)  # [n_g, G, k, E]
+    ohf = oh.reshape(n_g, G * k, E)
+    pos = jnp.cumsum(ohf, axis=1) - ohf  # prior assignments per expert
+    pos = (pos * ohf).sum(-1)  # [n_g, G*k]
+    keep = (pos < C).astype(x.dtype)
+
+    # dispatch/combine tensor [n_g, G*k, E, C] (one-hot in E and C)
+    disp = (
+        ohf.astype(x.dtype)[..., None]
+        * jax.nn.one_hot(jnp.clip(pos, 0, C - 1), C, dtype=x.dtype)[..., None, :]
+        * keep[..., None, None]
+    )
+    xrep = jnp.repeat(xg, k, axis=1)  # [n_g, G*k, h] (slot-adjacent order)
+    xe = jnp.einsum(
+        "gaec,gah->gech", disp, xrep, preferred_element_type=jnp.float32
+    ).astype(x.dtype)  # [n_g, E, C, h]
+
+    gate = jnp.einsum("gech,ehf->gecf", xe, lp["w_gate"], preferred_element_type=jnp.float32)
+    up = jnp.einsum("gech,ehf->gecf", xe, lp["w_up"], preferred_element_type=jnp.float32)
+    act = (jax.nn.silu(gate) * up).astype(x.dtype)
+    ye = jnp.einsum("gecf,efh->gech", act, lp["w_down"], preferred_element_type=jnp.float32)
+
+    wf = weights.astype(x.dtype).reshape(n_g, G * k)
+    out = jnp.einsum(
+        "gaec,gech->gah", disp * wf[..., None, None], ye.astype(x.dtype),
+        preferred_element_type=jnp.float32,
+    )  # [n_g, G*k, h] — one row per (token, slot) assignment
+    out = out.reshape(n_g, G, k, h).sum(axis=2).reshape(Tp, h)[:T]
+    return out.reshape(B, S, h).astype(x.dtype)
 
 
 def _layer_prefill(

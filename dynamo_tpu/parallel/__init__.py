@@ -1,4 +1,5 @@
-"""Parallelism: device meshes, sharding placement, ring attention."""
+"""Parallelism: device meshes, sharding placement, ring attention,
+pipeline stages."""
 
 from .mesh import (
     ParallelConfig,
@@ -7,14 +8,18 @@ from .mesh import (
     shard_kv_cache,
     shard_params,
 )
+from .pipeline import microbatch, pipeline_forward, stage_pspec
 from .ring_attention import ring_attention, ring_attention_local
 
 __all__ = [
     "ParallelConfig",
     "make_mesh",
+    "microbatch",
+    "pipeline_forward",
     "replicated",
     "ring_attention",
     "ring_attention_local",
     "shard_kv_cache",
     "shard_params",
+    "stage_pspec",
 ]

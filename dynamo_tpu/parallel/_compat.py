@@ -1,0 +1,17 @@
+"""jax version compatibility for shard_map (top-level with check_vma on
+jax >= 0.8; jax.experimental with check_rep before)."""
+
+from __future__ import annotations
+
+try:  # jax >= 0.8 exposes shard_map at top level (check_vma kwarg)
+    from jax import shard_map as _shard_map
+
+    def shard_map(f, **kw):
+        kw.setdefault("check_vma", False)
+        return _shard_map(f, **kw)
+except ImportError:  # pragma: no cover — older jax
+    from jax.experimental.shard_map import shard_map as _shard_map_old
+
+    def shard_map(f, **kw):
+        kw.setdefault("check_rep", False)
+        return _shard_map_old(f, **kw)

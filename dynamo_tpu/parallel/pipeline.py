@@ -1,0 +1,112 @@
+"""Pipeline parallelism: layer stages over a `pp` mesh axis.
+
+The reference delegates PP to its engines (`pipeline_parallel_size`
+passthrough, SURVEY.md §2.6); here it is implemented natively as the
+standard SPMD pipeline on TPU (the "pipelined scan" of the scaling book):
+
+- per-layer params are stacked on axis 0 and **sharded over the pp axis**,
+  so each device holds a contiguous block of layers (its stage);
+- microbatches flow through stages with `lax.ppermute` ring shifts inside
+  a `lax.scan` over ticks; stage s computes microbatch m at tick t = s + m
+  (GPipe schedule, S + M - 1 ticks, bubble fraction (S-1)/(S+M-1));
+- every device runs the same program every tick (SPMD) — bubble ticks
+  compute on garbage and their results are masked out.
+
+This composes with the other axes: the layer_fn's own einsums may be
+sharded over tp/ep within each stage.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ._compat import shard_map
+
+
+def stage_pspec(pytree: Any) -> Any:
+    """PartitionSpecs sharding every leaf's leading (layer) axis over pp."""
+    return jax.tree.map(
+        lambda leaf: P("pp", *([None] * (leaf.ndim - 1))), pytree
+    )
+
+
+def pipeline_forward(
+    mesh: Mesh,
+    layer_fn: Callable[[Any, jax.Array], jax.Array],
+    stacked_params: Any,
+    x_microbatches: jax.Array,  # [M, mb, ...] — microbatched input
+    axis: str = "pp",
+) -> jax.Array:
+    """Run `x` through all L stacked layers, pipelined over the pp axis.
+
+    `layer_fn(layer_params, h) -> h` applies ONE layer; `stacked_params`
+    leaves have leading axis L with L % pp_size == 0.  Returns outputs
+    shaped like `x_microbatches`, replicated over pp.
+    """
+    S = mesh.shape[axis]
+    n_layers = {leaf.shape[0] for leaf in jax.tree.leaves(stacked_params)}
+    if len(n_layers) != 1 or next(iter(n_layers)) % S:
+        raise ValueError(
+            f"stacked layer count {sorted(n_layers)} must be uniform and "
+            f"divisible by the {S}-stage pp axis"
+        )
+
+    def stage_body(params_local, x_local):
+        s = jax.lax.axis_index(axis)
+        M = x_local.shape[0]
+
+        def run_stage(h):
+            def lay(carry, lp):
+                return layer_fn(lp, carry), None
+
+            out, _ = jax.lax.scan(lay, h, params_local)
+            return out
+
+        perm = [(i, (i + 1) % S) for i in range(S)]
+
+        def tick(carry, t):
+            state, outputs = carry
+            # stage 0 injects microbatch t; later stages consume the ring
+            inject = x_local[jnp.clip(t, 0, M - 1)]
+            h_in = jnp.where(s == 0, inject, state)
+            h_out = run_stage(h_in)
+            # the last stage finished microbatch m = t - (S-1)
+            m = t - (S - 1)
+            write = (s == S - 1) & (m >= 0)
+            mi = jnp.clip(m, 0, M - 1)
+            outputs = jnp.where(
+                write,
+                outputs.at[mi].set(h_out),
+                outputs,
+            )
+            state = jax.lax.ppermute(h_out, axis, perm)
+            return (state, outputs), None
+
+        init = (jnp.zeros_like(x_local[0]), jnp.zeros_like(x_local))
+        (_, outputs), _ = jax.lax.scan(
+            tick, init, jnp.arange(M + S - 1)
+        )
+        # only the last stage holds real outputs — replicate them
+        return jax.lax.psum(
+            jnp.where(s == S - 1, outputs, jnp.zeros_like(outputs)), axis
+        )
+
+    return shard_map(
+        stage_body,
+        mesh=mesh,
+        in_specs=(stage_pspec(stacked_params), P()),
+        out_specs=P(),
+    )(stacked_params, x_microbatches)
+
+
+def microbatch(x: jax.Array, n: int) -> jax.Array:
+    """Split a batch [B, ...] into [n, B//n, ...] microbatches."""
+    B = x.shape[0]
+    if B % n:
+        raise ValueError(f"batch {B} not divisible into {n} microbatches")
+    return x.reshape(n, B // n, *x.shape[1:])

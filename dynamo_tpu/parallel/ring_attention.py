@@ -29,18 +29,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:  # jax >= 0.8 exposes shard_map at top level (check_vma kwarg)
-    from jax import shard_map as _shard_map
-
-    def shard_map(f, **kw):
-        kw.setdefault("check_vma", False)
-        return _shard_map(f, **kw)
-except ImportError:  # pragma: no cover — older jax
-    from jax.experimental.shard_map import shard_map as _shard_map_old
-
-    def shard_map(f, **kw):
-        kw.setdefault("check_rep", False)
-        return _shard_map_old(f, **kw)
+from ._compat import shard_map
 
 NEG_INF = -1e30
 

@@ -107,3 +107,56 @@ def test_ring_attention_noncausal(devices):
     )
     got = ring_attention(q, k, v, mesh, axis_name="sp", causal=False)
     np.testing.assert_allclose(ref, got, rtol=2e-4, atol=2e-4)
+
+
+# --------------------------------------------------------------------------- #
+# pipeline parallelism
+# --------------------------------------------------------------------------- #
+
+
+def _mlp_block(lp, h):
+    """One residual MLP block (stand-in layer for pipeline tests)."""
+    y = jnp.tanh(h @ lp["w1"]) @ lp["w2"]
+    return h + y
+
+
+@pytest.mark.parametrize("stages,microbatches", [(2, 4), (4, 2), (8, 8)])
+def test_pipeline_matches_sequential(devices, stages, microbatches):
+    """GPipe-scheduled pipeline over the pp axis == sequential layer scan."""
+    from dynamo_tpu.parallel import microbatch, pipeline_forward
+
+    L, B, h = 8, 16, 32
+    key = jax.random.PRNGKey(0)
+    k1, k2, k3 = jax.random.split(key, 3)
+    params = {
+        "w1": jax.random.normal(k1, (L, h, h * 2), jnp.float32) * 0.1,
+        "w2": jax.random.normal(k2, (L, h * 2, h), jnp.float32) * 0.1,
+    }
+    x = jax.random.normal(k3, (B, h), jnp.float32)
+
+    def seq(params, x):
+        def lay(carry, lp):
+            return _mlp_block(lp, carry), None
+
+        out, _ = jax.lax.scan(lay, x, params)
+        return out
+
+    want = seq(params, x)
+
+    mesh = Mesh(np.array(jax.devices()).reshape(stages, 8 // stages)[:, 0]
+                if stages < 8 else np.array(jax.devices()),
+                axis_names=("pp",))
+    x_mb = microbatch(x, microbatches)
+    got = jax.jit(
+        lambda p, xx: pipeline_forward(mesh, _mlp_block, p, xx)
+    )(params, x_mb)
+    np.testing.assert_allclose(
+        np.asarray(got).reshape(B, h), np.asarray(want), atol=1e-5, rtol=1e-5
+    )
+
+
+def test_pipeline_rejects_bad_microbatch():
+    from dynamo_tpu.parallel import microbatch
+
+    with pytest.raises(ValueError):
+        microbatch(jnp.zeros((10, 4)), 3)
