@@ -37,6 +37,69 @@ from .service import ModelManager, ModelWatcher
 logger = logging.getLogger(__name__)
 
 
+class _ChoiceParsers:
+    """Per-choice output parsing: reasoning split first, then tool-call
+    extraction on the content stream (reference: parsers crate wired into
+    the chat response path)."""
+
+    def __init__(self, mdc):
+        from ..parsers import get_reasoning_parser, get_tool_parser
+
+        self.reasoning = get_reasoning_parser(
+            getattr(mdc, "reasoning_parser", "") or "")
+        self.tools = get_tool_parser(
+            getattr(mdc, "tool_call_parser", "") or "")
+        self.n_tool_calls = 0
+
+    @staticmethod
+    def active(mdc) -> bool:
+        return bool(getattr(mdc, "reasoning_parser", "")
+                    or getattr(mdc, "tool_call_parser", ""))
+
+    def push(self, text: str) -> dict:
+        rd = self.reasoning.push(text)
+        td = self.tools.push(rd.content)
+        return {"content": td.content, "reasoning": rd.reasoning,
+                "tool_calls": td.tool_calls}
+
+    def finish(self) -> dict:
+        rd = self.reasoning.finish()
+        td = self.tools.push(rd.content)
+        fd = self.tools.finish()
+        return {"content": td.content + fd.content, "reasoning": rd.reasoning,
+                "tool_calls": td.tool_calls + fd.tool_calls}
+
+    def push_final(self, text: str) -> dict:
+        """push + finish merged — the single place that defines how the
+        flush combines with the last fragment (used by both the streaming
+        finish branch and the unary path)."""
+        parsed = self.push(text)
+        fin = self.finish()
+        return {
+            "content": parsed["content"] + fin["content"],
+            "reasoning": parsed["reasoning"] + fin["reasoning"],
+            "tool_calls": parsed["tool_calls"] + fin["tool_calls"],
+        }
+
+    def delta_fields(self, parsed: dict) -> dict:
+        """OpenAI chat delta fields for one parsed fragment."""
+        delta = {}
+        if parsed["content"]:
+            delta["content"] = parsed["content"]
+        if parsed["reasoning"]:
+            delta["reasoning_content"] = parsed["reasoning"]
+        if parsed["tool_calls"]:
+            delta["tool_calls"] = [
+                tc.to_openai(self.n_tool_calls + j)
+                for j, tc in enumerate(parsed["tool_calls"])
+            ]
+            self.n_tool_calls += len(parsed["tool_calls"])
+        return delta
+
+    def map_finish(self, reason):
+        return "tool_calls" if (self.n_tool_calls and reason == "stop") else reason
+
+
 class HttpService:
     def __init__(self, manager: ModelManager, host: str = "0.0.0.0",
                  port: int = 8000, metrics: Optional[FrontendMetrics] = None):
@@ -348,6 +411,10 @@ class HttpService:
         last_t = t0
         status = "200"
         contexts = [Context() for _ in range(n)]
+        parsers = (
+            [_ChoiceParsers(entry.mdc) for _ in range(n)]
+            if kind == "chat" and _ChoiceParsers.active(entry.mdc) else None
+        )
         queue: asyncio.Queue = asyncio.Queue()
 
         async def pump_choice(i, preq, ctx):
@@ -390,10 +457,24 @@ class HttpService:
                     self.metrics.itl.labels(model_name).observe(now - last_t)
                 last_t = now
                 ntokens += len(out.get("token_ids", []))
-                chunk = _make_chunk(
-                    rid, kind, model_name, created, out,
-                    out.get("finish_reason"), index=i, entry=entry,
-                )
+                finish = out.get("finish_reason")
+                if parsers is not None:
+                    if finish:
+                        parsed = parsers[i].push_final(out.get("text", ""))
+                    else:
+                        parsed = parsers[i].push(out.get("text", ""))
+                    delta = parsers[i].delta_fields(parsed)
+                    out = {**out, "text": ""}
+                    finish = parsers[i].map_finish(finish)
+                    chunk = _make_chunk(
+                        rid, kind, model_name, created, out, finish,
+                        index=i, entry=entry, delta_override=delta,
+                    )
+                else:
+                    chunk = _make_chunk(
+                        rid, kind, model_name, created, out, finish,
+                        index=i, entry=entry,
+                    )
                 await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
             await resp.write(b"data: [DONE]\n\n")
         except (ConnectionResetError, asyncio.CancelledError):
@@ -470,13 +551,31 @@ class HttpService:
             "total_tokens": prompt_tokens + token_count,
         }
         want_lp = preprocessed["sampling_options"].get("logprobs")
+        parse = kind == "chat" and _ChoiceParsers.active(entry.mdc)
         choices = []
         for i, r in enumerate(results):
             if kind == "chat":
+                message = {"role": "assistant", "content": r["text"]}
+                finish = r["finish_reason"]
+                if parse:
+                    parsed = _ChoiceParsers(entry.mdc).push_final(r["text"])
+                    content = parsed["content"]
+                    reasoning = parsed["reasoning"]
+                    calls = parsed["tool_calls"]
+                    message = {"role": "assistant",
+                               "content": content or (None if calls else "")}
+                    if reasoning:
+                        message["reasoning_content"] = reasoning
+                    if calls:
+                        message["tool_calls"] = [
+                            tc.to_openai(j) for j, tc in enumerate(calls)
+                        ]
+                        if finish == "stop":
+                            finish = "tool_calls"
                 choice = {
                     "index": i,
-                    "message": {"role": "assistant", "content": r["text"]},
-                    "finish_reason": r["finish_reason"],
+                    "message": message,
+                    "finish_reason": finish,
                 }
                 if want_lp:
                     choice["logprobs"] = _chat_logprobs(entry, r)
@@ -562,7 +661,7 @@ def _completions_logprobs(entry, r) -> Dict[str, Any]:
 
 
 def _make_chunk(rid, kind, model, created, out, finish_reason, index=0,
-                entry=None):
+                entry=None, delta_override=None):
     want_lp = entry is not None and out.get("log_probs")
     lp_args = {
         "token_ids": out.get("token_ids", []),
@@ -570,7 +669,10 @@ def _make_chunk(rid, kind, model, created, out, finish_reason, index=0,
         "top_logprobs": out.get("top_logprobs", []),
     }
     if kind == "chat":
-        delta = {"content": out.get("text", "")} if out.get("text") else {}
+        if delta_override is not None:
+            delta = delta_override
+        else:
+            delta = {"content": out.get("text", "")} if out.get("text") else {}
         choice = {"index": index, "delta": delta, "finish_reason": finish_reason}
         if want_lp:
             choice["logprobs"] = _chat_logprobs(entry, lp_args)

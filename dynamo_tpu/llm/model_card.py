@@ -47,6 +47,9 @@ class ModelDeploymentCard:
     runtime_config: RuntimeConfig = field(default_factory=RuntimeConfig)
     # disaggregation role: "both" | "prefill" | "decode"
     disagg_role: str = "both"
+    # output parsers (dynamo_tpu.parsers registry names; "" = passthrough)
+    reasoning_parser: str = ""
+    tool_call_parser: str = ""
     user_data: Dict[str, Any] = field(default_factory=dict)
 
     @property

@@ -1,0 +1,28 @@
+"""Output parsers: reasoning-content splitting and tool-call extraction
+(the dynamo-parsers crate equivalent, /root/reference/lib/parsers/)."""
+
+from .reasoning import (
+    ReasoningDelta,
+    ReasoningParser,
+    get_reasoning_parser,
+    reasoning_parser_names,
+)
+from .tool_calling import (
+    ToolCall,
+    ToolDelta,
+    ToolParser,
+    get_tool_parser,
+    tool_parser_names,
+)
+
+__all__ = [
+    "ReasoningDelta",
+    "ReasoningParser",
+    "ToolCall",
+    "ToolDelta",
+    "ToolParser",
+    "get_reasoning_parser",
+    "get_tool_parser",
+    "reasoning_parser_names",
+    "tool_parser_names",
+]

@@ -1,0 +1,233 @@
+"""Streaming reasoning parsers: split model output into `reasoning_content`
+vs `content` deltas.
+
+Reference: /root/reference/lib/parsers/src/reasoning/ (deepseek_r1 think
+tags, granite prose markers, gpt-oss harmony channels).  All parsers here
+are *incremental*: `push(delta)` may be called with arbitrary text
+fragments (token-by-token or batched) and returns the split for that
+fragment; text that could still turn into a marker is held back until
+disambiguated, so markers never leak across chunk boundaries.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple, Type
+
+__all__ = [
+    "ReasoningDelta",
+    "ReasoningParser",
+    "get_reasoning_parser",
+    "reasoning_parser_names",
+]
+
+
+@dataclass
+class ReasoningDelta:
+    content: str = ""
+    reasoning: str = ""
+
+
+def _held_suffix(buf: str, markers: Tuple[str, ...]) -> int:
+    """Length of the longest buffer suffix that is a proper prefix of any
+    marker — that many chars must be withheld until more text arrives."""
+    best = 0
+    for m in markers:
+        for k in range(min(len(buf), len(m) - 1), 0, -1):
+            if buf.endswith(m[:k]):
+                best = max(best, k)
+                break
+    return best
+
+
+class ReasoningParser:
+    """Base: everything is content."""
+
+    name = "none"
+
+    def push(self, delta: str) -> ReasoningDelta:
+        return ReasoningDelta(content=delta)
+
+    def finish(self) -> ReasoningDelta:
+        return ReasoningDelta()
+
+
+class TagReasoningParser(ReasoningParser):
+    """``<start>…reasoning…<end>…content…`` with optional implicit start
+    (DeepSeek-R1 templates often open the think block in the prompt, so
+    generation begins mid-reasoning)."""
+
+    start_tag = "<think>"
+    end_tag = "</think>"
+    implicit_start = False
+
+    def __init__(self) -> None:
+        self._buf = ""
+        # before | reasoning | after
+        self._state = "reasoning" if self.implicit_start else "before"
+
+    def _markers(self) -> Tuple[str, ...]:
+        if self._state == "before":
+            return (self.start_tag,)
+        if self._state == "reasoning":
+            return (self.end_tag,)
+        return ()
+
+    def push(self, delta: str) -> ReasoningDelta:
+        self._buf += delta
+        out = ReasoningDelta()
+        while True:
+            if self._state == "before":
+                idx = self._buf.find(self.start_tag)
+                if idx >= 0:
+                    out.content += self._buf[:idx]
+                    self._buf = self._buf[idx + len(self.start_tag):]
+                    self._state = "reasoning"
+                    continue
+                hold = _held_suffix(self._buf, (self.start_tag,))
+                emit = len(self._buf) - hold
+                out.content += self._buf[:emit]
+                self._buf = self._buf[emit:]
+                return out
+            if self._state == "reasoning":
+                idx = self._buf.find(self.end_tag)
+                if idx >= 0:
+                    out.reasoning += self._buf[:idx]
+                    self._buf = self._buf[idx + len(self.end_tag):]
+                    self._state = "after"
+                    continue
+                hold = _held_suffix(self._buf, (self.end_tag,))
+                emit = len(self._buf) - hold
+                out.reasoning += self._buf[:emit]
+                self._buf = self._buf[emit:]
+                return out
+            # after
+            out.content += self._buf
+            self._buf = ""
+            return out
+
+    def finish(self) -> ReasoningDelta:
+        buf, self._buf = self._buf, ""
+        if not buf:
+            return ReasoningDelta()
+        if self._state == "reasoning":
+            return ReasoningDelta(reasoning=buf)
+        return ReasoningDelta(content=buf)
+
+
+class DeepseekR1Parser(TagReasoningParser):
+    name = "deepseek_r1"
+    implicit_start = True
+
+
+class Qwen3Parser(TagReasoningParser):
+    name = "qwen3"
+    implicit_start = False
+
+
+class GraniteParser(TagReasoningParser):
+    """IBM Granite prose markers (reference reasoning/granite_parser.rs)."""
+
+    name = "granite"
+    start_tag = "Here is my thought process:"
+    end_tag = "Here is my response:"
+    implicit_start = False
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._content_started = False
+
+    def _strip(self, d: ReasoningDelta) -> ReasoningDelta:
+        # prose markers leave a space after the colon — strip the
+        # content's leading whitespace once, across deltas
+        if not self._content_started and d.content:
+            d.content = d.content.lstrip()
+            self._content_started = bool(d.content)
+        return d
+
+    def push(self, delta: str) -> ReasoningDelta:
+        return self._strip(super().push(delta))
+
+    def finish(self) -> ReasoningDelta:
+        return self._strip(super().finish())
+
+
+class HarmonyParser(ReasoningParser):
+    """gpt-oss harmony channels (simplified): ``<|channel|>analysis
+    <|message|>…<|end|>`` routes to reasoning; the ``final`` channel (or
+    channel-less text) routes to content (reference
+    reasoning/gpt_oss_parser.rs)."""
+
+    name = "gpt_oss"
+    CH = "<|channel|>"
+    MSG = "<|message|>"
+    END = "<|end|>"
+
+    def __init__(self) -> None:
+        self._buf = ""
+        self._channel: Optional[str] = None  # None = outside a block
+
+    def push(self, delta: str) -> ReasoningDelta:
+        self._buf += delta
+        out = ReasoningDelta()
+        while True:
+            if self._channel is None:
+                idx = self._buf.find(self.CH)
+                if idx >= 0:
+                    out.content += self._buf[:idx]
+                    rest = self._buf[idx + len(self.CH):]
+                    midx = rest.find(self.MSG)
+                    if midx >= 0:
+                        self._channel = rest[:midx].strip()
+                        self._buf = rest[midx + len(self.MSG):]
+                        continue
+                    self._buf = self._buf[idx:]  # header incomplete — hold
+                    return out
+                hold = _held_suffix(self._buf, (self.CH,))
+                emit = len(self._buf) - hold
+                out.content += self._buf[:emit]
+                self._buf = self._buf[emit:]
+                return out
+            # inside a channel block
+            idx = self._buf.find(self.END)
+            target = "reasoning" if self._channel != "final" else "content"
+            if idx >= 0:
+                setattr(out, target, getattr(out, target) + self._buf[:idx])
+                self._buf = self._buf[idx + len(self.END):]
+                self._channel = None
+                continue
+            hold = _held_suffix(self._buf, (self.END,))
+            emit = len(self._buf) - hold
+            setattr(out, target, getattr(out, target) + self._buf[:emit])
+            self._buf = self._buf[emit:]
+            return out
+
+    def finish(self) -> ReasoningDelta:
+        buf, self._buf = self._buf, ""
+        if not buf:
+            return ReasoningDelta()
+        if self._channel is not None and self._channel != "final":
+            return ReasoningDelta(reasoning=buf)
+        return ReasoningDelta(content=buf)
+
+
+_REGISTRY: Dict[str, Type[ReasoningParser]] = {
+    p.name: p
+    for p in (DeepseekR1Parser, Qwen3Parser, GraniteParser, HarmonyParser)
+}
+
+
+def reasoning_parser_names() -> list:
+    return sorted(_REGISTRY)
+
+
+def get_reasoning_parser(name: str) -> ReasoningParser:
+    """Instantiate a fresh (stateful) parser; '' / 'none' → passthrough."""
+    if not name or name == "none":
+        return ReasoningParser()
+    try:
+        return _REGISTRY[name]()
+    except KeyError:
+        raise ValueError(
+            f"unknown reasoning parser {name!r}; known: {reasoning_parser_names()}"
+        ) from None

@@ -1,0 +1,297 @@
+"""Streaming tool-call parsers: detect and extract structured tool calls
+from model output, jailing buffered text until a call is complete.
+
+Reference: /root/reference/lib/parsers/src/tool_calling/ (json, pythonic,
+harmony) plus the preprocessor's tool-call jail (preprocessor.rs:668
+`apply_tool_calling_jail`).  API mirrors the reasoning parsers:
+``push(delta) -> ToolDelta`` with held-back ambiguous suffixes, and
+``finish()`` flushing whatever remains (parsing a trailing complete call,
+or releasing the jail as plain text if it never completed).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+import uuid
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple, Type
+
+from .reasoning import _held_suffix
+
+__all__ = [
+    "ToolCall",
+    "ToolDelta",
+    "ToolParser",
+    "get_tool_parser",
+    "tool_parser_names",
+]
+
+
+@dataclass
+class ToolCall:
+    name: str
+    arguments: str  # JSON-encoded argument object
+    id: str = field(default_factory=lambda: "call_" + uuid.uuid4().hex[:24])
+
+    def to_openai(self, index: int) -> Dict:
+        return {
+            "index": index,
+            "id": self.id,
+            "type": "function",
+            "function": {"name": self.name, "arguments": self.arguments},
+        }
+
+
+@dataclass
+class ToolDelta:
+    content: str = ""
+    tool_calls: List[ToolCall] = field(default_factory=list)
+
+
+def _calls_from_json(value) -> Optional[List[ToolCall]]:
+    """Interpret a decoded JSON value as tool call(s)."""
+    if isinstance(value, dict):
+        value = [value]
+    if not isinstance(value, list):
+        return None
+    out = []
+    for item in value:
+        if not isinstance(item, dict):
+            return None
+        name = item.get("name")
+        args = item.get("arguments", item.get("parameters", {}))
+        if not isinstance(name, str):
+            return None
+        out.append(ToolCall(name=name, arguments=json.dumps(args)))
+    return out or None
+
+
+class ToolParser:
+    """Base: no tool calling — everything is content."""
+
+    name = "none"
+
+    def push(self, delta: str) -> ToolDelta:
+        return ToolDelta(content=delta)
+
+    def finish(self) -> ToolDelta:
+        return ToolDelta()
+
+
+class MarkerJsonToolParser(ToolParser):
+    """JSON tool calls wrapped in start/end markers, e.g. hermes/qwen
+    ``<tool_call>{...}</tool_call>`` (reference tool_calling/json).
+
+    Multiple sequential calls are supported; text outside markers streams
+    through as content."""
+
+    start_marker = "<tool_call>"
+    # None = the call body runs to the end of the message (flushed by
+    # finish()); a string closes each call inline
+    end_marker: Optional[str] = "</tool_call>"
+
+    def __init__(self) -> None:
+        self._buf = ""
+        self._jailed = False  # inside a call body
+
+    def push(self, delta: str) -> ToolDelta:
+        self._buf += delta
+        out = ToolDelta()
+        while True:
+            if not self._jailed:
+                idx = self._buf.find(self.start_marker)
+                if idx >= 0:
+                    out.content += self._buf[:idx]
+                    self._buf = self._buf[idx + len(self.start_marker):]
+                    self._jailed = True
+                    continue
+                hold = _held_suffix(self._buf, (self.start_marker,))
+                emit = len(self._buf) - hold
+                out.content += self._buf[:emit]
+                self._buf = self._buf[emit:]
+                return out
+            if self.end_marker is None:
+                return out  # body runs to end-of-message — stay jailed
+            idx = self._buf.find(self.end_marker)
+            if idx < 0:
+                return out  # body incomplete — stay jailed
+            body, self._buf = self._buf[:idx], self._buf[idx + len(self.end_marker):]
+            self._jailed = False
+            calls = None
+            try:
+                calls = _calls_from_json(json.loads(body))
+            except json.JSONDecodeError:
+                pass
+            if calls:
+                out.tool_calls.extend(calls)
+            else:  # malformed body — release the jail verbatim
+                out.content += self.start_marker + body + self.end_marker
+
+    def finish(self) -> ToolDelta:
+        buf, self._buf = self._buf, ""
+        if not buf and not self._jailed:
+            return ToolDelta()
+        if self._jailed:
+            self._jailed = False
+            # unterminated call: a complete JSON body still counts
+            try:
+                calls = _calls_from_json(json.loads(buf))
+                if calls:
+                    return ToolDelta(tool_calls=calls)
+            except json.JSONDecodeError:
+                pass
+            return ToolDelta(content=self.start_marker + buf)
+        return ToolDelta(content=buf)
+
+
+class HermesToolParser(MarkerJsonToolParser):
+    name = "hermes"
+
+
+class MistralToolParser(MarkerJsonToolParser):
+    """``[TOOL_CALLS][{...}, {...}]`` — the marker opens a JSON array that
+    runs to the end of the message (end_marker=None → finish() flushes)."""
+
+    name = "mistral"
+    start_marker = "[TOOL_CALLS]"
+    end_marker = None
+
+
+class JsonToolParser(ToolParser):
+    """Bare-JSON tool calls: the whole message (optionally after
+    ``<|python_tag|>``) is a JSON object/array of calls (llama3-style).
+    Streaming jails from the first ``{`` / ``[`` that parses at finish."""
+
+    name = "json"
+    PYTHON_TAG = "<|python_tag|>"
+
+    def __init__(self) -> None:
+        self._buf = ""
+        self._jailed = False
+
+    def push(self, delta: str) -> ToolDelta:
+        self._buf += delta
+        out = ToolDelta()
+        if not self._jailed:
+            stripped = self._buf.lstrip()
+            if stripped.startswith(self.PYTHON_TAG):
+                stripped = stripped[len(self.PYTHON_TAG):].lstrip()
+                self._jailed = True
+            if stripped[:1] in ("{", "["):
+                self._jailed = True
+            elif stripped and not self.PYTHON_TAG.startswith(stripped):
+                # definitely not a tool call — stream through
+                out.content += self._buf
+                self._buf = ""
+        return out
+
+    def finish(self) -> ToolDelta:
+        buf, self._buf = self._buf, ""
+        self._jailed = False
+        if not buf:
+            return ToolDelta()
+        body = buf.strip()
+        if body.startswith(self.PYTHON_TAG):
+            body = body[len(self.PYTHON_TAG):].strip()
+        try:
+            calls = _calls_from_json(json.loads(body))
+            if calls:
+                return ToolDelta(tool_calls=calls)
+        except json.JSONDecodeError:
+            pass
+        return ToolDelta(content=buf)
+
+
+class PythonicToolParser(ToolParser):
+    """Llama-4-style pythonic calls: ``[get_weather(city="SF"), f(x=1)]``
+    (reference tool_calling/pythonic).  Jailed from a leading ``[`` that
+    looks like a call list; parsed with ``ast`` at completion."""
+
+    name = "pythonic"
+    _CALLish = re.compile(r"^\[\s*[A-Za-z_][\w.]*\s*\(")
+
+    def __init__(self) -> None:
+        self._buf = ""
+        self._jailed = False
+
+    def push(self, delta: str) -> ToolDelta:
+        self._buf += delta
+        out = ToolDelta()
+        if not self._jailed:
+            stripped = self._buf.lstrip()
+            if self._CALLish.match(stripped):
+                self._jailed = True
+            elif stripped and not stripped.startswith("["):
+                out.content += self._buf
+                self._buf = ""
+            elif len(stripped) > 64 and not self._CALLish.match(stripped):
+                out.content += self._buf  # long non-call bracket text
+                self._buf = ""
+        return out
+
+    @classmethod
+    def _parse(cls, text: str) -> Optional[List[ToolCall]]:
+        try:
+            tree = ast.parse(text.strip(), mode="eval")
+        except SyntaxError:
+            return None
+        node = tree.body
+        if not isinstance(node, ast.List):
+            return None
+        calls = []
+        for el in node.elts:
+            if not isinstance(el, ast.Call) or not isinstance(
+                el.func, (ast.Name, ast.Attribute)
+            ):
+                return None
+            name = (
+                el.func.id if isinstance(el.func, ast.Name)
+                else ast.unparse(el.func)
+            )
+            args = {}
+            for kw in el.keywords:
+                if kw.arg is None:
+                    return None
+                try:
+                    args[kw.arg] = ast.literal_eval(kw.value)
+                except (ValueError, SyntaxError):
+                    return None
+            if el.args:  # positional args unsupported in the wire format
+                return None
+            calls.append(ToolCall(name=name, arguments=json.dumps(args)))
+        return calls or None
+
+    def finish(self) -> ToolDelta:
+        buf, self._buf = self._buf, ""
+        self._jailed = False
+        if not buf:
+            return ToolDelta()
+        calls = self._parse(buf)
+        if calls:
+            return ToolDelta(tool_calls=calls)
+        return ToolDelta(content=buf)
+
+
+_REGISTRY: Dict[str, Type[ToolParser]] = {
+    p.name: p
+    for p in (HermesToolParser, MistralToolParser, JsonToolParser,
+              PythonicToolParser)
+}
+
+
+def tool_parser_names() -> list:
+    return sorted(_REGISTRY)
+
+
+def get_tool_parser(name: str) -> ToolParser:
+    """Instantiate a fresh (stateful) parser; '' / 'none' → passthrough."""
+    if not name or name == "none":
+        return ToolParser()
+    try:
+        return _REGISTRY[name]()
+    except KeyError:
+        raise ValueError(
+            f"unknown tool parser {name!r}; known: {tool_parser_names()}"
+        ) from None

@@ -46,8 +46,21 @@ def main() -> None:
     ap.add_argument("--status-port", type=int, default=0,
                     help="system status server port (0 = ephemeral, "
                          "-1 = disabled); serves /health /live /metrics")
+    ap.add_argument("--reasoning-parser", default="",
+                    help="split reasoning_content from content "
+                         "(deepseek_r1|qwen3|granite|gpt_oss)")
+    ap.add_argument("--tool-call-parser", default="",
+                    help="extract tool calls (hermes|mistral|json|pythonic)")
     ap.add_argument("--log-level", default="info")
     args = ap.parse_args()
+    # fail fast on typo'd parser names (otherwise every request 500s)
+    from ..parsers import get_reasoning_parser, get_tool_parser
+
+    try:
+        get_reasoning_parser(args.reasoning_parser)
+        get_tool_parser(args.tool_call_parser)
+    except ValueError as e:
+        ap.error(str(e))
     if args.kvbm and getattr(args, "mock", False):
         ap.error("--kvbm requires a real JAX engine (incompatible with --mock)")
     logging.basicConfig(level=args.log_level.upper(),
@@ -178,6 +191,8 @@ def _build_engine(args):
             eos_token_ids=[margs.eos_token_id],
             context_length=args.max_model_len,
             disagg_role=args.disagg_role,
+            reasoning_parser=args.reasoning_parser,
+            tool_call_parser=args.tool_call_parser,
         )
         return engine, mdc
 
@@ -217,6 +232,8 @@ def _build_engine(args):
         eos_token_ids=eos,
         context_length=args.max_model_len,
         disagg_role=args.disagg_role,
+        reasoning_parser=args.reasoning_parser,
+        tool_call_parser=args.tool_call_parser,
     )
     return engine, mdc
 

@@ -1,0 +1,295 @@
+"""Reasoning + tool-call parsers: streaming correctness at hostile chunk
+boundaries (markers split across deltas), all registered formats."""
+
+import json
+
+import pytest
+
+from dynamo_tpu.parsers import (
+    get_reasoning_parser,
+    get_tool_parser,
+    reasoning_parser_names,
+    tool_parser_names,
+)
+
+
+def drive_reasoning(parser, text, chunk=3):
+    """Feed text in fixed-size chunks; return (content, reasoning)."""
+    content, reasoning = [], []
+    for i in range(0, len(text), chunk):
+        d = parser.push(text[i:i + chunk])
+        content.append(d.content)
+        reasoning.append(d.reasoning)
+    d = parser.finish()
+    content.append(d.content)
+    reasoning.append(d.reasoning)
+    return "".join(content), "".join(reasoning)
+
+
+def drive_tools(parser, text, chunk=3):
+    content, calls = [], []
+    for i in range(0, len(text), chunk):
+        d = parser.push(text[i:i + chunk])
+        content.append(d.content)
+        calls.extend(d.tool_calls)
+    d = parser.finish()
+    content.append(d.content)
+    calls.extend(d.tool_calls)
+    return "".join(content), calls
+
+
+# --------------------------------------------------------------------------- #
+# reasoning
+# --------------------------------------------------------------------------- #
+
+
+@pytest.mark.parametrize("chunk", [1, 3, 7, 1000])
+def test_qwen3_think_tags(chunk):
+    p = get_reasoning_parser("qwen3")
+    c, r = drive_reasoning(p, "<think>step A; step B</think>The answer is 4.", chunk)
+    assert r == "step A; step B"
+    assert c == "The answer is 4."
+
+
+@pytest.mark.parametrize("chunk", [1, 4, 1000])
+def test_deepseek_r1_implicit_start(chunk):
+    # R1 chat templates open the think block in the prompt
+    p = get_reasoning_parser("deepseek_r1")
+    c, r = drive_reasoning(p, "let me think...</think>42", chunk)
+    assert r == "let me think..."
+    assert c == "42"
+
+
+def test_reasoning_never_closed_goes_to_reasoning():
+    p = get_reasoning_parser("qwen3")
+    c, r = drive_reasoning(p, "<think>endless pondering")
+    assert r == "endless pondering" and c == ""
+
+
+def test_granite_markers():
+    p = get_reasoning_parser("granite")
+    text = ("Here is my thought process: consider both cases. "
+            "Here is my response: it is case one.")
+    c, r = drive_reasoning(p, text, 5)
+    assert "consider both cases" in r
+    assert c.startswith("it is case one")
+
+
+@pytest.mark.parametrize("chunk", [1, 6, 1000])
+def test_harmony_channels(chunk):
+    p = get_reasoning_parser("gpt_oss")
+    text = ("<|channel|>analysis<|message|>weigh the options<|end|>"
+            "<|channel|>final<|message|>Option B.")
+    c, r = drive_reasoning(p, text, chunk)
+    assert r == "weigh the options"
+    assert c == "Option B."
+
+
+def test_unknown_reasoning_parser_rejected():
+    with pytest.raises(ValueError, match="unknown reasoning parser"):
+        get_reasoning_parser("nope")
+    assert "deepseek_r1" in reasoning_parser_names()
+
+
+def test_passthrough_reasoning():
+    p = get_reasoning_parser("")
+    c, r = drive_reasoning(p, "plain text")
+    assert c == "plain text" and r == ""
+
+
+# --------------------------------------------------------------------------- #
+# tool calling
+# --------------------------------------------------------------------------- #
+
+
+@pytest.mark.parametrize("chunk", [1, 5, 1000])
+def test_hermes_tool_call(chunk):
+    p = get_tool_parser("hermes")
+    text = ('I will check.<tool_call>{"name": "get_weather", '
+            '"arguments": {"city": "SF"}}</tool_call>')
+    c, calls = drive_tools(p, text, chunk)
+    assert c == "I will check."
+    assert len(calls) == 1
+    assert calls[0].name == "get_weather"
+    assert json.loads(calls[0].arguments) == {"city": "SF"}
+    assert calls[0].id.startswith("call_")
+
+
+def test_hermes_multiple_calls_and_malformed():
+    p = get_tool_parser("hermes")
+    text = ('<tool_call>{"name": "a", "arguments": {}}</tool_call>'
+            '<tool_call>not json</tool_call>'
+            '<tool_call>{"name": "b", "arguments": {"x": 1}}</tool_call>')
+    c, calls = drive_tools(p, text, 4)
+    assert [t.name for t in calls] == ["a", "b"]
+    assert "not json" in c  # malformed body released verbatim
+
+
+def test_hermes_unterminated_but_complete_json():
+    p = get_tool_parser("hermes")
+    c, calls = drive_tools(p, '<tool_call>{"name": "f", "arguments": {}}')
+    assert len(calls) == 1 and calls[0].name == "f"
+
+
+def test_mistral_array():
+    p = get_tool_parser("mistral")
+    text = '[TOOL_CALLS][{"name": "f", "arguments": {"a": 1}}, {"name": "g", "arguments": {}}]'
+    c, calls = drive_tools(p, text, 7)
+    assert c == ""
+    assert [t.name for t in calls] == ["f", "g"]
+
+
+def test_json_whole_message():
+    p = get_tool_parser("json")
+    c, calls = drive_tools(p, '{"name": "lookup", "parameters": {"q": "x"}}', 6)
+    assert c == ""
+    assert calls[0].name == "lookup"
+    assert json.loads(calls[0].arguments) == {"q": "x"}
+
+
+def test_json_python_tag_prefix():
+    p = get_tool_parser("json")
+    c, calls = drive_tools(p, '<|python_tag|>{"name": "f", "arguments": {}}', 5)
+    assert calls and calls[0].name == "f"
+
+
+def test_json_plain_text_streams_through():
+    p = get_tool_parser("json")
+    pieces = []
+    for frag in ("hello ", "world"):
+        pieces.append(p.push(frag).content)
+    d = p.finish()
+    pieces.append(d.content)
+    assert "".join(pieces) == "hello world"
+    assert not d.tool_calls
+    # plain text must NOT be withheld until finish
+    assert pieces[0] == "hello "
+
+
+@pytest.mark.parametrize("chunk", [1, 4, 1000])
+def test_pythonic_calls(chunk):
+    p = get_tool_parser("pythonic")
+    c, calls = drive_tools(p, '[get_weather(city="SF", units="C"), ping()]', chunk)
+    assert c == ""
+    assert [t.name for t in calls] == ["get_weather", "ping"]
+    assert json.loads(calls[0].arguments) == {"city": "SF", "units": "C"}
+
+
+def test_pythonic_non_call_text():
+    p = get_tool_parser("pythonic")
+    c, calls = drive_tools(p, "just words, no brackets")
+    assert c == "just words, no brackets" and not calls
+
+
+def test_unknown_tool_parser_rejected():
+    with pytest.raises(ValueError, match="unknown tool parser"):
+        get_tool_parser("nope")
+    assert set(tool_parser_names()) >= {"hermes", "mistral", "json", "pythonic"}
+
+
+# --------------------------------------------------------------------------- #
+# e2e: parsers wired through the HTTP stack (scripted engine)
+# --------------------------------------------------------------------------- #
+
+
+SCRIPT = ('<think>plan carefully</think>Sure! <tool_call>'
+          '{"name": "get_weather", "arguments": {"city": "SF"}}</tool_call>')
+
+
+class _ScriptedEngine:
+    """AsyncEngine emitting a fixed token script one token at a time."""
+
+    def __init__(self, ids):
+        self.ids = ids
+
+    async def generate(self, request, context=None):
+        for i, t in enumerate(self.ids):
+            last = i == len(self.ids) - 1
+            yield {"token_ids": [t], "finish_reason": "stop" if last else None}
+
+    def metrics(self):
+        from dynamo_tpu.engine.engine import ForwardPassMetrics
+
+        return ForwardPassMetrics()
+
+
+async def test_parsers_through_http_stack():
+    import aiohttp
+
+    from dynamo_tpu.frontend import HttpService, ModelManager, ModelWatcher
+    from dynamo_tpu.llm import ModelDeploymentCard
+    from dynamo_tpu.runtime import ControlPlaneServer, DistributedRuntime
+    from dynamo_tpu.testing import tiny_tokenizer
+    from dynamo_tpu.worker import serve_engine
+
+    tok = tiny_tokenizer()
+    ids = tok.encode(SCRIPT)
+    assert tok.decode(ids) == SCRIPT  # markers survive the round-trip
+
+    control = await ControlPlaneServer().start()
+    worker_rt = await DistributedRuntime.connect(control.address)
+    mdc = ModelDeploymentCard(
+        name="scripted",
+        tokenizer_json=tok.to_json_str(),
+        eos_token_ids=[],
+        reasoning_parser="qwen3",
+        tool_call_parser="hermes",
+    )
+    await serve_engine(worker_rt, _ScriptedEngine(ids), mdc,
+                       publish_kv_events=False)
+    front_rt = await DistributedRuntime.connect(control.address)
+    manager = ModelManager()
+    watcher = await ModelWatcher(front_rt, manager).start()
+    await watcher.wait_for_model("scripted")
+    http = await HttpService(manager, host="127.0.0.1", port=0).start()
+    base = f"http://127.0.0.1:{http.port}"
+    body = {
+        "model": "scripted",
+        "messages": [{"role": "user", "content": "hi"}],
+        "max_tokens": 128,
+    }
+    try:
+        async with aiohttp.ClientSession() as session:
+            # unary: reasoning_content + tool_calls + finish_reason mapping
+            async with session.post(f"{base}/v1/chat/completions", json=body) as r:
+                assert r.status == 200
+                data = await r.json()
+            msg = data["choices"][0]["message"]
+            assert msg["reasoning_content"] == "plan carefully"
+            assert msg["content"] == "Sure! "
+            (call,) = msg["tool_calls"]
+            assert call["function"]["name"] == "get_weather"
+            assert json.loads(call["function"]["arguments"]) == {"city": "SF"}
+            assert data["choices"][0]["finish_reason"] == "tool_calls"
+
+            # streaming: deltas carry the split fields; markers never leak
+            async with session.post(
+                f"{base}/v1/chat/completions", json={**body, "stream": True}
+            ) as r:
+                assert r.status == 200
+                content, reasoning, calls, finish = "", "", [], None
+                async for raw in r.content:
+                    line = raw.decode().strip()
+                    if not line.startswith("data: ") or line == "data: [DONE]":
+                        continue
+                    c = json.loads(line[6:])
+                    if "choices" not in c:
+                        continue
+                    ch = c["choices"][0]
+                    delta = ch.get("delta", {})
+                    content += delta.get("content", "")
+                    reasoning += delta.get("reasoning_content", "")
+                    calls += delta.get("tool_calls", [])
+                    finish = ch.get("finish_reason") or finish
+            assert reasoning == "plan carefully"
+            assert content == "Sure! "
+            assert "<think>" not in content and "<tool_call>" not in content
+            assert len(calls) == 1
+            assert calls[0]["function"]["name"] == "get_weather"
+            assert finish == "tool_calls"
+    finally:
+        await http.stop()
+        await watcher.stop()
+        await front_rt.shutdown(graceful=False)
+        await worker_rt.shutdown(graceful=False)
+        await control.stop()
