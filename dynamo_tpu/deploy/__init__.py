@@ -1,0 +1,14 @@
+"""Deployment: declarative component graphs rendered to local processes or
+Kubernetes manifests (the reference's operator/CRD layer, redesigned as a
+renderer + launcher)."""
+
+from .graph import ComponentSpec, GraphSpec, LocalLauncher, format_commands
+from .k8s import render_manifests
+
+__all__ = [
+    "ComponentSpec",
+    "GraphSpec",
+    "LocalLauncher",
+    "format_commands",
+    "render_manifests",
+]

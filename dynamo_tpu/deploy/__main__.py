@@ -1,0 +1,59 @@
+"""`python -m dynamo_tpu.deploy --config graph.yaml` — launch a declarative
+deployment graph as local processes (or render what would run)."""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+import time
+
+from .graph import GraphSpec, LocalLauncher, format_commands
+
+logger = logging.getLogger(__name__)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser("dynamo_tpu.deploy")
+    ap.add_argument("--config", required=True, help="graph YAML path")
+    ap.add_argument("--control", default="",
+                    help="join an existing control plane instead of "
+                         "launching one")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="print the rendered commands and exit")
+    ap.add_argument("--render", choices=["local", "k8s"], default="local")
+    ap.add_argument("--log-level", default="info")
+    args = ap.parse_args()
+    logging.basicConfig(level=args.log_level.upper())
+
+    spec = GraphSpec.load(args.config)
+    if args.render == "k8s":
+        from .k8s import render_manifests
+
+        sys.stdout.write(render_manifests(spec))
+        return
+    if args.dry_run:
+        print(format_commands(spec, args.control))
+        return
+
+    launcher = LocalLauncher(spec, control=args.control)
+    control = launcher.start()
+    print(f"READY deploy control={control} "
+          f"processes={len(launcher.procs)}", flush=True)
+    stopping = []
+    signal.signal(signal.SIGINT, lambda *_: stopping.append(1))
+    signal.signal(signal.SIGTERM, lambda *_: stopping.append(1))
+    try:
+        while not stopping:
+            time.sleep(0.5)
+            dead = launcher.poll()
+            if dead:
+                logger.error("processes exited: %s — shutting down", dead)
+                break
+    finally:
+        launcher.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,185 @@
+"""Declarative deployment graphs: a YAML spec naming the components of a
+deployment (frontend, workers, routers, planner), rendered either to local
+subprocess commands or to Kubernetes manifests.
+
+The spec mirrors the reference's `DynamoGraphDeployment` CRD
+(/root/reference/deploy/cloud/operator/api/v1alpha1/
+dynamographdeployment_types.go:31 — a graph of services with per-service
+replicas/resources), flattened to what the TPU stack needs:
+
+```yaml
+namespace: dynamo
+control_plane: {}            # omit to join an existing one via --control
+components:
+  frontend:
+    kind: frontend           # frontend | worker | router | planner
+    replicas: 1
+    args: {port: 8000, router-mode: kv}
+  decode:
+    kind: worker
+    replicas: 2
+    args: {model: tiny, disagg-role: decode, page-size: 16}
+  prefill:
+    kind: worker
+    args: {model: tiny, disagg-role: prefill}
+  prefill-router:
+    kind: router
+    args: {target-component: prefill}
+```
+"""
+
+from __future__ import annotations
+
+import shlex
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import yaml
+
+_KIND_MODULE = {
+    "frontend": "dynamo_tpu.frontend",
+    "worker": "dynamo_tpu.worker",
+    "router": "dynamo_tpu.router",
+    "planner": "dynamo_tpu.planner",
+}
+
+
+@dataclass
+class ComponentSpec:
+    name: str
+    kind: str
+    replicas: int = 1
+    args: Dict[str, Any] = field(default_factory=dict)
+
+    def command(self, control: str) -> List[str]:
+        """The process argv for one replica (reference: per-service pod
+        command in DynamoComponentDeployment)."""
+        if self.kind not in _KIND_MODULE:
+            raise ValueError(
+                f"component {self.name!r}: unknown kind {self.kind!r} "
+                f"(known: {sorted(_KIND_MODULE)})"
+            )
+        argv = [sys.executable, "-m", _KIND_MODULE[self.kind],
+                "--control", control]
+        for key, value in self.args.items():
+            flag = "--" + str(key).replace("_", "-")
+            if value is True:
+                argv.append(flag)
+            elif value is False or value is None:
+                continue
+            else:
+                argv += [flag, str(value)]
+        return argv
+
+
+@dataclass
+class GraphSpec:
+    namespace: str = "dynamo"
+    control_plane: Optional[Dict[str, Any]] = None  # {} = launch one
+    components: List[ComponentSpec] = field(default_factory=list)
+
+    @classmethod
+    def parse(cls, text: str) -> "GraphSpec":
+        d = yaml.safe_load(text) or {}
+        comps = []
+        raw = d.get("components") or {}
+        if isinstance(raw, list):  # list form: entries carry their name
+            raw = {c.pop("name"): c for c in raw}
+        for name, c in raw.items():
+            comps.append(ComponentSpec(
+                name=name,
+                kind=c.get("kind", "worker"),
+                replicas=int(c.get("replicas", 1)),
+                args=dict(c.get("args") or {}),
+            ))
+        if not comps:
+            raise ValueError("deployment graph has no components")
+        return cls(
+            namespace=d.get("namespace", "dynamo"),
+            control_plane=d.get("control_plane"),
+            components=comps,
+        )
+
+    @classmethod
+    def load(cls, path: str) -> "GraphSpec":
+        with open(path) as f:
+            return cls.parse(f.read())
+
+    def render_local(self, control: str) -> List[List[str]]:
+        """Flat list of argvs, replicas expanded, namespace injected."""
+        out = []
+        for comp in self.components:
+            argv = comp.command(control)
+            if "--namespace" not in argv:
+                argv += ["--namespace", self.namespace]
+            for _ in range(comp.replicas):
+                out.append(list(argv))
+        return out
+
+
+class LocalLauncher:
+    """Realize a graph as local OS processes (the non-k8s deploy path —
+    the reference's launch scripts / LocalProcessConnector role)."""
+
+    def __init__(self, spec: GraphSpec, control: str = ""):
+        self.spec = spec
+        self.control = control
+        self.procs: List[subprocess.Popen] = []
+        self._control_proc: Optional[subprocess.Popen] = None
+
+    def start(self, stdout=None) -> str:
+        """Launch everything; returns the control-plane address."""
+        if not self.control:
+            if self.spec.control_plane is None:
+                raise ValueError(
+                    "graph has no control_plane section and no --control "
+                    "address was given"
+                )
+            import socket
+
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+            s.close()
+            self._control_proc = subprocess.Popen(
+                [sys.executable, "-m", "dynamo_tpu.runtime",
+                 "--host", "127.0.0.1", "--port", str(port)],
+                stdout=stdout, stderr=subprocess.STDOUT,
+            )
+            self.control = f"127.0.0.1:{port}"
+            time.sleep(0.5)  # the control plane binds quickly
+        for argv in self.spec.render_local(self.control):
+            self.procs.append(
+                subprocess.Popen(argv, stdout=stdout, stderr=subprocess.STDOUT)
+            )
+        return self.control
+
+    def poll(self) -> Dict[str, int]:
+        """pid → returncode for exited processes."""
+        return {
+            p.pid: p.returncode
+            for p in self.procs
+            if p.poll() is not None
+        }
+
+    def stop(self, timeout: float = 10.0) -> None:
+        import signal as _signal
+
+        for p in self.procs + ([self._control_proc] if self._control_proc else []):
+            if p.poll() is None:
+                p.send_signal(_signal.SIGTERM)
+        deadline = time.time() + timeout
+        for p in self.procs + ([self._control_proc] if self._control_proc else []):
+            while p.poll() is None and time.time() < deadline:
+                time.sleep(0.1)
+            if p.poll() is None:
+                p.kill()
+
+
+def format_commands(spec: GraphSpec, control: str) -> str:
+    return "\n".join(
+        shlex.join(argv) for argv in spec.render_local(control or "<control>")
+    )

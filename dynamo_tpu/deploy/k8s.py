@@ -1,0 +1,142 @@
+"""Render a deployment graph to Kubernetes manifests.
+
+The operator-less counterpart of the reference's Go operator: where
+`DynamoGraphDeployment` is reconciled into per-component Deployments with
+etcd/NATS wiring (/root/reference/deploy/cloud/operator/internal/
+controller/dynamographdeployment_controller.go), this renders the same
+shapes statically — one Deployment+Service for the control plane, one
+Deployment per component with `--control` pointed at the control-plane
+Service, replicas from the spec, and TPU resource requests for workers
+(GKE `google.com/tpu`).  Output is plain YAML for `kubectl apply -f -`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import yaml
+
+from .graph import _KIND_MODULE, ComponentSpec, GraphSpec
+
+CONTROL_PORT = 7801
+DEFAULT_IMAGE = "dynamo-tpu:latest"
+
+
+def _meta(name: str, ns: str) -> Dict[str, Any]:
+    return {
+        "name": name,
+        "namespace": ns,
+        "labels": {"app.kubernetes.io/part-of": "dynamo-tpu",
+                   "dynamo.component": name},
+    }
+
+
+def _control_manifests(ns: str, image: str) -> List[Dict[str, Any]]:
+    labels = {"dynamo.component": "control-plane"}
+    return [
+        {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": _meta("control-plane", ns),
+            "spec": {
+                "replicas": 1,
+                "selector": {"matchLabels": labels},
+                "template": {
+                    "metadata": {"labels": labels},
+                    "spec": {"containers": [{
+                        "name": "control-plane",
+                        "image": image,
+                        "command": ["python", "-m", "dynamo_tpu.runtime",
+                                    "--host", "0.0.0.0",
+                                    "--port", str(CONTROL_PORT)],
+                        "ports": [{"containerPort": CONTROL_PORT}],
+                    }]},
+                },
+            },
+        },
+        {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": _meta("control-plane", ns),
+            "spec": {
+                "selector": labels,
+                "ports": [{"port": CONTROL_PORT,
+                           "targetPort": CONTROL_PORT}],
+            },
+        },
+    ]
+
+
+def _component_manifest(comp: ComponentSpec, ns: str, image: str,
+                        control: str) -> List[Dict[str, Any]]:
+    argv = ["python", "-m", _KIND_MODULE[comp.kind], "--control", control,
+            "--namespace", ns]
+    for key, value in comp.args.items():
+        flag = "--" + str(key).replace("_", "-")
+        if value is True:
+            argv.append(flag)
+        elif value is False or value is None:
+            continue
+        else:
+            argv += [flag, str(value)]
+    labels = {"dynamo.component": comp.name}
+    container: Dict[str, Any] = {
+        "name": comp.name,
+        "image": image,
+        "command": argv,
+    }
+    out: List[Dict[str, Any]] = []
+    if comp.kind == "worker":
+        # one chip per worker replica by default (GKE TPU scheduling);
+        # tpu_resources in args overrides
+        tpus = comp.args.get("tpu_resources", 1)
+        if tpus:
+            container["resources"] = {
+                "limits": {"google.com/tpu": str(tpus)},
+            }
+    if comp.kind == "frontend":
+        port = int(comp.args.get("port", 8000))
+        container["ports"] = [{"containerPort": port}]
+        out.append({
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": _meta(comp.name, ns),
+            "spec": {
+                "selector": labels,
+                "ports": [{"port": port, "targetPort": port}],
+            },
+        })
+    out.insert(0, {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": _meta(comp.name, ns),
+        "spec": {
+            "replicas": comp.replicas,
+            "selector": {"matchLabels": labels},
+            "template": {
+                "metadata": {"labels": labels},
+                "spec": {"containers": [container]},
+            },
+        },
+    })
+    return out
+
+
+def render_manifests(spec: GraphSpec, image: str = DEFAULT_IMAGE) -> str:
+    ns = spec.namespace
+    docs: List[Dict[str, Any]] = [{
+        "apiVersion": "v1",
+        "kind": "Namespace",
+        "metadata": {"name": ns},
+    }]
+    control = f"control-plane.{ns}.svc:{CONTROL_PORT}"
+    if spec.control_plane is not None:
+        docs += _control_manifests(ns, image)
+    for comp in spec.components:
+        # drop local-only knobs before rendering
+        comp = ComponentSpec(
+            name=comp.name, kind=comp.kind, replicas=comp.replicas,
+            args={k: v for k, v in comp.args.items()},
+        )
+        docs += _component_manifest(comp, ns, image, control)
+    return yaml.safe_dump_all(docs, sort_keys=False)

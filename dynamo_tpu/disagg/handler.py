@@ -17,6 +17,80 @@ logger = logging.getLogger(__name__)
 PREFILL_COMPONENT = "prefill"
 
 
+class RemoteRouterClient:
+    """Adapter giving the standalone router service (`python -m
+    dynamo_tpu.router`) the same choose/mark_finished face as an
+    in-process KvRouter (reference: the decode handler calling the
+    dynamo.router prefill-router service, vllm/handlers.py:183).
+
+    The router service is STATEFUL (per-request load tracking), so all
+    traffic pins to one instance; a failed instance triggers a re-pin."""
+
+    def __init__(self, runtime: DistributedRuntime, namespace: str = "dynamo",
+                 component: str = "router"):
+        ep = runtime.namespace(namespace).component(component).endpoint("generate")
+        self.client: Client = ep.client()
+        self._router_id: Optional[int] = None
+        self._fin_tasks: set = set()
+
+    async def _pin(self) -> int:
+        if self._router_id is None:
+            await self.client.start()
+            await self.client.wait_for_instances(timeout=5.0)
+            instances = self.client.instances()
+            if not instances:
+                raise ServiceUnavailable("no router instances")
+            self._router_id = instances[0].instance_id
+        return self._router_id
+
+    async def choose(self, request) -> int:
+        rid = await self._pin()
+        try:
+            async for out in self.client.direct(
+                {"op": "choose", "token_ids": request.get("token_ids", []),
+                 "request_id": request.get("request_id")},
+                rid, Context(),
+            ):
+                if "error" in out:
+                    raise ServiceUnavailable(out["error"])
+                wid = out.get("worker_id")
+                if wid is None:
+                    raise ServiceUnavailable(f"malformed router reply: {out}")
+                return wid
+        except (ServiceUnavailable, RemoteStreamError):
+            self._router_id = None  # re-pin next time
+            raise
+        raise ServiceUnavailable("router returned no decision")
+
+    def mark_finished(self, request_id: str) -> None:
+        rid = self._router_id
+        if rid is None:
+            return
+
+        async def _fin():
+            try:
+                async for _ in self.client.direct(
+                    {"op": "finished", "request_id": request_id}, rid, Context()
+                ):
+                    break
+            except Exception:  # noqa: BLE001 — load tracking is advisory
+                pass
+
+        import asyncio
+
+        # the loop holds tasks weakly — keep a strong ref until done
+        task = asyncio.ensure_future(_fin())
+        self._fin_tasks.add(task)
+        task.add_done_callback(self._fin_tasks.discard)
+
+    async def stop(self) -> None:
+        import asyncio
+
+        if self._fin_tasks:
+            await asyncio.gather(*list(self._fin_tasks), return_exceptions=True)
+        await self.client.stop()
+
+
 async def serve_prefill_worker(
     runtime: DistributedRuntime,
     engine: JaxEngine,
@@ -224,4 +298,6 @@ class DisaggDecodeHandler:
 
     async def shutdown(self):
         await self.prefill_client.stop()
+        if self.prefill_router is not None and hasattr(self.prefill_router, "stop"):
+            await self.prefill_router.stop()
         await self.engine.shutdown()

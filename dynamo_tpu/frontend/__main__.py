@@ -15,6 +15,10 @@ def main() -> None:
     ap = argparse.ArgumentParser(description="dynamo-tpu OpenAI frontend")
     ap.add_argument("--control", required=True, help="control plane host:port")
     ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--namespace", default="dynamo",
+                    help="accepted for graph-launcher symmetry; model cards "
+                         "carry their own namespace and the watcher follows "
+                         "all of them")
     ap.add_argument("--port", type=int, default=8000)
     ap.add_argument(
         "--router-mode",

@@ -1,0 +1,3 @@
+"""`python -m dynamo_tpu.run` — the unified in×out launcher
+(reference: launch/dynamo-run `in={http,text,dyn://,batch} out={...}`,
+/root/reference/launch/dynamo-run/src/main.rs:29)."""

@@ -46,6 +46,10 @@ def main() -> None:
     ap.add_argument("--status-port", type=int, default=0,
                     help="system status server port (0 = ephemeral, "
                          "-1 = disabled); serves /health /live /metrics")
+    ap.add_argument("--prefill-router", default="", metavar="COMPONENT",
+                    help="route remote prefills through a standalone "
+                         "router service registered at this component "
+                         "(decode role only)")
     ap.add_argument("--reasoning-parser", default="",
                     help="split reasoning_content from content "
                          "(deepseek_r1|qwen3|granite|gpt_oss)")
@@ -105,8 +109,16 @@ async def _run(args) -> None:
         await serve_prefill_worker(runtime, engine, mdc, namespace=args.namespace)
     elif args.disagg_role == "decode":
         from ..disagg import DisaggDecodeHandler
+        from ..disagg.handler import RemoteRouterClient
 
-        engine = DisaggDecodeHandler(engine, runtime, namespace=args.namespace)
+        prefill_router = (
+            RemoteRouterClient(runtime, args.namespace, args.prefill_router)
+            if args.prefill_router else None
+        )
+        engine = DisaggDecodeHandler(
+            engine, runtime, namespace=args.namespace,
+            prefill_router=prefill_router,
+        )
         await serve_engine(
             runtime, engine, mdc,
             namespace=args.namespace, component=args.component,

@@ -1,0 +1,159 @@
+"""Unified launcher (dynamo_tpu.run), deployment graphs, and the
+standalone router service."""
+
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import pytest
+import yaml
+
+from dynamo_tpu.deploy import GraphSpec, format_commands, render_manifests
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ENV = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": ROOT}
+
+GRAPH = """
+namespace: testns
+control_plane: {}
+components:
+  frontend:
+    kind: frontend
+    args: {port: 8123, router-mode: kv}
+  decode:
+    kind: worker
+    replicas: 2
+    args: {model: tiny, disagg-role: decode}
+  prefill-router:
+    kind: router
+    args: {target-component: prefill, no-kv-events: true}
+"""
+
+
+def test_graph_parse_and_render():
+    spec = GraphSpec.parse(GRAPH)
+    assert spec.namespace == "testns"
+    assert [c.name for c in spec.components] == [
+        "frontend", "decode", "prefill-router"
+    ]
+    cmds = spec.render_local("127.0.0.1:1234")
+    assert len(cmds) == 4  # decode has 2 replicas
+    assert all("--control" in c and "127.0.0.1:1234" in c for c in cmds)
+    assert all("--namespace" in c for c in cmds)
+    text = format_commands(spec, "127.0.0.1:1234")
+    assert "dynamo_tpu.router" in text and "--no-kv-events" in text
+
+
+def test_graph_rejects_unknown_kind():
+    with pytest.raises(ValueError, match="unknown kind"):
+        GraphSpec.parse(
+            "components:\n  x:\n    kind: nonsense\n"
+        ).render_local("a:1")
+    with pytest.raises(ValueError, match="no components"):
+        GraphSpec.parse("namespace: x\n")
+
+
+def test_k8s_render_shapes():
+    spec = GraphSpec.parse(GRAPH)
+    docs = list(yaml.safe_load_all(render_manifests(spec)))
+    kinds = [(d["kind"], d["metadata"]["name"]) for d in docs]
+    assert ("Namespace", "testns") in kinds
+    assert ("Deployment", "control-plane") in kinds
+    assert ("Service", "control-plane") in kinds
+    assert ("Deployment", "frontend") in kinds
+    assert ("Service", "frontend") in kinds  # frontend exposes its port
+    decode = next(d for d in docs if d["kind"] == "Deployment"
+                  and d["metadata"]["name"] == "decode")
+    assert decode["spec"]["replicas"] == 2
+    container = decode["spec"]["template"]["spec"]["containers"][0]
+    assert container["resources"]["limits"]["google.com/tpu"] == "1"
+    assert "--control" in container["command"]
+    assert "control-plane.testns.svc:7801" in container["command"]
+
+
+def test_run_batch_echo(tmp_path):
+    """`dynamo_tpu.run --in batch --out echo` end-to-end as a subprocess:
+    embedded control plane, echo engine, JSONL in/out."""
+    inp = tmp_path / "in.jsonl"
+    outp = tmp_path / "out.jsonl"
+    rows = [{"prompt": "hello roundtrip"}, {"prompt": "second line"}]
+    inp.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    r = subprocess.run(
+        [sys.executable, "-m", "dynamo_tpu.run",
+         "--in", "batch", "--out", "echo",
+         "--input-file", str(inp), "--output-file", str(outp),
+         "--max-tokens", "64"],
+        env=ENV, cwd=ROOT, capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    got = [json.loads(line) for line in outp.read_text().splitlines()]
+    assert len(got) == 2
+    # echo engine: the templated prompt (which embeds the user text) comes back
+    assert "hello roundtrip" in got[0]["response"]
+    assert "second line" in got[1]["response"]
+
+
+async def test_standalone_router_service():
+    """Mock workers registered at ns.prefill + `python -m dynamo_tpu.router`
+    subprocess routing over them; RemoteRouterClient round-trips."""
+    from dynamo_tpu.disagg.handler import RemoteRouterClient
+    from dynamo_tpu.llm import ModelDeploymentCard
+    from dynamo_tpu.mocker import MockEngine, MockEngineArgs
+    from dynamo_tpu.runtime import ControlPlaneServer, DistributedRuntime
+    from dynamo_tpu.testing import tiny_tokenizer
+    from dynamo_tpu.worker import serve_engine
+
+    control = await ControlPlaneServer().start()
+    rts, wids = [], []
+    tok = tiny_tokenizer()
+    for _ in range(2):
+        rt = await DistributedRuntime.connect(control.address)
+        served = await serve_engine(
+            rt, MockEngine(MockEngineArgs()), ModelDeploymentCard(
+                name="mock", tokenizer_json=tok.to_json_str(),
+            ),
+            component="prefill", publish_kv_events=False,
+        )
+        rts.append(rt)
+        wids.append(served.instance.instance_id)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dynamo_tpu.router",
+         "--control", control.address, "--no-kv-events"],
+        env=ENV, cwd=ROOT, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+    try:
+        # wait for READY
+        loop = asyncio.get_running_loop()
+        line = await asyncio.wait_for(
+            loop.run_in_executor(None, proc.stdout.readline), 60
+        )
+        while "READY" not in line:
+            line = await asyncio.wait_for(
+                loop.run_in_executor(None, proc.stdout.readline), 60
+            )
+        client_rt = await DistributedRuntime.connect(control.address)
+        rrc = RemoteRouterClient(client_rt)
+        picks = set()
+        for i in range(6):
+            wid = await rrc.choose(
+                {"token_ids": list(range(16 * (i + 1))),
+                 "request_id": f"r{i}"}
+            )
+            assert wid in wids
+            picks.add(wid)
+            rrc.mark_finished(f"r{i}")
+        assert picks  # routed to real instances
+        await client_rt.shutdown(graceful=False)
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        for rt in rts:
+            await rt.shutdown(graceful=False)
+        await control.stop()
