@@ -13,7 +13,10 @@ import signal
 
 def main() -> None:
     ap = argparse.ArgumentParser(description="dynamo-tpu OpenAI frontend")
-    ap.add_argument("--control", required=True, help="control plane host:port")
+    from ..runtime.config import RuntimeConfig
+
+    _env_control = RuntimeConfig.from_env().control
+    ap.add_argument("--control", required=not _env_control, default=_env_control, help="control plane host:port")
     ap.add_argument("--host", default="0.0.0.0")
     ap.add_argument("--namespace", default="dynamo",
                     help="accepted for graph-launcher symmetry; model cards "
@@ -29,10 +32,12 @@ def main() -> None:
                     help="separate system status server port (0 = ephemeral,"
                          " -1 = disabled; the main port already serves "
                          "/health /live /metrics)")
-    ap.add_argument("--log-level", default="info")
+    ap.add_argument("--log-level", default="")
+    ap.add_argument("--log-jsonl", action="store_true", default=None)
     args = ap.parse_args()
-    logging.basicConfig(level=args.log_level.upper(),
-                        format="%(asctime)s %(levelname)s %(name)s %(message)s")
+    from ..runtime.tracing import setup_logging
+
+    setup_logging(args.log_level, args.log_jsonl)
     asyncio.run(_run(args))
 
 

@@ -102,11 +102,16 @@ class _ChoiceParsers:
 
 class HttpService:
     def __init__(self, manager: ModelManager, host: str = "0.0.0.0",
-                 port: int = 8000, metrics: Optional[FrontendMetrics] = None):
+                 port: int = 8000, metrics: Optional[FrontendMetrics] = None,
+                 audit=None):
+        from ..llm.audit import AuditBus
+
         self.manager = manager
         self.host = host
         self.port = port
         self.metrics = metrics or FrontendMetrics()
+        # request/response audit bus (DYN_AUDIT_SINK or explicit)
+        self.audit = audit if audit is not None else AuditBus.from_env()
         self.app = web.Application()
         self.app.add_routes(
             [
@@ -334,6 +339,11 @@ class HttpService:
 
     async def _serve(self, request: web.Request, kind: str) -> web.StreamResponse:
         t0 = time.monotonic()
+        # every HTTP request gets a trace; x-request-id joins an existing
+        # one (propagated to workers via wire-frame headers)
+        from ..runtime.tracing import new_trace, set_trace
+
+        set_trace(new_trace(request.headers.get("x-request-id")))
         try:
             body = await request.json()
         except json.JSONDecodeError:
@@ -350,14 +360,16 @@ class HttpService:
             return _error_response(
                 400, f"model '{model_name}' does not support {required}"
             )
+        from ..runtime.compute import run_compute
+
         try:
             if kind == "chat":
-                preprocessed = await asyncio.get_running_loop().run_in_executor(
-                    None, entry.preprocessor.preprocess_chat, body
+                preprocessed = await run_compute(
+                    entry.preprocessor.preprocess_chat, body
                 )
             else:
-                preprocessed = await asyncio.get_running_loop().run_in_executor(
-                    None, entry.preprocessor.preprocess_completion, body
+                preprocessed = await run_compute(
+                    entry.preprocessor.preprocess_completion, body
                 )
         except RequestError as e:
             self.metrics.requests.labels(model_name, kind, "400").inc()
@@ -366,6 +378,8 @@ class HttpService:
         n = preprocessed["sampling_options"].get("n", 1)
         rid = ("chatcmpl-" if kind == "chat" else "cmpl-") + uuid.uuid4().hex[:24]
         streaming = bool(body.get("stream", False))
+        if self.audit is not None:
+            self.audit.request(rid, model_name, kind, body)
         self.metrics.inflight.labels(model_name).inc()
         try:
             if streaming:
@@ -481,6 +495,8 @@ class HttpService:
             logger.info("client disconnected; killing %d choice(s)", n)
             for ctx in contexts:
                 ctx.kill()
+            if self.audit is not None:
+                self.audit.response(rid, model_name, kind, "disconnected")
             raise
         finally:
             for t in tasks:
@@ -488,6 +504,11 @@ class HttpService:
         self.metrics.requests.labels(model_name, kind, status).inc()
         self.metrics.output_tokens.labels(model_name).inc(ntokens)
         self.metrics.duration.labels(model_name).observe(time.monotonic() - t0)
+        if self.audit is not None:
+            self.audit.response(
+                rid, model_name, kind, status,
+                usage={"completion_tokens": ntokens},
+            )
         await resp.write_eof()
         return resp
 
@@ -537,10 +558,14 @@ class HttpService:
             await asyncio.gather(*tasks, return_exceptions=True)
             status = "503" if isinstance(e, ServiceUnavailable) else "502"
             self.metrics.requests.labels(model_name, kind, status).inc()
+            if self.audit is not None:
+                self.audit.response(rid, model_name, kind, status)
             return _error_response(int(status), str(e))
         for r in results:
             if r.get("error"):
                 self.metrics.requests.labels(model_name, kind, "500").inc()
+                if self.audit is not None:
+                    self.audit.response(rid, model_name, kind, "500")
                 return _error_response(500, r["error"])
         created = int(time.time())
         prompt_tokens = len(preprocessed.get("token_ids", []))
@@ -599,6 +624,11 @@ class HttpService:
         self.metrics.requests.labels(model_name, kind, "200").inc()
         self.metrics.output_tokens.labels(model_name).inc(token_count)
         self.metrics.duration.labels(model_name).observe(time.monotonic() - t0)
+        if self.audit is not None:
+            self.audit.response(
+                rid, model_name, kind, "200", usage=usage,
+                finish_reasons=[c.get("finish_reason") for c in choices],
+            )
         return web.json_response(payload)
 
 

@@ -1,0 +1,170 @@
+"""Request/response audit bus (reference: lib/llm/src/audit/{bus,config,
+handle,sink,stream}.rs — a config-driven bus with pluggable sinks that
+records what was asked and what was answered).
+
+Enabled via `DYN_AUDIT_SINK` (e.g. ``file:/var/log/dynamo/audit.jsonl``
+or ``logger:``) or programmatically with `AuditBus(sinks=[...])`.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class AuditRecord:
+    kind: str  # "request" | "response"
+    rid: str
+    model: str
+    endpoint: str  # chat | completions | embeddings | responses
+    ts: float = field(default_factory=time.time)
+    trace_id: Optional[str] = None
+    payload: Dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "ts": round(self.ts, 6),
+            "kind": self.kind,
+            "rid": self.rid,
+            "model": self.model,
+            "endpoint": self.endpoint,
+            "trace_id": self.trace_id,
+            **self.payload,
+        }
+
+
+class JsonlFileSink:
+    """Append-only JSONL file, written by a dedicated daemon thread so a
+    slow filesystem never stalls the event loop emitting the records."""
+
+    def __init__(self, path: str):
+        import queue
+
+        self.path = path
+        self._fh = open(path, "a", buffering=1)
+        self._q: "queue.Queue" = queue.Queue()
+        self._thread = threading.Thread(
+            target=self._writer, name="audit-writer", daemon=True
+        )
+        self._thread.start()
+
+    def _writer(self) -> None:
+        while True:
+            line = self._q.get()
+            if line is None:
+                break
+            try:
+                self._fh.write(line + "\n")
+            except (OSError, ValueError):
+                pass
+
+    def emit(self, record: AuditRecord) -> None:
+        self._q.put(json.dumps(record.to_dict(), ensure_ascii=False))
+
+    def close(self) -> None:
+        self._q.put(None)
+        self._thread.join(5)
+        self._fh.close()
+
+
+class LoggerSink:
+    def emit(self, record: AuditRecord) -> None:
+        logger.info("audit %s", json.dumps(record.to_dict(), ensure_ascii=False))
+
+    def close(self) -> None:
+        pass
+
+
+class CallbackSink:
+    def __init__(self, fn: Callable[[AuditRecord], None]):
+        self.fn = fn
+
+    def emit(self, record: AuditRecord) -> None:
+        self.fn(record)
+
+    def close(self) -> None:
+        pass
+
+
+def sink_from_spec(spec: str):
+    """"file:/path" → JsonlFileSink, "logger:" → LoggerSink."""
+    if not spec:
+        return None
+    scheme, _, rest = spec.partition(":")
+    if scheme == "file":
+        return JsonlFileSink(rest)
+    if scheme == "logger":
+        return LoggerSink()
+    raise ValueError(f"unknown audit sink spec {spec!r}")
+
+
+class AuditBus:
+    """Fan-out to sinks; failures in one sink never break the request
+    path (audit is observability, not control)."""
+
+    def __init__(self, sinks: Optional[List] = None):
+        self.sinks = list(sinks or [])
+
+    @classmethod
+    def from_env(cls) -> Optional["AuditBus"]:
+        from ..runtime.config import RuntimeConfig
+
+        spec = RuntimeConfig.from_env().audit_sink
+        sink = sink_from_spec(spec)
+        return cls([sink]) if sink else None
+
+    def emit(self, record: AuditRecord) -> None:
+        for sink in self.sinks:
+            try:
+                sink.emit(record)
+            except Exception:  # noqa: BLE001
+                logger.exception("audit sink failed")
+
+    def request(self, rid: str, model: str, endpoint: str,
+                body: Dict[str, Any]) -> None:
+        from ..runtime.tracing import current_trace
+
+        ctx = current_trace()
+        self.emit(AuditRecord(
+            kind="request", rid=rid, model=model, endpoint=endpoint,
+            trace_id=ctx.trace_id if ctx else None,
+            payload={"request": _scrub(body)},
+        ))
+
+    def response(self, rid: str, model: str, endpoint: str,
+                 status: str, usage: Optional[Dict[str, Any]] = None,
+                 finish_reasons: Optional[List[str]] = None) -> None:
+        from ..runtime.tracing import current_trace
+
+        ctx = current_trace()
+        self.emit(AuditRecord(
+            kind="response", rid=rid, model=model, endpoint=endpoint,
+            trace_id=ctx.trace_id if ctx else None,
+            payload={"status": status, "usage": usage or {},
+                     "finish_reasons": finish_reasons or []},
+        ))
+
+    def close(self) -> None:
+        for sink in self.sinks:
+            try:
+                sink.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+
+def _scrub(body: Dict[str, Any]) -> Dict[str, Any]:
+    """Drop bulky/opaque fields; keep what reconstructs the ask."""
+    keep = {}
+    for k, v in body.items():
+        if k in ("messages", "prompt", "input", "tools"):
+            keep[k] = v
+        elif isinstance(v, (int, float, bool, str)) or v is None:
+            keep[k] = v
+    return keep

@@ -1,0 +1,83 @@
+"""Model resolution: name/path → local checkpoint directory.
+
+Reference: lib/llm/src/hub.rs:19 `from_hf` — try the model-express cache
+service, fall back to direct HF-hub download.  TPU-native chain:
+
+1. an existing directory path is used as-is;
+2. ``DYN_MODEL_CACHE/<org--name>`` (the deployment's shared cache dir);
+3. ``huggingface_hub.snapshot_download`` when the library is importable
+   and the environment has egress (gated — zero-egress deployments get a
+   clear error instead of a hang).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_REQUIRED = ("config.json",)
+
+
+def _is_checkpoint_dir(path: str) -> bool:
+    return os.path.isdir(path) and all(
+        os.path.exists(os.path.join(path, f)) for f in _REQUIRED
+    )
+
+
+def cache_dir() -> Optional[str]:
+    from ..runtime.config import RuntimeConfig
+
+    return RuntimeConfig.from_env().model_cache or None
+
+
+def resolve_model(name_or_path: str, allow_download: bool = True) -> str:
+    """Return a local checkpoint directory for `name_or_path` or raise
+    FileNotFoundError with the full chain that was tried."""
+    tried = []
+    if _is_checkpoint_dir(name_or_path):
+        return name_or_path
+    tried.append(name_or_path)
+
+    cache = cache_dir()
+    if cache:
+        slug = name_or_path.replace("/", "--")
+        cached = os.path.join(cache, slug)
+        if _is_checkpoint_dir(cached):
+            return cached
+        tried.append(cached)
+
+    if allow_download and "/" in name_or_path:
+        local = _try_hub_download(name_or_path, cache)
+        if local:
+            return local
+        tried.append(f"huggingface-hub:{name_or_path}")
+
+    raise FileNotFoundError(
+        f"model {name_or_path!r} not found; tried: {tried}. "
+        f"Set DYN_MODEL_CACHE to a directory of checkpoints, or pass a "
+        f"local path."
+    )
+
+
+def _try_hub_download(repo_id: str, cache: Optional[str]) -> Optional[str]:
+    try:
+        from huggingface_hub import snapshot_download
+    except ImportError:
+        logger.info("huggingface_hub not installed; skipping hub download")
+        return None
+    try:
+        target = None
+        if cache:
+            target = os.path.join(cache, repo_id.replace("/", "--"))
+        path = snapshot_download(
+            repo_id,
+            local_dir=target,
+            allow_patterns=["*.json", "*.safetensors", "tokenizer*"],
+        )
+        return path if _is_checkpoint_dir(path) else None
+    except Exception as e:  # noqa: BLE001 — offline/zero-egress envs
+        logger.warning("hub download of %s failed: %s", repo_id, e)
+        return None

@@ -1,0 +1,223 @@
+"""Profiling sweep harness: measure a worker's TTFT-vs-prefill-load and
+ITL-vs-concurrency curves and write the `PerfProfile` npz the planner
+sizes deployments from.
+
+Reference: the planner's pre-swept npz grids
+(/root/reference/components/src/dynamo/planner/utils/pre_swept_results/)
+produced by benchmark sweeps (docs/benchmarks/benchmarking.md: ISL/OSL +
+concurrency sweeps) — here the sweep is first-party and drives any
+AsyncEngine: the JaxEngine on a real chip, or the mock engine in CI.
+
+CLI: ``python -m dynamo_tpu.planner.profiler --out profile.npz
+[--model tiny|DIR] [--mock] [--isl 512] [--osl 64] ...``
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .perf_model import PerfProfile
+
+
+def _prompt(isl: int, salt: int, vocab: int = 1000) -> List[int]:
+    return [((salt * 131 + j * 7) % vocab) + 1 for j in range(isl)]
+
+
+@dataclass
+class SweepConfig:
+    isl: int = 512  # input sequence length (reference default 2000, scaled)
+    osl: int = 64  # output tokens for decode measurements
+    concurrencies: Sequence[int] = (1, 2, 4, 8)
+    # prefill offered-load points as fractions of measured serial capacity
+    load_fractions: Sequence[float] = (0.25, 0.5, 0.75, 0.9, 1.1)
+    prefill_window_s: float = 6.0  # open-loop window per load point
+    vocab: int = 1000
+
+
+async def _gen(engine, req, on_first=None):
+    t0 = time.perf_counter()
+    t_first = t_last = None
+    n = 0
+    async for out in engine.generate(req):
+        if out.get("finish_reason") == "error":
+            raise RuntimeError(out.get("error", "engine error"))
+        if out.get("token_ids"):
+            t_last = time.perf_counter()
+            if t_first is None:
+                t_first = t_last
+                if on_first:
+                    on_first(t_first - t0)
+            n += len(out["token_ids"])
+    return n, (t_first - t0 if t_first else 0.0), (t_last or t0) - (t_first or t0)
+
+
+def _req(tokens, max_tokens):
+    return {
+        "token_ids": tokens,
+        "sampling_options": {"temperature": 0.0},
+        "stop_conditions": {"max_tokens": max_tokens, "ignore_eos": True},
+    }
+
+
+async def sweep_decode(engine, cfg: SweepConfig):
+    """Closed-loop: c concurrent streams; per-point median ITL + aggregate
+    output throughput."""
+    conc, itls, thpts = [], [], []
+    for c in cfg.concurrencies:
+        async def one(i):
+            return await _gen(
+                engine, _req(_prompt(cfg.isl, i, cfg.vocab), cfg.osl)
+            )
+
+        # warmup pass: each concurrency point compiles its own batch
+        # bucket — measuring the compile would poison the curve
+        await asyncio.gather(*[one(i + c * 1000) for i in range(c)])
+        t0 = time.perf_counter()
+        rows = await asyncio.gather(*[one(i + c * 100) for i in range(c)])
+        dt = time.perf_counter() - t0
+        total = sum(r[0] for r in rows)
+        per_itl = sorted(
+            r[2] / max(r[0] - 1, 1) for r in rows
+        )
+        conc.append(float(c))
+        itls.append(per_itl[len(per_itl) // 2])
+        thpts.append(total / dt)
+    return conc, itls, thpts
+
+
+async def sweep_prefill(engine, cfg: SweepConfig):
+    """Open-loop: offer prompts at a fixed token rate for a window, record
+    median TTFT (max_tokens=1 → pure prefill)."""
+    # serial capacity estimate (warm the prefill buckets, then measure)
+    await _gen(engine, _req(_prompt(cfg.isl, 1, cfg.vocab), 1))
+    await _gen(engine, _req(_prompt(cfg.isl, 3, cfg.vocab), 1))
+    t0 = time.perf_counter()
+    await _gen(engine, _req(_prompt(cfg.isl, 2, cfg.vocab), 1))
+    serial_s = time.perf_counter() - t0
+    capacity = cfg.isl / max(serial_s, 1e-6)
+
+    loads, ttfts = [], []
+    for frac in cfg.load_fractions:
+        rate = capacity * frac  # tokens/s offered
+        interval = cfg.isl / rate
+        window_ttfts: List[float] = []
+        tasks = []
+        t_end = time.perf_counter() + cfg.prefill_window_s
+        salt = int(frac * 1000)
+        while time.perf_counter() < t_end:
+            salt += 1
+            req = _req(_prompt(cfg.isl, salt, cfg.vocab), 1)
+            tasks.append(asyncio.ensure_future(_gen(engine, req)))
+            await asyncio.sleep(interval)
+        rows = await asyncio.gather(*tasks)
+        window_ttfts = sorted(r[1] for r in rows)
+        loads.append(rate)
+        ttfts.append(window_ttfts[len(window_ttfts) // 2])
+    # interpolators need monotone x
+    order = np.argsort(loads)
+    return (
+        [loads[i] for i in order],
+        [ttfts[i] for i in order],
+    )
+
+
+async def sweep_engine(engine, cfg: Optional[SweepConfig] = None) -> PerfProfile:
+    cfg = cfg or SweepConfig()
+    conc, itls, thpts = await sweep_decode(engine, cfg)
+    loads, ttfts = await sweep_prefill(engine, cfg)
+    return PerfProfile(
+        prefill_load=loads, ttft_s=ttfts,
+        decode_concurrency=conc, itl_s=itls, decode_throughput=thpts,
+    )
+
+
+def _build_engine(args):
+    if args.mock:
+        from ..mocker import MockEngine, MockEngineArgs
+
+        return MockEngine(MockEngineArgs(
+            max_model_len=args.isl + args.osl + 16,
+            max_num_seqs=max(args.concurrency),
+        ))
+    import jax
+    import jax.numpy as jnp
+
+    from ..engine import EngineConfig, JaxEngine
+    from ..models import init_params, tiny_config
+    from ..models.config import LLAMA_3_2_1B
+    from ..models.loader import load_params
+
+    maxc = max(args.concurrency)
+    if args.model == "tiny":
+        cfg = tiny_config()
+        params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+        dtype = jnp.float32
+    elif args.model == "llama-1b":
+        cfg = LLAMA_3_2_1B
+        dtype = jnp.bfloat16
+        params = init_params(cfg, jax.random.PRNGKey(0), dtype=dtype)
+    else:
+        from ..llm import HuggingFaceTokenizer  # noqa: F401 — config check
+        from ..models import ModelConfig
+
+        cfg = ModelConfig.from_pretrained(args.model)
+        dtype = jnp.bfloat16
+        params = load_params(args.model, cfg, dtype=dtype)
+    pages = -(-(args.isl + args.osl) // 16) + 1
+    return JaxEngine(cfg, params, EngineConfig(
+        page_size=16,
+        num_pages=1 + (maxc + 2) * pages + 32,
+        max_num_seqs=maxc,
+        max_prefill_tokens=args.isl,
+        prefill_batch_size=4,
+        max_model_len=args.isl + args.osl + 16,
+        decode_steps=8,
+        enable_prefix_caching=False,
+    ), eos_token_ids=[], kv_dtype=dtype)
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser("dynamo_tpu.planner.profiler")
+    ap.add_argument("--out", required=True, help="output npz path")
+    ap.add_argument("--model", default="tiny",
+                    help="tiny | llama-1b | checkpoint dir")
+    ap.add_argument("--mock", action="store_true")
+    ap.add_argument("--isl", type=int, default=512)
+    ap.add_argument("--osl", type=int, default=64)
+    ap.add_argument("--concurrency", type=int, nargs="+",
+                    default=[1, 2, 4, 8])
+    ap.add_argument("--window", type=float, default=6.0)
+    args = ap.parse_args(argv)
+
+    engine = _build_engine(args)
+    cfg = SweepConfig(
+        isl=args.isl, osl=args.osl,
+        concurrencies=args.concurrency,
+        prefill_window_s=args.window,
+    )
+
+    async def run():
+        profile = await sweep_engine(engine, cfg)
+        if hasattr(engine, "shutdown"):
+            await engine.shutdown()
+        return profile
+
+    profile = asyncio.run(run())
+    profile.save_npz(args.out)
+    print(f"profile written to {args.out}:")
+    for c, itl, t in zip(profile.decode_concurrency, profile.itl_s,
+                         profile.decode_throughput):
+        print(f"  decode c={c:5.0f}: itl={itl*1000:7.2f}ms {t:9.1f} tok/s")
+    for load, ttft in zip(profile.prefill_load, profile.ttft_s):
+        print(f"  prefill {load:9.1f} tok/s offered: ttft={ttft*1000:7.1f}ms")
+
+
+if __name__ == "__main__":
+    main()

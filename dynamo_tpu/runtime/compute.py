@@ -1,0 +1,51 @@
+"""Sized compute pool for CPU-bound work (tokenization, template
+rendering) — the analog of the reference's rayon pool bridged into tokio
+(lib/runtime/src/compute/mod.rs:34 `ComputeConfig`, compute/pool.rs).
+
+asyncio's default executor is unbounded-ish and shared with blocking I/O;
+CPU-bound work gets its own bounded pool so a tokenization burst cannot
+starve device-op dispatch, sized by DYN_COMPUTE_THREADS (0 = auto:
+min(8, cpus))."""
+
+from __future__ import annotations
+
+import asyncio
+import contextvars
+import os
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Optional, TypeVar
+
+T = TypeVar("T")
+
+_POOL: Optional[ThreadPoolExecutor] = None
+
+
+def compute_pool() -> ThreadPoolExecutor:
+    """Process-wide pool, built on first use from DYN_COMPUTE_THREADS."""
+    global _POOL
+    if _POOL is None:
+        from .config import env_int
+
+        threads = env_int("DYN_COMPUTE_THREADS", 0) or min(
+            8, os.cpu_count() or 4
+        )
+        _POOL = ThreadPoolExecutor(
+            max_workers=threads, thread_name_prefix="dyn-compute"
+        )
+    return _POOL
+
+
+async def run_compute(fn: Callable[..., T], *args: Any) -> T:
+    """Run CPU-bound `fn` on the compute pool.  The caller's contextvars
+    (request trace) ride along — run_in_executor alone would drop them."""
+    ctx = contextvars.copy_context()
+    return await asyncio.get_running_loop().run_in_executor(
+        compute_pool(), lambda: ctx.run(fn, *args)
+    )
+
+
+def shutdown_compute_pool() -> None:
+    global _POOL
+    if _POOL is not None:
+        _POOL.shutdown(wait=False, cancel_futures=True)
+        _POOL = None

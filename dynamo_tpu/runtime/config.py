@@ -1,0 +1,103 @@
+"""Env-first runtime configuration — the `DYN_*` variable surface
+(reference: lib/runtime/src/config.rs `RuntimeConfig` via figment, and the
+`DYN_LOG` conventions in logging.rs).
+
+Every CLI flag that matters operationally has an env fallback so k8s
+deployments configure processes without rewriting commands:
+
+  DYN_CONTROL          control-plane address (host:port)
+  DYN_NAMESPACE        default namespace
+  DYN_LOG              log level, optionally per-target:
+                       "info,dynamo_tpu.router=debug"
+  DYN_LOG_JSONL        "1" → structured JSONL logs
+  DYN_LEASE_TTL        lease TTL seconds
+  DYN_STATUS_PORT      system-status server port
+  DYN_COMPUTE_THREADS  compute-pool size (tokenization etc.)
+  DYN_AUDIT_SINK       audit sink spec ("file:/path/audit.jsonl")
+  DYN_MODEL_CACHE      local model cache directory (hub)
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+
+def env_str(name: str, default: str = "") -> str:
+    return os.environ.get(name, default)
+
+
+def env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        raise ValueError(f"{name} must be an integer, got {raw!r}") from None
+
+
+def env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        raise ValueError(f"{name} must be a number, got {raw!r}") from None
+
+
+def env_bool(name: str, default: bool = False) -> bool:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    return raw.lower() in ("1", "true", "yes", "on")
+
+
+@dataclass
+class RuntimeConfig:
+    control: str = ""
+    namespace: str = "dynamo"
+    log_level: str = "info"
+    log_targets: Dict[str, str] = field(default_factory=dict)
+    log_jsonl: bool = False
+    lease_ttl: float = 5.0
+    status_port: Optional[int] = None
+    compute_threads: int = 0  # 0 → auto
+    audit_sink: str = ""
+    model_cache: str = ""
+
+    @classmethod
+    def from_env(cls) -> "RuntimeConfig":
+        level, targets = parse_dyn_log(env_str("DYN_LOG", "info"))
+        status = env_str("DYN_STATUS_PORT")
+        return cls(
+            control=env_str("DYN_CONTROL", env_str("DYN_TPU_CONTROL")),
+            namespace=env_str("DYN_NAMESPACE", "dynamo"),
+            log_level=level,
+            log_targets=targets,
+            log_jsonl=env_bool("DYN_LOG_JSONL"),
+            lease_ttl=env_float("DYN_LEASE_TTL", 5.0),
+            status_port=int(status) if status else None,
+            compute_threads=env_int("DYN_COMPUTE_THREADS", 0),
+            audit_sink=env_str("DYN_AUDIT_SINK"),
+            model_cache=env_str("DYN_MODEL_CACHE"),
+        )
+
+
+def parse_dyn_log(spec: str) -> tuple:
+    """`"info,dynamo_tpu.router=debug,aiohttp=warning"` →
+    ("info", {"dynamo_tpu.router": "debug", "aiohttp": "warning"})."""
+    level = "info"
+    targets: Dict[str, str] = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" in part:
+            target, lvl = part.split("=", 1)
+            targets[target.strip()] = lvl.strip()
+        else:
+            level = part
+    return level, targets

@@ -1,0 +1,120 @@
+"""Structured logging + distributed trace propagation.
+
+Reference: lib/runtime/src/logging.rs (JSONL structured logs with span
+ids, per-target levels via DYN_LOG) and the OTEL context injected into
+NATS headers at egress (addressed_router.rs:152) so frontend→worker spans
+join one trace.
+
+TPU-native shape: a contextvar carries (trace_id, span_id); the service
+transport copies it into request-frame headers and restores it around the
+worker-side handler, so a log line on the worker carries the same
+trace_id the frontend minted — grep one id, see the whole request.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import logging
+import sys
+import time
+import uuid
+from dataclasses import dataclass
+from typing import Optional
+
+_TRACE: contextvars.ContextVar = contextvars.ContextVar("dyn_trace", default=None)
+
+
+@dataclass(frozen=True)
+class TraceContext:
+    trace_id: str
+    span_id: str
+
+    def child(self) -> "TraceContext":
+        return TraceContext(self.trace_id, uuid.uuid4().hex[:16])
+
+
+def new_trace(trace_id: Optional[str] = None) -> TraceContext:
+    return TraceContext(trace_id or uuid.uuid4().hex, uuid.uuid4().hex[:16])
+
+
+def current_trace() -> Optional[TraceContext]:
+    return _TRACE.get()
+
+
+def set_trace(ctx: Optional[TraceContext]) -> contextvars.Token:
+    return _TRACE.set(ctx)
+
+
+def reset_trace(token: contextvars.Token) -> None:
+    _TRACE.reset(token)
+
+
+def trace_headers() -> dict:
+    """Headers to inject into an outgoing request frame."""
+    ctx = current_trace()
+    if ctx is None:
+        return {}
+    return {"trace_id": ctx.trace_id, "span_id": ctx.span_id}
+
+
+def trace_from_headers(header: dict) -> Optional[TraceContext]:
+    tid = header.get("trace_id")
+    if not tid:
+        return None
+    return TraceContext(tid, header.get("span_id", "")).child()
+
+
+class JsonlFormatter(logging.Formatter):
+    """One JSON object per line: ts, level, target, message, trace/span."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        entry = {
+            "ts": round(time.time(), 6),
+            "level": record.levelname.lower(),
+            "target": record.name,
+            "message": record.getMessage(),
+        }
+        ctx = current_trace()
+        if ctx is not None:
+            entry["trace_id"] = ctx.trace_id
+            entry["span_id"] = ctx.span_id
+        if record.exc_info:
+            entry["exception"] = self.formatException(record.exc_info)
+        return json.dumps(entry, ensure_ascii=False)
+
+
+class TraceFormatter(logging.Formatter):
+    """Human format with the trace id appended when present."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        base = super().format(record)
+        ctx = current_trace()
+        if ctx is not None:
+            base += f" trace={ctx.trace_id[:12]}"
+        return base
+
+
+def setup_logging(level: str = "", jsonl: Optional[bool] = None,
+                  targets: Optional[dict] = None) -> None:
+    """Configure root logging from args or the DYN_LOG / DYN_LOG_JSONL
+    env (env wins when args are empty/None)."""
+    from .config import RuntimeConfig
+
+    env = RuntimeConfig.from_env()
+    level = level or env.log_level
+    jsonl = env.log_jsonl if jsonl is None else jsonl
+    targets = {**env.log_targets, **(targets or {})}
+
+    handler = logging.StreamHandler(sys.stderr)
+    if jsonl:
+        handler.setFormatter(JsonlFormatter())
+    else:
+        handler.setFormatter(TraceFormatter(
+            "%(asctime)s %(levelname)s %(name)s %(message)s"
+        ))
+    root = logging.getLogger()
+    root.handlers[:] = [handler]
+    root.setLevel(level.upper())
+    for target, lvl in targets.items():
+        logging.getLogger(target).setLevel(lvl.upper())

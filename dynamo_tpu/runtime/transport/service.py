@@ -124,8 +124,11 @@ class ServiceServer:
                                          pack({"message": f"no endpoint {endpoint!r}"})))
                         continue
                     ctx = Context(frame.header.get("rid") or None)
+                    from ..tracing import trace_from_headers
+
+                    trace = trace_from_headers(frame.header)
                     task = asyncio.create_task(
-                        self._run_stream(send, frame, handler, ctx, key)
+                        self._run_stream(send, frame, handler, ctx, key, trace)
                     )
                     self._inflight[key] = (task, ctx)
                 elif frame.kind == K_CANCEL:
@@ -152,8 +155,14 @@ class ServiceServer:
             writer.close()
 
     async def _run_stream(self, send, req_frame: Frame, handler: Handler,
-                          ctx: Context, key) -> None:
+                          ctx: Context, key, trace=None) -> None:
         sid = req_frame.stream_id
+        if trace is not None:
+            # worker-side logs join the caller's trace (reference: OTEL
+            # context from NATS headers, addressed_router.rs:152)
+            from ..tracing import set_trace
+
+            set_trace(trace)
         try:
             request = unpack(req_frame.payload)
             async for item in handler(request, ctx):
@@ -255,7 +264,9 @@ class ServiceClient:
         conn.streams[sid] = q
         ctx = context or Context()
 
-        hdr = {"endpoint": endpoint, "rid": ctx.id}
+        from ..tracing import trace_headers
+
+        hdr = {"endpoint": endpoint, "rid": ctx.id, **trace_headers()}
         frame = Frame(K_REQ, sid, hdr, pack(request))
         async with conn.send_lock:
             try:

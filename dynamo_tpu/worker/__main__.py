@@ -15,7 +15,10 @@ import signal
 
 def main() -> None:
     ap = argparse.ArgumentParser(description="dynamo-tpu JAX worker")
-    ap.add_argument("--control", required=True)
+    from ..runtime.config import RuntimeConfig
+
+    _env_control = RuntimeConfig.from_env().control
+    ap.add_argument("--control", required=not _env_control, default=_env_control)
     ap.add_argument("--model", default="tiny",
                     help="HF checkpoint dir, or 'tiny' for the test model")
     ap.add_argument("--model-name", default=None)
@@ -55,7 +58,8 @@ def main() -> None:
                          "(deepseek_r1|qwen3|granite|gpt_oss)")
     ap.add_argument("--tool-call-parser", default="",
                     help="extract tool calls (hermes|mistral|json|pythonic)")
-    ap.add_argument("--log-level", default="info")
+    ap.add_argument("--log-level", default="")
+    ap.add_argument("--log-jsonl", action="store_true", default=None)
     args = ap.parse_args()
     # fail fast on typo'd parser names (otherwise every request 500s)
     from ..parsers import get_reasoning_parser, get_tool_parser
@@ -67,8 +71,9 @@ def main() -> None:
         ap.error(str(e))
     if args.kvbm and getattr(args, "mock", False):
         ap.error("--kvbm requires a real JAX engine (incompatible with --mock)")
-    logging.basicConfig(level=args.log_level.upper(),
-                        format="%(asctime)s %(levelname)s %(name)s %(message)s")
+    from ..runtime.tracing import setup_logging
+
+    setup_logging(args.log_level, args.log_jsonl)
     if args.platform == "cpu":
         # the axon TPU plugin ignores the env var; the config update wins
         import jax
@@ -230,9 +235,12 @@ def _build_engine(args):
         from ..models import ModelConfig
         from ..models.loader import load_params
 
-        cfg = ModelConfig.from_pretrained(args.model)
-        params = load_params(args.model, cfg, dtype=dtype)
-        tok = HuggingFaceTokenizer.from_pretrained(args.model)
+        from ..models.hub import resolve_model
+
+        model_dir = resolve_model(args.model)
+        cfg = ModelConfig.from_pretrained(model_dir)
+        params = load_params(model_dir, cfg, dtype=dtype)
+        tok = HuggingFaceTokenizer.from_pretrained(model_dir)
         name = args.model_name or cfg.name
         tokenizer_json = tok.to_json_str()
         eos = list(tok.eos_token_ids)

@@ -1,0 +1,201 @@
+"""Aux subsystems: DYN_* config, structured logging + trace propagation,
+audit bus, KV event recorder/replay, compute pool, model hub."""
+
+import asyncio
+import io
+import json
+import logging
+import os
+
+import pytest
+
+from dynamo_tpu.runtime.config import RuntimeConfig, parse_dyn_log
+from dynamo_tpu.runtime.tracing import (
+    JsonlFormatter,
+    current_trace,
+    new_trace,
+    set_trace,
+    trace_from_headers,
+    trace_headers,
+)
+
+
+def test_dyn_log_parsing():
+    level, targets = parse_dyn_log("debug,dynamo_tpu.router=warning,aiohttp=error")
+    assert level == "debug"
+    assert targets == {"dynamo_tpu.router": "warning", "aiohttp": "error"}
+    assert parse_dyn_log("") == ("info", {})
+
+
+def test_runtime_config_from_env(monkeypatch):
+    monkeypatch.setenv("DYN_CONTROL", "1.2.3.4:5")
+    monkeypatch.setenv("DYN_LOG", "warning,x=debug")
+    monkeypatch.setenv("DYN_LOG_JSONL", "true")
+    monkeypatch.setenv("DYN_LEASE_TTL", "2.5")
+    monkeypatch.setenv("DYN_COMPUTE_THREADS", "3")
+    cfg = RuntimeConfig.from_env()
+    assert cfg.control == "1.2.3.4:5"
+    assert cfg.log_level == "warning" and cfg.log_targets == {"x": "debug"}
+    assert cfg.log_jsonl is True
+    assert cfg.lease_ttl == 2.5
+    assert cfg.compute_threads == 3
+
+
+def test_runtime_config_rejects_garbage(monkeypatch):
+    monkeypatch.setenv("DYN_LEASE_TTL", "soon")
+    with pytest.raises(ValueError, match="DYN_LEASE_TTL"):
+        RuntimeConfig.from_env()
+
+
+def test_trace_header_round_trip():
+    tok = set_trace(None)
+    try:
+        assert trace_headers() == {}
+        ctx = new_trace()
+        set_trace(ctx)
+        hdr = trace_headers()
+        assert hdr["trace_id"] == ctx.trace_id
+        restored = trace_from_headers(hdr)
+        assert restored.trace_id == ctx.trace_id
+        assert restored.span_id != ctx.span_id  # child span
+        assert trace_from_headers({}) is None
+    finally:
+        set_trace(None)
+
+
+def test_jsonl_formatter_includes_trace():
+    tok = set_trace(new_trace("abc123"))
+    try:
+        rec = logging.LogRecord("t", logging.INFO, "f.py", 1, "hello %s",
+                                ("world",), None)
+        entry = json.loads(JsonlFormatter().format(rec))
+        assert entry["message"] == "hello world"
+        assert entry["level"] == "info"
+        assert entry["trace_id"] == "abc123"
+    finally:
+        set_trace(None)
+
+
+async def test_trace_propagates_over_the_wire():
+    """The frontend's trace id must appear in the worker-side handler's
+    context (wire-frame header propagation)."""
+    from dynamo_tpu.runtime import Context, DistributedRuntime
+    from dynamo_tpu.testing import local_cluster
+
+    seen = {}
+
+    async def handler(request, context):
+        ctx = current_trace()
+        seen["trace_id"] = ctx.trace_id if ctx else None
+        yield {"ok": True}
+
+    async with local_cluster(2) as (server, (rt_w, rt_c)):
+        ep = rt_w.namespace("t").component("c").endpoint("e")
+        await ep.serve_endpoint(handler)
+        client = rt_c.namespace("t").component("c").endpoint("e").client()
+        await client.start()
+        await client.wait_for_instances()
+        tok = set_trace(new_trace("trace-e2e"))
+        try:
+            async for _ in client.round_robin({"x": 1}, Context()):
+                pass
+        finally:
+            set_trace(None)
+        assert seen["trace_id"] == "trace-e2e"
+        await client.stop()
+
+
+def test_audit_bus_sinks(tmp_path):
+    from dynamo_tpu.llm.audit import AuditBus, JsonlFileSink, sink_from_spec
+
+    path = tmp_path / "audit.jsonl"
+    bus = AuditBus([JsonlFileSink(str(path))])
+    bus.request("r1", "m", "chat", {"messages": [{"role": "user", "content": "q"}],
+                                    "max_tokens": 5, "api_key": {"nested": 1}})
+    bus.response("r1", "m", "chat", "200",
+                 usage={"completion_tokens": 5}, finish_reasons=["stop"])
+    bus.close()
+    rows = [json.loads(line) for line in path.read_text().splitlines()]
+    assert [r["kind"] for r in rows] == ["request", "response"]
+    assert rows[0]["request"]["messages"][0]["content"] == "q"
+    assert "api_key" not in rows[0]["request"]  # non-scalar scrubbed
+    assert rows[1]["usage"]["completion_tokens"] == 5
+
+    assert sink_from_spec("") is None
+    assert sink_from_spec("logger:") is not None
+    with pytest.raises(ValueError):
+        sink_from_spec("s3:bucket")
+
+
+def test_audit_bus_survives_broken_sink():
+    from dynamo_tpu.llm.audit import AuditBus, CallbackSink
+
+    good = []
+    bus = AuditBus([
+        CallbackSink(lambda r: (_ for _ in ()).throw(RuntimeError("boom"))),
+        CallbackSink(good.append),
+    ])
+    bus.request("r", "m", "chat", {})
+    assert len(good) == 1
+
+
+async def test_kv_event_recorder_and_replay():
+    """Record a worker's KV event stream, replay it into a fresh index,
+    and get the same prefix matches the live router would."""
+    from dynamo_tpu.engine.page_pool import KvEvent
+    from dynamo_tpu.router import KvEventPublisher
+    from dynamo_tpu.router.recorder import KvEventRecorder, replay_into_index
+    from dynamo_tpu.testing import local_runtime
+
+    async with local_runtime() as rt:
+        pub = KvEventPublisher(rt, "ns", "backend", worker_id=7).start()
+        pub.sink(KvEvent("stored", [11, 22, 33]))
+        pub.sink(KvEvent("stored", [44], parent_hash=33))
+        pub.sink(KvEvent("removed", [44]))
+        await asyncio.sleep(0.3)  # drain publisher queue
+
+        buf = io.StringIO()
+        rec = KvEventRecorder(rt, "ns", "backend", buf)
+        await rec.drain_once()
+        assert rec.events_written == 3
+        await pub.stop()
+
+        buf.seek(0)
+        index = replay_into_index(buf)
+        matches = index.find_matches([11, 22, 33, 44])
+        assert matches == {7: 3}  # 44 was removed
+
+
+async def test_compute_pool_runs_work(monkeypatch):
+    import dynamo_tpu.runtime.compute as compute
+
+    compute.shutdown_compute_pool()
+    monkeypatch.setenv("DYN_COMPUTE_THREADS", "2")
+    try:
+        out = await compute.run_compute(lambda a, b: a + b, 2, 3)
+        assert out == 5
+        assert compute.compute_pool()._max_workers == 2
+    finally:
+        compute.shutdown_compute_pool()
+
+
+def test_hub_resolution(tmp_path, monkeypatch):
+    from dynamo_tpu.models.hub import resolve_model
+
+    # direct dir
+    ckpt = tmp_path / "m1"
+    ckpt.mkdir()
+    (ckpt / "config.json").write_text("{}")
+    assert resolve_model(str(ckpt)) == str(ckpt)
+
+    # cache-dir hit by slug
+    cache = tmp_path / "cache"
+    slug = cache / "org--model"
+    slug.mkdir(parents=True)
+    (slug / "config.json").write_text("{}")
+    monkeypatch.setenv("DYN_MODEL_CACHE", str(cache))
+    assert resolve_model("org/model", allow_download=False) == str(slug)
+
+    # miss: error lists the chain
+    with pytest.raises(FileNotFoundError, match="org/nope"):
+        resolve_model("org/nope", allow_download=False)

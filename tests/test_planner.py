@@ -100,3 +100,67 @@ async def test_virtual_connector_roundtrip():
     finally:
         await rt.shutdown(graceful=False)
         await control.stop()
+
+
+# --------------------------------------------------------------------------- #
+# measured profiles: sweep harness -> npz -> planner sizing (VERDICT item 9)
+# --------------------------------------------------------------------------- #
+
+
+async def test_planner_sizes_from_measured_mock_profile(tmp_path):
+    """Sweep the mock engine, persist the PerfProfile npz, and have the
+    planner size replicas from the MEASURED curves — no synthetic
+    defaults anywhere in the path."""
+    from dynamo_tpu.mocker import MockEngine, MockEngineArgs
+    from dynamo_tpu.planner import (
+        LoadSample,
+        Planner,
+        PlannerConfig,
+        SLO,
+        VirtualConnector,
+    )
+    from dynamo_tpu.planner.perf_model import PerfProfile
+    from dynamo_tpu.planner.profiler import SweepConfig, sweep_engine
+    from dynamo_tpu.testing import local_runtime
+
+    engine = MockEngine(MockEngineArgs(max_num_seqs=8))
+    cfg = SweepConfig(isl=96, osl=16, concurrencies=(1, 2, 4),
+                      load_fractions=(0.3, 0.8), prefill_window_s=1.5)
+    profile = await sweep_engine(engine, cfg)
+    await engine.shutdown()
+
+    path = str(tmp_path / "mock.npz")
+    profile.save_npz(path)
+    loaded = PerfProfile.load_npz(path)
+    assert list(loaded.decode_concurrency) == [1.0, 2.0, 4.0]
+    assert all(t > 0 for t in loaded.itl_s)
+    assert loaded.decode_throughput[-1] > loaded.decode_throughput[0]
+
+    # measured curves must actually drive sizing: pick an ITL SLO between
+    # the c=1 and c=4 measurements so capacity lands inside the sweep
+    itl_slo = (loaded.itl_s[0] + loaded.itl_s[-1]) / 2
+    per_worker = loaded.max_decode_concurrency_under(itl_slo)
+    assert 1.0 <= per_worker <= 4.0
+
+    async with local_runtime() as rt:
+        connector = VirtualConnector(rt)
+        planner = Planner(
+            connector,
+            prefill_profile=loaded,
+            decode_profile=loaded,
+            config=PlannerConfig(
+                slo=SLO(ttft_s=loaded.ttft_s[-1] * 2, itl_s=itl_slo),
+                min_replicas=1, max_replicas=64,
+            ),
+        )
+        # offered decode load of 12 concurrent → ceil(12 / per_worker)
+        for _ in range(4):
+            planner.observe(LoadSample(
+                prefill_tokens_per_s=loaded.prefill_load[0],
+                concurrent_decodes=12.0,
+            ))
+        targets = await planner.apply()
+        import math
+
+        assert targets["decode"] == math.ceil(12.0 / per_worker)
+        assert targets["prefill"] >= 1
