@@ -23,6 +23,11 @@ def main() -> None:
                          "carry their own namespace and the watcher follows "
                          "all of them")
     ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--tls-cert", default="", help="PEM cert chain → HTTPS")
+    ap.add_argument("--tls-key", default="", help="PEM private key")
+    ap.add_argument("--grpc-port", type=int, default=-1,
+                    help="also serve the KServe v2 gRPC protocol on this "
+                         "port (0 = ephemeral, -1 = disabled)")
     ap.add_argument(
         "--router-mode",
         default="round_robin",
@@ -56,7 +61,18 @@ async def _run(args) -> None:
         runtime, manager, router_mode=args.router_mode,
         kv_chooser_factory=kv_factory,
     ).start()
-    http = await HttpService(manager, host=args.host, port=args.port).start()
+    http = await HttpService(
+        manager, host=args.host, port=args.port,
+        tls_cert=args.tls_cert, tls_key=args.tls_key,
+    ).start()
+    kserve = None
+    if args.grpc_port >= 0:
+        from ..grpc import KserveGrpcService
+
+        kserve = await KserveGrpcService(
+            manager, host=args.host, port=args.grpc_port
+        ).start()
+        print(f"GRPC {args.host}:{kserve.port}", flush=True)
     status = None
     if args.status_port >= 0:
         from ..runtime.status import SystemStatusServer
@@ -76,6 +92,8 @@ async def _run(args) -> None:
     await stop.wait()
     if status:
         await status.stop()
+    if kserve:
+        await kserve.stop()
     await http.stop()
     await watcher.stop()
     await runtime.shutdown()

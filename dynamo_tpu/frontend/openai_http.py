@@ -103,12 +103,21 @@ class _ChoiceParsers:
 class HttpService:
     def __init__(self, manager: ModelManager, host: str = "0.0.0.0",
                  port: int = 8000, metrics: Optional[FrontendMetrics] = None,
-                 audit=None):
+                 audit=None, tls_cert: str = "", tls_key: str = ""):
         from ..llm.audit import AuditBus
 
         self.manager = manager
         self.host = host
         self.port = port
+        # TLS (reference service_v2.rs:222): both paths or neither
+        if bool(tls_cert) != bool(tls_key):
+            raise ValueError("tls_cert and tls_key must be given together")
+        self._ssl = None
+        if tls_cert:
+            import ssl
+
+            self._ssl = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            self._ssl.load_cert_chain(tls_cert, tls_key)
         self.metrics = metrics or FrontendMetrics()
         # request/response audit bus (DYN_AUDIT_SINK or explicit)
         self.audit = audit if audit is not None else AuditBus.from_env()
@@ -133,7 +142,8 @@ class HttpService:
     async def start(self) -> "HttpService":
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
-        site = web.TCPSite(self._runner, self.host, self.port)
+        site = web.TCPSite(self._runner, self.host, self.port,
+                           ssl_context=self._ssl)
         await site.start()
         # resolve the real port when 0 was requested
         for s in site._server.sockets:  # noqa: SLF001

@@ -1,0 +1,5 @@
+"""KServe v2 gRPC inference frontend (reference lib/llm/src/grpc/)."""
+
+from .service import KserveGrpcService
+
+__all__ = ["KserveGrpcService"]

@@ -1,0 +1,286 @@
+"""KServe v2 gRPC inference service.
+
+Reference: /root/reference/lib/llm/src/grpc/service/kserve.rs:91
+`KserveService` — the tonic server exposing ServerLive/ServerReady/
+ModelReady/ModelMetadata/ModelInfer(+stream) over the same model manager
+the HTTP frontend uses.
+
+Implementation note: the service is registered with grpc's *generic
+handler* API against protoc-generated message classes (no grpc_tools
+codegen dependency).  LLM models follow the KServe text convention the
+reference implements: BYTES input tensor ``text_input`` (+ optional
+``streaming``/sampling parameters), BYTES output tensor ``text_output``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import uuid
+from typing import Optional
+
+import grpc
+
+from . import kserve_pb2 as pb
+from ..llm.preprocessor import RequestError
+from ..runtime import Context
+from ..runtime.compute import run_compute
+from ..runtime.transport.service import RemoteStreamError, ServiceUnavailable
+
+logger = logging.getLogger(__name__)
+
+SERVICE = "inference.GRPCInferenceService"
+
+
+def _param(p: "pb.InferParameter"):
+    which = p.WhichOneof("parameter_choice")
+    return getattr(p, which) if which else None
+
+
+def _unpack_raw_bytes(raw: bytes) -> list:
+    """Decode Triton's length-prefixed BYTES packing; fall back to one
+    unprefixed utf-8 blob."""
+    import struct
+
+    out, off = [], 0
+    while off + 4 <= len(raw):
+        (n,) = struct.unpack_from("<I", raw, off)
+        if off + 4 + n > len(raw):
+            break
+        out.append(raw[off + 4:off + 4 + n].decode("utf-8", "replace"))
+        off += 4 + n
+    if out and off == len(raw):
+        return out
+    return [raw.decode("utf-8", "replace")]
+
+
+def _bytes_tensor(name: str, values) -> "pb.ModelInferResponse.InferOutputTensor":
+    t = pb.ModelInferResponse.InferOutputTensor(
+        name=name, datatype="BYTES", shape=[len(values)]
+    )
+    t.contents.bytes_contents.extend(
+        v.encode() if isinstance(v, str) else v for v in values
+    )
+    return t
+
+
+class KserveGrpcService:
+    """gRPC front door over the frontend's ModelManager."""
+
+    def __init__(self, manager, host: str = "0.0.0.0", port: int = 8787):
+        self.manager = manager
+        self.host = host
+        self.port = port
+        self.server: Optional[grpc.aio.Server] = None
+
+    # -- rpc implementations ------------------------------------------------ #
+
+    async def server_live(self, request, context) -> "pb.ServerLiveResponse":
+        return pb.ServerLiveResponse(live=True)
+
+    async def server_ready(self, request, context) -> "pb.ServerReadyResponse":
+        return pb.ServerReadyResponse(ready=bool(self.manager.names()))
+
+    async def model_ready(self, request, context) -> "pb.ModelReadyResponse":
+        entry = self.manager.get(request.name)
+        return pb.ModelReadyResponse(
+            ready=entry is not None and bool(entry.instances)
+        )
+
+    async def model_metadata(self, request, context
+                             ) -> "pb.ModelMetadataResponse":
+        entry = self.manager.get(request.name)
+        if entry is None:
+            await context.abort(
+                grpc.StatusCode.NOT_FOUND, f"model {request.name!r} not found"
+            )
+        resp = pb.ModelMetadataResponse(
+            name=request.name, versions=["1"], platform="dynamo_tpu",
+        )
+        resp.inputs.add(name="text_input", datatype="BYTES", shape=[-1])
+        resp.outputs.add(name="text_output", datatype="BYTES", shape=[-1])
+        return resp
+
+    async def model_infer(self, request, context) -> "pb.ModelInferResponse":
+        entry = self.manager.get(request.model_name)
+        if entry is None:
+            await context.abort(
+                grpc.StatusCode.NOT_FOUND,
+                f"model {request.model_name!r} not found",
+            )
+        try:
+            texts, max_tokens, temperature = self._parse_llm_inputs(request)
+        except ValueError as e:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        if not texts:
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                "expected a BYTES input tensor named 'text_input'",
+            )
+        outputs = []
+        for text in texts:
+            body = {
+                "model": request.model_name,
+                "prompt": text,
+                "max_tokens": max_tokens,
+                "temperature": temperature,
+            }
+            try:
+                pre = await run_compute(
+                    entry.preprocessor.preprocess_completion, body
+                )
+            except RequestError as e:
+                await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            ctx = Context()
+            parts = []
+            try:
+                async for out in entry.generate(pre, ctx):
+                    if out.get("finish_reason") == "error":
+                        await context.abort(
+                            grpc.StatusCode.INTERNAL,
+                            out.get("error", "engine error"),
+                        )
+                    parts.append(out.get("text", ""))
+            except ServiceUnavailable as e:
+                await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+            except RemoteStreamError as e:
+                await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+            except asyncio.CancelledError:
+                # client cancelled mid-generation: stop the worker too
+                # (the HTTP path's disconnect → ctx.kill contract)
+                ctx.kill()
+                raise
+            outputs.append("".join(parts))
+        resp = pb.ModelInferResponse(
+            model_name=request.model_name,
+            id=request.id or uuid.uuid4().hex,
+        )
+        resp.outputs.append(_bytes_tensor("text_output", outputs))
+        return resp
+
+    async def model_stream_infer(self, request_iterator, context):
+        """Bidirectional streaming: each request streams deltas back as
+        ModelStreamInferResponse (the reference's streaming tensor RPC)."""
+        async for request in request_iterator:
+            entry = self.manager.get(request.model_name)
+            if entry is None:
+                yield pb.ModelStreamInferResponse(
+                    error_message=f"model {request.model_name!r} not found"
+                )
+                continue
+            try:
+                texts, max_tokens, temperature = self._parse_llm_inputs(request)
+            except ValueError as e:
+                yield pb.ModelStreamInferResponse(error_message=str(e))
+                continue
+            if not texts:
+                yield pb.ModelStreamInferResponse(
+                    error_message="expected a BYTES 'text_input' tensor"
+                )
+                continue
+            rid = request.id or uuid.uuid4().hex
+            for text in texts:  # every element of the batch streams
+                body = {
+                    "model": request.model_name,
+                    "prompt": text,
+                    "max_tokens": max_tokens,
+                    "temperature": temperature,
+                }
+                ctx = Context()
+                try:
+                    pre = await run_compute(
+                        entry.preprocessor.preprocess_completion, body
+                    )
+                    async for out in entry.generate(pre, ctx):
+                        if out.get("finish_reason") == "error":
+                            yield pb.ModelStreamInferResponse(
+                                error_message=out.get("error", "engine error")
+                            )
+                            break
+                        piece = out.get("text", "")
+                        if not piece and not out.get("finish_reason"):
+                            continue
+                        resp = pb.ModelInferResponse(
+                            model_name=request.model_name, id=rid
+                        )
+                        resp.outputs.append(
+                            _bytes_tensor("text_output", [piece])
+                        )
+                        yield pb.ModelStreamInferResponse(infer_response=resp)
+                except asyncio.CancelledError:
+                    ctx.kill()
+                    raise
+                except Exception as e:  # noqa: BLE001 — stream the failure
+                    yield pb.ModelStreamInferResponse(error_message=str(e))
+
+    # -- plumbing ----------------------------------------------------------- #
+
+    def _parse_llm_inputs(self, request):
+        texts = []
+        for tensor in request.inputs:
+            if tensor.name == "text_input":
+                texts = [
+                    b.decode("utf-8", "replace")
+                    for b in tensor.contents.bytes_contents
+                ]
+        if not texts and request.raw_input_contents:
+            # raw BYTES form: elements are 4-byte-LE length-prefixed
+            # (KServe/Triton packing); also accept a bare unprefixed blob
+            raw = request.raw_input_contents[0]
+            texts = _unpack_raw_bytes(raw)
+        params = {k: _param(v) for k, v in request.parameters.items()}
+        max_tokens = int(params.get("max_tokens", 64) or 64)
+        temperature = float(params.get("temperature", 0.0) or 0.0)
+        return texts, max_tokens, temperature
+
+    def _handlers(self) -> grpc.GenericRpcHandler:
+        rpcs = {
+            "ServerLive": grpc.unary_unary_rpc_method_handler(
+                self.server_live,
+                request_deserializer=pb.ServerLiveRequest.FromString,
+                response_serializer=pb.ServerLiveResponse.SerializeToString,
+            ),
+            "ServerReady": grpc.unary_unary_rpc_method_handler(
+                self.server_ready,
+                request_deserializer=pb.ServerReadyRequest.FromString,
+                response_serializer=pb.ServerReadyResponse.SerializeToString,
+            ),
+            "ModelReady": grpc.unary_unary_rpc_method_handler(
+                self.model_ready,
+                request_deserializer=pb.ModelReadyRequest.FromString,
+                response_serializer=pb.ModelReadyResponse.SerializeToString,
+            ),
+            "ModelMetadata": grpc.unary_unary_rpc_method_handler(
+                self.model_metadata,
+                request_deserializer=pb.ModelMetadataRequest.FromString,
+                response_serializer=pb.ModelMetadataResponse.SerializeToString,
+            ),
+            "ModelInfer": grpc.unary_unary_rpc_method_handler(
+                self.model_infer,
+                request_deserializer=pb.ModelInferRequest.FromString,
+                response_serializer=pb.ModelInferResponse.SerializeToString,
+            ),
+            "ModelStreamInfer": grpc.stream_stream_rpc_method_handler(
+                self.model_stream_infer,
+                request_deserializer=pb.ModelInferRequest.FromString,
+                response_serializer=pb.ModelStreamInferResponse.SerializeToString,
+            ),
+        }
+        return grpc.method_handlers_generic_handler(SERVICE, rpcs)
+
+    async def start(self) -> "KserveGrpcService":
+        self.server = grpc.aio.server()
+        self.server.add_generic_rpc_handlers((self._handlers(),))
+        requested = self.port
+        self.port = self.server.add_insecure_port(f"{self.host}:{self.port}")
+        if self.port == 0 and requested != 0:
+            raise OSError(
+                f"could not bind kserve grpc port {self.host}:{requested}"
+            )
+        await self.server.start()
+        logger.info("kserve grpc service on %s:%d", self.host, self.port)
+        return self
+
+    async def stop(self) -> None:
+        if self.server:
+            await self.server.stop(grace=2.0)

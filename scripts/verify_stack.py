@@ -17,6 +17,7 @@ import time
 import urllib.request
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
 ENV = {
     **os.environ,
     "JAX_PLATFORMS": "cpu",
@@ -98,8 +99,10 @@ def main():
         w1 = spawn([*worker_args, "--status-port", str(w1_status)], "worker1")
         w2 = spawn(worker_args, "worker2")
         http_port = free_port()
+        grpc_port = free_port()
         spawn(["-m", "dynamo_tpu.frontend", "--control", control,
-               "--host", "127.0.0.1", "--port", str(http_port)], "frontend")
+               "--host", "127.0.0.1", "--port", str(http_port),
+               "--grpc-port", str(grpc_port)], "frontend")
         base = f"http://127.0.0.1:{http_port}"
 
         # model discovered
@@ -149,6 +152,26 @@ def main():
                         {"model": "tiny-chat", "input": ["hello", "hello"]})
         assert len(emb["data"]) == 2 and emb["data"][0]["embedding"], emb
         print("OK embeddings route")
+
+        # KServe v2 gRPC surface on the same frontend process
+        import grpc as _grpc
+
+        from dynamo_tpu.grpc import kserve_pb2 as _pb
+        from dynamo_tpu.grpc.service import SERVICE as _SVC
+
+        with _grpc.insecure_channel(f"127.0.0.1:{grpc_port}") as chan:
+            infer = chan.unary_unary(
+                f"/{_SVC}/ModelInfer",
+                request_serializer=_pb.ModelInferRequest.SerializeToString,
+                response_deserializer=_pb.ModelInferResponse.FromString,
+            )
+            req = _pb.ModelInferRequest(model_name="tiny-chat", id="v1")
+            t = req.inputs.add(name="text_input", datatype="BYTES", shape=[1])
+            t.contents.bytes_contents.append(b"9999 9999")
+            req.parameters["max_tokens"].int64_param = 6
+            resp = infer(req, timeout=120)
+            assert resp.outputs[0].contents.bytes_contents, resp
+        print("OK kserve grpc infer")
 
         # disaggregated pair with MISMATCHED page sizes: prefill (page 8)
         # streams KV by block id over the data plane, decode (page 16)

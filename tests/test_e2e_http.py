@@ -163,3 +163,41 @@ async def test_e2e_worker_removal(model_setup):
         await engine.shutdown()
         await front_rt.shutdown(graceful=False)
         await control.stop()
+
+
+async def test_https_frontend(model_setup, tmp_path):
+    """TLS termination on the frontend (reference service_v2.rs:222)."""
+    import ssl
+    import subprocess
+
+    import aiohttp
+
+    cert, key = tmp_path / "c.pem", tmp_path / "k.pem"
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(key), "-out", str(cert), "-days", "1",
+         "-subj", "/CN=localhost"],
+        check=True, capture_output=True,
+    )
+    control, worker_rt, front_rt, engine, watcher, http = await start_stack(model_setup)
+    https = await HttpService(
+        ModelManager(), host="127.0.0.1", port=0,
+        tls_cert=str(cert), tls_key=str(key),
+    ).start()
+    # share the discovered models with the TLS listener
+    https.manager = http.manager
+    try:
+        ctx = ssl.create_default_context(cafile=str(cert))
+        ctx.check_hostname = False
+        async with aiohttp.ClientSession(
+            connector=aiohttp.TCPConnector(ssl=ctx)
+        ) as session:
+            async with session.get(
+                f"https://127.0.0.1:{https.port}/v1/models"
+            ) as r:
+                assert r.status == 200
+                data = await r.json()
+        assert [m["id"] for m in data["data"]] == ["tiny-chat"]
+    finally:
+        await https.stop()
+        await stop_stack(control, worker_rt, front_rt, engine, watcher, http)

@@ -1,0 +1,97 @@
+"""KServe v2 gRPC service e2e: live/ready/metadata/infer/stream over the
+same model manager the HTTP frontend uses (reference kserve.rs:91)."""
+
+import asyncio
+
+import grpc
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dynamo_tpu.grpc import KserveGrpcService
+from dynamo_tpu.grpc import kserve_pb2 as pb
+from dynamo_tpu.grpc.service import SERVICE
+
+from tests.test_e2e_http import model_setup, start_stack, stop_stack  # noqa: F401
+
+
+def _rpc(channel, name, req_cls, resp_cls):
+    return channel.unary_unary(
+        f"/{SERVICE}/{name}",
+        request_serializer=req_cls.SerializeToString,
+        response_deserializer=resp_cls.FromString,
+    )
+
+
+async def test_kserve_grpc_surface(model_setup):  # noqa: F811
+    stack = await start_stack(model_setup)
+    manager = stack[-1].manager
+    kserve = await KserveGrpcService(manager, host="127.0.0.1", port=0).start()
+    try:
+        async with grpc.aio.insecure_channel(
+            f"127.0.0.1:{kserve.port}"
+        ) as channel:
+            live = await _rpc(channel, "ServerLive", pb.ServerLiveRequest,
+                              pb.ServerLiveResponse)(pb.ServerLiveRequest())
+            assert live.live
+
+            ready = await _rpc(channel, "ServerReady", pb.ServerReadyRequest,
+                               pb.ServerReadyResponse)(pb.ServerReadyRequest())
+            assert ready.ready
+
+            mr = await _rpc(channel, "ModelReady", pb.ModelReadyRequest,
+                            pb.ModelReadyResponse)(
+                pb.ModelReadyRequest(name="tiny-chat"))
+            assert mr.ready
+            mr2 = await _rpc(channel, "ModelReady", pb.ModelReadyRequest,
+                             pb.ModelReadyResponse)(
+                pb.ModelReadyRequest(name="nope"))
+            assert not mr2.ready
+
+            meta = await _rpc(channel, "ModelMetadata", pb.ModelMetadataRequest,
+                              pb.ModelMetadataResponse)(
+                pb.ModelMetadataRequest(name="tiny-chat"))
+            assert meta.platform == "dynamo_tpu"
+            assert meta.inputs[0].name == "text_input"
+
+            # unary infer: BYTES text_input -> BYTES text_output
+            req = pb.ModelInferRequest(model_name="tiny-chat", id="r1")
+            t = req.inputs.add(name="text_input", datatype="BYTES", shape=[1])
+            t.contents.bytes_contents.append(b"9999 9999 9999")
+            req.parameters["max_tokens"].int64_param = 6
+            req.parameters["temperature"].double_param = 0.0
+            resp = await _rpc(channel, "ModelInfer", pb.ModelInferRequest,
+                              pb.ModelInferResponse)(req)
+            assert resp.id == "r1"
+            (out,) = resp.outputs
+            assert out.name == "text_output" and out.datatype == "BYTES"
+            unary_text = out.contents.bytes_contents[0].decode()
+            assert len(unary_text) > 0
+
+            # streaming infer: concatenated deltas == unary result
+            stream = channel.stream_stream(
+                f"/{SERVICE}/ModelStreamInfer",
+                request_serializer=pb.ModelInferRequest.SerializeToString,
+                response_deserializer=pb.ModelStreamInferResponse.FromString,
+            )
+            call = stream(iter([req]))
+            pieces = []
+            async for chunk in call:
+                assert not chunk.error_message, chunk.error_message
+                for t in chunk.infer_response.outputs:
+                    pieces.extend(
+                        b.decode() for b in t.contents.bytes_contents
+                    )
+            assert "".join(pieces) == unary_text
+
+            # unknown model → NOT_FOUND
+            bad = pb.ModelInferRequest(model_name="nope")
+            bt = bad.inputs.add(name="text_input", datatype="BYTES", shape=[1])
+            bt.contents.bytes_contents.append(b"x")
+            with pytest.raises(grpc.aio.AioRpcError) as ei:
+                await _rpc(channel, "ModelInfer", pb.ModelInferRequest,
+                           pb.ModelInferResponse)(bad)
+            assert ei.value.code() == grpc.StatusCode.NOT_FOUND
+    finally:
+        await kserve.stop()
+        await stop_stack(*stack)
