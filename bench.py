@@ -76,7 +76,7 @@ async def main_async():
         max_model_len=PROMPT_LEN + GEN_TOKENS + 16,
         decode_batch_buckets=[BATCH],
         chunk_buckets=[PROMPT_LEN],
-        decode_steps=16,
+        decode_steps=32,
         decode_chain=4,  # chained dispatches hide the ~83ms axon RTT
         enable_prefix_caching=False,  # measure raw compute, not cache hits
     )

@@ -48,3 +48,29 @@ def radix_lib() -> Optional[ctypes.CDLL]:
     lib.radix_workers.restype = ctypes.c_int64
     _radix_lib = lib
     return lib
+
+
+_tokens_lib: Optional[ctypes.CDLL] = None
+_tokens_lib_missing = False
+
+
+def tokens_lib() -> Optional[ctypes.CDLL]:
+    """The libdynamo_tokens.so handle (chained block hashing), or None."""
+    global _tokens_lib, _tokens_lib_missing
+    if _tokens_lib is not None or _tokens_lib_missing:
+        return _tokens_lib
+    path = os.path.join(_BUILD, "libdynamo_tokens.so")
+    if not os.path.exists(path):
+        _tokens_lib_missing = True
+        return None
+    lib = ctypes.CDLL(path)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    lib.dyn_hash_bytes.argtypes = [u8p, ctypes.c_uint64]
+    lib.dyn_hash_bytes.restype = ctypes.c_uint64
+    lib.dyn_block_hashes.argtypes = [
+        u32p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64, u64p]
+    lib.dyn_block_hashes.restype = ctypes.c_uint64
+    _tokens_lib = lib
+    return lib

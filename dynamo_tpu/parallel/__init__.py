@@ -8,11 +8,23 @@ from .mesh import (
     shard_kv_cache,
     shard_params,
 )
+from .multihost import (
+    broadcast_plan,
+    global_mesh,
+    host_array_to_global,
+    initialize_multihost,
+    is_multihost,
+)
 from .pipeline import microbatch, pipeline_forward, stage_pspec
 from .ring_attention import ring_attention, ring_attention_local
 
 __all__ = [
     "ParallelConfig",
+    "broadcast_plan",
+    "global_mesh",
+    "host_array_to_global",
+    "initialize_multihost",
+    "is_multihost",
     "make_mesh",
     "microbatch",
     "pipeline_forward",

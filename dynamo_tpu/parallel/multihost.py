@@ -1,0 +1,122 @@
+"""Multi-host initialization and global meshes.
+
+The reference reaches multi-node through its engines (vLLM/SGLang NCCL
+worlds under `MultinodeSpec` nodeCount,
+/root/reference/deploy/cloud/operator/api/v1alpha1/
+dynamocomponentdeployment_types.go:108); TPU-natively the equivalent is
+`jax.distributed.initialize` + a mesh over the GLOBAL device set, with
+XLA collectives riding ICI within a slice and DCN across slices.
+
+Deployment contract (SPMD): every host in a multihost worker group runs
+the same program and must issue the same jitted steps in the same order —
+one registered worker per host, rank 0's scheduler decisions broadcast
+via `broadcast_plan`.  Host-local arrays enter global shardings through
+`host_array_to_global` (each process contributes the shards it owns).
+
+Env surface (DYN_* style, overridable by CLI flags):
+  DYN_COORDINATOR    host:port of rank 0's coordinator
+  DYN_NUM_HOSTS      number of processes in the group
+  DYN_HOST_ID        this process's rank
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+logger = logging.getLogger(__name__)
+
+
+_initialized = False
+
+
+def initialize_multihost(
+    coordinator: Optional[str] = None,
+    num_hosts: Optional[int] = None,
+    host_id: Optional[int] = None,
+) -> bool:
+    """Join the jax distributed world (idempotent; no-op for single host).
+
+    Returns True when running multi-host.  Must be called before any
+    device query on every host in the group.
+    """
+    global _initialized
+
+    from ..runtime.config import env_int, env_str
+
+    coordinator = coordinator or env_str("DYN_COORDINATOR")
+    num_hosts = num_hosts if num_hosts is not None else env_int("DYN_NUM_HOSTS", 0)
+    host_id = host_id if host_id is not None else env_int("DYN_HOST_ID", 0)
+    if not coordinator or not num_hosts or num_hosts <= 1:
+        return False
+    if _initialized:
+        return True
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_hosts,
+        process_id=host_id,
+    )
+    _initialized = True
+    logger.info(
+        "multihost up: rank %d/%d, %d global / %d local devices",
+        host_id, num_hosts, jax.device_count(), jax.local_device_count(),
+    )
+    return True
+
+
+def is_multihost() -> bool:
+    return jax.process_count() > 1
+
+
+def global_mesh(dp: int, tp: int, devices: Optional[Sequence] = None) -> Mesh:
+    """dp×tp mesh over the GLOBAL device set, laid out so tp groups stay
+    within a host whenever tp divides the local device count (tp traffic
+    rides ICI; dp crosses hosts over DCN)."""
+    devices = list(devices if devices is not None else jax.devices())
+    if dp * tp != len(devices):
+        raise ValueError(f"dp*tp = {dp * tp} != global devices {len(devices)}")
+    arr = np.array(devices).reshape(dp, tp)
+    return Mesh(arr, axis_names=("dp", "tp"))
+
+
+def host_array_to_global(mesh: Mesh, spec: PartitionSpec, host_array) -> jax.Array:
+    """Place a host-local numpy array into a global sharding: every
+    process passes the SAME logical array and contributes the shards its
+    devices own (single-host: plain device_put)."""
+    sharding = NamedSharding(mesh, spec)
+    host_array = np.asarray(host_array)
+    if not is_multihost():
+        return jax.device_put(host_array, sharding)
+    # global_shape MUST be passed: without it jax infers the global shape
+    # by concatenating per-process data along sharded dims (doubling every
+    # cross-host axis when each process passes the full array)
+    return jax.make_array_from_process_local_data(
+        sharding, host_array, global_shape=host_array.shape
+    )
+
+
+def broadcast_plan(payload: bytes, root: int = 0) -> bytes:
+    """Broadcast rank-`root`'s bytes to every host (the scheduler-plan
+    broadcast that keeps multihost engine pumps in lockstep)."""
+    from jax.experimental import multihost_utils
+
+    if not is_multihost():
+        return payload
+    max_len = 1 << 16
+    if len(payload) > max_len:
+        raise ValueError(f"plan too large to broadcast ({len(payload)}B)")
+    local = np.zeros((max_len + 8,), np.uint8)
+    if jax.process_index() == root:
+        local[:8] = np.frombuffer(np.int64(len(payload)).tobytes(), np.uint8)
+        local[8:8 + len(payload)] = np.frombuffer(payload, np.uint8)
+    out = np.asarray(
+        multihost_utils.broadcast_one_to_all(
+            local, is_source=jax.process_index() == root
+        )
+    ).astype(np.uint8)
+    n = int(np.frombuffer(out[:8].tobytes(), np.int64)[0])
+    return out[8:8 + n].tobytes()

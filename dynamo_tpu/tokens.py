@@ -41,15 +41,47 @@ def compute_block_hash_for_seq(
     """Chained hashes of each *full* block of `tokens`.
 
     Returns one u64 per full block; a trailing partial block contributes
-    nothing (it is not shareable yet).
+    nothing (it is not shareable yet).  Uses the native batched hasher
+    (native/block_hash.cpp) when built — one FFI call per sequence
+    instead of one hashlib call per block.
     """
+    n_full = len(tokens) // block_size
+    if n_full == 0:
+        return []
+    lib = _native_lib()
+    if lib is not None:
+        return _native_block_hashes(lib, tokens, block_size, chain_seed(salt))
     hashes: List[int] = []
     parent = chain_seed(salt)
-    n_full = len(tokens) // block_size
     for i in range(n_full):
         parent = next_block_hash(parent, tokens[i * block_size : (i + 1) * block_size])
         hashes.append(parent)
     return hashes
+
+
+def _native_lib():
+    from .native import tokens_lib
+
+    return tokens_lib()
+
+
+def _native_block_hashes(lib, tokens: Sequence[int], block_size: int,
+                         seed: int) -> List[int]:
+    import array
+    import ctypes
+
+    # array.array builds the u32 buffer at C speed (per-element ctypes
+    # construction costs more than the hashing it replaces)
+    buf = (
+        tokens
+        if isinstance(tokens, array.array) and tokens.typecode == "I"
+        else array.array("I", tokens)
+    )
+    n = len(buf)
+    arr = (ctypes.c_uint32 * n).from_buffer(buf)
+    out = (ctypes.c_uint64 * (n // block_size))()
+    n_full = lib.dyn_block_hashes(arr, n, block_size, seed, out)
+    return list(out[:n_full])
 
 
 def hash_for_partial(parent: int, tokens: Sequence[int]) -> int:

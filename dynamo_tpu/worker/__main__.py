@@ -49,6 +49,12 @@ def main() -> None:
     ap.add_argument("--status-port", type=int, default=0,
                     help="system status server port (0 = ephemeral, "
                          "-1 = disabled); serves /health /live /metrics")
+    # multihost (jax.distributed): every host in the group runs this CLI
+    # with the same flags and a unique --host-id; see parallel/multihost.py
+    ap.add_argument("--coordinator", default="",
+                    help="rank-0 coordinator host:port (DYN_COORDINATOR)")
+    ap.add_argument("--num-hosts", type=int, default=None)
+    ap.add_argument("--host-id", type=int, default=None)
     ap.add_argument("--prefill-router", default="", metavar="COMPONENT",
                     help="route remote prefills through a standalone "
                          "router service registered at this component "
@@ -79,6 +85,9 @@ def main() -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    from ..parallel import initialize_multihost
+
+    initialize_multihost(args.coordinator, args.num_hosts, args.host_id)
     asyncio.run(_run(args))
 
 

@@ -1,0 +1,150 @@
+// Chained token-block hashing — native twin of dynamo_tpu/tokens.py
+// (reference: the dynamo-tokens Rust crate, lib/tokens/src/lib.rs).
+//
+// One FFI call hashes every full block of a sequence: the Python path
+// makes one hashlib call per block (per request, per router hop), which
+// dominates routing cost for long prompts.  BLAKE2b implemented per
+// RFC 7693 so digests match hashlib.blake2b(digest_size=8) bit-for-bit
+// (verified by tests/test_native_hash.py).
+//
+// Build: make -C native   →  build/libdynamo_tokens.so  (ctypes)
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr uint64_t IV[8] = {
+    0x6a09e667f3bcc908ULL, 0xbb67ae8584caa73bULL, 0x3c6ef372fe94f82bULL,
+    0xa54ff53a5f1d36f1ULL, 0x510e527fade682d1ULL, 0x9b05688c2b3e6c1fULL,
+    0x1f83d9abfb41bd6bULL, 0x5be0cd19137e2179ULL,
+};
+
+constexpr uint8_t SIGMA[12][16] = {
+    {0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15},
+    {14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3},
+    {11, 8, 12, 0, 5, 2, 15, 13, 10, 14, 3, 6, 7, 1, 9, 4},
+    {7, 9, 3, 1, 13, 12, 11, 14, 2, 6, 5, 10, 4, 0, 15, 8},
+    {9, 0, 5, 7, 2, 4, 10, 15, 14, 1, 11, 12, 6, 8, 3, 13},
+    {2, 12, 6, 10, 0, 11, 8, 3, 4, 13, 7, 5, 15, 14, 1, 9},
+    {12, 5, 1, 15, 14, 13, 4, 10, 0, 7, 6, 3, 9, 2, 8, 11},
+    {13, 11, 7, 14, 12, 1, 3, 9, 5, 0, 15, 4, 8, 6, 2, 10},
+    {6, 15, 14, 9, 11, 3, 0, 8, 12, 2, 13, 7, 1, 4, 10, 5},
+    {10, 2, 8, 4, 7, 6, 1, 5, 15, 11, 9, 14, 3, 12, 13, 0},
+    {0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15},
+    {14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3},
+};
+
+inline uint64_t rotr64(uint64_t x, int n) {
+  return (x >> n) | (x << (64 - n));
+}
+
+struct Blake2b {
+  uint64_t h[8];
+  uint8_t buf[128];
+  size_t buflen = 0;
+  uint64_t t = 0;  // bytes compressed so far (sequences stay < 2^64)
+
+  explicit Blake2b(size_t digest_len) {
+    for (int i = 0; i < 8; i++) h[i] = IV[i];
+    // parameter block word 0: digest_length | (key_len<<8) | fanout<<16 |
+    // depth<<24 — sequential mode, no key
+    h[0] ^= 0x01010000ULL ^ (uint64_t)digest_len;
+  }
+
+  void compress(const uint8_t* block, bool last) {
+    uint64_t m[16];
+    std::memcpy(m, block, 128);
+    uint64_t v[16];
+    for (int i = 0; i < 8; i++) v[i] = h[i];
+    for (int i = 0; i < 8; i++) v[i + 8] = IV[i];
+    v[12] ^= t;
+    // t_hi stays 0 for our sizes
+    if (last) v[14] = ~v[14];
+
+    auto G = [&](int a, int b, int c, int d, uint64_t x, uint64_t y) {
+      v[a] = v[a] + v[b] + x;
+      v[d] = rotr64(v[d] ^ v[a], 32);
+      v[c] = v[c] + v[d];
+      v[b] = rotr64(v[b] ^ v[c], 24);
+      v[a] = v[a] + v[b] + y;
+      v[d] = rotr64(v[d] ^ v[a], 16);
+      v[c] = v[c] + v[d];
+      v[b] = rotr64(v[b] ^ v[c], 63);
+    };
+    for (int r = 0; r < 12; r++) {
+      const uint8_t* s = SIGMA[r];
+      G(0, 4, 8, 12, m[s[0]], m[s[1]]);
+      G(1, 5, 9, 13, m[s[2]], m[s[3]]);
+      G(2, 6, 10, 14, m[s[4]], m[s[5]]);
+      G(3, 7, 11, 15, m[s[6]], m[s[7]]);
+      G(0, 5, 10, 15, m[s[8]], m[s[9]]);
+      G(1, 6, 11, 12, m[s[10]], m[s[11]]);
+      G(2, 7, 8, 13, m[s[12]], m[s[13]]);
+      G(3, 4, 9, 14, m[s[14]], m[s[15]]);
+    }
+    for (int i = 0; i < 8; i++) h[i] ^= v[i] ^ v[i + 8];
+  }
+
+  void update(const uint8_t* data, size_t len) {
+    while (len > 0) {
+      if (buflen == 128) {  // buffer full and more coming → compress
+        t += 128;
+        compress(buf, false);
+        buflen = 0;
+      }
+      size_t take = 128 - buflen;
+      if (take > len) take = len;
+      std::memcpy(buf + buflen, data, take);
+      buflen += take;
+      data += take;
+      len -= take;
+    }
+  }
+
+  uint64_t final_u64() {
+    t += buflen;
+    std::memset(buf + buflen, 0, 128 - buflen);
+    compress(buf, true);
+    uint64_t out;
+    std::memcpy(&out, h, 8);  // first 8 little-endian digest bytes
+    return out;
+  }
+};
+
+uint64_t hash_once(const uint8_t* data, size_t len) {
+  Blake2b b(8);
+  b.update(data, len);
+  return b.final_u64();
+}
+
+}  // namespace
+
+extern "C" {
+
+// blake2b-8 of raw bytes (chain_seed computes salt hashes through this)
+uint64_t dyn_hash_bytes(const uint8_t* data, uint64_t len) {
+  return hash_once(data, (size_t)len);
+}
+
+// Chained block hashes: out[i] = H(out[i-1] || tokens[block i]) with
+// out[-1] = seed; tokens packed little-endian u32 (mirrors struct.pack).
+// Returns the number of full blocks written.
+uint64_t dyn_block_hashes(const uint32_t* tokens, uint64_t n_tokens,
+                          uint64_t block_size, uint64_t seed,
+                          uint64_t* out) {
+  if (block_size == 0) return 0;
+  uint64_t n_full = n_tokens / block_size;
+  uint64_t parent = seed;
+  for (uint64_t i = 0; i < n_full; i++) {
+    Blake2b b(8);
+    b.update(reinterpret_cast<const uint8_t*>(&parent), 8);
+    b.update(reinterpret_cast<const uint8_t*>(tokens + i * block_size),
+             block_size * 4);
+    parent = b.final_u64();
+    out[i] = parent;
+  }
+  return n_full;
+}
+
+}  // extern "C"

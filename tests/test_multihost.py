@@ -1,0 +1,160 @@
+"""Multi-host: 2 OS processes joined via jax.distributed, a global dp×tp
+mesh spanning both, SPMD model steps producing tokens identical to
+single-process — the TPU-native counterpart of the reference's
+multi-node engine worlds (MultinodeSpec nodeCount)."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)  # 2 local x 2 hosts = 4 global
+
+from dynamo_tpu.parallel.multihost import (
+    broadcast_plan, global_mesh, host_array_to_global, initialize_multihost,
+)
+
+rank = int(sys.argv[1])
+assert initialize_multihost(sys.argv[2], num_hosts=2, host_id=rank)
+assert jax.device_count() == 4 and jax.local_device_count() == 2
+
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from dynamo_tpu.models import KVCache, forward_decode, forward_prefill, init_params, tiny_config
+from dynamo_tpu.models.llama import kv_cache_pspec, param_pspecs
+
+cfg = tiny_config()
+params_host = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+mesh = global_mesh(dp=2, tp=2)
+
+specs = param_pspecs(cfg)
+params = jax.tree.map(
+    lambda a, s: host_array_to_global(mesh, s, np.asarray(a)), params_host, specs
+)
+page_size, pages_per_seq, B, S = 8, 6, 4, 16
+kv_spec = kv_cache_pspec()
+kv_host = KVCache.create(cfg, 1 + B * pages_per_seq, page_size, jnp.float32)
+kv = KVCache(
+    host_array_to_global(mesh, kv_spec.k, np.asarray(kv_host.k)),
+    host_array_to_global(mesh, kv_spec.v, np.asarray(kv_host.v)),
+)
+
+tokens = np.arange(B * S, dtype=np.int32).reshape(B, S) % cfg.vocab_size
+table = np.arange(1, 1 + B * pages_per_seq, dtype=np.int32).reshape(B, pages_per_seq)
+put = lambda arr, *ax: host_array_to_global(mesh, P(*ax), np.asarray(arr))
+
+# sampled tokens come back REPLICATED so every host can fetch them
+# (cross-process shards are not addressable locally)
+rep = NamedSharding(mesh, P())
+kv_out = KVCache(NamedSharding(mesh, kv_spec.k), NamedSharding(mesh, kv_spec.v))
+
+@lambda f: jax.jit(f, out_shardings=(rep, kv_out))
+def prefill_step(p, k, t, tb, pre, ch):
+    logits, k = forward_prefill(p, cfg, k, t, tb, pre, ch)
+    return jnp.argmax(logits, -1).astype(jnp.int32), k
+
+@lambda f: jax.jit(f, out_shardings=(rep, kv_out))
+def decode_step(p, k, t, po, tb):
+    logits, k = forward_decode(p, cfg, k, t, po, tb)
+    return jnp.argmax(logits, -1).astype(jnp.int32), k
+
+last_d, kv = prefill_step(
+    params, kv,
+    put(tokens, "dp", None), put(table, "dp", None),
+    put(np.zeros(B, np.int32), "dp"), put(np.full(B, S, np.int32), "dp"),
+)
+toks = []
+positions = np.full(B, S, np.int32)
+for step in range(4):
+    last = np.asarray(jax.device_get(last_d)).astype(np.int32)
+    toks.append(last.tolist())
+    last_d, kv = decode_step(
+        params, kv, put(last, "dp"), put(positions, "dp"), put(table, "dp", None),
+    )
+    positions = positions + 1
+
+# lockstep plan broadcast: every rank must see rank 0's bytes
+plan = broadcast_plan(b"plan-from-rank-0" if rank == 0 else b"overwritten")
+assert plan == b"plan-from-rank-0", plan
+print("TOKENS", repr(toks), flush=True)
+"""
+
+REFERENCE = r"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import jax.numpy as jnp
+from dynamo_tpu.models import KVCache, forward_decode, forward_prefill, init_params, tiny_config
+
+cfg = tiny_config()
+params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+page_size, pages_per_seq, B, S = 8, 6, 4, 16
+kv = KVCache.create(cfg, 1 + B * pages_per_seq, page_size, jnp.float32)
+tokens = jnp.asarray(np.arange(B * S, dtype=np.int32).reshape(B, S) % cfg.vocab_size)
+table = jnp.asarray(np.arange(1, 1 + B * pages_per_seq, dtype=np.int32).reshape(B, pages_per_seq))
+logits, kv = forward_prefill(params, cfg, kv, tokens, table,
+                             jnp.zeros(B, jnp.int32), jnp.full(B, S, jnp.int32))
+toks = []
+last = np.asarray(logits).argmax(-1).astype(np.int32)
+positions = np.full(B, S, np.int32)
+for step in range(4):
+    toks.append(last.tolist())
+    logits, kv = forward_decode(params, cfg, kv, jnp.asarray(last),
+                                jnp.asarray(positions), table)
+    last = np.asarray(logits).argmax(-1).astype(np.int32)
+    positions = positions + 1
+print("TOKENS", repr(toks), flush=True)
+"""
+
+
+def _tokens_from(out: str):
+    for line in out.splitlines():
+        if line.startswith("TOKENS "):
+            return eval(line[len("TOKENS "):])  # noqa: S307 — our own output
+    raise AssertionError(f"no TOKENS line in:\n{out}")
+
+
+@pytest.mark.timeout(300)
+def test_two_host_spmd_matches_single_process():
+    env = {**os.environ, "PYTHONPATH": ROOT}
+    env.pop("XLA_FLAGS", None)  # workers set their own device counts
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    coordinator = f"127.0.0.1:{s.getsockname()[1]}"
+    s.close()
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", WORKER, str(rank), coordinator],
+            env=env, cwd=ROOT, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+        )
+        for rank in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=240)
+        assert p.returncode == 0, out
+        outs.append(out)
+    ref = subprocess.run(
+        [sys.executable, "-c", REFERENCE], env=env, cwd=ROOT,
+        capture_output=True, text=True, timeout=240,
+    )
+    assert ref.returncode == 0, ref.stdout + ref.stderr
+
+    want = _tokens_from(ref.stdout)
+    for out in outs:
+        assert _tokens_from(out) == want

@@ -1,0 +1,48 @@
+"""Native block hasher == Python hashlib implementation, bit for bit."""
+
+import random
+
+import pytest
+
+from dynamo_tpu.native import tokens_lib
+from dynamo_tpu.tokens import (
+    _native_block_hashes,
+    chain_seed,
+    compute_block_hash_for_seq,
+    next_block_hash,
+)
+
+
+def _python_hashes(tokens, block_size, salt=""):
+    hashes, parent = [], chain_seed(salt)
+    for i in range(len(tokens) // block_size):
+        parent = next_block_hash(parent, tokens[i * block_size:(i + 1) * block_size])
+        hashes.append(parent)
+    return hashes
+
+
+@pytest.mark.skipif(tokens_lib() is None, reason="native lib not built")
+@pytest.mark.parametrize("n,bs,salt", [
+    (0, 16, ""), (15, 16, ""), (16, 16, ""), (1000, 16, ""),
+    (257, 8, "tenant-a"), (4096, 64, "s"), (33, 32, ""),
+])
+def test_native_matches_python(n, bs, salt):
+    rng = random.Random(n * 31 + bs)
+    tokens = [rng.randrange(0, 1 << 31) for _ in range(n)]
+    assert compute_block_hash_for_seq(tokens, bs, salt) == \
+        _python_hashes(tokens, bs, salt)
+
+
+@pytest.mark.skipif(tokens_lib() is None, reason="native lib not built")
+def test_native_raw_bytes_hash_matches_hashlib():
+    import ctypes
+    import hashlib
+    import struct
+
+    lib = tokens_lib()
+    for data in (b"", b"x", b"salt-string", bytes(range(256)) * 3):
+        buf = (ctypes.c_uint8 * len(data))(*data)
+        want = struct.unpack(
+            "<Q", hashlib.blake2b(data, digest_size=8).digest()
+        )[0]
+        assert lib.dyn_hash_bytes(buf, len(data)) == want
