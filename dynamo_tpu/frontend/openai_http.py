@@ -36,6 +36,9 @@ from .service import ModelManager, ModelWatcher
 
 logger = logging.getLogger(__name__)
 
+# idle SSE connections get a comment ping this often (seconds)
+SSE_KEEPALIVE_S = 10.0
+
 
 class _ChoiceParsers:
     """Per-choice output parsing: reasoning split first, then tool-call
@@ -459,7 +462,16 @@ class HttpService:
         live = n
         try:
             while live:
-                i, out, err = await queue.get()
+                try:
+                    i, out, err = await asyncio.wait_for(
+                        queue.get(), timeout=SSE_KEEPALIVE_S
+                    )
+                except asyncio.TimeoutError:
+                    # comment line keeps idle connections open through
+                    # proxies during long prefills (reference: SSE
+                    # keep-alive pings, http/service/openai.rs)
+                    await resp.write(b": keep-alive\n\n")
+                    continue
                 if err is not None:
                     status = "502"
                     chunk = _sse_error_chunk(rid, str(err))
