@@ -67,27 +67,43 @@ async def main_async():
     cfg = LLAMA_3_2_1B
     params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
     pages_per_seq = (PROMPT_LEN + GEN_TOKENS) // 16 + 1
-    ecfg = EngineConfig(
-        page_size=16,
-        num_pages=1 + BATCH * pages_per_seq + 32,
-        max_num_seqs=BATCH,
-        max_prefill_tokens=BATCH * PROMPT_LEN,  # all prompts in one dispatch
-        prefill_batch_size=BATCH,
-        max_model_len=PROMPT_LEN + GEN_TOKENS + 16,
-        decode_batch_buckets=[BATCH],
-        chunk_buckets=[PROMPT_LEN],
-        decode_steps=32,
-        decode_chain=4,  # chained dispatches hide the ~83ms axon RTT
-        enable_prefix_caching=False,  # measure raw compute, not cache hits
-    )
-    engine = JaxEngine(cfg, params, ecfg, eos_token_ids=[])
 
-    # warmup (compiles prefill + decode)
-    await run_round(engine, seed_base=0)
-    # measure
-    total, dt, ttft_p50, itl_p50 = await run_round(engine, seed_base=5000)
-    await engine.shutdown()
-    return total, dt, ttft_p50, itl_p50
+    def ecfg(quant):
+        return EngineConfig(
+            page_size=16,
+            num_pages=1 + BATCH * pages_per_seq + 32,
+            max_num_seqs=BATCH,
+            max_prefill_tokens=BATCH * PROMPT_LEN,  # all prompts, one dispatch
+            prefill_batch_size=BATCH,
+            max_model_len=PROMPT_LEN + GEN_TOKENS + 16,
+            decode_batch_buckets=[BATCH],
+            chunk_buckets=[PROMPT_LEN],
+            decode_steps=32,
+            decode_chain=4,  # chained dispatches hide the ~83ms axon RTT
+            enable_prefix_caching=False,  # raw compute, not cache hits
+            quantization=quant,
+        )
+
+    async def median_of(engine, rounds=3):
+        """One measured round is ~0.6s and tunnel jitter is 5-10%; the
+        MEDIAN round is robust to one bad sample without inflating the
+        number the way a best-of would (prior rounds were single-round)."""
+        await run_round(engine, seed_base=0)  # warmup compiles
+        results = [
+            await run_round(engine, seed_base=5000 + 999 * r)
+            for r in range(rounds)
+        ]
+        await engine.shutdown()
+        results.sort(key=lambda res: res[0] / res[1])
+        return results[len(results) // 2]
+
+    engine = JaxEngine(cfg, params, ecfg("none"), eos_token_ids=[])
+    total, dt, ttft_p50, itl_p50 = await median_of(engine)
+
+    # secondary metric: weight-only int8 serving (same engine, same shapes)
+    engine = JaxEngine(cfg, params, ecfg("int8"), eos_token_ids=[])
+    total_q, dt_q, _, _ = await median_of(engine)
+    return total, dt, ttft_p50, itl_p50, total_q / dt_q
 
 
 def previous_round_value():
@@ -109,7 +125,7 @@ def previous_round_value():
 
 
 def main():
-    total, dt, ttft_p50, itl_p50 = asyncio.run(main_async())
+    total, dt, ttft_p50, itl_p50, int8_tps = asyncio.run(main_async())
     value = round(total / dt, 2)
     prev = previous_round_value()
     vs = round(value / prev, 3) if prev else 1.0
@@ -120,6 +136,7 @@ def main():
         "vs_baseline": vs,
         "ttft_p50_ms": round(ttft_p50 * 1000, 1),
         "itl_p50_ms": round(itl_p50 * 1000, 2),
+        "int8_tok_s": round(int8_tps, 2),
     }))
 
 

@@ -38,6 +38,10 @@ class EngineConfig:
     enable_prefix_caching: bool = True
     block_hash_salt: str = ""
 
+    # weight-only quantization: "none" | "int8" (per-output-channel
+    # symmetric; halves weight HBM traffic on the decode hot path)
+    quantization: str = "none"
+
     # attention implementation: "auto" resolves to the Pallas streaming
     # kernels (ops/pallas_attention.py) on single-device TPU and the XLA
     # einsum path otherwise; "pallas"/"xla" force one
@@ -49,6 +53,10 @@ class EngineConfig:
     table_width_buckets: Optional[Sequence[int]] = None
 
     def __post_init__(self):
+        if self.quantization not in ("none", "int8"):
+            raise ValueError(
+                f"quantization must be none|int8, got {self.quantization!r}"
+            )
         if self.attention_impl not in ("auto", "adaptive", "pallas", "xla"):
             raise ValueError(
                 f"attention_impl must be auto|adaptive|pallas|xla, "

@@ -250,6 +250,15 @@ class JaxEngine:
         self._attn_impl = resolve_attention_impl(
             self.cfg.attention_impl, meshed=self.mesh is not None
         )
+        if self.cfg.quantization == "int8":
+            if self.mesh is not None:
+                raise ValueError(
+                    "int8 quantization is single-device for now (the "
+                    "sharding specs address unquantized pytrees)"
+                )
+            from ..models.quantization import quantize_params
+
+            params = quantize_params(params)
         self.params = self._shard_params(params)
         self.kv = self._make_kv()
         self._extra_event_sinks: List[Callable[[KvEvent], None]] = []

@@ -35,6 +35,7 @@ from ..ops import (
     write_kv_pages,
 )
 from .config import ModelConfig
+from .quantization import matmul_any
 
 Params = dict
 
@@ -174,13 +175,10 @@ def kv_cache_pspec(tp_axis: str = "tp") -> KVCache:
 
 
 def _mlp(lp: Params, x: jax.Array) -> jax.Array:
-    gate = jnp.einsum("bsh,hf->bsf", x, lp["w_gate"], preferred_element_type=jnp.float32)
-    up = jnp.einsum("bsh,hf->bsf", x, lp["w_up"], preferred_element_type=jnp.float32)
+    gate = matmul_any(x, lp["w_gate"], "bsh,hf->bsf")
+    up = matmul_any(x, lp["w_up"], "bsh,hf->bsf")
     act = jax.nn.silu(gate) * up
-    return jnp.einsum(
-        "bsf,fh->bsh", act.astype(x.dtype), lp["w_down"],
-        preferred_element_type=jnp.float32,
-    ).astype(x.dtype)
+    return matmul_any(act.astype(x.dtype), lp["w_down"], "bsf,fh->bsh").astype(x.dtype)
 
 
 def _moe_dense(lp: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
@@ -347,9 +345,10 @@ def _layer_prefill(
     k_pages, v_pages = kv_layer
 
     attn_in = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-    q = jnp.einsum("bsh,hd->bsd", attn_in, lp["wq"]).reshape(B, S, nh, hd)
-    k = jnp.einsum("bsh,hd->bsd", attn_in, lp["wk"]).reshape(B, S, nkv, hd)
-    v = jnp.einsum("bsh,hd->bsd", attn_in, lp["wv"]).reshape(B, S, nkv, hd)
+    dt = x.dtype
+    q = matmul_any(attn_in, lp["wq"], "bsh,hd->bsd").astype(dt).reshape(B, S, nh, hd)
+    k = matmul_any(attn_in, lp["wk"], "bsh,hd->bsd").astype(dt).reshape(B, S, nkv, hd)
+    v = matmul_any(attn_in, lp["wv"], "bsh,hd->bsd").astype(dt).reshape(B, S, nkv, hd)
     q = apply_rope(q, positions, inv_freq)
     k = apply_rope(k, positions, inv_freq)
 
@@ -360,8 +359,8 @@ def _layer_prefill(
     k_pages, v_pages = write_kv_pages(
         k_pages, v_pages, k, v, page_table, prefix_lens, chunk_lens
     )
-    attn_out = jnp.einsum(
-        "bsd,dh->bsh", attn.reshape(B, S, nh * hd), lp["wo"]
+    attn_out = matmul_any(
+        attn.reshape(B, S, nh * hd), lp["wo"], "bsd,dh->bsh"
     ).astype(x.dtype)
     x = x + attn_out
 
@@ -386,9 +385,10 @@ def _layer_decode(
     k_pages, v_pages = kv_layer
 
     attn_in = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-    q = (attn_in @ lp["wq"]).reshape(B, 1, nh, hd)
-    k = (attn_in @ lp["wk"]).reshape(B, 1, nkv, hd)
-    v = (attn_in @ lp["wv"]).reshape(B, 1, nkv, hd)
+    dt = x.dtype
+    q = matmul_any(attn_in, lp["wq"], "bh,hd->bd").astype(dt).reshape(B, 1, nh, hd)
+    k = matmul_any(attn_in, lp["wk"], "bh,hd->bd").astype(dt).reshape(B, 1, nkv, hd)
+    v = matmul_any(attn_in, lp["wv"], "bh,hd->bd").astype(dt).reshape(B, 1, nkv, hd)
     q = apply_rope(q, positions[:, None], inv_freq)[:, 0]
     k = apply_rope(k, positions[:, None], inv_freq)
 
@@ -397,7 +397,9 @@ def _layer_decode(
         k_pages, v_pages, k, v, page_table, positions, jnp.ones_like(positions)
     )
     attn = decode_attention(q, k_pages, v_pages, page_table, seq_lens, impl=attn_impl)
-    attn_out = (attn.reshape(B, nh * hd) @ lp["wo"]).astype(x.dtype)
+    attn_out = matmul_any(
+        attn.reshape(B, nh * hd), lp["wo"], "bd,dh->bh"
+    ).astype(x.dtype)
     x = x + attn_out
 
     mlp_in = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
@@ -410,8 +412,11 @@ def _layer_decode(
 
 def _lm_logits(params: Params, cfg: ModelConfig, x: jax.Array) -> jax.Array:
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
-    head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
-    return jnp.einsum("...h,hv->...v", x, head, preferred_element_type=jnp.float32)
+    head = params.get("lm_head")  # quantization adds one even when tied
+    if head is None:
+        return jnp.einsum("...h,hv->...v", x, params["embed"].T,
+                          preferred_element_type=jnp.float32)
+    return matmul_any(x, head, "...h,hv->...v")
 
 
 def forward_prefill(

@@ -1,0 +1,90 @@
+"""Weight-only int8 quantization for serving.
+
+Decode at small batch is HBM-bandwidth-bound: every step reads every
+weight once, so storing the big projection matrices as int8 with a
+per-output-channel scale halves the bytes the MXU waits on (the
+reference ecosystem gets this from its engines' FP8/INT8 paths; here it
+is first-party).  Dequantization is a cast fused into the matmul by XLA
+— compute stays bf16/f32.
+
+Quantized tensors ride the params pytree as ``{"q": int8[..., out],
+"s": f32[out]}`` dicts; `models.llama` consumes either form through
+`matmul_any`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+# weights quantized when their name matches (per layer); norms, router and
+# embeddings stay high-precision (embedding is a lookup; router logits are
+# tiny and drive discrete top-k choices)
+QUANT_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def is_quantized(w: Any) -> bool:
+    return isinstance(w, dict) and "q" in w
+
+
+def quantize_tensor(w: jax.Array, stacked: bool = False) -> Dict[str, jax.Array]:
+    """Symmetric per-output-channel int8 (last axis = output channels).
+
+    `stacked` keeps the leading (layer) axis: scales come out [L, out] so
+    every pytree leaf still scans over axis 0."""
+    reduce_axes = tuple(range(1 if stacked else 0, w.ndim - 1))
+    absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=reduce_axes)
+    scale = jnp.maximum(absmax, 1e-8) / 127.0
+    # broadcastable divisor: insert the reduced axes back as size-1
+    div = jnp.expand_dims(scale, tuple(
+        range(1 if stacked else 0, w.ndim - 1)
+    ))
+    q = jnp.clip(
+        jnp.round(w.astype(jnp.float32) / div), -127, 127
+    ).astype(jnp.int8)
+    return {"q": q, "s": scale.astype(jnp.float32)}
+
+
+def dequantize_tensor(wq: Dict[str, jax.Array], dtype=jnp.bfloat16) -> jax.Array:
+    q, s = wq["q"], wq["s"]
+    s = jnp.expand_dims(s, tuple(range(s.ndim - 1, q.ndim - 1)))
+    return (q.astype(jnp.float32) * s).astype(dtype)
+
+
+def quantize_params(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Quantize the projection weights of an `init_params`/loader pytree
+    in place (returns a new tree; layer-stacked arrays keep axis 0)."""
+    out = dict(params)
+    layers = dict(params["layers"])
+    for key in QUANT_KEYS:
+        w = layers.get(key)
+        # layer-stacked dense weights are [L, in, out]; MoE expert stacks
+        # ([L, E, in, out]) stay high-precision (ragged_dot path)
+        if w is not None and not is_quantized(w) and w.ndim == 3:
+            layers[key] = quantize_tensor(w, stacked=True)
+    out["layers"] = layers
+    if "lm_head" in params and not is_quantized(params["lm_head"]):
+        out["lm_head"] = quantize_tensor(params["lm_head"])
+    elif "lm_head" not in params and "embed" in params:
+        # tied embeddings: materialize an int8 head copy — the lm_head
+        # matmul is the single biggest weight read of a decode step and
+        # the embedding LOOKUP still uses the original table
+        out["lm_head"] = quantize_tensor(jnp.asarray(params["embed"]).T)
+    return out
+
+
+def matmul_any(x: jax.Array, w: Any, eq: str) -> jax.Array:
+    """einsum over a plain array or a quantized {"q","s"} dict.
+
+    The int8 operand is cast inside the contraction — XLA reads int8 from
+    HBM and converts on the way into the MXU; the per-channel scale is a
+    cheap epilogue on the (much smaller) output.
+    """
+    if not is_quantized(w):
+        return jnp.einsum(eq, x, w, preferred_element_type=jnp.float32)
+    y = jnp.einsum(
+        eq, x, w["q"].astype(x.dtype), preferred_element_type=jnp.float32
+    )
+    return y * w["s"]

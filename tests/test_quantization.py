@@ -1,0 +1,99 @@
+"""Weight-only int8: quantized model tracks the fp model closely and the
+engine serves with quantization enabled."""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine import EngineConfig, JaxEngine
+from dynamo_tpu.models import KVCache, forward_prefill, init_params, tiny_config
+from dynamo_tpu.models.quantization import (
+    dequantize_tensor,
+    matmul_any,
+    quantize_params,
+    quantize_tensor,
+)
+
+
+def test_quantize_round_trip_error_bounded():
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 128), jnp.float32)
+    q = quantize_tensor(w)
+    assert q["q"].dtype == jnp.int8 and q["s"].shape == (128,)
+    err = np.abs(np.asarray(dequantize_tensor(q, jnp.float32) - w))
+    # per-channel symmetric int8: error < scale/2 per element
+    assert err.max() <= float(np.asarray(q["s"]).max()) * 0.5 + 1e-6
+
+
+def test_matmul_any_quantized_close_to_fp():
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 64), jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(2), (64, 32), jnp.float32) * 0.1
+    fp = matmul_any(x, w, "bh,hf->bf")
+    q = matmul_any(x, quantize_tensor(w), "bh,hf->bf")
+    cos = np.sum(np.asarray(fp) * np.asarray(q)) / (
+        np.linalg.norm(fp) * np.linalg.norm(q)
+    )
+    assert cos > 0.999
+
+
+def test_quantized_forward_logits_close():
+    cfg = tiny_config()
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    qparams = quantize_params(params)
+    assert qparams["layers"]["wq"]["q"].dtype == jnp.int8
+
+    B, S, page = 2, 32, 8
+    kv = KVCache.create(cfg, 1 + B * S // page, page, jnp.float32)
+    kvq = KVCache.create(cfg, 1 + B * S // page, page, jnp.float32)
+    tokens = jnp.asarray(
+        np.arange(B * S, dtype=np.int32).reshape(B, S) % cfg.vocab_size
+    )
+    table = jnp.asarray(
+        np.arange(1, 1 + B * S // page, dtype=np.int32).reshape(B, -1)
+    )
+    pre = jnp.zeros((B,), jnp.int32)
+    chunk = jnp.full((B,), S, jnp.int32)
+    fp_logits, _ = forward_prefill(params, cfg, kv, tokens, table, pre, chunk)
+    q_logits, _ = forward_prefill(qparams, cfg, kvq, tokens, table, pre, chunk)
+    fp = np.asarray(fp_logits)
+    q = np.asarray(q_logits)
+    cos = (fp * q).sum(-1) / (
+        np.linalg.norm(fp, axis=-1) * np.linalg.norm(q, axis=-1)
+    )
+    assert cos.min() > 0.99
+
+
+async def test_engine_serves_quantized():
+    cfg = tiny_config()
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    engine = JaxEngine(
+        cfg, params,
+        EngineConfig(page_size=8, num_pages=64, max_num_seqs=2,
+                     max_prefill_tokens=64, max_model_len=128,
+                     quantization="int8"),
+        eos_token_ids=[], kv_dtype=jnp.float32,
+    )
+    req = {"token_ids": list(range(1, 40)),
+           "sampling_options": {"temperature": 0.0},
+           "stop_conditions": {"max_tokens": 6, "ignore_eos": True}}
+    toks = []
+    async for out in engine.generate(req):
+        assert out.get("finish_reason") != "error", out
+        toks += out["token_ids"]
+    assert len(toks) == 6
+    await engine.shutdown()
+
+
+def test_quantization_rejected_on_mesh():
+    from dynamo_tpu.parallel import ParallelConfig
+
+    cfg = tiny_config()
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    with pytest.raises(ValueError, match="single-device"):
+        JaxEngine(
+            cfg, params,
+            EngineConfig(quantization="int8"),
+            parallel=ParallelConfig(dp=4, tp=2),
+        )
