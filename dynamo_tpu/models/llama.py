@@ -414,6 +414,11 @@ def _lm_logits(params: Params, cfg: ModelConfig, x: jax.Array) -> jax.Array:
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     head = params.get("lm_head")  # quantization adds one even when tied
     if head is None:
+        if not cfg.tie_word_embeddings:
+            raise KeyError(
+                "untied model params are missing 'lm_head' — falling back "
+                "to embed.T would silently produce wrong logits"
+            )
         return jnp.einsum("...h,hv->...v", x, params["embed"].T,
                           preferred_element_type=jnp.float32)
     return matmul_any(x, head, "...h,hv->...v")

@@ -1,0 +1,105 @@
+"""SLA planner service: `python -m dynamo_tpu.planner`.
+
+Reference: `python -m dynamo.planner` (planner_sla.py:37 +
+utils/planner_core.py) — watches worker load metrics, predicts the next
+interval, sizes replica targets from perf profiles under TTFT/ITL SLOs,
+and applies them through a connector.
+
+Connectors:
+  --connector virtual   write desired targets to the control plane
+                        (an operator/launcher realizes them)
+  --connector local     spawn/stop `python -m dynamo_tpu.worker`
+                        subprocesses on this host (non-k8s autoscaling)
+
+Profiles come from `python -m dynamo_tpu.planner.profiler` sweeps
+(npz); without --decode-profile/--prefill-profile a queueing-shaped
+synthetic profile is used.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import signal
+
+logger = logging.getLogger(__name__)
+
+
+async def _amain(args) -> None:
+    from ..runtime import DistributedRuntime
+    from .connectors import LocalProcessConnector, VirtualConnector
+    from .core import Planner, PlannerConfig, SLO
+    from .perf_model import PerfProfile
+
+    runtime = await DistributedRuntime.connect(args.control)
+    if args.connector == "local":
+        connector = LocalProcessConnector(
+            runtime, args.control,
+            worker_args=args.worker_args.split() if args.worker_args else None,
+            namespace=args.namespace, component=args.component,
+        )
+    else:
+        connector = VirtualConnector(
+            runtime, namespace=args.namespace, component=args.component
+        )
+    await connector.start()
+
+    def load(path):
+        return PerfProfile.load_npz(path) if path else None
+
+    planner = Planner(
+        connector,
+        prefill_profile=load(args.prefill_profile),
+        decode_profile=load(args.decode_profile),
+        config=PlannerConfig(
+            slo=SLO(ttft_s=args.ttft_slo, itl_s=args.itl_slo),
+            adjustment_interval_s=args.interval,
+            min_replicas=args.min_replicas,
+            max_replicas=args.max_replicas,
+        ),
+    ).start()
+    print(f"READY planner connector={args.connector} "
+          f"slo=ttft:{args.ttft_slo}s/itl:{args.itl_slo}s", flush=True)
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    await planner.stop()
+    await connector.stop()
+    await runtime.shutdown(graceful=False)
+
+
+def main() -> None:
+    from ..runtime.config import RuntimeConfig
+    from ..runtime.tracing import setup_logging
+
+    _env_control = RuntimeConfig.from_env().control
+    ap = argparse.ArgumentParser("dynamo_tpu.planner")
+    ap.add_argument("--control", required=not _env_control,
+                    default=_env_control)
+    ap.add_argument("--namespace", default="dynamo")
+    ap.add_argument("--component", default="backend",
+                    help="worker component whose load is planned")
+    ap.add_argument("--connector", default="virtual",
+                    choices=["virtual", "local"])
+    ap.add_argument("--worker-args", default="",
+                    help="extra args for spawned workers (local connector)")
+    ap.add_argument("--ttft-slo", type=float, default=0.5)
+    ap.add_argument("--itl-slo", type=float, default=0.05)
+    ap.add_argument("--interval", type=float, default=30.0)
+    ap.add_argument("--min-replicas", type=int, default=1)
+    ap.add_argument("--max-replicas", type=int, default=64)
+    ap.add_argument("--prefill-profile", default="",
+                    help="PerfProfile npz from the sweep profiler")
+    ap.add_argument("--decode-profile", default="")
+    ap.add_argument("--log-level", default="")
+    args = ap.parse_args()
+    setup_logging(args.log_level)
+    asyncio.run(_amain(args))
+
+
+if __name__ == "__main__":
+    main()
