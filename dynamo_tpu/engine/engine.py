@@ -116,6 +116,25 @@ def _build_prefill_step(cfg: ModelConfig, with_top: bool = False,
     return step
 
 
+def _build_prefill_step_sp(cfg: ModelConfig, mesh, with_top: bool = False):
+    """Sequence-parallel whole-prompt prefill (parallel/sp_prefill.py):
+    the prompt is sharded over the sp axis and attention runs as ring
+    attention; sampling happens on the gathered last-position logits."""
+    from ..parallel.sp_prefill import forward_prefill_sp
+
+    @partial(jax.jit, donate_argnums=(1,))
+    def step(params, kv, tokens, page_table, prefix_lens, chunk_lens, samp, seeds, counters):
+        del prefix_lens  # whole-prompt prefill: enforced zero host-side
+        logits, kv = forward_prefill_sp(
+            params, cfg, kv, tokens, page_table, chunk_lens, mesh
+        )
+        out = sample_tokens(logits, samp, seeds, counters)
+        logp = compute_logprobs(logits, out)
+        return _pack_out(out, logp, logits if with_top else None), kv
+
+    return step
+
+
 def _build_export_fn():
     @jax.jit
     def export(kv, pages):  # pages [N] int32 → (k,v) [L, N, page, n_kv, hd]
@@ -233,11 +252,34 @@ class JaxEngine:
         # `--tp/--dp` flags, SURVEY.md §2.6).
         self.mesh = None
         self._dp = 1
+        self._sp = 1
         if parallel is not None and parallel.world > 1:
             from ..parallel import make_mesh
 
             self.mesh = make_mesh(parallel, devices)
             self._dp = parallel.dp
+            self._sp = parallel.sp
+            if self._sp > 1:
+                # sp prefill is whole-prompt ring attention: no cached
+                # prefixes, no chunking, buckets divisible by sp
+                if self.cfg.enable_prefix_caching:
+                    raise ValueError(
+                        "sp > 1 requires enable_prefix_caching=False "
+                        "(ring prefill assumes the prompt starts at 0)"
+                    )
+                if (self.cfg.max_prefill_tokens
+                        < self.cfg.max_model_len * self.cfg.prefill_batch_size):
+                    raise ValueError(
+                        "sp > 1 requires max_prefill_tokens >= "
+                        "max_model_len * prefill_batch_size — the step "
+                        "budget is shared across co-planned prompts and "
+                        "none may be split into chunks"
+                    )
+                bad = [b for b in self.cfg.chunk_buckets if b % self._sp]
+                if bad:
+                    raise ValueError(
+                        f"chunk buckets {bad} not divisible by sp={self._sp}"
+                    )
             # every batch shape must divide dp (rows beyond the real batch
             # are trash-page padding)
             self.cfg = dataclasses.replace(
@@ -395,9 +437,14 @@ class JaxEngine:
 
     def _get_prefill_step(self, with_top: bool):
         if with_top not in self._prefill_steps:
-            self._prefill_steps[with_top] = _build_prefill_step(
-                self.model_cfg, with_top, attn_impl=self._attn_impl
-            )
+            if self._sp > 1:
+                self._prefill_steps[with_top] = _build_prefill_step_sp(
+                    self.model_cfg, self.mesh, with_top
+                )
+            else:
+                self._prefill_steps[with_top] = _build_prefill_step(
+                    self.model_cfg, with_top, attn_impl=self._attn_impl
+                )
         return self._prefill_steps[with_top]
 
     def _get_decode_step(self, penalized: bool, with_top: bool):
@@ -646,6 +693,10 @@ class JaxEngine:
             prefix[i] = it.chunk_start
             chunk[i] = it.chunk_len
         seqs = [it.seq for it in items]
+        if self._sp > 1 and prefix.any():
+            # cannot happen with prefix caching off + whole-prompt chunks;
+            # guards scheduler regressions from silently corrupting sp runs
+            raise RuntimeError("sp prefill requires prefix_lens == 0")
         with_top = any(s.opts.top_logprobs > 0 for s in seqs)
         table = self._table_array(seqs, rows=B)
         seeds, counters = self._seed_arrays(seqs, B)

@@ -26,28 +26,42 @@ from ..models import ModelConfig, kv_cache_pspec, param_pspecs
 class ParallelConfig:
     dp: int = 1
     tp: int = 1
-    sp: int = 1  # sequence parallelism degree (within tp group for prefill)
+    # sequence parallelism: sp > 1 gives a dp×sp mesh where prefill runs
+    # ring attention over the prompt (parallel/sp_prefill.py); mutually
+    # exclusive with tp > 1 for now (params are replicated under sp)
+    sp: int = 1
 
     @property
     def world(self) -> int:
-        return self.dp * self.tp
+        return self.dp * self.tp * self.sp
 
     def validate(self, n_devices: int) -> None:
+        if self.sp > 1 and self.tp > 1:
+            raise ValueError("sp and tp cannot both exceed 1 (yet)")
         if self.world != n_devices:
             raise ValueError(
-                f"dp*tp = {self.world} != available devices {n_devices}"
+                f"dp*tp*sp = {self.world} != available devices {n_devices}"
             )
 
 
 def make_mesh(pcfg: ParallelConfig, devices: Optional[Sequence] = None) -> Mesh:
     devices = list(devices if devices is not None else jax.devices())
     pcfg.validate(len(devices))
+    if pcfg.sp > 1:
+        arr = np.array(devices).reshape(pcfg.dp, pcfg.sp)
+        return Mesh(arr, axis_names=("dp", "sp"))
     arr = np.array(devices).reshape(pcfg.dp, pcfg.tp)
     return Mesh(arr, axis_names=("dp", "tp"))
 
 
 def shard_params(params, cfg: ModelConfig, mesh: Mesh):
-    """Place a param pytree onto the mesh per the model's TP specs."""
+    """Place a param pytree onto the mesh: megatron TP specs on a tp
+    mesh, replicated on an sp mesh (sp parallelizes the sequence, not
+    the weights)."""
+    if "sp" in mesh.axis_names:
+        return jax.tree.map(
+            lambda x: jax.device_put(x, replicated(mesh)), params
+        )
     specs = param_pspecs(cfg)
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
@@ -55,6 +69,10 @@ def shard_params(params, cfg: ModelConfig, mesh: Mesh):
 
 
 def shard_kv_cache(kv, mesh: Mesh):
+    if "sp" in mesh.axis_names:
+        return jax.tree.map(
+            lambda x: jax.device_put(x, replicated(mesh)), kv
+        )
     spec = kv_cache_pspec()
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), kv, spec

@@ -1,0 +1,133 @@
+"""Sequence-parallel prefill for the serving engine.
+
+The reference has no sequence/context parallelism (SURVEY.md §2.6 —
+absent; long context is delegated to engines).  Here long-prompt prefill
+is sharded over an `sp` mesh axis: each device holds S/sp of the prompt,
+attention runs as ring attention (K/V blocks rotate over ICI while the
+flash accumulator runs), so prefill FLOPs and activation memory scale
+down by sp while attention stays exact.
+
+Design constraints (v1, enforced by the engine):
+- whole-prompt prefill (no cached prefix, no chunking): ring causality
+  assumes the chunk starts at position 0;
+- the KV pool is REPLICATED over sp (and dp): each device all-gathers
+  the new chunk's K/V and performs the identical pool scatter, keeping
+  every replica bit-identical without a pool-sized collective — sp buys
+  compute parallelism and activation memory, not KV capacity;
+- the sequence bucket must divide by sp and the batch by dp.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models import KVCache, ModelConfig
+from ..models.llama import _lm_logits, _mlp, _moe
+from ..models.quantization import matmul_any
+from ..ops import apply_rope, rms_norm, rope_frequencies, write_kv_pages
+from ._compat import shard_map
+from .ring_attention import ring_attention_local
+
+
+def _layer_sp(lp, kv_layer, x, positions, table_full, chunk_full, cfg, inv_freq):
+    """One decoder layer on a [Bl, Sl] shard: ring attention over sp, KV
+    written to the replicated pool from the all-gathered chunk."""
+    Bl, Sl, h = x.shape
+    nh, nkv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim_
+    k_pages, v_pages = kv_layer
+    dt = x.dtype
+
+    attn_in = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+    q = matmul_any(attn_in, lp["wq"], "bsh,hd->bsd").astype(dt).reshape(Bl, Sl, nh, hd)
+    k = matmul_any(attn_in, lp["wk"], "bsh,hd->bsd").astype(dt).reshape(Bl, Sl, nkv, hd)
+    v = matmul_any(attn_in, lp["wv"], "bsh,hd->bsd").astype(dt).reshape(Bl, Sl, nkv, hd)
+    q = apply_rope(q, positions, inv_freq)
+    k = apply_rope(k, positions, inv_freq)
+
+    attn = ring_attention_local(q, k, v, axis_name="sp", causal=True)
+
+    # the pool write must be identical on every device: gather the full
+    # chunk (sp → sequence axis, dp → batch axis) and scatter all rows
+    k_full = jax.lax.all_gather(k, "sp", axis=1, tiled=True)
+    v_full = jax.lax.all_gather(v, "sp", axis=1, tiled=True)
+    k_full = jax.lax.all_gather(k_full, "dp", axis=0, tiled=True)
+    v_full = jax.lax.all_gather(v_full, "dp", axis=0, tiled=True)
+    zeros = jnp.zeros((k_full.shape[0],), jnp.int32)
+    k_pages, v_pages = write_kv_pages(
+        k_pages, v_pages, k_full, v_full, table_full, zeros, chunk_full
+    )
+
+    attn_out = matmul_any(
+        attn.reshape(Bl, Sl, nh * hd), lp["wo"], "bsd,dh->bsh"
+    ).astype(dt)
+    x = x + attn_out
+    mlp_in = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+    mlp_out = _moe(lp, mlp_in, cfg) if cfg.is_moe else _mlp(lp, mlp_in)
+    return x + mlp_out.astype(dt), (k_pages, v_pages)
+
+
+def forward_prefill_sp(
+    params,
+    cfg: ModelConfig,
+    kv: KVCache,
+    tokens: jax.Array,  # [B, S] — S divisible by sp, B by dp
+    page_table: jax.Array,  # [B, max_pages]
+    chunk_lens: jax.Array,  # [B] valid tokens (prompt starts at position 0)
+    mesh: Mesh,
+) -> Tuple[jax.Array, KVCache]:
+    """Whole-prompt prefill with the sequence sharded over `sp`.
+
+    Returns (last-position logits [B, V], updated KVCache) — the pool
+    comes back replicated, ready for the ordinary decode path.
+    """
+    inv_freq = rope_frequencies(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
+
+    def body(params, kv_k, kv_v, tokens_l, table_l, chunk_l):
+        sp_i = jax.lax.axis_index("sp")
+        Bl, Sl = tokens_l.shape
+        positions = sp_i * Sl + jnp.arange(Sl)[None, :] + jnp.zeros(
+            (Bl, 1), jnp.int32
+        )
+        table_full = jax.lax.all_gather(table_l, "dp", axis=0, tiled=True)
+        chunk_full = jax.lax.all_gather(chunk_l, "dp", axis=0, tiled=True)
+
+        x = params["embed"][tokens_l]
+
+        def layer(carry, xs):
+            h = carry
+            lp, k_pages, v_pages = xs
+            h, (k_pages, v_pages) = _layer_sp(
+                lp, (k_pages, v_pages), h, positions, table_full,
+                chunk_full, cfg, inv_freq,
+            )
+            return h, (k_pages, v_pages)
+
+        x, (k_new, v_new) = jax.lax.scan(
+            layer, x, (params["layers"], kv_k, kv_v)
+        )
+        # the row's last valid hidden state lives on ONE shard: each
+        # shard contributes its masked candidate and a psum combines them
+        # — an O(h) collective instead of gathering the whole [Bl, S, h]
+        last = jnp.maximum(chunk_l - 1, 0)  # global position per row
+        owner = (last // Sl) == sp_i  # [Bl]
+        local_idx = jnp.clip(last - sp_i * Sl, 0, Sl - 1)
+        cand = jnp.take_along_axis(x, local_idx[:, None, None], axis=1)[:, 0]
+        x_last = jax.lax.psum(
+            jnp.where(owner[:, None], cand, jnp.zeros_like(cand)), "sp"
+        ).astype(x.dtype)
+        logits = _lm_logits(params, cfg, x_last)  # [Bl, V]
+        return logits, k_new, v_new
+
+    pspec = jax.tree.map(lambda _: P(), params)
+    logits, k_new, v_new = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(pspec, P(), P(), P("dp", "sp"), P("dp", None), P("dp")),
+        out_specs=(P("dp", None), P(), P()),
+    )(params, kv.k, kv.v, tokens, page_table, chunk_lens)
+    return logits, KVCache(k_new, v_new)

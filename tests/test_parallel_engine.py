@@ -7,6 +7,7 @@ import asyncio
 
 import jax
 import jax.numpy as jnp
+import pytest
 
 from dynamo_tpu.engine import EngineConfig, JaxEngine
 from dynamo_tpu.models import init_params, tiny_config, tiny_moe_config
@@ -104,3 +105,54 @@ async def test_engine_moe_ep_sharded():
     await par.shutdown()
 
     assert out_par == out_ref
+
+
+async def test_engine_sp_sequence_parallel_prefill():
+    """sp engine: whole-prompt ring-attention prefill over a dp×sp mesh,
+    greedy continuation identical to single-device (the sequence-parallel
+    serving path the reference lacks entirely, SURVEY.md §2.6)."""
+    cfg = tiny_config()
+    params = init_params(cfg, jax.random.PRNGKey(2), dtype=jnp.float32)
+    prompts = _prompts(cfg, n=3)
+
+    def ecfg():
+        return _ecfg(
+            enable_prefix_caching=False,
+            max_prefill_tokens=256,
+            max_model_len=256,
+        )
+
+    ref = JaxEngine(cfg, params, ecfg(), kv_dtype=jnp.float32)
+    out_ref = await _collect(ref, prompts)
+    await ref.shutdown()
+
+    par = JaxEngine(
+        cfg, params, ecfg(), kv_dtype=jnp.float32,
+        parallel=ParallelConfig(dp=2, sp=4),
+    )
+    assert par._sp == 4
+    out_par = await _collect(par, prompts)
+    await par.shutdown()
+
+    assert out_par == out_ref
+
+
+def test_engine_sp_validation():
+    cfg = tiny_config()
+    params = init_params(cfg, jax.random.PRNGKey(2), dtype=jnp.float32)
+    with pytest.raises(ValueError, match="prefix_caching"):
+        JaxEngine(
+            cfg, params,
+            _ecfg(enable_prefix_caching=True, max_prefill_tokens=256,
+                  max_model_len=256),
+            parallel=ParallelConfig(dp=2, sp=4),
+        )
+    with pytest.raises(ValueError, match="max_prefill_tokens"):
+        JaxEngine(
+            cfg, params,
+            _ecfg(enable_prefix_caching=False, max_prefill_tokens=64,
+                  max_model_len=256),
+            parallel=ParallelConfig(dp=2, sp=4),
+        )
+    with pytest.raises(ValueError, match="sp and tp"):
+        ParallelConfig(dp=2, tp=2, sp=2).validate(8)
