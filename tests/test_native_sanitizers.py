@@ -1,0 +1,21 @@
+"""Native components under ASan/UBSan and TSan (`make -C native check`) —
+the C++ counterpart of the reference's reliance on Rust ownership for
+memory/race safety (SURVEY.md §5)."""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no C++ toolchain")
+def test_native_sanitizer_harness():
+    r = subprocess.run(
+        ["make", "-C", os.path.join(ROOT, "native"), "check"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("native checks OK") == 2  # asan + tsan
