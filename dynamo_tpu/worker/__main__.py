@@ -151,6 +151,8 @@ async def _run(args) -> None:
         from ..runtime.health import HealthCheckManager
         from ..runtime.status import SystemStatusServer
 
+        from ..runtime.metrics import MetricsScope
+
         health = HealthCheckManager(runtime).start()
 
         def _stats():
@@ -160,7 +162,39 @@ async def _run(args) -> None:
             except Exception:  # noqa: BLE001
                 return {}
 
+        # Prometheus worker metrics (reference dynamo_component_*): a
+        # custom collector builds metric families from live engine
+        # ForwardPassMetrics on every scrape — counters for monotonic
+        # fields so rate() is well-typed, gauges for the rest
+        from prometheus_client.core import CounterMetricFamily, GaugeMetricFamily
+
+        scope = MetricsScope(
+            namespace=args.namespace, component=args.component,
+        )
+        _COUNTERS = ("num_requests_total", "kv_transfer_count",
+                     "kv_transfer_ms_total", "kv_transfer_bytes_total")
+
+        class _EngineCollector:
+            def collect(self):
+                labels = {"dynamo_namespace": args.namespace,
+                          "dynamo_component": args.component}
+                for key, value in _stats().items():
+                    if not isinstance(value, (int, float)):
+                        continue
+                    name = f"dynamo_tpu_worker_{key}"
+                    fam_cls = (CounterMetricFamily if key in _COUNTERS
+                               else GaugeMetricFamily)
+                    if fam_cls is CounterMetricFamily and name.endswith("_total"):
+                        name = name[: -len("_total")]  # client re-appends
+                    fam = fam_cls(name, f"engine {key} (live)",
+                                  labels=list(labels))
+                    fam.add_metric(list(labels.values()), value)
+                    yield fam
+
+        scope.registry.register(_EngineCollector())
+
         status = await SystemStatusServer(
+            metrics=scope,
             health_fn=lambda: _async_health(health),
             stats_fn=_stats,
             port=args.status_port,

@@ -145,7 +145,12 @@ def main():
         # request path (engine wedged → 503)
         health = http_json(f"http://127.0.0.1:{w1_status}/health")
         assert health["status"] == "healthy", health
-        print("OK worker status server healthy")
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{w1_status}/metrics", timeout=30
+        ) as r:
+            prom = r.read().decode()
+        assert "dynamo_tpu_worker_kv_usage" in prom, prom[:400]
+        print("OK worker status server healthy (+prometheus engine gauges)")
 
         # embeddings path end-to-end
         emb = http_json(f"{base}/v1/embeddings",
