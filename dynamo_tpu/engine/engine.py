@@ -293,11 +293,6 @@ class JaxEngine:
             self.cfg.attention_impl, meshed=self.mesh is not None
         )
         if self.cfg.quantization == "int8":
-            if self.mesh is not None:
-                raise ValueError(
-                    "int8 quantization is single-device for now (the "
-                    "sharding specs address unquantized pytrees)"
-                )
             from ..models.quantization import quantize_params
 
             params = quantize_params(params)

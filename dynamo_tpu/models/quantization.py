@@ -88,3 +88,37 @@ def matmul_any(x: jax.Array, w: Any, eq: str) -> jax.Array:
         eq, x, w["q"].astype(x.dtype), preferred_element_type=jnp.float32
     )
     return y * w["s"]
+
+
+def quantized_pspec(weight_spec):
+    """PartitionSpecs for a {"q","s"} leaf given the unquantized weight's
+    spec: q shards like the weight; the per-output-channel scale shards
+    on the weight's LAST axis entry."""
+    from jax.sharding import PartitionSpec as P
+
+    parts = tuple(weight_spec)
+    last = parts[-1] if parts else None
+    # stacked layer weights keep their leading (layer) axis on s
+    s_spec = P(parts[0], last) if len(parts) >= 3 else P(last)
+    return {"q": weight_spec, "s": s_spec}
+
+
+def quantize_pspecs(params, specs, tp_axis: str = "tp"):
+    """Mirror a pspec tree onto a (possibly quantized) params tree.
+
+    Tied models gain an ``lm_head`` leaf during quantization that the
+    unquantized spec tree lacks — it gets the untied head's convention
+    (vocab sharded on tp)."""
+    from jax.sharding import PartitionSpec as P
+
+    def walk(p, s):
+        if is_quantized(p):
+            return quantized_pspec(s)
+        if isinstance(p, dict):
+            return {
+                k: walk(p[k], s[k] if k in s else P(None, tp_axis))
+                for k in p
+            }
+        return s
+
+    return walk(params, specs)

@@ -56,13 +56,16 @@ def make_mesh(pcfg: ParallelConfig, devices: Optional[Sequence] = None) -> Mesh:
 
 def shard_params(params, cfg: ModelConfig, mesh: Mesh):
     """Place a param pytree onto the mesh: megatron TP specs on a tp
-    mesh, replicated on an sp mesh (sp parallelizes the sequence, not
-    the weights)."""
+    mesh (int8-quantized {"q","s"} leaves shard q like the weight and
+    the scale on the weight's output axis), replicated on an sp mesh
+    (sp parallelizes the sequence, not the weights)."""
     if "sp" in mesh.axis_names:
         return jax.tree.map(
             lambda x: jax.device_put(x, replicated(mesh)), params
         )
-    specs = param_pspecs(cfg)
+    from ..models.quantization import quantize_pspecs
+
+    specs = quantize_pspecs(params, param_pspecs(cfg))
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
     )

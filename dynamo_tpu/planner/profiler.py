@@ -189,34 +189,59 @@ def main(argv=None) -> None:
     ap.add_argument("--model", default="tiny",
                     help="tiny | llama-1b | checkpoint dir")
     ap.add_argument("--mock", action="store_true")
-    ap.add_argument("--isl", type=int, default=512)
-    ap.add_argument("--osl", type=int, default=64)
+    ap.add_argument("--isl", type=int, nargs="+", default=[512],
+                    help="one value sweeps a single cell; several sweep "
+                         "a grid (one npz per cell, reference "
+                         "pre_swept_results layout)")
+    ap.add_argument("--osl", type=int, nargs="+", default=[64])
     ap.add_argument("--concurrency", type=int, nargs="+",
                     default=[1, 2, 4, 8])
     ap.add_argument("--window", type=float, default=6.0)
     args = ap.parse_args(argv)
 
-    engine = _build_engine(args)
-    cfg = SweepConfig(
-        isl=args.isl, osl=args.osl,
-        concurrencies=args.concurrency,
-        prefill_window_s=args.window,
-    )
+    grid = [(i, o) for i in args.isl for o in args.osl]
 
-    async def run():
-        profile = await sweep_engine(engine, cfg)
-        if hasattr(engine, "shutdown"):
-            await engine.shutdown()
-        return profile
+    def cell_path(isl, osl):
+        if len(grid) == 1:
+            return args.out
+        import os
 
-    profile = asyncio.run(run())
-    profile.save_npz(args.out)
-    print(f"profile written to {args.out}:")
-    for c, itl, t in zip(profile.decode_concurrency, profile.itl_s,
-                         profile.decode_throughput):
-        print(f"  decode c={c:5.0f}: itl={itl*1000:7.2f}ms {t:9.1f} tok/s")
-    for load, ttft in zip(profile.prefill_load, profile.ttft_s):
-        print(f"  prefill {load:9.1f} tok/s offered: ttft={ttft*1000:7.1f}ms")
+        os.makedirs(args.out, exist_ok=True)
+        return os.path.join(args.out, f"isl{isl}_osl{osl}.npz")
+
+    index = {}
+    for isl, osl in grid:
+        cell_args = argparse.Namespace(**{**vars(args), "isl": isl, "osl": osl})
+        engine = _build_engine(cell_args)
+        cfg = SweepConfig(
+            isl=isl, osl=osl,
+            concurrencies=args.concurrency,
+            prefill_window_s=args.window,
+        )
+
+        async def run():
+            profile = await sweep_engine(engine, cfg)
+            if hasattr(engine, "shutdown"):
+                await engine.shutdown()
+            return profile
+
+        profile = asyncio.run(run())
+        path = cell_path(isl, osl)
+        profile.save_npz(path)
+        index[f"{isl}x{osl}"] = path
+        print(f"profile [isl={isl} osl={osl}] written to {path}:")
+        for c, itl, t in zip(profile.decode_concurrency, profile.itl_s,
+                             profile.decode_throughput):
+            print(f"  decode c={c:5.0f}: itl={itl*1000:7.2f}ms {t:9.1f} tok/s")
+        for load, ttft in zip(profile.prefill_load, profile.ttft_s):
+            print(f"  prefill {load:9.1f} tok/s offered: ttft={ttft*1000:7.1f}ms")
+    if len(grid) > 1:
+        import json
+        import os
+
+        with open(os.path.join(args.out, "index.json"), "w") as f:
+            json.dump(index, f, indent=2)
+        print(f"grid index written to {args.out}/index.json")
 
 
 if __name__ == "__main__":

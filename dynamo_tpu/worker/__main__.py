@@ -173,6 +173,9 @@ async def _run(args) -> None:
         )
         _COUNTERS = ("num_requests_total", "kv_transfer_count",
                      "kv_transfer_ms_total", "kv_transfer_bytes_total")
+        # prometheus appends _total to counter families: name them so the
+        # exposed series match the dashboard queries exactly
+        _RENAME = {"kv_transfer_count": "kv_transfers_total"}
 
         class _EngineCollector:
             def collect(self):
@@ -181,7 +184,7 @@ async def _run(args) -> None:
                 for key, value in _stats().items():
                     if not isinstance(value, (int, float)):
                         continue
-                    name = f"dynamo_tpu_worker_{key}"
+                    name = f"dynamo_tpu_worker_{_RENAME.get(key, key)}"
                     fam_cls = (CounterMetricFamily if key in _COUNTERS
                                else GaugeMetricFamily)
                     if fam_cls is CounterMetricFamily and name.endswith("_total"):

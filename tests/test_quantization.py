@@ -6,7 +6,6 @@ import asyncio
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from dynamo_tpu.engine import EngineConfig, JaxEngine
 from dynamo_tpu.models import KVCache, forward_prefill, init_params, tiny_config
@@ -86,14 +85,35 @@ async def test_engine_serves_quantized():
     await engine.shutdown()
 
 
-def test_quantization_rejected_on_mesh():
+async def test_quantized_engine_on_tp_mesh():
+    """int8 weights shard under the dp×tp mesh ({"q","s"} leaves get
+    derived pspecs): greedy output equals the single-device int8 engine."""
     from dynamo_tpu.parallel import ParallelConfig
 
     cfg = tiny_config()
     params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
-    with pytest.raises(ValueError, match="single-device"):
-        JaxEngine(
-            cfg, params,
-            EngineConfig(quantization="int8"),
-            parallel=ParallelConfig(dp=4, tp=2),
-        )
+
+    def ecfg():
+        return EngineConfig(page_size=8, num_pages=96, max_num_seqs=4,
+                            max_prefill_tokens=64, max_model_len=128,
+                            quantization="int8")
+
+    async def run(engine):
+        req = {"token_ids": list(range(1, 40)),
+               "sampling_options": {"temperature": 0.0},
+               "stop_conditions": {"max_tokens": 8, "ignore_eos": True}}
+        toks = []
+        async for out in engine.generate(req):
+            assert out.get("finish_reason") != "error", out
+            toks += out["token_ids"]
+        await engine.shutdown()
+        return toks
+
+    ref = JaxEngine(cfg, params, ecfg(), eos_token_ids=[], kv_dtype=jnp.float32)
+    want = await run(ref)
+    par = JaxEngine(
+        cfg, params, ecfg(), eos_token_ids=[], kv_dtype=jnp.float32,
+        parallel=ParallelConfig(dp=4, tp=2),
+    )
+    got = await run(par)
+    assert got == want
