@@ -181,3 +181,62 @@ async def test_kv_layout_registered_in_control_plane(model_setup):
         await prefill_engine.shutdown()
         await prefill_rt.shutdown(graceful=False)
         await control.stop()
+
+
+async def test_xpyd_runtime_reconfiguration(model_setup):
+    """Elastic xPyD (reference disagg_serving.md:110-120): a decode worker
+    starts with NO prefill workers (serves locally), a prefill worker
+    joins at runtime and long prompts start riding the data plane, then
+    it leaves and the decode worker falls back local again."""
+    control = await ControlPlaneServer().start()
+    decode_rt = await DistributedRuntime.connect(control.address)
+    decode_engine = make_engine(model_setup)
+    vocab = 256  # tiny_config vocab — keep every prompt in range
+    prompt_a = list(range(1, 81))
+    prompt_b = [(t * 3) % vocab for t in range(50, 130)]
+    prompt_c = [(t * 5 + 1) % vocab for t in range(1, 81)]
+    prefill_rt = prefill_engine = None
+    try:
+        handler = DisaggDecodeHandler(
+            decode_engine, decode_rt,
+            router=DisaggRouter(max_local_prefill_length=16),
+        )
+        # phase 1: no prefill workers → local serving works
+        got, _ = await collect(handler.generate(req(prompt_a), Context()))
+        assert len(got) == 8
+        assert handler.kv_transfer_count == 0
+
+        # phase 2: a prefill worker joins at runtime
+        prefill_rt = await DistributedRuntime.connect(control.address)
+        prefill_engine = make_engine(model_setup)
+        await serve_prefill_worker(
+            prefill_rt, prefill_engine, ModelDeploymentCard(name="tiny")
+        )
+        deadline = asyncio.get_running_loop().time() + 15
+        while handler.kv_transfer_count == 0:
+            assert asyncio.get_running_loop().time() < deadline
+            got, _ = await collect(handler.generate(req(prompt_b), Context()))
+            assert len(got) == 8
+            # vary the prompt (in-vocab): an identical one would be
+            # decode-prefix-cached and routed locally forever
+            prompt_b = [(t + 7) % vocab for t in prompt_b]
+            await asyncio.sleep(0.2)
+        transfers = handler.kv_transfer_count
+
+        # phase 3: the prefill worker leaves (explicit deregistration —
+        # the crashed-worker lease-expiry path is covered by
+        # tests/test_resilience.py) → fallback local, no errors
+        await prefill_rt.shutdown(graceful=False)
+        await prefill_engine.shutdown()
+        prefill_rt = prefill_engine = None
+        got, reason = await collect(handler.generate(req(prompt_c), Context()))
+        assert len(got) == 8 and reason == "length"
+        assert handler.kv_transfer_count == transfers  # no new transfers
+    finally:
+        await handler.shutdown()
+        if prefill_engine is not None:
+            await prefill_engine.shutdown()
+        if prefill_rt is not None:
+            await prefill_rt.shutdown(graceful=False)
+        await decode_rt.shutdown(graceful=False)
+        await control.stop()
