@@ -20,7 +20,7 @@ deployments configure processes without rewriting commands:
 from __future__ import annotations
 
 import os
-from dataclasses import dataclass, field
+from dataclasses import asdict, dataclass, field
 from typing import Dict, Optional
 
 
@@ -101,3 +101,24 @@ def parse_dyn_log(spec: str) -> tuple:
         else:
             level = part
     return level, targets
+
+
+def dump_config() -> dict:
+    """Resolved runtime configuration + the DYN_* environment that produced
+    it (the reference's `dynamo.common.config_dump` sanity utility)."""
+    cfg = RuntimeConfig.from_env()
+    return {
+        "resolved": asdict(cfg),
+        "env": {k: v for k, v in sorted(os.environ.items())
+                if k.startswith("DYN_")},
+    }
+
+
+def main() -> None:  # python -m dynamo_tpu.runtime.config
+    import json  # local: only the CLI needs it
+
+    print(json.dumps(dump_config(), indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -199,3 +199,14 @@ def test_hub_resolution(tmp_path, monkeypatch):
     # miss: error lists the chain
     with pytest.raises(FileNotFoundError, match="org/nope"):
         resolve_model("org/nope", allow_download=False)
+
+
+def test_config_dump(monkeypatch):
+    from dynamo_tpu.runtime.config import dump_config
+
+    monkeypatch.setenv("DYN_CONTROL", "h:9")
+    monkeypatch.setenv("DYN_NAMESPACE", "prod")
+    d = dump_config()
+    assert d["resolved"]["control"] == "h:9"
+    assert d["resolved"]["namespace"] == "prod"
+    assert d["env"]["DYN_CONTROL"] == "h:9"

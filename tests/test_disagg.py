@@ -195,7 +195,7 @@ async def test_xpyd_runtime_reconfiguration(model_setup):
     prompt_a = list(range(1, 81))
     prompt_b = [(t * 3) % vocab for t in range(50, 130)]
     prompt_c = [(t * 5 + 1) % vocab for t in range(1, 81)]
-    prefill_rt = prefill_engine = None
+    prefill_rt = prefill_engine = handler = None
     try:
         handler = DisaggDecodeHandler(
             decode_engine, decode_rt,
@@ -233,7 +233,10 @@ async def test_xpyd_runtime_reconfiguration(model_setup):
         assert len(got) == 8 and reason == "length"
         assert handler.kv_transfer_count == transfers  # no new transfers
     finally:
-        await handler.shutdown()
+        if handler is not None:
+            await handler.shutdown()
+        else:
+            await decode_engine.shutdown()
         if prefill_engine is not None:
             await prefill_engine.shutdown()
         if prefill_rt is not None:
