@@ -468,13 +468,22 @@ class JaxEngine:
 
     def metrics(self) -> ForwardPassMetrics:
         running, waiting = self.scheduler.num_requests()
-        return ForwardPassMetrics(
+        m = ForwardPassMetrics(
             active_seqs=running,
             waiting_seqs=waiting,
             kv_usage=self.pool.usage(),
             kv_total_pages=self.cfg.usable_pages,
             num_requests_total=self._requests_total,
         )
+        if self.tiered is not None:
+            # KVBM tier stats ride the same snapshot (dynamic attrs are
+            # picked up by vars() consumers: /metrics.json, Prometheus)
+            m.kvbm_host_blocks = len(self.tiered.host)
+            m.kvbm_pending_offloads = self.tiered.pending_offloads
+            m.kvbm_onboarded_blocks_total = self.tiered.onboarded_blocks
+            if self.tiered.disk is not None:
+                m.kvbm_disk_blocks = len(self.tiered.disk)
+        return m
 
     def clear_kv_blocks(self) -> int:
         return self.pool.clear_cache()
