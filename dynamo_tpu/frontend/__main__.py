@@ -23,6 +23,13 @@ def main() -> None:
                          "carry their own namespace and the watcher follows "
                          "all of them")
     ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--busy-threshold", type=float, default=0.0,
+                    help="kv-router mode: shed load (503) when every "
+                         "worker's kv_usage exceeds this (0 = off)")
+    ap.add_argument("--routes", default="",
+                    help="comma list restricting optional routes "
+                         "(chat,completions,embeddings,responses); "
+                         "empty = all")
     ap.add_argument("--tls-cert", default="", help="PEM cert chain → HTTPS")
     ap.add_argument("--tls-key", default="", help="PEM private key")
     ap.add_argument("--grpc-port", type=int, default=-1,
@@ -56,14 +63,21 @@ async def _run(args) -> None:
     if args.router_mode == "kv":
         from ..router import kv_chooser_factory
 
-        kv_factory = kv_chooser_factory(runtime)
+        kv_factory = kv_chooser_factory(
+            runtime, busy_threshold=args.busy_threshold
+        )
     watcher = await ModelWatcher(
         runtime, manager, router_mode=args.router_mode,
         kv_chooser_factory=kv_factory,
     ).start()
+    enabled = (
+        {r.strip() for r in args.routes.split(",") if r.strip()}
+        if args.routes else None
+    )
     http = await HttpService(
         manager, host=args.host, port=args.port,
         tls_cert=args.tls_cert, tls_key=args.tls_key,
+        enabled_routes=enabled,
     ).start()
     kserve = None
     if args.grpc_port >= 0:

@@ -106,7 +106,8 @@ class _ChoiceParsers:
 class HttpService:
     def __init__(self, manager: ModelManager, host: str = "0.0.0.0",
                  port: int = 8000, metrics: Optional[FrontendMetrics] = None,
-                 audit=None, tls_cert: str = "", tls_key: str = ""):
+                 audit=None, tls_cert: str = "", tls_key: str = "",
+                 enabled_routes: Optional[set] = None):
         from ..llm.audit import AuditBus
 
         self.manager = manager
@@ -125,19 +126,31 @@ class HttpService:
         # request/response audit bus (DYN_AUDIT_SINK or explicit)
         self.audit = audit if audit is not None else AuditBus.from_env()
         self.app = web.Application()
-        self.app.add_routes(
-            [
-                web.post("/v1/chat/completions", self.chat_completions),
-                web.post("/v1/completions", self.completions),
-                web.post("/v1/embeddings", self.embeddings),
-                web.post("/v1/responses", self.responses),
-                web.get("/v1/models", self.list_models),
-                web.get("/health", self.health),
-                web.get("/live", self.live),
-                web.get("/metrics", self.prometheus),
-                web.post("/clear_kv_blocks", self.clear_kv_blocks),
-            ]
-        )
+        # per-route enable flags (reference service_v2.rs per-route
+        # builder flags); health/live/metrics/models always serve
+        optional = {
+            "chat": web.post("/v1/chat/completions", self.chat_completions),
+            "completions": web.post("/v1/completions", self.completions),
+            "embeddings": web.post("/v1/embeddings", self.embeddings),
+            "responses": web.post("/v1/responses", self.responses),
+        }
+        if enabled_routes is not None:
+            unknown = set(enabled_routes) - set(optional)
+            if unknown:
+                raise ValueError(f"unknown routes {sorted(unknown)}; "
+                                 f"known: {sorted(optional)}")
+        routes = [
+            r for name, r in optional.items()
+            if enabled_routes is None or name in enabled_routes
+        ]
+        routes += [
+            web.get("/v1/models", self.list_models),
+            web.get("/health", self.health),
+            web.get("/live", self.live),
+            web.get("/metrics", self.prometheus),
+            web.post("/clear_kv_blocks", self.clear_kv_blocks),
+        ]
+        self.app.add_routes(routes)
         self._runner: Optional[web.AppRunner] = None
 
     # -- lifecycle ----------------------------------------------------------- #

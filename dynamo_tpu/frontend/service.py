@@ -49,6 +49,8 @@ class ModelEntry:
         """Pick a worker per router mode and stream engine outputs."""
         if self.kv_chooser is not None:
             request = {**request, "request_id": context.id}
+            # AllWorkersBusy (an Overloaded/ServiceUnavailable) propagates:
+            # migration re-raises it and the frontend answers 503
             worker_id = await self.kv_chooser.choose(request)
             stream = self.client.direct(request, worker_id, context)
             try:

@@ -15,7 +15,11 @@ import logging
 from typing import Any, AsyncIterator, Callable, Dict
 
 from ..runtime import Context
-from ..runtime.transport.service import RemoteStreamError, ServiceUnavailable
+from ..runtime.transport.service import (
+    Overloaded,
+    RemoteStreamError,
+    ServiceUnavailable,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -62,6 +66,10 @@ async def migrating_stream(
             # stream ended without finish_reason: treat as worker loss
             raise RemoteStreamError("stream ended without finish")
         except (ServiceUnavailable, RemoteStreamError, ConnectionError) as e:
+            if isinstance(e, Overloaded) and not generated:
+                # deliberate load shedding before any output: retrying
+                # cannot help — surface the 503 immediately
+                raise
             if context.is_killed() or context.is_stopped():
                 return
             if progressed:

@@ -1,7 +1,7 @@
 """KV-cache-aware routing: indexers, cost-based scheduler, publishers."""
 
 from .indexer import ApproxKvIndexer, RadixIndex
-from .kv_router import KvRouter, kv_chooser_factory
+from .kv_router import AllWorkersBusy, KvRouter, kv_chooser_factory
 from .publisher import (
     KvEventPublisher,
     WorkerMetricsPublisher,
@@ -17,6 +17,7 @@ from .scheduler import (
 from .sequence import ActiveSequences
 
 __all__ = [
+    "AllWorkersBusy",
     "ActiveSequences",
     "ApproxKvIndexer",
     "KvEventPublisher",

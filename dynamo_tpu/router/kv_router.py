@@ -35,6 +35,15 @@ logger = logging.getLogger(__name__)
 SNAPSHOT_BUCKET = "kv-router-snapshots"
 
 
+from ..runtime.transport.service import Overloaded
+
+
+class AllWorkersBusy(Overloaded):
+    """Every live worker is above the busy threshold — callers shed load
+    (the frontend answers 503; reference worker_monitor.rs:53
+    `KvWorkerMonitor` busy gating)."""
+
+
 class KvRouter:
     def __init__(
         self,
@@ -48,6 +57,7 @@ class KvRouter:
         use_approx: bool = False,
         snapshot_threshold: int = 1000,
         salt: str = "",
+        busy_threshold: float = 0.0,  # kv_usage above this = busy; 0 = off
     ):
         self.runtime = runtime
         self.client = client
@@ -56,6 +66,7 @@ class KvRouter:
         self.stream = kv_stream_name(namespace, component)
         self.metrics_subject = metrics_subject(namespace, component)
         self.snapshot_name = f"{namespace}.{component}"
+        self.busy_threshold = busy_threshold
         self.snapshot_threshold = snapshot_threshold
         self.index = RadixIndex()
         self.approx = ApproxKvIndexer() if use_approx else None
@@ -216,6 +227,17 @@ class KvRouter:
         hashes = compute_block_hash_for_seq(token_ids, self.block_size, self.salt)
         await self.client.wait_for_instances(timeout=5.0)
         workers = self._live_workers()
+        if self.busy_threshold > 0:
+            free = {
+                wid: st for wid, st in workers.items()
+                if st.kv_usage <= self.busy_threshold
+            }
+            if not free:
+                raise AllWorkersBusy(
+                    f"all {len(workers)} workers above kv_usage "
+                    f"{self.busy_threshold:.2f}"
+                )
+            workers = free
         overlaps = self.index.find_matches(hashes)
         if self.approx:
             a = self.approx.find_matches(hashes)

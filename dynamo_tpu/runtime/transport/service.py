@@ -184,6 +184,12 @@ class ServiceUnavailable(Exception):
     instance (drives request migration)."""
 
 
+class Overloaded(ServiceUnavailable):
+    """Deliberate load shedding (every candidate worker is busy) — NOT
+    retryable: migration re-raises it so the frontend answers 503
+    immediately instead of burning retries."""
+
+
 class RemoteStreamError(Exception):
     """The remote handler raised mid-stream."""
 

@@ -201,3 +201,38 @@ async def test_https_frontend(model_setup, tmp_path):
     finally:
         await https.stop()
         await stop_stack(control, worker_rt, front_rt, engine, watcher, http)
+
+
+async def test_route_enable_flags(model_setup):
+    """Per-route enable flags (reference service_v2 builder flags):
+    disabled routes 404 while enabled ones and the always-on set serve."""
+    import aiohttp
+
+    control, worker_rt, front_rt, engine, watcher, http = await start_stack(model_setup)
+    limited = await HttpService(
+        ModelManager(), host="127.0.0.1", port=0, enabled_routes={"chat"},
+    ).start()
+    limited.manager = http.manager
+    try:
+        base = f"http://127.0.0.1:{limited.port}"
+        async with aiohttp.ClientSession() as session:
+            body = {"model": "tiny-chat",
+                    "messages": [{"role": "user", "content": "hi"}],
+                    "max_tokens": 2, "nvext": {"ignore_eos": True}}
+            async with session.post(f"{base}/v1/chat/completions", json=body) as r:
+                assert r.status == 200
+            async with session.post(f"{base}/v1/completions",
+                                    json={"model": "tiny-chat", "prompt": "x"}) as r:
+                assert r.status == 404
+            async with session.post(f"{base}/v1/embeddings",
+                                    json={"model": "tiny-chat", "input": "x"}) as r:
+                assert r.status == 404
+            async with session.get(f"{base}/v1/models") as r:
+                assert r.status == 200  # always-on
+        import pytest
+
+        with pytest.raises(ValueError, match="unknown routes"):
+            HttpService(ModelManager(), enabled_routes={"nope"})
+    finally:
+        await limited.stop()
+        await stop_stack(control, worker_rt, front_rt, engine, watcher, http)

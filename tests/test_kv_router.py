@@ -244,3 +244,107 @@ async def test_metrics_flow_to_router():
             assert st.kv_total_pages == 127  # 128 pages minus trash page
     finally:
         await stop_fleet(*stack)
+
+
+async def test_busy_threshold_sheds_load():
+    """Busy gating (reference KvWorkerMonitor): workers above the
+    kv_usage threshold are excluded; when ALL are busy the router raises
+    AllWorkersBusy (mapped to HTTP 503 by the frontend)."""
+    from dynamo_tpu.router import AllWorkersBusy
+    from dynamo_tpu.router.kv_router import WorkerState
+
+    stack = await start_fleet(2)
+    control, runtimes, engines, front, client, router = stack
+    try:
+        router.busy_threshold = 0.5
+        deadline = asyncio.get_running_loop().time() + 5
+        while len(router.worker_states) < 2:
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.05)
+        wids = list(router.worker_states)
+
+        def inject(usage0, usage1):
+            # pin the router's view: live metric publications (every
+            # 0.5s) could otherwise overwrite synthetic states inside
+            # choose()'s await points
+            states = {
+                wids[0]: WorkerState(worker_id=wids[0], kv_usage=usage0,
+                                     kv_total_pages=127),
+                wids[1]: WorkerState(worker_id=wids[1], kv_usage=usage1,
+                                     kv_total_pages=127),
+            }
+            router._live_workers = lambda: states
+
+        # one busy worker → routing avoids it
+        inject(0.9, 0.1)
+        for i in range(3):
+            chosen = await router.choose(
+                {"token_ids": list(range(32 * (i + 1))), "request_id": f"b{i}"})
+            assert chosen == wids[1]
+            router.mark_finished(f"b{i}")
+
+        # every worker busy → shed
+        import pytest
+
+        inject(0.9, 0.95)
+        with pytest.raises(AllWorkersBusy):
+            await router.choose({"token_ids": [1, 2, 3], "request_id": "x"})
+
+        # threshold off → routes again
+        router.busy_threshold = 0.0
+        chosen = await router.choose({"token_ids": [1, 2, 3], "request_id": "y"})
+        assert chosen in wids
+        router.mark_finished("y")
+    finally:
+        await stop_fleet(*stack)
+
+
+async def test_busy_shed_returns_503_through_http():
+    """The full path: kv-mode frontend + busy workers → HTTP 503 (the
+    shed must BYPASS migration retries, not decay into a 500)."""
+    import aiohttp
+
+    from dynamo_tpu.frontend import HttpService, ModelManager, ModelWatcher
+    from dynamo_tpu.router import kv_chooser_factory
+    from dynamo_tpu.router.kv_router import WorkerState
+    from dynamo_tpu.testing import tiny_tokenizer
+
+    control = await ControlPlaneServer().start()
+    rt = await DistributedRuntime.connect(control.address)
+    engine = MockEngine(fleet_args())
+    tok = tiny_tokenizer()
+    await serve_engine(rt, engine, ModelDeploymentCard(
+        name="mock", context_length=2048, tokenizer_json=tok.to_json_str(),
+    ))
+    front_rt = await DistributedRuntime.connect(control.address)
+    manager = ModelManager()
+    watcher = await ModelWatcher(
+        front_rt, manager, router_mode="kv",
+        kv_chooser_factory=kv_chooser_factory(front_rt, busy_threshold=0.5),
+    ).start()
+    entry = await watcher.wait_for_model("mock")
+    http = await HttpService(manager, host="127.0.0.1", port=0).start()
+    try:
+        wid = next(iter(entry.instances))
+        base = f"http://127.0.0.1:{http.port}"
+        body = {"model": "mock",
+                "messages": [{"role": "user", "content": "hello"}],
+                "max_tokens": 4, "nvext": {"ignore_eos": True}}
+        async with aiohttp.ClientSession() as session:
+            # healthy worker → 200
+            async with session.post(f"{base}/v1/chat/completions", json=body) as r:
+                assert r.status == 200
+
+            # saturate: the router sees only a busy worker
+            busy = {wid: WorkerState(worker_id=wid, kv_usage=0.99,
+                                     kv_total_pages=127)}
+            entry.kv_chooser._live_workers = lambda: busy
+            async with session.post(f"{base}/v1/chat/completions", json=body) as r:
+                assert r.status == 503, await r.text()
+    finally:
+        await http.stop()
+        await watcher.stop()
+        await engine.shutdown()
+        await front_rt.shutdown(graceful=False)
+        await rt.shutdown(graceful=False)
+        await control.stop()
