@@ -101,13 +101,34 @@ def _unpack_out(packed: np.ndarray, b: int, with_top: bool = False):
     )
 
 
+def _lockstep_out_shardings(mesh, *extra):
+    """jit out_shardings for multihost lockstep: the packed sample output
+    comes back REPLICATED (cross-process shards are not addressable, so
+    the leader could not read a dp-sharded result), the KV keeps its
+    serving layout, extras keep their stated specs."""
+    from ..models import kv_cache_pspec
+
+    rep = NamedSharding(mesh, P())
+    kv = jax.tree.map(lambda s: NamedSharding(mesh, s), kv_cache_pspec())
+    return (rep, *[
+        jax.tree.map(lambda s: NamedSharding(mesh, s), e) for e in extra
+    ], kv)
+
+
 def _build_prefill_step(cfg: ModelConfig, with_top: bool = False,
-                        attn_impl: str = "xla"):
-    @partial(jax.jit, donate_argnums=(1,))
-    def step(params, kv, tokens, page_table, prefix_lens, chunk_lens, samp, seeds, counters):
+                        attn_impl: str = "xla", lockstep_mesh=None,
+                        with_embeds: bool = False):
+    kw = ({"out_shardings": _lockstep_out_shardings(lockstep_mesh)}
+          if lockstep_mesh is not None else {})
+
+    @partial(jax.jit, donate_argnums=(1,), **kw)
+    def step(params, kv, tokens, page_table, prefix_lens, chunk_lens, samp,
+             seeds, counters, *mm):
         logits, kv = forward_prefill(
             params, cfg, kv, tokens, page_table, prefix_lens, chunk_lens,
             attn_impl=attn_impl,
+            extra_embeds=mm[0] if with_embeds else None,
+            extra_mask=mm[1] if with_embeds else None,
         )
         out = sample_tokens(logits, samp, seeds, counters)
         logp = compute_logprobs(logits, out)
@@ -116,13 +137,17 @@ def _build_prefill_step(cfg: ModelConfig, with_top: bool = False,
     return step
 
 
-def _build_prefill_step_sp(cfg: ModelConfig, mesh, with_top: bool = False):
+def _build_prefill_step_sp(cfg: ModelConfig, mesh, with_top: bool = False,
+                           lockstep: bool = False):
     """Sequence-parallel whole-prompt prefill (parallel/sp_prefill.py):
     the prompt is sharded over the sp axis and attention runs as ring
     attention; sampling happens on the gathered last-position logits."""
     from ..parallel.sp_prefill import forward_prefill_sp
 
-    @partial(jax.jit, donate_argnums=(1,))
+    kw = ({"out_shardings": _lockstep_out_shardings(mesh)}
+          if lockstep else {})
+
+    @partial(jax.jit, donate_argnums=(1,), **kw)
     def step(params, kv, tokens, page_table, prefix_lens, chunk_lens, samp, seeds, counters):
         del prefix_lens  # whole-prompt prefill: enforced zero host-side
         logits, kv = forward_prefill_sp(
@@ -156,7 +181,7 @@ def _build_import_fn():
 
 def _build_decode_step(cfg: ModelConfig, n_steps: int, max_valid_pos: int,
                        penalized: bool = False, with_top: bool = False,
-                       attn_impl: str = "xla"):
+                       attn_impl: str = "xla", lockstep_mesh=None):
     """Decode `n_steps` tokens per dispatch: lax.scan keeps the whole block
     on-device, so host→device latency is paid once per block, not per
     token (the TPU analog of multi-step scheduling).
@@ -193,8 +218,13 @@ def _build_decode_step(cfg: ModelConfig, n_steps: int, max_valid_pos: int,
         packed = _pack_out(out, logp, logits if with_top else None)
         return kv, out, counts, packed
 
+    dp = P("dp")
     if penalized:
-        @partial(jax.jit, donate_argnums=(1, 5))
+        kw = ({"out_shardings": _lockstep_out_shardings(
+            lockstep_mesh, dp, dp, dp, P("dp", None))}
+            if lockstep_mesh is not None else {})
+
+        @partial(jax.jit, donate_argnums=(1, 5), **kw)
         def step(params, kv, tokens, positions, counters, counts,
                  page_table, samp, seeds):
             def body(carry, _):
@@ -210,7 +240,11 @@ def _build_decode_step(cfg: ModelConfig, n_steps: int, max_valid_pos: int,
             )
             return packed, tok, pos, ctr, cts, kv
     else:
-        @partial(jax.jit, donate_argnums=(1,))
+        kw = ({"out_shardings": _lockstep_out_shardings(
+            lockstep_mesh, dp, dp, dp)}
+            if lockstep_mesh is not None else {})
+
+        @partial(jax.jit, donate_argnums=(1,), **kw)
         def step(params, kv, tokens, positions, counters, page_table, samp, seeds):
             def body(carry, _):
                 kv, tok, pos, ctr = carry
@@ -227,8 +261,51 @@ def _build_decode_step(cfg: ModelConfig, n_steps: int, max_valid_pos: int,
     return step
 
 
+# -- multihost lockstep plan codec ----------------------------------------- #
+# The leader (rank 0) broadcasts one step descriptor per dispatch; follower
+# ranks replay it so every process issues identical jitted steps in the same
+# order (the SPMD contract of parallel/multihost.py).  msgpack with numpy
+# leaves encoded as (dtype, shape, bytes) triples.
+
+
+def _plan_pack(obj) -> bytes:
+    import msgpack
+
+    def enc(o):
+        if isinstance(o, np.ndarray):
+            return {"__nd__": [str(o.dtype), list(o.shape),
+                               np.ascontiguousarray(o).tobytes()]}
+        if isinstance(o, (np.integer, np.floating)):
+            return o.item()
+        raise TypeError(f"unserializable plan leaf: {type(o)}")
+
+    return msgpack.packb(obj, default=enc, use_bin_type=True)
+
+
+def _plan_unpack(data: bytes):
+    import msgpack
+
+    def hook(o):
+        if "__nd__" in o:
+            dtype, shape, buf = o["__nd__"]
+            return np.frombuffer(buf, dtype=np.dtype(dtype)).reshape(shape)
+        return o
+
+    return msgpack.unpackb(data, raw=False, object_hook=hook)
+
+
 class JaxEngine:
-    """Single-host continuous-batching engine over a paged KV cache."""
+    """Continuous-batching engine over a paged KV cache.
+
+    Single-host by default; on a multi-process JAX world (multihost —
+    `jax.distributed.initialize` via `parallel.initialize_multihost`) the
+    engine runs in LOCKSTEP: rank 0 owns the scheduler and serves
+    requests, every other rank constructs the same engine and calls
+    `follower_loop()`, and each device dispatch is preceded by a plan
+    broadcast so all ranks issue identical steps (the reference reaches
+    multi-node only through its engines' NCCL worlds — MultinodeSpec,
+    dynamocomponentdeployment_types.go:108; here the engine itself spans
+    hosts with dp/tp over ICI+DCN)."""
 
     def __init__(
         self,
@@ -241,6 +318,7 @@ class JaxEngine:
         tiered=None,  # kvbm.TieredKvCache — host/disk KV tiers
         parallel=None,  # parallel.ParallelConfig — dp×tp serving mesh
         devices=None,
+        vision=None,  # (vision_params, models.vision.VisionConfig)
     ):
         self.model_cfg = model_cfg
         self.cfg = engine_cfg or EngineConfig()
@@ -253,6 +331,19 @@ class JaxEngine:
         self.mesh = None
         self._dp = 1
         self._sp = 1
+        # multihost lockstep: rank 0 leads, others replay (follower_loop)
+        self._multihost = jax.process_count() > 1
+        self._lockstep_leader = jax.process_index() == 0
+        if self._multihost and (parallel is None or parallel.world <= 1):
+            raise ValueError(
+                "multihost requires a ParallelConfig spanning the global "
+                "device set (dp*tp*sp == jax.device_count())"
+            )
+        if self._multihost and tiered is not None:
+            raise ValueError(
+                "KV tiering (kvbm) is not supported under multihost "
+                "lockstep yet — offload device ops are leader-local"
+            )
         if parallel is not None and parallel.world > 1:
             from ..parallel import make_mesh
 
@@ -280,6 +371,28 @@ class JaxEngine:
                     raise ValueError(
                         f"chunk buckets {bad} not divisible by sp={self._sp}"
                     )
+                if parallel.tp > 1 and model_cfg.is_moe:
+                    raise ValueError(
+                        "sp > 1 with tp > 1 requires a dense model (MoE "
+                        "expert dispatch inside the sp shard_map is not "
+                        "implemented; use tp-only for MoE)"
+                    )
+                # the sp shard_map's param specs shard heads, the ffn dim
+                # AND the vocab over tp — catch uneven splits here with a
+                # clear message instead of an opaque shard_map shape error
+                # at first prefill
+                uneven = {
+                    "q heads": model_cfg.num_attention_heads,
+                    "kv heads": model_cfg.num_key_value_heads,
+                    "vocab_size": model_cfg.vocab_size,
+                    "intermediate_size": model_cfg.intermediate_size,
+                }
+                bad_dims = [k for k, v in uneven.items() if v % parallel.tp]
+                if bad_dims:
+                    raise ValueError(
+                        f"tp={parallel.tp} must evenly divide "
+                        f"{', '.join(bad_dims)} for sp×tp prefill"
+                    )
             # every batch shape must divide dp (rows beyond the real batch
             # are trash-page padding)
             self.cfg = dataclasses.replace(
@@ -296,6 +409,15 @@ class JaxEngine:
             from ..models.quantization import quantize_params
 
             params = quantize_params(params)
+        # vision tower (multimodal): embeds computed engine-side at first
+        # prefill of the sequence, injected in place of placeholder tokens
+        self.vision = vision
+        self._encode_fn = None
+        if vision is not None and (self._multihost or self._sp > 1):
+            raise ValueError(
+                "the vision tower is not supported under multihost "
+                "lockstep or sp prefill yet"
+            )
         self.params = self._shard_params(params)
         self.kv = self._make_kv()
         self._extra_event_sinks: List[Callable[[KvEvent], None]] = []
@@ -342,6 +464,10 @@ class JaxEngine:
         routes admission-time cache misses through it — the engine-facing
         equivalent of the reference's KVConnector protocol
         (block_manager/connector/protocol.rs)."""
+        if self._multihost:
+            raise ValueError(
+                "KV tiering (kvbm) is not supported under multihost lockstep"
+            )
         self.tiered = connector
         self.add_event_sink(connector.on_event)
         # onboarding runs inside admission (pump loop thread, between
@@ -413,14 +539,22 @@ class JaxEngine:
         return shard_kv_cache(kv, self.mesh)
 
     def _put(self, arr, *axes):
-        """Host array → device, batch axis sharded over dp when meshed."""
+        """Host array → device, batch axis sharded over dp when meshed.
+        Multihost: every process passes the same logical array and
+        contributes the shards its local devices own."""
         if self.mesh is None:
             return jnp.asarray(arr)
+        if self._multihost:
+            from ..parallel.multihost import host_array_to_global
+
+            return host_array_to_global(self.mesh, P(*axes), np.asarray(arr))
         return jax.device_put(arr, NamedSharding(self.mesh, P(*axes)))
 
     def _put_samp(self, samp: SamplingParams) -> SamplingParams:
         if self.mesh is None:
             return samp
+        if self._multihost:
+            return jax.tree.map(lambda a: self._put(np.asarray(a), "dp"), samp)
         return jax.device_put(samp, NamedSharding(self.mesh, P("dp")))
 
     def _pad_batch(self, n: int) -> int:
@@ -430,17 +564,21 @@ class JaxEngine:
 
     # -- step variants -------------------------------------------------------- #
 
-    def _get_prefill_step(self, with_top: bool):
-        if with_top not in self._prefill_steps:
+    def _get_prefill_step(self, with_top: bool, with_mm: bool = False):
+        key = (with_top, with_mm)
+        if key not in self._prefill_steps:
             if self._sp > 1:
-                self._prefill_steps[with_top] = _build_prefill_step_sp(
-                    self.model_cfg, self.mesh, with_top
+                self._prefill_steps[key] = _build_prefill_step_sp(
+                    self.model_cfg, self.mesh, with_top,
+                    lockstep=self._multihost,
                 )
             else:
-                self._prefill_steps[with_top] = _build_prefill_step(
-                    self.model_cfg, with_top, attn_impl=self._attn_impl
+                self._prefill_steps[key] = _build_prefill_step(
+                    self.model_cfg, with_top, attn_impl=self._attn_impl,
+                    lockstep_mesh=self.mesh if self._multihost else None,
+                    with_embeds=with_mm,
                 )
-        return self._prefill_steps[with_top]
+        return self._prefill_steps[key]
 
     def _get_decode_step(self, penalized: bool, with_top: bool):
         key = (penalized, with_top)
@@ -449,6 +587,7 @@ class JaxEngine:
                 self.model_cfg, self.cfg.decode_steps, self.cfg.hard_cap,
                 penalized=penalized, with_top=with_top,
                 attn_impl=self._attn_impl,
+                lockstep_mesh=self.mesh if self._multihost else None,
             )
         return self._decode_steps[key]
 
@@ -523,6 +662,11 @@ class JaxEngine:
         seq = Sequence(context.id, prompt, opts)
         seq.seed = opts.seed if opts.seed is not None else self._py_rng.getrandbits(31)
         seq.hold_pages = bool(request.get("_hold_pages"))
+        if request.get("mm_pixels"):
+            err = self._attach_mm(seq, request)
+            if err:
+                yield {"token_ids": [], "finish_reason": "error", "error": err}
+                return
         queue: asyncio.Queue = asyncio.Queue()
         self._queues[context.id] = queue
         self._contexts[context.id] = context
@@ -574,6 +718,11 @@ class JaxEngine:
         self._wake.set()
         if self._pump_task:
             await asyncio.gather(self._pump_task, return_exceptions=True)
+        if self._multihost and self._lockstep_leader:
+            # release follower ranks blocked in follower_loop
+            await asyncio.get_running_loop().run_in_executor(
+                None, self._lockstep_send, {"kind": "shutdown"}
+            )
 
     def _plan_step(self) -> StepPlan:
         """Apply deferred scheduler mutations and plan the next step.
@@ -704,18 +853,23 @@ class JaxEngine:
         with_top = any(s.opts.top_logprobs > 0 for s in seqs)
         table = self._table_array(seqs, rows=B)
         seeds, counters = self._seed_arrays(seqs, B)
-        packed_d, kv = self._get_prefill_step(with_top)(
-            self.params,
-            self.kv,
-            self._put(tokens, "dp", None),
-            self._put(table, "dp", None),
-            self._put(prefix, "dp"),
-            self._put(chunk, "dp"),
-            self._put_samp(self._samp_arrays(seqs, B)),
-            self._put(seeds, "dp"),
-            self._put(counters, "dp"),
+        samp = self._samp_arrays(seqs, B)
+        for s in seqs:  # encode pending vision inputs (step thread)
+            if s.mm_pixels is not None:
+                self._encode_mm(s)
+        mm = ()
+        if any(s.mm_embeds is not None for s in seqs):
+            mm = self._mm_arrays(items, B, chunk_bucket)
+        if self._multihost:
+            self._lockstep_send({
+                "kind": "prefill", "with_top": with_top,
+                "arrays": [tokens, table, prefix, chunk,
+                           *[np.asarray(a) for a in samp], seeds, counters],
+            })
+        packed_d = self._dispatch_prefill(
+            tokens, table, prefix, chunk, samp, seeds, counters, with_top,
+            mm=mm,
         )
-        self.kv = kv
         out, logp, tids, tlps = _unpack_out(
             np.asarray(jax.device_get(packed_d)), B, with_top
         )
@@ -730,6 +884,101 @@ class JaxEngine:
                     s, int(out[i]), float(logp[i]),
                     _tops_for(s, tids, tlps, i),
                 )
+
+    def _attach_mm(self, seq, request) -> Optional[str]:
+        """Validate + attach multimodal pixels to a sequence; returns an
+        error string instead of raising (engine errors are streamed)."""
+        if self.vision is None:
+            return "this worker has no vision tower attached"
+        from ..llm.multimodal import unpack_pixels
+
+        import hashlib
+
+        _, vcfg = self.vision
+        try:
+            pixels = unpack_pixels(request["mm_pixels"])
+        except Exception:  # noqa: BLE001 — wire payloads are untrusted
+            return "malformed mm_pixels payload"
+        offsets = list(request.get("mm_offsets") or [])
+        if pixels.ndim != 4 or pixels.shape[0] != len(offsets):
+            return "mm_pixels/mm_offsets mismatch"
+        if pixels.shape[1:] != (vcfg.image_size, vcfg.image_size, 3):
+            return (
+                f"image shape {pixels.shape[1:]} != tower input "
+                f"({vcfg.image_size}, {vcfg.image_size}, 3)"
+            )
+        P = vcfg.num_patches
+        for off in offsets:
+            if (not isinstance(off, int) or isinstance(off, bool)
+                    or not 0 <= off <= len(seq.prompt) - P):
+                return "mm_offsets must be integer offsets inside the prompt"
+        seq.mm_pixels = pixels
+        seq.mm_offsets = offsets
+        # same tokens + same image bytes → same hashes (legal reuse);
+        # different image → disjoint cache namespace.  Prefer the
+        # preprocessor's salt (the router scored overlap with it); the
+        # local hash is the fallback for direct engine callers
+        salt = request.get("cache_salt")
+        seq.cache_salt = salt if isinstance(salt, str) and salt else (
+            hashlib.blake2b(pixels.tobytes(), digest_size=8).hexdigest()
+        )
+        return None
+
+    def _encode_mm(self, seq) -> None:
+        """Run the vision tower for a sequence (step thread, between
+        dispatches)."""
+        vparams, vcfg = self.vision
+        if self._encode_fn is None:
+            from ..models.vision import encode_images
+
+            self._encode_fn = jax.jit(
+                lambda p, px: encode_images(p, vcfg, px)
+            )
+        seq.mm_embeds = np.asarray(
+            jax.device_get(self._encode_fn(vparams, jnp.asarray(seq.mm_pixels)))
+        )
+        seq.mm_pixels = None
+
+    def _mm_arrays(self, items, B, chunk_bucket):
+        """Build (extra_embeds [B,S,h], mask [B,S]) covering every image
+        patch run intersecting this chunk (chunked prefill may slice
+        through a run)."""
+        h = self.model_cfg.hidden_size
+        extra = np.zeros((B, chunk_bucket, h), np.float32)
+        mask = np.zeros((B, chunk_bucket), bool)
+        for i, it in enumerate(items):
+            s = it.seq
+            if s.mm_embeds is None:
+                continue
+            P = s.mm_embeds.shape[1]
+            for img, off in enumerate(s.mm_offsets):
+                lo = max(off, it.chunk_start)
+                hi = min(off + P, it.chunk_start + it.chunk_len)
+                if hi > lo:
+                    extra[i, lo - it.chunk_start : hi - it.chunk_start] = (
+                        s.mm_embeds[img, lo - off : hi - off]
+                    )
+                    mask[i, lo - it.chunk_start : hi - it.chunk_start] = True
+        return extra, mask
+
+    def _dispatch_prefill(self, tokens, table, prefix, chunk, samp, seeds,
+                          counters, with_top, mm=()):
+        """Issue the jitted prefill (identical on leader and followers)."""
+        packed_d, kv = self._get_prefill_step(with_top, bool(mm))(
+            self.params,
+            self.kv,
+            self._put(tokens, "dp", None),
+            self._put(table, "dp", None),
+            self._put(prefix, "dp"),
+            self._put(chunk, "dp"),
+            self._put_samp(samp),
+            self._put(seeds, "dp"),
+            self._put(counters, "dp"),
+            *(self._put(m, "dp", None) if m.ndim == 2
+              else self._put(m, "dp", None, None) for m in mm),
+        )
+        self.kv = kv
+        return packed_d
 
     def _chain_ok(self, seqs: List[Sequence], k: int, T: int, hard_cap: int) -> bool:
         """May decode block k be dispatched before block k-1's results are
@@ -777,13 +1026,7 @@ class JaxEngine:
         table = self._table_array(seqs, rows=Bb)
         penalized = any(s.opts.penalized for s in seqs)
         with_top = any(s.opts.top_logprobs > 0 for s in seqs)
-        step = self._get_decode_step(penalized, with_top)
-        tok_d = self._put(tokens, "dp")
-        pos_d = self._put(positions, "dp")
-        ctr_d = self._put(counters, "dp")
-        table_d = self._put(table, "dp", None)
-        samp_d = self._put_samp(self._samp_arrays(seqs, Bb))
-        seeds_d = self._put(seeds, "dp")
+        samp = self._samp_arrays(seqs, Bb)
         if penalized:
             # output-token histograms (prompt tokens are not penalized);
             # updated on-device within and across chained blocks
@@ -791,24 +1034,20 @@ class JaxEngine:
             for i, s in enumerate(seqs):
                 if s.output_tokens:
                     np.add.at(counts[i], s.output_tokens, 1.0)
-            cts_d = self._put(counts, "dp", None)
-        dispatches = []
-        for _ in range(chain_len):
-            if penalized:
-                packed_d, tok_d, pos_d, ctr_d, cts_d, self.kv = step(
-                    self.params, self.kv, tok_d, pos_d, ctr_d, cts_d,
-                    table_d, samp_d, seeds_d,
-                )
-            else:
-                packed_d, tok_d, pos_d, ctr_d, self.kv = step(
-                    self.params, self.kv, tok_d, pos_d, ctr_d,
-                    table_d, samp_d, seeds_d,
-                )
-            try:  # start the host copy early; overlaps later blocks' compute
-                packed_d.copy_to_host_async()
-            except Exception:  # noqa: BLE001 — sharded arrays may not support it
-                pass
-            dispatches.append(packed_d)
+        else:
+            counts = None
+        if self._multihost:
+            self._lockstep_send({
+                "kind": "decode", "penalized": penalized,
+                "with_top": with_top, "chain_len": chain_len,
+                "arrays": [tokens, positions, counters, table,
+                           *[np.asarray(a) for a in samp], seeds],
+                "counts": counts,
+            })
+        dispatches = self._dispatch_decode(
+            tokens, positions, counters, counts, table, samp, seeds,
+            penalized, with_top, chain_len,
+        )
         # page frees deferred until the whole chain drains: an in-flight
         # dispatch must never see its table's pages reallocated (unchained
         # decode keeps the synchronous free — consumers may observe pool
@@ -836,6 +1075,97 @@ class JaxEngine:
             self.scheduler.deferred_free = None
             if deferred:
                 self.pool.free(deferred)
+
+    def _dispatch_decode(self, tokens, positions, counters, counts, table,
+                         samp, seeds, penalized, with_top, chain_len):
+        """Issue the chained decode dispatches (identical on leader and
+        followers); returns the per-block packed outputs."""
+        step = self._get_decode_step(penalized, with_top)
+        tok_d = self._put(tokens, "dp")
+        pos_d = self._put(positions, "dp")
+        ctr_d = self._put(counters, "dp")
+        table_d = self._put(table, "dp", None)
+        samp_d = self._put_samp(samp)
+        seeds_d = self._put(seeds, "dp")
+        if penalized:
+            cts_d = self._put(counts, "dp", None)
+        dispatches = []
+        for _ in range(chain_len):
+            if penalized:
+                packed_d, tok_d, pos_d, ctr_d, cts_d, self.kv = step(
+                    self.params, self.kv, tok_d, pos_d, ctr_d, cts_d,
+                    table_d, samp_d, seeds_d,
+                )
+            else:
+                packed_d, tok_d, pos_d, ctr_d, self.kv = step(
+                    self.params, self.kv, tok_d, pos_d, ctr_d,
+                    table_d, samp_d, seeds_d,
+                )
+            try:  # start the host copy early; overlaps later blocks' compute
+                packed_d.copy_to_host_async()
+            except Exception:  # noqa: BLE001 — sharded arrays may not support it
+                pass
+            dispatches.append(packed_d)
+        return dispatches
+
+    # -- multihost lockstep --------------------------------------------------- #
+
+    def _lockstep_send(self, desc: Dict[str, Any]) -> None:
+        from ..parallel.multihost import broadcast_plan
+
+        broadcast_plan(_plan_pack(desc))
+
+    def follower_loop(self) -> None:
+        """Replay the leader's dispatches on this follower rank (blocking;
+        returns when the leader broadcasts shutdown).  Every rank of a
+        multihost group except rank 0 runs this instead of serving."""
+        if not self._multihost or self._lockstep_leader:
+            raise RuntimeError("follower_loop is for multihost ranks > 0")
+        from ..parallel.multihost import broadcast_plan
+
+        samp_n = len(SamplingParams._fields)
+        # a follower-local dispatch failure leaves this rank's KV shards
+        # diverged from the leader's; the ONLY consistent continuation is
+        # the leader's own "recover" plan (it failed too and everyone
+        # rebuilds).  Any other plan while poisoned must crash the process
+        # rather than stream silently-wrong collectives.
+        poisoned = False
+        while True:
+            desc = _plan_unpack(broadcast_plan(b""))
+            kind = desc["kind"]
+            if kind == "shutdown":
+                return
+            if kind == "recover":
+                self.kv = self._make_kv()
+                poisoned = False
+                continue
+            if poisoned:
+                raise RuntimeError(
+                    "follower state diverged from the leader (local "
+                    "dispatch failed but the leader kept going) — the "
+                    "multihost group must restart together"
+                )
+            try:
+                if kind == "prefill":
+                    a = desc["arrays"]
+                    self._dispatch_prefill(
+                        a[0], a[1], a[2], a[3],
+                        SamplingParams(*a[4:4 + samp_n]),
+                        a[4 + samp_n], a[5 + samp_n], desc["with_top"],
+                    )
+                elif kind == "decode":
+                    a = desc["arrays"]
+                    self._dispatch_decode(
+                        a[0], a[1], a[2], desc["counts"], a[3],
+                        SamplingParams(*a[4:4 + samp_n]), a[4 + samp_n],
+                        desc["penalized"], desc["with_top"],
+                        desc["chain_len"],
+                    )
+            except Exception:  # noqa: BLE001
+                logger.exception(
+                    "follower dispatch failed; awaiting leader recover"
+                )
+                poisoned = True
 
     # -- disaggregation: KV export / import ---------------------------------- #
 
@@ -877,6 +1207,11 @@ class JaxEngine:
 
     async def _device_op(self, op):
         """Run a device op between pump steps (never concurrent with them)."""
+        if self._multihost:
+            raise RuntimeError(
+                "leader-local device ops (disagg KV export/import, embed) "
+                "are not supported under multihost lockstep yet"
+            )
         self._ensure_pump()
         fut = self._loop.create_future()
         self._pending_ops.append((op, fut))
@@ -1136,6 +1471,9 @@ class JaxEngine:
         for seq in list(self.scheduler.running):
             self.scheduler.finish(seq, "error")
             self._deliver(seq, [], "error")
+        if self._multihost:
+            # keep followers lockstep: they rebuild their KV shards too
+            self._lockstep_send({"kind": "recover"})
         self.kv = self._make_kv()
         self.pool = PagePool(
             self.cfg.num_pages, self.cfg.page_size, event_sink=self._emit_event

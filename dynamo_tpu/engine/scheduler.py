@@ -61,6 +61,14 @@ class Sequence:
         self.opts = opts
         self.seed = 0  # per-request sampling seed (engine assigns)
         self.hold_pages = False  # finish() keeps pages (disagg KV export)
+        # multimodal: processed pixels arrive with the request; the engine
+        # encodes them at first prefill.  cache_salt isolates the prefix
+        # cache per image content — image placeholder tokens are identical
+        # across different images, so token-only hashes would alias
+        self.mm_pixels = None  # np [N, H, W, 3] float32
+        self.mm_offsets: List[int] = []
+        self.mm_embeds = None  # np [N, patches, h] (engine fills)
+        self.cache_salt = ""
         self.pages: List[int] = []
         self.num_cached = 0  # prompt tokens satisfied from prefix cache
         self.num_computed = 0  # tokens whose KV is written
@@ -181,7 +189,7 @@ class Scheduler:
         # never cache-hit the *entire* prompt: the last token must be
         # recomputed so prefill produces logits to sample from.
         hashes = compute_block_hash_for_seq(
-            seq.prompt, ps, self.cfg.block_hash_salt
+            seq.prompt, ps, self.cfg.block_hash_salt + seq.cache_salt
         )
         if seq.prompt_len % ps == 0 and hashes:
             hashes = hashes[:-1]
@@ -313,7 +321,7 @@ class Scheduler:
             parent = (
                 seq.block_hashes[-1]
                 if seq.block_hashes
-                else chain_seed(self.cfg.block_hash_salt)
+                else chain_seed(self.cfg.block_hash_salt + seq.cache_salt)
             )
             seq.block_hashes.append(
                 next_block_hash(parent, tokens[i * ps : (i + 1) * ps])

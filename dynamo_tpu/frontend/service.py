@@ -46,12 +46,20 @@ class ModelEntry:
 
     async def route(self, request: Dict[str, Any], context: Context
                     ) -> AsyncIterator[Dict[str, Any]]:
-        """Pick a worker per router mode and stream engine outputs."""
+        """Pick a worker per router mode and stream engine outputs.
+
+        Routing is restricted to the instances that published THIS
+        model's card: several models can share one component endpoint
+        (e.g. a text fleet plus a vision worker on `backend/generate`),
+        and the endpoint-level round-robin would happily send a request
+        for model A to a worker serving only model B."""
         if self.kv_chooser is not None:
             request = {**request, "request_id": context.id}
             # AllWorkersBusy (an Overloaded/ServiceUnavailable) propagates:
             # migration re-raises it and the frontend answers 503
-            worker_id = await self.kv_chooser.choose(request)
+            worker_id = await self.kv_chooser.choose(
+                request, allowed=self.instances
+            )
             stream = self.client.direct(request, worker_id, context)
             try:
                 async for item in stream:
@@ -60,9 +68,11 @@ class ModelEntry:
                 self.kv_chooser.mark_finished(context.id)
             return
         if self.router_mode == "random":
-            stream = self.client.random(request, context)
+            stream = self.client.random(request, context,
+                                        allowed=self.instances)
         else:
-            stream = self.client.round_robin(request, context)
+            stream = self.client.round_robin(request, context,
+                                             allowed=self.instances)
         async for item in stream:
             yield item
 

@@ -50,6 +50,13 @@ class ModelDeploymentCard:
     # output parsers (dynamo_tpu.parsers registry names; "" = passthrough)
     reasoning_parser: str = ""
     tool_call_parser: str = ""
+    # multimodal: non-empty image_token → the worker accepts image_url
+    # content parts; the preprocessor expands the placeholder to
+    # image_patches tokens and ships processed pixels on the wire
+    image_token: str = ""
+    image_token_id: Optional[int] = None
+    image_patches: int = 0
+    image_size: int = 0
     user_data: Dict[str, Any] = field(default_factory=dict)
 
     @property

@@ -1,0 +1,141 @@
+"""Multimodal input handling: image loading + preprocessing.
+
+The reference's encode worker loads images by URL, runs an
+AutoImageProcessor, and expands the single image placeholder token into
+one token per patch (/root/reference/components/src/dynamo/sglang/
+request_handlers/multimodal/encode_worker_handler.py:109-156).  Here
+loading supports `data:` URIs and local files only (serving environments
+gate arbitrary egress); processing is a PIL resize + [0,1] normalize
+into the fixed ViT input shape.
+
+Wire format (rides the msgpack engine request):
+    "mm_pixels": {"shape": [N, H, W, 3], "data": <f32 bytes>}
+    "mm_offsets": [token offset of each image's patch run]
+"""
+
+from __future__ import annotations
+
+import base64
+import binascii
+import io
+import os
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+from .preprocessor import RequestError
+
+# refuse absurd payloads before PIL touches them (decompression bombs)
+MAX_IMAGE_BYTES = 32 << 20
+
+
+def _image_file_root() -> str:
+    """Local-file images are OFF unless the operator sets
+    DYN_IMAGE_FILE_ROOT to a directory; only files under it are
+    readable.  An unrestricted path would hand HTTP clients a local
+    file-read/probe primitive through the chat endpoint."""
+    from ..runtime.config import env_str
+
+    return env_str("DYN_IMAGE_FILE_ROOT") or ""
+
+
+def load_image_bytes(url: str) -> bytes:
+    """data: URI (always) or a path under DYN_IMAGE_FILE_ROOT (opt-in) →
+    raw encoded image bytes."""
+    if url.startswith("data:"):
+        try:
+            header, payload = url.split(",", 1)
+        except ValueError:
+            raise RequestError("malformed data: URI") from None
+        if ";base64" not in header:
+            raise RequestError("data: URIs must be base64-encoded")
+        try:
+            raw = base64.b64decode(payload, validate=True)
+        except (binascii.Error, ValueError):
+            raise RequestError("invalid base64 image payload") from None
+    elif url.startswith("file://") or url.startswith("/"):
+        root = _image_file_root()
+        if not root:
+            raise RequestError(
+                "local image files are disabled (set DYN_IMAGE_FILE_ROOT)"
+            )
+        path = url[len("file://"):] if url.startswith("file://") else url
+        real = os.path.realpath(path)
+        if not real.startswith(os.path.realpath(root) + os.sep):
+            raise RequestError("image path outside DYN_IMAGE_FILE_ROOT")
+        if not os.path.isfile(real):
+            raise RequestError("image file not found")
+        with open(real, "rb") as f:
+            raw = f.read()
+    else:
+        raise RequestError(
+            "only data: URIs (and DYN_IMAGE_FILE_ROOT paths) are supported"
+        )
+    if len(raw) > MAX_IMAGE_BYTES:
+        raise RequestError("image exceeds the 32MB limit")
+    return raw
+
+
+def process_image(raw: bytes, image_size: int) -> np.ndarray:
+    """Encoded bytes → [H, W, 3] float32 in [0, 1] at the tower's input
+    resolution."""
+    from PIL import Image
+
+    try:
+        img = Image.open(io.BytesIO(raw))
+        img = img.convert("RGB").resize(
+            (image_size, image_size), Image.BILINEAR
+        )
+    except Exception as e:  # noqa: BLE001 — PIL raises many types
+        raise RequestError(f"cannot decode image: {e}") from None
+    return np.asarray(img, np.float32) / 255.0
+
+
+def extract_image_urls(messages: List[Dict[str, Any]]) -> List[str]:
+    """Collect image_url parts in reading order (template order)."""
+    urls = []
+    for m in messages:
+        content = m.get("content")
+        if not isinstance(content, list):
+            continue
+        for part in content:
+            if isinstance(part, dict) and part.get("type") == "image_url":
+                url = (part.get("image_url") or {}).get("url")
+                if not url:
+                    raise RequestError("image_url part missing 'url'")
+                urls.append(url)
+    return urls
+
+
+def expand_image_tokens(
+    token_ids: List[int], image_token_id: int, n_images: int,
+    patches_per_image: int,
+) -> Tuple[List[int], List[int]]:
+    """Replace each single image placeholder token with `patches_per_image`
+    copies (reference encode_worker_handler.py:144-156); returns
+    (expanded token_ids, start offset of each image's patch run)."""
+    found = [i for i, t in enumerate(token_ids) if t == image_token_id]
+    if len(found) != n_images:
+        raise RequestError(
+            f"prompt contains {len(found)} image placeholder(s) for "
+            f"{n_images} image(s)"
+        )
+    out: List[int] = []
+    offsets: List[int] = []
+    prev = 0
+    for idx in found:
+        out.extend(token_ids[prev:idx])
+        offsets.append(len(out))
+        out.extend([image_token_id] * patches_per_image)
+        prev = idx + 1
+    out.extend(token_ids[prev:])
+    return out, offsets
+
+
+def pack_pixels(pixels: np.ndarray) -> Dict[str, Any]:
+    pixels = np.ascontiguousarray(pixels, np.float32)
+    return {"shape": list(pixels.shape), "data": pixels.tobytes()}
+
+
+def unpack_pixels(blob: Dict[str, Any]) -> np.ndarray:
+    return np.frombuffer(blob["data"], np.float32).reshape(blob["shape"])

@@ -49,13 +49,14 @@ class OpenAIPreprocessor:
 
     def apply_template(self, messages: List[Dict[str, Any]],
                        tools: Optional[list] = None,
-                       add_generation_prompt: bool = True) -> str:
+                       add_generation_prompt: bool = True,
+                       image_token: str = "") -> str:
         for m in messages:
             if not isinstance(m, dict) or "role" not in m:
                 raise RequestError("each message needs a 'role'")
         try:
             return self._template.render(
-                messages=_normalize_messages(messages),
+                messages=_normalize_messages(messages, image_token),
                 tools=tools,
                 add_generation_prompt=add_generation_prompt,
                 bos_token="",
@@ -68,13 +69,71 @@ class OpenAIPreprocessor:
         messages = request.get("messages")
         if not messages:
             raise RequestError("'messages' must be a non-empty list")
-        prompt = self.apply_template(messages, tools=request.get("tools"))
+        from .multimodal import extract_image_urls
+
+        image_urls = extract_image_urls(messages)
+        if image_urls and not self.mdc.image_token:
+            raise RequestError(
+                f"model {self.mdc.name!r} does not accept image input"
+            )
+        prompt = self.apply_template(
+            messages, tools=request.get("tools"),
+            image_token=self.mdc.image_token,
+        )
         token_ids = self.tokenizer.encode(prompt)
         if self.tokenizer.bos_token_id is not None and (
             not token_ids or token_ids[0] != self.tokenizer.bos_token_id
         ):
             token_ids = [self.tokenizer.bos_token_id] + token_ids
-        return self._finish(request, token_ids, prompt)
+        mm = None
+        if image_urls:
+            token_ids, mm = self._process_images(token_ids, image_urls)
+        out = self._finish(request, token_ids, prompt)
+        if mm:
+            out.update(mm)
+        return out
+
+    def _process_images(self, token_ids, image_urls):
+        """Load + resize each image, expand placeholders to patch runs
+        (the frontend-side half of the reference's encode worker — the
+        vision tower itself runs engine-side on the worker)."""
+        import numpy as np
+
+        from .multimodal import (
+            expand_image_tokens,
+            load_image_bytes,
+            pack_pixels,
+            process_image,
+        )
+
+        tok_id = self.mdc.image_token_id
+        if tok_id is None:
+            ids = self.tokenizer.encode(self.mdc.image_token)
+            if len(ids) != 1:
+                raise RequestError(
+                    "model's image_token does not map to a single token"
+                )
+            tok_id = ids[0]
+        token_ids, offsets = expand_image_tokens(
+            token_ids, tok_id, len(image_urls), self.mdc.image_patches
+        )
+        pixels = np.stack([
+            process_image(load_image_bytes(u), self.mdc.image_size)
+            for u in image_urls
+        ])
+        import hashlib
+
+        return token_ids, {
+            "mm_pixels": pack_pixels(pixels),
+            "mm_offsets": offsets,
+            # per-image-content cache namespace — MUST equal the engine's
+            # seq.cache_salt so router overlap scoring and engine prefix
+            # hits agree (identical tokens, different image ⇒ no reuse)
+            "cache_salt": hashlib.blake2b(
+                np.ascontiguousarray(pixels, np.float32).tobytes(),
+                digest_size=8,
+            ).hexdigest(),
+        }
 
     # -- completions --------------------------------------------------------- #
 
@@ -213,9 +272,11 @@ def _validate_sampling(request: Dict[str, Any]) -> None:
             raise RequestError("'logprobs' must be a bool or an int in [0, 20]")
 
 
-def _normalize_messages(messages: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
-    """Flatten OpenAI content-part arrays to plain strings (text parts only;
-    multimodal parts are rejected until the vision path lands)."""
+def _normalize_messages(messages: List[Dict[str, Any]],
+                        image_token: str = "") -> List[Dict[str, Any]]:
+    """Flatten OpenAI content-part arrays to plain strings; image parts
+    become the model's single placeholder token (expanded to the patch
+    run after tokenization — reference encode_worker_handler.py:144)."""
     out = []
     for m in messages:
         content = m.get("content")
@@ -224,9 +285,12 @@ def _normalize_messages(messages: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
             for part in content:
                 if isinstance(part, dict) and part.get("type") == "text":
                     texts.append(part.get("text", ""))
+                elif (isinstance(part, dict)
+                        and part.get("type") == "image_url" and image_token):
+                    texts.append(image_token)
                 else:
                     raise RequestError(
-                        "only text content parts are supported"
+                        "unsupported content part for this model"
                     )
             content = "".join(texts)
         out.append({**m, "content": content or ""})

@@ -433,12 +433,22 @@ def forward_prefill(
     prefix_lens: jax.Array,  # [B]
     chunk_lens: jax.Array,  # [B]
     attn_impl: str = "xla",
+    extra_embeds: Optional[jax.Array] = None,  # [B, S, h]
+    extra_mask: Optional[jax.Array] = None,  # [B, S] bool
 ) -> Tuple[jax.Array, KVCache]:
-    """Run a prefill chunk; returns logits at the last valid position [B, V]."""
+    """Run a prefill chunk; returns logits at the last valid position [B, V].
+
+    `extra_embeds`/`extra_mask` inject precomputed embeddings (vision
+    tower patches) in place of the token embedding at masked positions —
+    the multimodal prompt path (the reference forwards precomputed
+    embeddings to its engines, sglang/request_handlers/multimodal/
+    encode_worker_handler.py)."""
     B, S = tokens.shape
     inv_freq = rope_frequencies(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
     positions = prefix_lens[:, None] + jnp.arange(S)[None, :]
     x = params["embed"][tokens]  # [B, S, h]
+    if extra_embeds is not None:
+        x = jnp.where(extra_mask[..., None], extra_embeds.astype(x.dtype), x)
 
     def body(carry, xs):
         h = carry

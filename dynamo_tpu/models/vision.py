@@ -1,0 +1,128 @@
+"""Vision tower: ViT patch encoder + projector for multimodal prompts.
+
+The reference runs a separate encode worker whose vision model produces
+precomputed embeddings that replace image placeholder tokens in the
+prompt (/root/reference/components/src/dynamo/sglang/request_handlers/
+multimodal/encode_worker_handler.py:109-156).  Here the tower is
+first-party JAX: a pre-LN ViT over fixed-size patches, followed by a
+llava-style linear projector into the LLM's hidden space.  The whole
+encoder is one jitted program — patchify is a reshape+matmul (MXU
+friendly), attention is full (image token counts are small and static).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class VisionConfig:
+    image_size: int = 224
+    patch_size: int = 14
+    hidden_size: int = 256
+    intermediate_size: int = 1024
+    num_hidden_layers: int = 4
+    num_attention_heads: int = 4
+    out_hidden_size: int = 64  # LLM hidden size (projector output)
+    layer_norm_eps: float = 1e-6
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+
+def tiny_vision_config(**over) -> VisionConfig:
+    """Tiny tower for tests (pairs with models.tiny_config: out 64)."""
+    base = dict(
+        image_size=32, patch_size=8, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=2, out_hidden_size=64,
+    )
+    base.update(over)
+    return VisionConfig(**base)
+
+
+def init_vision_params(cfg: VisionConfig, key, dtype=jnp.float32) -> Params:
+    h, f, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_hidden_layers
+    patch_dim = cfg.patch_size * cfg.patch_size * 3
+    ks = iter(jax.random.split(key, 16))
+
+    def w(k, *shape, scale=None):
+        scale = scale if scale is not None else (shape[-2] ** -0.5)
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
+
+    return {
+        "patch_proj": w(next(ks), patch_dim, h),
+        "pos_embed": w(next(ks), cfg.num_patches, h, scale=0.02),
+        "layers": {
+            "ln1_scale": jnp.ones((L, h), dtype),
+            "ln1_bias": jnp.zeros((L, h), dtype),
+            "wq": w(next(ks), L, h, h),
+            "wk": w(next(ks), L, h, h),
+            "wv": w(next(ks), L, h, h),
+            "wo": w(next(ks), L, h, h),
+            "ln2_scale": jnp.ones((L, h), dtype),
+            "ln2_bias": jnp.zeros((L, h), dtype),
+            "w1": w(next(ks), L, h, f),
+            "b1": jnp.zeros((L, f), dtype),
+            "w2": w(next(ks), L, f, h),
+            "b2": jnp.zeros((L, h), dtype),
+        },
+        "post_ln_scale": jnp.ones((h,), dtype),
+        "post_ln_bias": jnp.zeros((h,), dtype),
+        "proj": w(next(ks), h, cfg.out_hidden_size),
+    }
+
+
+def _layer_norm(x, scale, bias, eps):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = ((x32 - mu) ** 2).mean(-1, keepdims=True)
+    return ((x32 - mu) * jax.lax.rsqrt(var + eps) * scale + bias).astype(x.dtype)
+
+
+def _vit_layer(lp, x, cfg: VisionConfig):
+    N, S, h = x.shape
+    nh, hd = cfg.num_attention_heads, cfg.head_dim
+    a = _layer_norm(x, lp["ln1_scale"], lp["ln1_bias"], cfg.layer_norm_eps)
+    q = (a @ lp["wq"]).reshape(N, S, nh, hd)
+    k = (a @ lp["wk"]).reshape(N, S, nh, hd)
+    v = (a @ lp["wv"]).reshape(N, S, nh, hd)
+    s = jnp.einsum("nqhd,nkhd->nhqk", q, k,
+                   preferred_element_type=jnp.float32) * (hd ** -0.5)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("nhqk,nkhd->nqhd", p, v.astype(jnp.float32))
+    x = x + (o.reshape(N, S, h).astype(x.dtype) @ lp["wo"])
+    m = _layer_norm(x, lp["ln2_scale"], lp["ln2_bias"], cfg.layer_norm_eps)
+    m = jax.nn.gelu(m @ lp["w1"] + lp["b1"]) @ lp["w2"] + lp["b2"]
+    return x + m.astype(x.dtype)
+
+
+def encode_images(params: Params, cfg: VisionConfig,
+                  pixels: jax.Array) -> jax.Array:
+    """[N, H, W, 3] float in [0,1] → patch embeddings [N, num_patches,
+    out_hidden] in the LLM's embedding space."""
+    N = pixels.shape[0]
+    p = cfg.patch_size
+    g = cfg.image_size // p
+    # patchify: [N, g, p, g, p, 3] → [N, g*g, p*p*3]
+    x = pixels.reshape(N, g, p, g, p, 3).transpose(0, 1, 3, 2, 4, 5)
+    x = x.reshape(N, g * g, p * p * 3).astype(params["patch_proj"].dtype)
+    x = x @ params["patch_proj"] + params["pos_embed"][None]
+
+    def body(carry, lp):
+        return _vit_layer(lp, carry, cfg), None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = _layer_norm(x, params["post_ln_scale"], params["post_ln_bias"],
+                    cfg.layer_norm_eps)
+    return x @ params["proj"]  # [N, num_patches, out_hidden]

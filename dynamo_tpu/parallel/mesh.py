@@ -26,9 +26,9 @@ from ..models import ModelConfig, kv_cache_pspec, param_pspecs
 class ParallelConfig:
     dp: int = 1
     tp: int = 1
-    # sequence parallelism: sp > 1 gives a dp×sp mesh where prefill runs
-    # ring attention over the prompt (parallel/sp_prefill.py); mutually
-    # exclusive with tp > 1 for now (params are replicated under sp)
+    # sequence parallelism: sp > 1 shards prefill over the prompt axis
+    # (ring attention, parallel/sp_prefill.py).  Composes with tp: the
+    # mesh becomes dp×sp×tp, heads sharded over tp within each sp shard.
     sp: int = 1
 
     @property
@@ -36,8 +36,6 @@ class ParallelConfig:
         return self.dp * self.tp * self.sp
 
     def validate(self, n_devices: int) -> None:
-        if self.sp > 1 and self.tp > 1:
-            raise ValueError("sp and tp cannot both exceed 1 (yet)")
         if self.world != n_devices:
             raise ValueError(
                 f"dp*tp*sp = {self.world} != available devices {n_devices}"
@@ -48,37 +46,34 @@ def make_mesh(pcfg: ParallelConfig, devices: Optional[Sequence] = None) -> Mesh:
     devices = list(devices if devices is not None else jax.devices())
     pcfg.validate(len(devices))
     if pcfg.sp > 1:
-        arr = np.array(devices).reshape(pcfg.dp, pcfg.sp)
-        return Mesh(arr, axis_names=("dp", "sp"))
+        # sp meshes always carry a tp axis (size 1 when unused) so param
+        # and KV specs are one convention everywhere
+        arr = np.array(devices).reshape(pcfg.dp, pcfg.sp, pcfg.tp)
+        return Mesh(arr, axis_names=("dp", "sp", "tp"))
     arr = np.array(devices).reshape(pcfg.dp, pcfg.tp)
     return Mesh(arr, axis_names=("dp", "tp"))
 
 
 def shard_params(params, cfg: ModelConfig, mesh: Mesh):
-    """Place a param pytree onto the mesh: megatron TP specs on a tp
-    mesh (int8-quantized {"q","s"} leaves shard q like the weight and
-    the scale on the weight's output axis), replicated on an sp mesh
-    (sp parallelizes the sequence, not the weights)."""
-    if "sp" in mesh.axis_names:
-        return jax.tree.map(
-            lambda x: jax.device_put(x, replicated(mesh)), params
-        )
+    """Place a param pytree onto the mesh: megatron TP specs over the tp
+    axis (int8-quantized {"q","s"} leaves shard q like the weight and
+    the scale on the weight's output axis), replicated over dp and sp
+    (those axes parallelize batch and sequence, not weights)."""
     from ..models.quantization import quantize_pspecs
+    from .multihost import host_array_to_global
 
     specs = quantize_pspecs(params, param_pspecs(cfg))
     return jax.tree.map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
+        lambda x, s: host_array_to_global(mesh, s, x), params, specs
     )
 
 
 def shard_kv_cache(kv, mesh: Mesh):
-    if "sp" in mesh.axis_names:
-        return jax.tree.map(
-            lambda x: jax.device_put(x, replicated(mesh)), kv
-        )
+    from .multihost import host_array_to_global
+
     spec = kv_cache_pspec()
     return jax.tree.map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), kv, spec
+        lambda x, s: host_array_to_global(mesh, s, x), kv, spec
     )
 
 

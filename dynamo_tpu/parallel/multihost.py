@@ -88,9 +88,10 @@ def host_array_to_global(mesh: Mesh, spec: PartitionSpec, host_array) -> jax.Arr
     process passes the SAME logical array and contributes the shards its
     devices own (single-host: plain device_put)."""
     sharding = NamedSharding(mesh, spec)
-    host_array = np.asarray(host_array)
     if not is_multihost():
+        # device or host array alike; avoids forcing a host copy
         return jax.device_put(host_array, sharding)
+    host_array = np.asarray(host_array)
     # global_shape MUST be passed: without it jax infers the global shape
     # by concatenating per-process data along sharded dims (doubling every
     # cross-host axis when each process passes the full array)
@@ -101,22 +102,29 @@ def host_array_to_global(mesh: Mesh, spec: PartitionSpec, host_array) -> jax.Arr
 
 def broadcast_plan(payload: bytes, root: int = 0) -> bytes:
     """Broadcast rank-`root`'s bytes to every host (the scheduler-plan
-    broadcast that keeps multihost engine pumps in lockstep)."""
+    broadcast that keeps multihost engine pumps in lockstep).
+
+    Two-phase (length then payload) so plans of any size fit: the length
+    round is a fixed 8-byte collective every rank can join without
+    knowing the size; payload buffers are padded to a power of two to
+    bound the number of distinct collective shapes XLA compiles."""
     from jax.experimental import multihost_utils
 
     if not is_multihost():
         return payload
-    max_len = 1 << 16
-    if len(payload) > max_len:
-        raise ValueError(f"plan too large to broadcast ({len(payload)}B)")
-    local = np.zeros((max_len + 8,), np.uint8)
-    if jax.process_index() == root:
-        local[:8] = np.frombuffer(np.int64(len(payload)).tobytes(), np.uint8)
-        local[8:8 + len(payload)] = np.frombuffer(payload, np.uint8)
+    src = jax.process_index() == root
+    n = int(
+        np.asarray(multihost_utils.broadcast_one_to_all(
+            np.asarray([len(payload)], np.int64), is_source=src
+        ))[0]
+    )
+    if n == 0:
+        return b""
+    width = 1 << max(6, (n - 1).bit_length())
+    local = np.zeros((width,), np.uint8)
+    if src:
+        local[:n] = np.frombuffer(payload, np.uint8)
     out = np.asarray(
-        multihost_utils.broadcast_one_to_all(
-            local, is_source=jax.process_index() == root
-        )
+        multihost_utils.broadcast_one_to_all(local, is_source=src)
     ).astype(np.uint8)
-    n = int(np.frombuffer(out[:8].tobytes(), np.int64)[0])
-    return out[8:8 + n].tobytes()
+    return out[:n].tobytes()

@@ -7,38 +7,64 @@ attention runs as ring attention (K/V blocks rotate over ICI while the
 flash accumulator runs), so prefill FLOPs and activation memory scale
 down by sp while attention stays exact.
 
+Composes with tensor parallelism: on a dp×sp×tp mesh each device holds
+S/sp of the sequence AND heads/tp of every projection (megatron
+convention, the same `param_pspecs` the GSPMD decode path uses).  Ring
+attention is per-head, so the ring rotates only the local head slice
+over `sp` while `tp` psums reduce the attention/MLP outputs — the two
+axes never talk to each other.
+
 Design constraints (v1, enforced by the engine):
 - whole-prompt prefill (no cached prefix, no chunking): ring causality
   assumes the chunk starts at position 0;
-- the KV pool is REPLICATED over sp (and dp): each device all-gathers
-  the new chunk's K/V and performs the identical pool scatter, keeping
-  every replica bit-identical without a pool-sized collective — sp buys
-  compute parallelism and activation memory, not KV capacity;
-- the sequence bucket must divide by sp and the batch by dp.
+- the KV pool is REPLICATED over sp and dp but SHARDED on kv-heads over
+  tp (the same layout decode uses): each device all-gathers the new
+  chunk's K/V over sp/dp and scatters its own head slice, keeping every
+  sp/dp replica bit-identical without a pool-sized collective;
+- the sequence bucket must divide by sp, the batch by dp, and the
+  q/kv head counts by tp;
+- MoE models require tp == 1 under sp (expert dispatch inside shard_map
+  is not implemented; the GSPMD tp path covers MoE without sp).
 """
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Tuple
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..models import KVCache, ModelConfig
-from ..models.llama import _lm_logits, _mlp, _moe
-from ..models.quantization import matmul_any
+from ..models import KVCache, ModelConfig, kv_cache_pspec, param_pspecs
+from ..models.llama import _lm_logits, _moe
+from ..models.quantization import matmul_any, quantize_pspecs
 from ..ops import apply_rope, rms_norm, rope_frequencies, write_kv_pages
 from ._compat import shard_map
 from .ring_attention import ring_attention_local
 
 
-def _layer_sp(lp, kv_layer, x, positions, table_full, chunk_full, cfg, inv_freq):
-    """One decoder layer on a [Bl, Sl] shard: ring attention over sp, KV
-    written to the replicated pool from the all-gathered chunk."""
+def _embed_sp(embed_local: jax.Array, tokens: jax.Array) -> jax.Array:
+    """Embedding lookup with the vocab sharded over tp: each shard
+    gathers the rows it owns, the psum fills in the rest (the manual
+    form of what GSPMD does for a sharded gather)."""
+    v_local = embed_local.shape[0]
+    off = jax.lax.axis_index("tp") * v_local
+    idx = jnp.clip(tokens - off, 0, v_local - 1)
+    x = embed_local[idx]
+    mine = (tokens >= off) & (tokens < off + v_local)
+    return jax.lax.psum(jnp.where(mine[..., None], x, 0), "tp")
+
+
+def _layer_sp(lp, kv_layer, x, positions, table_full, chunk_full, cfg, inv_freq,
+              tp: int):
+    """One decoder layer on a [Bl, Sl] shard holding heads/tp: ring
+    attention over sp on the local heads, KV head-slice written to the
+    tp-sharded pool from the sp/dp-gathered chunk, tp psums after the
+    attention and MLP output projections."""
     Bl, Sl, h = x.shape
-    nh, nkv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim_
+    nh = cfg.num_attention_heads // tp
+    nkv = cfg.num_key_value_heads // tp
+    hd = cfg.head_dim_
     k_pages, v_pages = kv_layer
     dt = x.dtype
 
@@ -51,8 +77,10 @@ def _layer_sp(lp, kv_layer, x, positions, table_full, chunk_full, cfg, inv_freq)
 
     attn = ring_attention_local(q, k, v, axis_name="sp", causal=True)
 
-    # the pool write must be identical on every device: gather the full
-    # chunk (sp → sequence axis, dp → batch axis) and scatter all rows
+    # the pool write must be identical on every sp/dp replica (the pool
+    # is head-sharded over tp, so each tp shard scatters its own slice):
+    # gather the full chunk (sp → sequence axis, dp → batch axis) and
+    # scatter all rows
     k_full = jax.lax.all_gather(k, "sp", axis=1, tiled=True)
     v_full = jax.lax.all_gather(v, "sp", axis=1, tiled=True)
     k_full = jax.lax.all_gather(k_full, "dp", axis=0, tiled=True)
@@ -64,11 +92,25 @@ def _layer_sp(lp, kv_layer, x, positions, table_full, chunk_full, cfg, inv_freq)
 
     attn_out = matmul_any(
         attn.reshape(Bl, Sl, nh * hd), lp["wo"], "bsd,dh->bsh"
-    ).astype(dt)
+    )
+    attn_out = jax.lax.psum(attn_out, "tp").astype(dt)
     x = x + attn_out
     mlp_in = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-    mlp_out = _moe(lp, mlp_in, cfg) if cfg.is_moe else _mlp(lp, mlp_in)
+    if cfg.is_moe:
+        mlp_out = _moe(lp, mlp_in, cfg)  # tp == 1 (enforced below)
+    else:
+        mlp_out = jax.lax.psum(_mlp_partial(lp, mlp_in), "tp")
     return x + mlp_out.astype(dt), (k_pages, v_pages)
+
+
+def _mlp_partial(lp, x):
+    """`models.llama._mlp` without the implicit full-width assumption:
+    returns the PARTIAL down-projection (summed over the local ffn
+    shard) for the caller to psum over tp."""
+    gate = matmul_any(x, lp["w_gate"], "bsh,hf->bsf")
+    up = matmul_any(x, lp["w_up"], "bsh,hf->bsf")
+    act = jax.nn.silu(gate) * up
+    return matmul_any(act.astype(x.dtype), lp["w_down"], "bsf,fh->bsh")
 
 
 def forward_prefill_sp(
@@ -80,11 +122,21 @@ def forward_prefill_sp(
     chunk_lens: jax.Array,  # [B] valid tokens (prompt starts at position 0)
     mesh: Mesh,
 ) -> Tuple[jax.Array, KVCache]:
-    """Whole-prompt prefill with the sequence sharded over `sp`.
+    """Whole-prompt prefill with the sequence sharded over `sp` and heads
+    over `tp`.
 
     Returns (last-position logits [B, V], updated KVCache) — the pool
-    comes back replicated, ready for the ordinary decode path.
+    comes back in the decode path's layout (sp/dp-replicated,
+    head-sharded over tp), ready for the ordinary decode step.
     """
+    tp = mesh.shape.get("tp", 1)
+    if cfg.is_moe and tp > 1:
+        raise NotImplementedError("sp prefill with tp > 1 requires a dense model")
+    if cfg.num_attention_heads % tp or cfg.num_key_value_heads % tp:
+        raise ValueError(
+            f"tp={tp} must divide the head counts "
+            f"({cfg.num_attention_heads} q / {cfg.num_key_value_heads} kv)"
+        )
     inv_freq = rope_frequencies(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
 
     def body(params, kv_k, kv_v, tokens_l, table_l, chunk_l):
@@ -96,21 +148,21 @@ def forward_prefill_sp(
         table_full = jax.lax.all_gather(table_l, "dp", axis=0, tiled=True)
         chunk_full = jax.lax.all_gather(chunk_l, "dp", axis=0, tiled=True)
 
-        x = params["embed"][tokens_l]
+        x = _embed_sp(params["embed"], tokens_l)
 
         def layer(carry, xs):
             h = carry
             lp, k_pages, v_pages = xs
             h, (k_pages, v_pages) = _layer_sp(
                 lp, (k_pages, v_pages), h, positions, table_full,
-                chunk_full, cfg, inv_freq,
+                chunk_full, cfg, inv_freq, tp,
             )
             return h, (k_pages, v_pages)
 
         x, (k_new, v_new) = jax.lax.scan(
             layer, x, (params["layers"], kv_k, kv_v)
         )
-        # the row's last valid hidden state lives on ONE shard: each
+        # the row's last valid hidden state lives on ONE sp shard: each
         # shard contributes its masked candidate and a psum combines them
         # — an O(h) collective instead of gathering the whole [Bl, S, h]
         last = jnp.maximum(chunk_l - 1, 0)  # global position per row
@@ -120,14 +172,15 @@ def forward_prefill_sp(
         x_last = jax.lax.psum(
             jnp.where(owner[:, None], cand, jnp.zeros_like(cand)), "sp"
         ).astype(x.dtype)
-        logits = _lm_logits(params, cfg, x_last)  # [Bl, V]
+        logits = _lm_logits(params, cfg, x_last)  # [Bl, V/tp] (vocab-sharded)
         return logits, k_new, v_new
 
-    pspec = jax.tree.map(lambda _: P(), params)
+    pspec = quantize_pspecs(params, param_pspecs(cfg))
+    kv_spec = kv_cache_pspec().k
     logits, k_new, v_new = shard_map(
         body,
         mesh=mesh,
-        in_specs=(pspec, P(), P(), P("dp", "sp"), P("dp", None), P("dp")),
-        out_specs=(P("dp", None), P(), P()),
+        in_specs=(pspec, kv_spec, kv_spec, P("dp", "sp"), P("dp", None), P("dp")),
+        out_specs=(P("dp", "tp"), kv_spec, kv_spec),
     )(params, kv.k, kv.v, tokens, page_table, chunk_lens)
     return logits, KVCache(k_new, v_new)

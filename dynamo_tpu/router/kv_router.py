@@ -220,13 +220,24 @@ class KvRouter:
                     self.approx.remove_worker(wid)
         return live
 
-    async def choose(self, request: dict) -> int:
+    async def choose(self, request: dict, allowed=None) -> int:
         """Pick a worker for a preprocessed request; updates load tracking.
-        The caller routes with `client.direct(request, worker_id)`."""
+        The caller routes with `client.direct(request, worker_id)`.
+        `allowed` restricts candidates (e.g. to the instances serving one
+        model when several models share a component endpoint)."""
         token_ids: Sequence[int] = request.get("token_ids", [])
-        hashes = compute_block_hash_for_seq(token_ids, self.block_size, self.salt)
+        # cache_salt (e.g. per-image content hash on multimodal requests)
+        # must match the engine's block-hash chain or indexed blocks from
+        # KV events could never score overlap for these requests
+        hashes = compute_block_hash_for_seq(
+            token_ids, self.block_size,
+            self.salt + str(request.get("cache_salt") or ""),
+        )
         await self.client.wait_for_instances(timeout=5.0)
         workers = self._live_workers()
+        if allowed:
+            scoped = {wid: st for wid, st in workers.items() if wid in allowed}
+            workers = scoped or workers  # card watcher may lag briefly
         if self.busy_threshold > 0:
             free = {
                 wid: st for wid, st in workers.items()

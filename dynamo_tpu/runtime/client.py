@@ -107,16 +107,25 @@ class Client:
 
     # -- routing ------------------------------------------------------------ #
 
-    def _pick_random(self) -> Instance:
+    def _candidates(self, allowed) -> list[Instance]:
+        """Live instances, optionally restricted to an id set (several
+        models can share one endpoint; a model's requests must only
+        reach instances that serve it).  An allowed set with no live
+        member falls back to every instance — the caller's view (card
+        watcher) may briefly lag this client's endpoint watch."""
         insts = self.instances()
+        if allowed:
+            scoped = [i for i in insts if i.instance_id in allowed]
+            insts = scoped or insts
         if not insts:
             raise ServiceUnavailable(f"no instances for {self.endpoint.wire_name}")
-        return random.choice(insts)
+        return insts
 
-    def _pick_round_robin(self) -> Instance:
-        insts = self.instances()
-        if not insts:
-            raise ServiceUnavailable(f"no instances for {self.endpoint.wire_name}")
+    def _pick_random(self, allowed=None) -> Instance:
+        return random.choice(self._candidates(allowed))
+
+    def _pick_round_robin(self, allowed=None) -> Instance:
+        insts = self._candidates(allowed)
         inst = insts[self._rr % len(insts)]
         self._rr += 1
         return inst
@@ -152,12 +161,17 @@ class Client:
                context: Context | None = None) -> AsyncIterator[Any]:
         return self._routed(lambda: self._pick_direct(instance_id), request, context)
 
-    def random(self, request: Any, context: Context | None = None) -> AsyncIterator[Any]:
-        return self._routed(self._pick_random, request, context)
+    def random(self, request: Any, context: Context | None = None,
+               allowed=None) -> AsyncIterator[Any]:
+        return self._routed(
+            lambda: self._pick_random(allowed), request, context
+        )
 
-    def round_robin(self, request: Any,
-                    context: Context | None = None) -> AsyncIterator[Any]:
-        return self._routed(self._pick_round_robin, request, context)
+    def round_robin(self, request: Any, context: Context | None = None,
+                    allowed=None) -> AsyncIterator[Any]:
+        return self._routed(
+            lambda: self._pick_round_robin(allowed), request, context
+        )
 
     async def generate(self, request: Any,
                        context: Context | None = None) -> AsyncIterator[Any]:

@@ -97,6 +97,9 @@ def tiny_tokenizer():
         "0123456789 !@#$%^&*()",
     ]
     tok.train_from_iterator(corpus, trainer)
+    # appended AFTER training so every other id is unchanged; used by the
+    # multimodal path as the single-image placeholder
+    tok.add_special_tokens(["<image>"])
     eos = tok.token_to_id("<|endoftext|>")
     return HuggingFaceTokenizer(tok, eos_token_ids=[eos])
 

@@ -26,6 +26,9 @@ def main() -> None:
     ap.add_argument("--component", default="backend")
     ap.add_argument("--endpoint", default="generate")
     ap.add_argument("--mock", action="store_true", help="MockEngine simulator")
+    ap.add_argument("--vision", default="", choices=["", "tiny"],
+                    help="attach a vision tower (multimodal chat); 'tiny' "
+                         "pairs the test tower with --model tiny")
     ap.add_argument("--page-size", type=int, default=16)
     ap.add_argument("--num-pages", type=int, default=2048)
     ap.add_argument("--max-num-seqs", type=int, default=16)
@@ -49,8 +52,16 @@ def main() -> None:
     ap.add_argument("--status-port", type=int, default=0,
                     help="system status server port (0 = ephemeral, "
                          "-1 = disabled); serves /health /live /metrics")
+    # serving mesh: dp*tp*sp devices (all local devices by default); on a
+    # multihost group this spans the GLOBAL device set
+    ap.add_argument("--dp", type=int, default=1, help="data-parallel degree")
+    ap.add_argument("--tp", type=int, default=1, help="tensor-parallel degree")
+    ap.add_argument("--sp", type=int, default=1,
+                    help="sequence-parallel degree (ring-attention prefill)")
     # multihost (jax.distributed): every host in the group runs this CLI
-    # with the same flags and a unique --host-id; see parallel/multihost.py
+    # with the same flags and a unique --host-id; see parallel/multihost.py.
+    # Rank 0 serves the endpoint; other ranks replay its dispatches in
+    # lockstep (JaxEngine.follower_loop)
     ap.add_argument("--coordinator", default="",
                     help="rank-0 coordinator host:port (DYN_COORDINATOR)")
     ap.add_argument("--num-hosts", type=int, default=None)
@@ -88,6 +99,16 @@ def main() -> None:
     from ..parallel import initialize_multihost
 
     initialize_multihost(args.coordinator, args.num_hosts, args.host_id)
+    import jax
+
+    if jax.process_count() > 1 and jax.process_index() != 0:
+        # follower rank: same engine, no endpoint — replay rank 0's steps
+        if args.mock:
+            raise SystemExit("--mock cannot run multihost")
+        engine, _ = _build_engine(args)
+        print("READY follower", flush=True)
+        engine.follower_loop()
+        return
     asyncio.run(_run(args))
 
 
@@ -292,7 +313,32 @@ def _build_engine(args):
         tokenizer_json = tok.to_json_str()
         eos = list(tok.eos_token_ids)
 
-    engine = JaxEngine(cfg, params, ecfg, eos_token_ids=eos, kv_dtype=dtype)
+    parallel = None
+    if args.dp * args.tp * args.sp > 1:
+        from ..parallel import ParallelConfig
+
+        parallel = ParallelConfig(dp=args.dp, tp=args.tp, sp=args.sp)
+    vision = None
+    mm_fields = {}
+    if args.vision:
+        import jax
+
+        from ..models.vision import init_vision_params, tiny_vision_config
+
+        vcfg = tiny_vision_config(out_hidden_size=cfg.hidden_size)
+        vision = (init_vision_params(vcfg, jax.random.PRNGKey(7), dtype=dtype),
+                  vcfg)
+        image_ids = tok.encode("<image>")
+        if len(image_ids) != 1:
+            raise SystemExit("tokenizer has no single-token <image> marker")
+        mm_fields = dict(
+            image_token="<image>",
+            image_token_id=image_ids[0],
+            image_patches=vcfg.num_patches,
+            image_size=vcfg.image_size,
+        )
+    engine = JaxEngine(cfg, params, ecfg, eos_token_ids=eos, kv_dtype=dtype,
+                       parallel=parallel, vision=vision)
     mdc = ModelDeploymentCard(
         name=name,
         tokenizer_json=tokenizer_json,
@@ -301,6 +347,7 @@ def _build_engine(args):
         disagg_role=args.disagg_role,
         reasoning_parser=args.reasoning_parser,
         tool_call_parser=args.tool_call_parser,
+        **mm_fields,
     )
     return engine, mdc
 

@@ -220,13 +220,45 @@ def main():
         print(f"OK disagg transfer: {m['kv_transfer_count']} transfers, "
               f"{m['kv_transfer_ms_total']}ms total")
 
+        # multimodal worker: vision tower + image content part over HTTP
+        spawn([*worker_args, "--vision", "tiny",
+               "--model-name", "tiny-vlm"], "vlm-worker")
+        import base64 as _b64
+        import io as _io
+
+        from PIL import Image as _Image
+
+        buf = _io.BytesIO()
+        _Image.new("RGB", (40, 40), (200, 30, 30)).save(buf, format="PNG")
+        uri = "data:image/png;base64," + _b64.b64encode(buf.getvalue()).decode()
+        mm_chat = {
+            "model": "tiny-vlm",
+            "messages": [{"role": "user", "content": [
+                {"type": "text", "text": "look: "},
+                {"type": "image_url", "image_url": {"url": uri}},
+            ]}],
+            "max_tokens": 6, "temperature": 0,
+            "nvext": {"ignore_eos": True},
+        }
+        deadline = time.time() + 30
+        while True:
+            models = http_json(f"{base}/v1/models")
+            if "tiny-vlm" in [m["id"] for m in models["data"]]:
+                break
+            assert time.time() < deadline, models
+            time.sleep(0.5)
+        out = http_json(f"{base}/v1/chat/completions", mm_chat)
+        assert out["usage"]["completion_tokens"] == 6, out
+        print("OK multimodal chat:",
+              repr(out["choices"][0]["message"]["content"]))
+
         # kill worker1 → requests keep working on worker2
         w1.send_signal(signal.SIGKILL)
         time.sleep(7)  # > lease TTL
         out = http_json(f"{base}/v1/chat/completions", chat)
         assert out["choices"][0]["message"]["content"] == text1
         models = http_json(f"{base}/v1/models")
-        assert [m["id"] for m in models["data"]] == ["tiny-chat"]
+        assert set(m["id"] for m in models["data"]) == {"tiny-chat", "tiny-vlm"}
         print("OK survives worker kill")
 
         print("VERIFY PASS")

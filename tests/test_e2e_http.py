@@ -236,3 +236,71 @@ async def test_route_enable_flags(model_setup):
     finally:
         await limited.stop()
         await stop_stack(control, worker_rt, front_rt, engine, watcher, http)
+
+
+async def test_mixed_models_on_shared_component_route_correctly(model_setup):
+    """Two models served by different workers on the SAME component
+    endpoint: requests must only reach instances that published that
+    model's card (the endpoint-level round-robin would cross-route)."""
+    tok, cfg, params = model_setup
+    control = await ControlPlaneServer().start()
+
+    def ecfg():
+        return EngineConfig(page_size=8, num_pages=128, max_num_seqs=4,
+                            max_prefill_tokens=64, max_model_len=256)
+
+    # model A: ordinary params; model B: different params under a
+    # different card name, same component/endpoint
+    rt_a = await DistributedRuntime.connect(control.address)
+    eng_a = JaxEngine(cfg, params, ecfg(),
+                      eos_token_ids=list(tok.eos_token_ids),
+                      kv_dtype=jnp.float32)
+    await serve_engine(rt_a, eng_a, ModelDeploymentCard(
+        name="model-a", tokenizer_json=tok.to_json_str(),
+        eos_token_ids=list(tok.eos_token_ids)))
+
+    params_b = init_params(cfg, jax.random.PRNGKey(99), dtype=jnp.float32)
+    rt_b = await DistributedRuntime.connect(control.address)
+    eng_b = JaxEngine(cfg, params_b, ecfg(),
+                      eos_token_ids=list(tok.eos_token_ids),
+                      kv_dtype=jnp.float32)
+    await serve_engine(rt_b, eng_b, ModelDeploymentCard(
+        name="model-b", tokenizer_json=tok.to_json_str(),
+        eos_token_ids=list(tok.eos_token_ids)))
+
+    front_rt = await DistributedRuntime.connect(control.address)
+    manager = ModelManager()
+    watcher = await ModelWatcher(front_rt, manager).start()
+    await watcher.wait_for_model("model-a")
+    await watcher.wait_for_model("model-b")
+    http = await HttpService(manager, host="127.0.0.1", port=0).start()
+    base = f"http://127.0.0.1:{http.port}"
+    try:
+        async with aiohttp.ClientSession() as session:
+            async def ask(model):
+                body = {"model": model,
+                        "messages": [{"role": "user", "content": "route me"}],
+                        "max_tokens": 6, "temperature": 0,
+                        "nvext": {"ignore_eos": True}}
+                async with session.post(
+                    f"{base}/v1/chat/completions", json=body
+                ) as r:
+                    assert r.status == 200, await r.text()
+                    out = await r.json()
+                return out["choices"][0]["message"]["content"]
+
+            # repeated calls: every response for a model must be identical
+            # (different params would produce different greedy tokens, so
+            # any cross-route shows up as a flapping answer)
+            a = {await ask("model-a") for _ in range(4)}
+            b = {await ask("model-b") for _ in range(4)}
+        assert len(a) == 1 and len(b) == 1
+        assert a != b  # the two models really do produce different text
+    finally:
+        await http.stop()
+        await watcher.stop()
+        await eng_a.shutdown()
+        await eng_b.shutdown()
+        for rt in (front_rt, rt_a, rt_b):
+            await rt.shutdown(graceful=False)
+        await control.stop()

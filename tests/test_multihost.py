@@ -158,3 +158,123 @@ def test_two_host_spmd_matches_single_process():
     want = _tokens_from(ref.stdout)
     for out in outs:
         assert _tokens_from(out) == want
+
+# -- lockstep serving engine across 2 processes ----------------------------- #
+# Rank 0 serves requests through the real JaxEngine (scheduler + pump);
+# rank 1 constructs the same engine and replays rank 0's broadcast plans
+# (JaxEngine.follower_loop).  Greedy output must equal a single-process
+# single-device engine.
+
+LOCKSTEP_WORKER = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)  # 2 local x 2 hosts = 4 global
+
+from dynamo_tpu.parallel.multihost import initialize_multihost
+
+rank = int(sys.argv[1])
+assert initialize_multihost(sys.argv[2], num_hosts=2, host_id=rank)
+assert jax.device_count() == 4
+
+import asyncio
+import jax.numpy as jnp
+from dynamo_tpu.engine import EngineConfig, JaxEngine
+from dynamo_tpu.models import init_params, tiny_config
+from dynamo_tpu.parallel import ParallelConfig
+
+cfg = tiny_config()
+params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+ecfg = EngineConfig(page_size=8, num_pages=64, max_num_seqs=4,
+                    max_prefill_tokens=32, max_model_len=64)
+engine = JaxEngine(cfg, params, ecfg, kv_dtype=jnp.float32,
+                   parallel=ParallelConfig(dp=2, tp=2))
+
+if rank == 0:
+    async def run():
+        outs = []
+        for i in range(3):
+            p = [(i * 13 + j) % cfg.vocab_size for j in range(5 + 3 * i)]
+            req = {"token_ids": p,
+                   "sampling_options": {"temperature": 0.0},
+                   "stop_conditions": {"max_tokens": 6, "ignore_eos": True}}
+            toks = []
+            async for out in engine.generate(req):
+                assert out.get("finish_reason") != "error", out
+                toks += out["token_ids"]
+            outs.append(toks)
+        await engine.shutdown()
+        return outs
+
+    print("TOKENS", repr(asyncio.run(run())), flush=True)
+else:
+    engine.follower_loop()
+    print("FOLLOWER DONE", flush=True)
+"""
+
+LOCKSTEP_REFERENCE = r"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import asyncio
+import jax.numpy as jnp
+from dynamo_tpu.engine import EngineConfig, JaxEngine
+from dynamo_tpu.models import init_params, tiny_config
+
+cfg = tiny_config()
+params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+ecfg = EngineConfig(page_size=8, num_pages=64, max_num_seqs=4,
+                    max_prefill_tokens=32, max_model_len=64)
+engine = JaxEngine(cfg, params, ecfg, kv_dtype=jnp.float32)
+
+async def run():
+    outs = []
+    for i in range(3):
+        p = [(i * 13 + j) % cfg.vocab_size for j in range(5 + 3 * i)]
+        req = {"token_ids": p,
+               "sampling_options": {"temperature": 0.0},
+               "stop_conditions": {"max_tokens": 6, "ignore_eos": True}}
+        toks = []
+        async for out in engine.generate(req):
+            assert out.get("finish_reason") != "error", out
+            toks += out["token_ids"]
+        outs.append(toks)
+    await engine.shutdown()
+    return outs
+
+print("TOKENS", repr(asyncio.run(run())), flush=True)
+"""
+
+
+@pytest.mark.timeout(300)
+def test_lockstep_engine_two_hosts_matches_single_process():
+    env = {**os.environ, "PYTHONPATH": ROOT}
+    env.pop("XLA_FLAGS", None)
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    coordinator = f"127.0.0.1:{s.getsockname()[1]}"
+    s.close()
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", LOCKSTEP_WORKER, str(rank), coordinator],
+            env=env, cwd=ROOT, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+        )
+        for rank in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=240)
+        assert p.returncode == 0, out
+        outs.append(out)
+    assert "FOLLOWER DONE" in outs[1]
+
+    ref = subprocess.run(
+        [sys.executable, "-c", LOCKSTEP_REFERENCE], env=env, cwd=ROOT,
+        capture_output=True, text=True, timeout=240,
+    )
+    assert ref.returncode == 0, ref.stdout + ref.stderr
+    assert _tokens_from(outs[0]) == _tokens_from(ref.stdout)

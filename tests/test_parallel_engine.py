@@ -154,5 +154,43 @@ def test_engine_sp_validation():
                   max_model_len=256),
             parallel=ParallelConfig(dp=2, sp=4),
         )
-    with pytest.raises(ValueError, match="sp and tp"):
-        ParallelConfig(dp=2, tp=2, sp=2).validate(8)
+    from dynamo_tpu.models import tiny_moe_config
+
+    with pytest.raises(ValueError, match="dense model"):
+        JaxEngine(
+            tiny_moe_config(),
+            init_params(tiny_moe_config(), jax.random.PRNGKey(0),
+                        dtype=jnp.float32),
+            _ecfg(enable_prefix_caching=False, max_prefill_tokens=256,
+                  max_model_len=256),
+            parallel=ParallelConfig(dp=2, sp=2, tp=2),
+        )
+
+
+async def test_engine_sp_tp_composed():
+    """sp×tp engine: ring-attention prefill over sp with heads sharded
+    over tp on a dp×sp×tp mesh — greedy continuation identical to
+    single-device."""
+    cfg = tiny_config()
+    params = init_params(cfg, jax.random.PRNGKey(3), dtype=jnp.float32)
+    prompts = _prompts(cfg, n=3)
+
+    def ecfg():
+        return _ecfg(
+            enable_prefix_caching=False,
+            max_prefill_tokens=256,
+            max_model_len=256,
+        )
+
+    ref = JaxEngine(cfg, params, ecfg(), kv_dtype=jnp.float32)
+    out_ref = await _collect(ref, prompts)
+    await ref.shutdown()
+
+    par = JaxEngine(
+        cfg, params, ecfg(), kv_dtype=jnp.float32,
+        parallel=ParallelConfig(dp=2, sp=2, tp=2),
+    )
+    out_par = await _collect(par, prompts)
+    await par.shutdown()
+
+    assert out_par == out_ref
