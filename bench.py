@@ -145,6 +145,9 @@ def previous_round_value():
         try:
             with open(path) as f:
                 d = json.load(f)
+            # the driver wraps the bench line as {"parsed": {...}, ...}
+            if "parsed" in d and isinstance(d["parsed"], dict):
+                d = d["parsed"]
             if d.get("unit") == "tok/s":
                 best = d.get("value")
         except (OSError, ValueError):

@@ -364,12 +364,17 @@ class HttpService:
     # -- core serving path --------------------------------------------------- #
 
     async def _serve(self, request: web.Request, kind: str) -> web.StreamResponse:
-        t0 = time.monotonic()
         # every HTTP request gets a trace; x-request-id joins an existing
-        # one (propagated to workers via wire-frame headers)
-        from ..runtime.tracing import new_trace, set_trace
+        # one (propagated to workers via wire-frame headers); the span
+        # lands in the DYN_OTEL_FILE sink when configured
+        from ..runtime.tracing import new_trace, set_trace, span
 
         set_trace(new_trace(request.headers.get("x-request-id")))
+        with span(f"http.{kind}", path=request.path):
+            return await self._serve_inner(request, kind)
+
+    async def _serve_inner(self, request: web.Request, kind: str) -> web.StreamResponse:
+        t0 = time.monotonic()
         try:
             body = await request.json()
         except json.JSONDecodeError:

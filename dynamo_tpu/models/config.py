@@ -103,7 +103,11 @@ class ModelConfig:
             rope_theta=d.get("rope_theta", 10000.0),
             rope_scaling=d.get("rope_scaling"),
             tie_word_embeddings=d.get("tie_word_embeddings", False),
-            attention_bias=d.get("attention_bias", False),
+            # HF Qwen2Config has no attention_bias field — its attention
+            # hardcodes qkv bias on (o_proj off); mirror that default
+            attention_bias=d.get(
+                "attention_bias", d.get("model_type") == "qwen2"
+            ),
             num_experts=num_experts,
             num_experts_per_tok=d.get("num_experts_per_tok", 2),
             moe_intermediate_size=d.get("moe_intermediate_size"),
@@ -223,7 +227,39 @@ MIXTRAL_8X7B = ModelConfig(
     name="mixtral-8x7b",
 )
 
+QWEN2_5_7B = ModelConfig(
+    vocab_size=152064,
+    hidden_size=3584,
+    intermediate_size=18944,
+    num_hidden_layers=28,
+    num_attention_heads=28,
+    num_key_value_heads=4,
+    max_position_embeddings=32768,
+    rms_norm_eps=1e-6,
+    rope_theta=1000000.0,
+    attention_bias=True,
+    model_type="qwen2",
+    name="qwen2.5-7b",
+)
+
+QWEN2_5_0_5B = ModelConfig(
+    vocab_size=151936,
+    hidden_size=896,
+    intermediate_size=4864,
+    num_hidden_layers=24,
+    num_attention_heads=14,
+    num_key_value_heads=2,
+    max_position_embeddings=32768,
+    rms_norm_eps=1e-6,
+    rope_theta=1000000.0,
+    attention_bias=True,
+    tie_word_embeddings=True,
+    model_type="qwen2",
+    name="qwen2.5-0.5b",
+)
+
 CONFIGS = {
     c.name: c
-    for c in [LLAMA_3_2_1B, LLAMA_3_1_8B, LLAMA_3_70B, MIXTRAL_8X7B]
+    for c in [LLAMA_3_2_1B, LLAMA_3_1_8B, LLAMA_3_70B, MIXTRAL_8X7B,
+              QWEN2_5_7B, QWEN2_5_0_5B]
 }

@@ -92,6 +92,14 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
         "attn_norm": jnp.ones((L, h), dtype),
         "mlp_norm": jnp.ones((L, h), dtype),
     }
+    if cfg.attention_bias:  # qwen2-style qkv bias (no o_proj bias)
+        layers.update(
+            {
+                "bq": w(next(ks), L, nh * hd, scale=0.02),
+                "bk": w(next(ks), L, nkv * hd, scale=0.02),
+                "bv": w(next(ks), L, nkv * hd, scale=0.02),
+            }
+        )
     if cfg.is_moe:
         fm = cfg.moe_intermediate_size or f
         E = cfg.num_experts
@@ -136,6 +144,14 @@ def param_pspecs(cfg: ModelConfig, tp_axis: str = "tp", ep_axis: str = "tp") -> 
         "attn_norm": P(None, None),
         "mlp_norm": P(None, None),
     }
+    if cfg.attention_bias:  # biases shard with their projection's heads
+        layers.update(
+            {
+                "bq": P(None, tp_axis),
+                "bk": P(None, tp_axis),
+                "bv": P(None, tp_axis),
+            }
+        )
     if cfg.is_moe:
         layers.update(
             {
@@ -172,6 +188,15 @@ def kv_cache_pspec(tp_axis: str = "tp") -> KVCache:
 # --------------------------------------------------------------------------- #
 # forward
 # --------------------------------------------------------------------------- #
+
+
+def _proj(x: jax.Array, lp: Params, wkey: str, bkey: str,
+          eq: str = "bsh,hd->bsd") -> jax.Array:
+    """QKV projection with the optional qwen2-style additive bias."""
+    y = matmul_any(x, lp[wkey], eq)
+    if bkey in lp:
+        y = y + lp[bkey]
+    return y
 
 
 def _mlp(lp: Params, x: jax.Array) -> jax.Array:
@@ -346,9 +371,9 @@ def _layer_prefill(
 
     attn_in = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
     dt = x.dtype
-    q = matmul_any(attn_in, lp["wq"], "bsh,hd->bsd").astype(dt).reshape(B, S, nh, hd)
-    k = matmul_any(attn_in, lp["wk"], "bsh,hd->bsd").astype(dt).reshape(B, S, nkv, hd)
-    v = matmul_any(attn_in, lp["wv"], "bsh,hd->bsd").astype(dt).reshape(B, S, nkv, hd)
+    q = _proj(attn_in, lp, "wq", "bq").astype(dt).reshape(B, S, nh, hd)
+    k = _proj(attn_in, lp, "wk", "bk").astype(dt).reshape(B, S, nkv, hd)
+    v = _proj(attn_in, lp, "wv", "bv").astype(dt).reshape(B, S, nkv, hd)
     q = apply_rope(q, positions, inv_freq)
     k = apply_rope(k, positions, inv_freq)
 
@@ -386,9 +411,9 @@ def _layer_decode(
 
     attn_in = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
     dt = x.dtype
-    q = matmul_any(attn_in, lp["wq"], "bh,hd->bd").astype(dt).reshape(B, 1, nh, hd)
-    k = matmul_any(attn_in, lp["wk"], "bh,hd->bd").astype(dt).reshape(B, 1, nkv, hd)
-    v = matmul_any(attn_in, lp["wv"], "bh,hd->bd").astype(dt).reshape(B, 1, nkv, hd)
+    q = _proj(attn_in, lp, "wq", "bq", "bh,hd->bd").astype(dt).reshape(B, 1, nh, hd)
+    k = _proj(attn_in, lp, "wk", "bk", "bh,hd->bd").astype(dt).reshape(B, 1, nkv, hd)
+    v = _proj(attn_in, lp, "wv", "bv", "bh,hd->bd").astype(dt).reshape(B, 1, nkv, hd)
     q = apply_rope(q, positions[:, None], inv_freq)[:, 0]
     k = apply_rope(k, positions[:, None], inv_freq)
 

@@ -78,6 +78,14 @@ def load_params(path: str, cfg: ModelConfig, dtype=jnp.bfloat16):
         "attn_norm": stack(p + "input_layernorm.weight", transpose=False),
         "mlp_norm": stack(p + "post_attention_layernorm.weight", transpose=False),
     }
+    if r.has("model.layers.0.self_attn.q_proj.bias"):  # qwen2-style
+        layers.update(
+            {
+                "bq": stack(p + "self_attn.q_proj.bias", transpose=False),
+                "bk": stack(p + "self_attn.k_proj.bias", transpose=False),
+                "bv": stack(p + "self_attn.v_proj.bias", transpose=False),
+            }
+        )
     if cfg.is_moe:
         E = cfg.num_experts
 

@@ -36,7 +36,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..models import KVCache, ModelConfig, kv_cache_pspec, param_pspecs
-from ..models.llama import _lm_logits, _moe
+from ..models.llama import _lm_logits, _moe, _proj
 from ..models.quantization import matmul_any, quantize_pspecs
 from ..ops import apply_rope, rms_norm, rope_frequencies, write_kv_pages
 from ._compat import shard_map
@@ -69,9 +69,9 @@ def _layer_sp(lp, kv_layer, x, positions, table_full, chunk_full, cfg, inv_freq,
     dt = x.dtype
 
     attn_in = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-    q = matmul_any(attn_in, lp["wq"], "bsh,hd->bsd").astype(dt).reshape(Bl, Sl, nh, hd)
-    k = matmul_any(attn_in, lp["wk"], "bsh,hd->bsd").astype(dt).reshape(Bl, Sl, nkv, hd)
-    v = matmul_any(attn_in, lp["wv"], "bsh,hd->bsd").astype(dt).reshape(Bl, Sl, nkv, hd)
+    q = _proj(attn_in, lp, "wq", "bq").astype(dt).reshape(Bl, Sl, nh, hd)
+    k = _proj(attn_in, lp, "wk", "bk").astype(dt).reshape(Bl, Sl, nkv, hd)
+    v = _proj(attn_in, lp, "wv", "bv").astype(dt).reshape(Bl, Sl, nkv, hd)
     q = apply_rope(q, positions, inv_freq)
     k = apply_rope(k, positions, inv_freq)
 

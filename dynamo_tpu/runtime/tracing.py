@@ -35,7 +35,10 @@ class TraceContext:
 
 
 def new_trace(trace_id: Optional[str] = None) -> TraceContext:
-    return TraceContext(trace_id or uuid.uuid4().hex, uuid.uuid4().hex[:16])
+    """Root context: the empty span_id means "no span yet", so the first
+    `span()` under it exports with no parentSpanId (a proper root) —
+    an exported parent id must always reference an exported span."""
+    return TraceContext(trace_id or uuid.uuid4().hex, "")
 
 
 def current_trace() -> Optional[TraceContext]:
@@ -59,10 +62,14 @@ def trace_headers() -> dict:
 
 
 def trace_from_headers(header: dict) -> Optional[TraceContext]:
+    """Adopt the caller's context VERBATIM (remote parent): the header's
+    span_id is the caller's live span, so the callee's first `span()`
+    exports with that as parentSpanId and replayed OTLP files show the
+    real frontend→worker nesting."""
     tid = header.get("trace_id")
     if not tid:
         return None
-    return TraceContext(tid, header.get("span_id", "")).child()
+    return TraceContext(tid, header.get("span_id", ""))
 
 
 class JsonlFormatter(logging.Formatter):
@@ -93,6 +100,114 @@ class TraceFormatter(logging.Formatter):
         if ctx is not None:
             base += f" trace={ctx.trace_id[:12]}"
         return base
+
+
+# -- span export (OTEL OTLP-JSON shape, file sink) --------------------------- #
+# The reference exports OTLP spans to a collector (logging.rs,
+# OTEL_EXPORT_ENABLED).  This environment has no collector, so spans are
+# written as OTLP/JSON ResourceSpans — one JSON object per line — to the
+# file named by DYN_OTEL_FILE; any OTLP/HTTP collector can replay them,
+# and tests can assert cross-process trace joins from the file.
+
+_EXPORTER: Optional["SpanFileExporter"] = None
+
+
+class SpanFileExporter:
+    def __init__(self, path: str, service_name: str = "dynamo_tpu"):
+        self.path = path
+        self.service_name = service_name
+        self._f = open(path, "a", buffering=1)
+
+    def export(self, name: str, ctx: TraceContext, parent_span: str,
+               start_ns: int, end_ns: int, attrs: dict) -> None:
+        span = {
+            "traceId": ctx.trace_id,
+            "spanId": ctx.span_id,
+            "name": name,
+            "kind": 1,
+            "startTimeUnixNano": str(start_ns),
+            "endTimeUnixNano": str(end_ns),
+            "attributes": [
+                {"key": k, "value": {"stringValue": str(v)}}
+                for k, v in attrs.items()
+            ],
+        }
+        if parent_span:
+            span["parentSpanId"] = parent_span
+        self._f.write(json.dumps({
+            "resourceSpans": [{
+                "resource": {"attributes": [{
+                    "key": "service.name",
+                    "value": {"stringValue": self.service_name},
+                }]},
+                "scopeSpans": [{
+                    "scope": {"name": "dynamo_tpu.tracing"},
+                    "spans": [span],
+                }],
+            }],
+        }) + "\n")
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+        except OSError:
+            pass
+
+
+def get_exporter() -> Optional[SpanFileExporter]:
+    global _EXPORTER
+    if _EXPORTER is None:
+        from .config import env_str
+
+        path = env_str("DYN_OTEL_FILE")
+        if path:
+            import os as _os
+
+            _EXPORTER = SpanFileExporter(
+                path, service_name=env_str("DYN_SERVICE_NAME")
+                or _os.path.basename(sys.argv[0]) or "dynamo_tpu",
+            )
+    return _EXPORTER
+
+
+class span:
+    """Context manager recording one span under the current trace:
+
+        with span("engine.prefill", batch=B):
+            ...
+
+    Creates a child span of the current trace context (minting a fresh
+    trace when none is active), restores the parent on exit, and exports
+    to the DYN_OTEL_FILE sink when configured (no-op otherwise)."""
+
+    def __init__(self, name: str, **attrs):
+        self.name = name
+        self.attrs = attrs
+
+    def __enter__(self) -> "span":
+        parent = current_trace()
+        self.parent_span = parent.span_id if parent else ""
+        ctx = parent.child() if parent else new_trace()
+        self._token = set_trace(ctx)
+        self.ctx = ctx
+        self._start = time.time_ns()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        try:
+            exporter = get_exporter()
+            if exporter is not None:
+                attrs = dict(self.attrs)
+                if exc_type is not None:
+                    attrs["error"] = exc_type.__name__
+                exporter.export(
+                    self.name, self.ctx, self.parent_span,
+                    self._start, time.time_ns(), attrs,
+                )
+        except Exception:  # noqa: BLE001 — tracing must not break serving
+            pass
+        finally:
+            reset_trace(self._token)
 
 
 def setup_logging(level: str = "", jsonl: Optional[bool] = None,

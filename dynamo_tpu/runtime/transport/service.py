@@ -163,12 +163,16 @@ class ServiceServer:
             from ..tracing import set_trace
 
             set_trace(trace)
+        from ..tracing import span
+
         try:
-            request = unpack(req_frame.payload)
-            async for item in handler(request, ctx):
-                if ctx.is_killed():
-                    break
-                await send(Frame(K_DATA, sid, {}, pack(item)))
+            with span("service.handle",
+                      endpoint=req_frame.header.get("endpoint", "")):
+                request = unpack(req_frame.payload)
+                async for item in handler(request, ctx):
+                    if ctx.is_killed():
+                        break
+                    await send(Frame(K_DATA, sid, {}, pack(item)))
             await send(Frame(K_END, sid, {}, b""))
         except asyncio.CancelledError:
             pass

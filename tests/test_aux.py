@@ -57,7 +57,9 @@ def test_trace_header_round_trip():
         assert hdr["trace_id"] == ctx.trace_id
         restored = trace_from_headers(hdr)
         assert restored.trace_id == ctx.trace_id
-        assert restored.span_id != ctx.span_id  # child span
+        # adopted VERBATIM: the callee's first span() must parent onto the
+        # caller's live span for coherent exported hierarchies
+        assert restored.span_id == ctx.span_id
         assert trace_from_headers({}) is None
     finally:
         set_trace(None)
@@ -210,3 +212,60 @@ def test_config_dump(monkeypatch):
     assert d["resolved"]["control"] == "h:9"
     assert d["resolved"]["namespace"] == "prod"
     assert d["env"]["DYN_CONTROL"] == "h:9"
+
+
+async def test_otel_span_file_export(tmp_path, monkeypatch):
+    """Spans land in the DYN_OTEL_FILE sink as OTLP/JSON lines, and a
+    worker-side service.handle span joins the caller's trace (the
+    reference exports OTLP spans to a collector; here the sink is a
+    replayable file)."""
+    import json as _json
+
+    import dynamo_tpu.runtime.tracing as tracing
+    from dynamo_tpu.runtime import Context
+    from dynamo_tpu.testing import local_cluster
+
+    path = tmp_path / "spans.jsonl"
+    monkeypatch.setenv("DYN_OTEL_FILE", str(path))
+    monkeypatch.setattr(tracing, "_EXPORTER", None)  # re-read env
+
+    async def handler(request, context):
+        with tracing.span("engine.step", batch="1"):
+            yield {"ok": True}
+
+    async with local_cluster(2) as (server, (rt_w, rt_c)):
+        ep = rt_w.namespace("t").component("c").endpoint("e")
+        await ep.serve_endpoint(handler)
+        client = rt_c.namespace("t").component("c").endpoint("e").client()
+        await client.start()
+        await client.wait_for_instances()
+        tok = set_trace(new_trace("otel-e2e"))
+        try:
+            with tracing.span("http.chat", path="/v1/chat/completions"):
+                async for _ in client.round_robin({"x": 1}, Context()):
+                    pass
+        finally:
+            set_trace(None)
+        await client.stop()
+
+    spans = {}
+    for line in path.read_text().splitlines():
+        rs = _json.loads(line)["resourceSpans"][0]
+        sp = rs["scopeSpans"][0]["spans"][0]
+        spans[sp["name"]] = sp
+    assert {"http.chat", "service.handle", "engine.step"} <= set(spans)
+    # every span joined the same trace minted by the frontend
+    assert {s["traceId"] for s in spans.values()} == {"otel-e2e"}
+    # the replayed file shows the real cross-process hierarchy:
+    # http.chat (root) → service.handle (worker) → engine.step
+    assert "parentSpanId" not in spans["http.chat"]
+    assert spans["service.handle"]["parentSpanId"] == spans["http.chat"]["spanId"]
+    assert spans["engine.step"]["parentSpanId"] == spans["service.handle"]["spanId"]
+    assert int(spans["http.chat"]["endTimeUnixNano"]) >= int(
+        spans["http.chat"]["startTimeUnixNano"]
+    )
+    # attributes survive the OTLP shaping
+    attrs = {a["key"]: a["value"]["stringValue"]
+             for a in spans["http.chat"]["attributes"]}
+    assert attrs["path"] == "/v1/chat/completions"
+    tracing._EXPORTER = None  # do not leak the sink into other tests
