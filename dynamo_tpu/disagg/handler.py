@@ -7,6 +7,7 @@ from typing import Any, AsyncIterator, Dict, Optional
 
 from ..engine import JaxEngine
 from ..llm import ModelDeploymentCard
+from ..router.worker_key import unpack_worker
 from ..runtime import Client, Context, DistributedRuntime
 from ..runtime.transport.service import RemoteStreamError, ServiceUnavailable
 from .router import DisaggRouter
@@ -208,10 +209,13 @@ class DisaggDecodeHandler:
         self._inflight_prefills += 1
         try:
             if self.prefill_router is not None:
-                wid = await self.prefill_router.choose(
+                key = await self.prefill_router.choose(
                     {**request, "request_id": prefill_ctx.id}
                 )
-                stream = self.prefill_client.direct(request, wid, prefill_ctx)
+                inst, dp_rank = unpack_worker(key)
+                stream = self.prefill_client.direct(
+                    {**request, "dp_rank": dp_rank}, inst, prefill_ctx
+                )
             else:
                 stream = self.prefill_client.round_robin(request, prefill_ctx)
             result = None

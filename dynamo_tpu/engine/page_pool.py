@@ -69,8 +69,14 @@ class PagePool:
         return self.free_pages + self.evictable_pages
 
     def usage(self) -> float:
+        """Fraction of the pool that is NOT reclaimable (pages held by
+        running sequences).  Cached-but-evictable pages count as free —
+        they are capacity, not load; counting them as used would make
+        the router/busy-threshold systematically penalize cache-rich
+        workers (vLLM v1 semantics: cached blocks sit in the free
+        queue)."""
         usable = self.num_pages - 1
-        return 1.0 - (self.free_pages / usable) if usable else 1.0
+        return 1.0 - (self.available_pages / usable) if usable else 1.0
 
     # -- allocation ---------------------------------------------------------- #
 

@@ -25,6 +25,7 @@ from ..llm import (
     postprocess_stream,
 )
 from ..llm.migration import migrating_stream
+from ..router.worker_key import unpack_worker
 from ..runtime import Client, Context, DistributedRuntime
 from ..runtime.transport.wire import pack, unpack
 
@@ -57,10 +58,12 @@ class ModelEntry:
             request = {**request, "request_id": context.id}
             # AllWorkersBusy (an Overloaded/ServiceUnavailable) propagates:
             # migration re-raises it and the frontend answers 503
-            worker_id = await self.kv_chooser.choose(
+            worker_key = await self.kv_chooser.choose(
                 request, allowed=self.instances
             )
-            stream = self.client.direct(request, worker_id, context)
+            instance_id, dp_rank = unpack_worker(worker_key)
+            request["dp_rank"] = dp_rank
+            stream = self.client.direct(request, instance_id, context)
             try:
                 async for item in stream:
                     yield item

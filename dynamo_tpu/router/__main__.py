@@ -6,7 +6,10 @@ disagg decode handler uses one as its *prefill router*).
 
 Serves `{namespace}.{component}.generate` with two request shapes:
 - {"op": "choose", "token_ids": [...], "request_id": ...}
-      → {"worker_id": int}   (KV-aware selection over the target workers)
+      → {"worker_id": int}   (KV-aware selection over the target workers;
+        the id is a PACKED (instance, dp_rank) key — callers unpack with
+        `router.worker_key.unpack_worker`, direct to the instance, and
+        stamp dp_rank on the request)
 - {"op": "finished", "request_id": ...}
       → {"status": "ok"}     (releases the request's load tracking)
 """

@@ -29,6 +29,7 @@ from .indexer import ApproxKvIndexer, RadixIndex
 from .publisher import kv_stream_name, metrics_subject
 from .scheduler import KvWorkerSelector, SchedulingDecision, WorkerState
 from .sequence import ActiveSequences
+from .worker_key import pack_worker, unpack_worker
 
 logger = logging.getLogger(__name__)
 
@@ -63,9 +64,11 @@ class KvRouter:
         self.client = client
         self.block_size = block_size
         self.salt = salt
+        from .publisher import KV_WIRE_VERSION
+
         self.stream = kv_stream_name(namespace, component)
         self.metrics_subject = metrics_subject(namespace, component)
-        self.snapshot_name = f"{namespace}.{component}"
+        self.snapshot_name = f"{namespace}.{component}@{KV_WIRE_VERSION}"
         self.busy_threshold = busy_threshold
         self.snapshot_threshold = snapshot_threshold
         self.index = RadixIndex()
@@ -205,26 +208,40 @@ class KvRouter:
     # -- the routing decision ------------------------------------------------ #
 
     def _live_workers(self) -> Dict[int, WorkerState]:
-        """Live instances from discovery joined with last-published state."""
-        live = {}
-        for inst in self.client.instances():
-            wid = inst.instance_id
-            live[wid] = self.worker_states.get(wid, WorkerState(worker_id=wid))
-        # drop state/index entries for dead workers
-        for wid in list(self.worker_states):
-            if wid not in live:
-                del self.worker_states[wid]
-                self.index.remove_worker(wid)
-                self.active.remove_worker(wid)
+        """Live candidates keyed by PACKED (instance, dp_rank) worker id.
+
+        Discovery yields instances; published metrics reveal each
+        instance's dp ranks (a multi-rank worker publishes one
+        ForwardPassMetrics per rank).  An instance with no metrics yet is
+        routable at rank 0 so brand-new workers take traffic."""
+        live_inst = {inst.instance_id for inst in self.client.instances()}
+        live: Dict[int, WorkerState] = {
+            key: st for key, st in self.worker_states.items()
+            if unpack_worker(key)[0] in live_inst
+        }
+        covered = {unpack_worker(key)[0] for key in live}
+        for iid in live_inst - covered:
+            k0 = pack_worker(iid, 0)
+            live[k0] = WorkerState(worker_id=k0)
+        # drop state/index entries for dead workers (all their ranks)
+        for key in list(self.worker_states):
+            if unpack_worker(key)[0] not in live_inst:
+                del self.worker_states[key]
+                self.index.remove_worker(key)
+                self.active.remove_worker(key)
                 if self.approx:
-                    self.approx.remove_worker(wid)
+                    self.approx.remove_worker(key)
         return live
 
     async def choose(self, request: dict, allowed=None) -> int:
         """Pick a worker for a preprocessed request; updates load tracking.
-        The caller routes with `client.direct(request, worker_id)`.
-        `allowed` restricts candidates (e.g. to the instances serving one
-        model when several models share a component endpoint)."""
+
+        Returns a PACKED (instance, dp_rank) worker key — callers unpack
+        with `worker_key.unpack_worker`, route with
+        `client.direct(request, instance)`, and put the rank in
+        `request["dp_rank"]`.  `allowed` restricts candidate INSTANCES
+        (e.g. to the instances serving one model when several models
+        share a component endpoint)."""
         token_ids: Sequence[int] = request.get("token_ids", [])
         # cache_salt (e.g. per-image content hash on multimodal requests)
         # must match the engine's block-hash chain or indexed blocks from
@@ -236,7 +253,10 @@ class KvRouter:
         await self.client.wait_for_instances(timeout=5.0)
         workers = self._live_workers()
         if allowed:
-            scoped = {wid: st for wid, st in workers.items() if wid in allowed}
+            scoped = {
+                wid: st for wid, st in workers.items()
+                if unpack_worker(wid)[0] in allowed
+            }
             workers = scoped or workers  # card watcher may lag briefly
         if self.busy_threshold > 0:
             free = {

@@ -21,22 +21,34 @@ from ..runtime.transport.wire import pack, unpack
 logger = logging.getLogger(__name__)
 
 
+# v2: worker ids in events/metrics are PACKED (instance, dp_rank) keys
+# (worker_key.py).  The version in the names forces routers and workers
+# from before the packing change onto disjoint streams/snapshots — mixed
+# formats would silently score zero overlap forever.
+KV_WIRE_VERSION = "v2"
+
+
 def kv_stream_name(namespace: str, component: str) -> str:
-    return f"kv-events.{namespace}.{component}"
+    return f"kv-events.{KV_WIRE_VERSION}.{namespace}.{component}"
 
 
 def metrics_subject(namespace: str, component: str) -> str:
-    return f"metrics.{namespace}.{component}"
+    return f"metrics.{KV_WIRE_VERSION}.{namespace}.{component}"
 
 
 class KvEventPublisher:
-    """Engine event sink → durable control-plane stream."""
+    """Engine event sink → durable control-plane stream.  Events key by
+    the PACKED (instance, dp_rank) worker id (worker_key.py) so a
+    multi-rank worker's engine replicas index separately."""
 
     def __init__(self, runtime: DistributedRuntime, namespace: str,
-                 component: str, worker_id: int):
+                 component: str, worker_id: int, dp_rank: int = 0):
+        from .worker_key import pack_worker
+
         self.runtime = runtime
         self.stream = kv_stream_name(namespace, component)
-        self.worker_id = worker_id
+        self.worker_id = pack_worker(worker_id, dp_rank)
+        self.dp_rank = dp_rank
         self._queue: asyncio.Queue = asyncio.Queue()
         self._task: Optional[asyncio.Task] = None
         self._loop = asyncio.get_event_loop()
@@ -51,6 +63,7 @@ class KvEventPublisher:
         payload = pack(
             {
                 "worker_id": self.worker_id,
+                "dp_rank": self.dp_rank,
                 "kind": ev.kind,
                 "block_hashes": ev.block_hashes,
                 "parent_hash": ev.parent_hash,
@@ -83,11 +96,13 @@ class WorkerMetricsPublisher:
 
     def __init__(self, runtime: DistributedRuntime, engine: Any,
                  namespace: str, component: str, worker_id: int,
-                 interval: float = 0.5):
+                 interval: float = 0.5, dp_rank: int = 0):
+        from .worker_key import pack_worker
+
         self.runtime = runtime
         self.engine = engine
         self.subject = metrics_subject(namespace, component)
-        self.worker_id = worker_id
+        self.worker_id = pack_worker(worker_id, dp_rank)
         self.interval = interval
         self._task: Optional[asyncio.Task] = None
 

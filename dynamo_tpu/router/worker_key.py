@@ -1,0 +1,27 @@
+"""Composite worker keys: (instance_id, dp_rank) packed into one int.
+
+The reference routes to `WorkerWithDpRank` when an engine exposes
+data-parallel ranks (/root/reference/lib/llm/src/kv_router/protocols.rs;
+vllm main.py:120-143 publishes per-dp-rank KV events).  Here a worker
+process can serve N independent engine replicas behind one endpoint
+(`worker.DpRankEngine`); the router's whole pipeline — radix index,
+ActiveSequences, selector, metrics — keys by packed int, and the routing
+edge unpacks to (instance for `client.direct`, dp_rank for the request).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+# ranks per instance bound; packed key = instance_id * DP_RANK_LIMIT + rank
+DP_RANK_LIMIT = 1024
+
+
+def pack_worker(instance_id: int, dp_rank: int = 0) -> int:
+    if not 0 <= dp_rank < DP_RANK_LIMIT:
+        raise ValueError(f"dp_rank must be in [0, {DP_RANK_LIMIT})")
+    return instance_id * DP_RANK_LIMIT + dp_rank
+
+
+def unpack_worker(key: int) -> Tuple[int, int]:
+    return key // DP_RANK_LIMIT, key % DP_RANK_LIMIT

@@ -156,7 +156,8 @@ class ServedEndpoint:
         # stop any attached publishers / data-plane servers first
         for attr in ("kv_publisher", "metrics_publisher", "transfer_source"):
             svc = getattr(self, attr, None)
-            if svc is not None:
-                await svc.stop()
+            for one in (svc if isinstance(svc, list) else [svc]):
+                if one is not None:
+                    await one.stop()  # dp-rank workers attach one per rank
         await self.endpoint.runtime.control.delete(self.instance.path)
         self.endpoint.runtime.service_server.unregister(self.endpoint.wire_name)

@@ -20,6 +20,77 @@ from ..runtime import Context, DistributedRuntime, ServedEndpoint
 logger = logging.getLogger(__name__)
 
 
+class DpRankEngine:
+    """N independent engine replicas behind one endpoint — the engine
+    data-parallel ranks of the reference (vLLM `data_parallel_size`
+    with per-dp-rank KV events and `WorkerWithDpRank` routing,
+    /root/reference/components/src/dynamo/vllm/main.py:120-143).
+
+    Each rank has its own KV pool and scheduler; the KV router addresses
+    (instance, dp_rank) via packed worker keys, and rank-less requests
+    round-robin locally."""
+
+    def __init__(self, engines):
+        if not engines:
+            raise ValueError("DpRankEngine needs at least one engine")
+        self.engines = list(engines)
+        self._rr = 0
+
+    @property
+    def dp_ranks(self) -> int:
+        return len(self.engines)
+
+    def _pick(self, request) -> Any:
+        rank = request.get("dp_rank") if isinstance(request, dict) else None
+        if rank is None:
+            rank = self._rr % len(self.engines)
+            self._rr += 1
+        if not isinstance(rank, int) or not 0 <= rank < len(self.engines):
+            raise ValueError(
+                f"dp_rank {rank!r} outside [0, {len(self.engines)})"
+            )
+        return self.engines[rank]
+
+    async def generate(self, request: Any, context: Optional[Context] = None
+                       ) -> AsyncIterator[Any]:
+        try:
+            engine = self._pick(request)
+        except ValueError as e:
+            yield {"token_ids": [], "finish_reason": "error", "error": str(e)}
+            return
+        async for out in engine.generate(request, context):
+            yield out
+
+    async def embed(self, request: Any, context: Optional[Context] = None):
+        try:
+            engine = self._pick(request)
+        except ValueError as e:  # structured error, like generate
+            return {"error": str(e)}
+        return await engine.embed(request, context)
+
+    def metrics(self) -> ForwardPassMetrics:
+        """Aggregate snapshot (per-rank states publish separately)."""
+        per = [e.metrics() for e in self.engines]
+        return ForwardPassMetrics(
+            active_seqs=sum(m.active_seqs for m in per),
+            waiting_seqs=sum(m.waiting_seqs for m in per),
+            kv_usage=sum(m.kv_usage for m in per) / len(per),
+            kv_total_pages=sum(m.kv_total_pages for m in per),
+            num_requests_total=sum(m.num_requests_total for m in per),
+        )
+
+    def clear_kv_blocks(self) -> int:
+        return sum(e.clear_kv_blocks() for e in self.engines)
+
+    def cached_prefix_len(self, prompt) -> int:
+        return max(e.cached_prefix_len(prompt) for e in self.engines)
+
+    async def shutdown(self) -> None:
+        import asyncio
+
+        await asyncio.gather(*(e.shutdown() for e in self.engines))
+
+
 class EngineWorker:
     """Wraps an engine with the endpoint handler protocol: request dicts in,
     token-delta dicts out; control requests served inline."""
@@ -76,10 +147,32 @@ async def serve_engine(
         worker.handle,
         health_check_payload={"control": "metrics"},
     )
-    if publish_kv_events and hasattr(engine, "add_event_sink"):
+    wid = served.instance.instance_id
+    if publish_kv_events and isinstance(engine, DpRankEngine):
+        # one event stream + one metrics publisher PER RANK, keyed by the
+        # packed (instance, dp_rank) worker id (reference: per-dp-rank
+        # ZMQ event ports, vllm/main.py:120-143)
         from ..router import KvEventPublisher, WorkerMetricsPublisher
 
-        wid = served.instance.instance_id
+        served.kv_publisher = []
+        served.metrics_publisher = []
+        for rank, eng in enumerate(engine.engines):
+            # metrics publish for EVERY rank — the router discovers an
+            # instance's dp ranks from published metrics, so a silent
+            # rank would never take KV-routed traffic
+            served.metrics_publisher.append(WorkerMetricsPublisher(
+                runtime, eng, namespace, component, wid, dp_rank=rank
+            ).start())
+            if not hasattr(eng, "add_event_sink"):
+                continue
+            kv_pub = KvEventPublisher(
+                runtime, namespace, component, wid, dp_rank=rank
+            ).start()
+            eng.add_event_sink(kv_pub.sink)
+            served.kv_publisher.append(kv_pub)
+    elif publish_kv_events and hasattr(engine, "add_event_sink"):
+        from ..router import KvEventPublisher, WorkerMetricsPublisher
+
         kv_pub = KvEventPublisher(runtime, namespace, component, wid).start()
         engine.add_event_sink(kv_pub.sink)
         metrics_pub = WorkerMetricsPublisher(
@@ -87,15 +180,17 @@ async def serve_engine(
         ).start()
         served.kv_publisher = kv_pub
         served.metrics_publisher = metrics_pub
-    if isinstance(engine, JaxEngine):
+    ranks = engine.dp_ranks if isinstance(engine, DpRankEngine) else 1
+    inner = engine.engines[0] if isinstance(engine, DpRankEngine) else engine
+    if isinstance(inner, JaxEngine):
         if "embedding" not in mdc.types:
             mdc.model_type = mdc.model_type + ",embedding"
-        mdc.kv_cache_block_size = engine.cfg.page_size
-        mdc.context_length = engine.cfg.max_model_len
+        mdc.kv_cache_block_size = inner.cfg.page_size
+        mdc.context_length = inner.cfg.max_model_len
         mdc.runtime_config = RuntimeConfig(
-            total_kv_blocks=engine.cfg.usable_pages,
-            max_num_seqs=engine.cfg.max_num_seqs,
-            max_num_batched_tokens=engine.cfg.max_prefill_tokens,
+            total_kv_blocks=inner.cfg.usable_pages * ranks,
+            max_num_seqs=inner.cfg.max_num_seqs * ranks,
+            max_num_batched_tokens=inner.cfg.max_prefill_tokens,
         )
     await register_llm(runtime, served, mdc)
     return served

@@ -58,6 +58,10 @@ def main() -> None:
     ap.add_argument("--tp", type=int, default=1, help="tensor-parallel degree")
     ap.add_argument("--sp", type=int, default=1,
                     help="sequence-parallel degree (ring-attention prefill)")
+    ap.add_argument("--dp-ranks", type=int, default=1,
+                    help="independent engine replicas behind this endpoint "
+                         "(per-rank KV pools + events; the router targets "
+                         "(instance, dp_rank))")
     # multihost (jax.distributed): every host in the group runs this CLI
     # with the same flags and a unique --host-id; see parallel/multihost.py.
     # Rank 0 serves the endpoint; other ranks replay its dispatches in
@@ -88,6 +92,18 @@ def main() -> None:
         ap.error(str(e))
     if args.kvbm and getattr(args, "mock", False):
         ap.error("--kvbm requires a real JAX engine (incompatible with --mock)")
+    if args.dp_ranks > 1:
+        # DpRankEngine serves the plain generate/embed surface only; the
+        # disagg handlers, KVBM worker, mock branch, and multihost
+        # follower all require the single-JaxEngine API
+        for bad, flag in [
+            (args.disagg_role != "both", "--disagg-role"),
+            (args.kvbm, "--kvbm"),
+            (args.mock, "--mock"),
+            (bool(args.coordinator), "--coordinator (multihost)"),
+        ]:
+            if bad:
+                ap.error(f"--dp-ranks > 1 is incompatible with {flag}")
     from ..runtime.tracing import setup_logging
 
     setup_logging(args.log_level, args.log_jsonl)
@@ -337,8 +353,17 @@ def _build_engine(args):
             image_patches=vcfg.num_patches,
             image_size=vcfg.image_size,
         )
-    engine = JaxEngine(cfg, params, ecfg, eos_token_ids=eos, kv_dtype=dtype,
-                       parallel=parallel, vision=vision)
+    def make_engine():
+        return JaxEngine(cfg, params, ecfg, eos_token_ids=eos,
+                         kv_dtype=dtype, parallel=parallel, vision=vision)
+
+    if args.dp_ranks > 1:
+        from . import DpRankEngine
+
+        # replicas share the param buffers; each gets its own KV pool
+        engine = DpRankEngine([make_engine() for _ in range(args.dp_ranks)])
+    else:
+        engine = make_engine()
     mdc = ModelDeploymentCard(
         name=name,
         tokenizer_json=tokenizer_json,

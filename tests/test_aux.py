@@ -164,8 +164,10 @@ async def test_kv_event_recorder_and_replay():
 
         buf.seek(0)
         index = replay_into_index(buf)
+        from dynamo_tpu.router.worker_key import pack_worker
+
         matches = index.find_matches([11, 22, 33, 44])
-        assert matches == {7: 3}  # 44 was removed
+        assert matches == {pack_worker(7): 3}  # 44 was removed
 
 
 async def test_compute_pool_runs_work(monkeypatch):

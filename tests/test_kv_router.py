@@ -20,6 +20,7 @@ from dynamo_tpu.router import (
     WorkerState,
 )
 from dynamo_tpu.runtime import ControlPlaneServer, DistributedRuntime
+from dynamo_tpu.router.worker_key import unpack_worker
 from dynamo_tpu.tokens import compute_block_hash_for_seq
 from dynamo_tpu.worker import serve_engine
 
@@ -180,7 +181,7 @@ async def test_kv_routing_prefers_cached_worker():
         # first request lands somewhere; stream it fully
         r1 = req(prompt, rid="r1")
         w1 = await router.choose(r1)
-        async for _ in client.direct(r1, w1):
+        async for _ in client.direct(r1, unpack_worker(w1)[0]):
             pass
         router.mark_finished("r1")
         # wait for KV events to arrive at the router
@@ -198,7 +199,7 @@ async def test_kv_routing_prefers_cached_worker():
         # (no overlap anywhere → pure load balance; all idle → any is fine)
         r3 = req(list(range(500, 564)), rid="r3")
         w3 = await router.choose(r3)
-        assert w3 in [s.instance_id for s in client.instances()]
+        assert unpack_worker(w3)[0] in [s.instance_id for s in client.instances()]
     finally:
         await stop_fleet(*stack)
 
@@ -211,7 +212,7 @@ async def test_kv_router_replica_sync():
         prompt = list(range(0, 64))
         r1 = req(prompt, rid="a")
         w1 = await router.choose(r1)
-        async for _ in client.direct(r1, w1):
+        async for _ in client.direct(r1, unpack_worker(w1)[0]):
             pass
         hashes = compute_block_hash_for_seq(prompt, 16)
         deadline = asyncio.get_running_loop().time() + 5

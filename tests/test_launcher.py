@@ -143,7 +143,9 @@ async def test_standalone_router_service():
                 {"token_ids": list(range(16 * (i + 1))),
                  "request_id": f"r{i}"}
             )
-            assert wid in wids
+            from dynamo_tpu.router.worker_key import unpack_worker
+
+            assert unpack_worker(wid)[0] in wids
             picks.add(wid)
             rrc.mark_finished(f"r{i}")
         assert picks  # routed to real instances
