@@ -156,6 +156,7 @@ class DisaggDecodeHandler:
         namespace: str = "dynamo",
         router: Optional[DisaggRouter] = None,
         prefill_router=None,  # optional KvRouter over prefill workers
+        device_lane: bool = True,  # colocated device-path transfers
     ):
         self.engine = engine
         self.runtime = runtime
@@ -167,13 +168,16 @@ class DisaggDecodeHandler:
             .endpoint("generate")
         )
         self.prefill_client: Client = ep.client()
-        self.transfer_client = KvTransferClient(engine)
+        self.transfer_client = KvTransferClient(
+            engine, allow_device_lane=device_lane
+        )
         self._started = False
         # data-plane observability (the reference's NIXL transfer metrics)
         self._inflight_prefills = 0
         self.kv_transfer_count = 0
         self.kv_transfer_ms_total = 0.0
         self.kv_transfer_bytes_total = 0
+        self.kv_transfer_device_count = 0  # colocated device-lane fetches
 
     async def _prefill_available(self) -> bool:
         if not self._started:
@@ -254,6 +258,8 @@ class DisaggDecodeHandler:
             self.kv_transfer_count += 1
             self.kv_transfer_ms_total += stats.ms
             self.kv_transfer_bytes_total += stats.bytes
+            if stats.lane in ("device", "dma"):
+                self.kv_transfer_device_count += 1
             logger.debug(
                 "kv transfer %d pages -> %d pages, %.1fKB in %.1fms",
                 stats.src_pages, stats.dest_pages, stats.bytes / 1024, stats.ms,
@@ -292,6 +298,7 @@ class DisaggDecodeHandler:
         m.kv_transfer_count = self.kv_transfer_count
         m.kv_transfer_ms_total = round(self.kv_transfer_ms_total, 3)
         m.kv_transfer_bytes_total = self.kv_transfer_bytes_total
+        m.kv_transfer_device_count = self.kv_transfer_device_count
         return m
 
     def clear_kv_blocks(self):

@@ -109,6 +109,7 @@ class _Held:
     pages: List[int]
     prompt_len: int
     deadline: float
+    dma_addr: Optional[str] = None  # transfer-server address when armed
 
 
 class KvTransferSource:
@@ -154,30 +155,81 @@ class KvTransferSource:
 
     # -- handle lifecycle --------------------------------------------------- #
 
-    def register(self, pages: List[int], prompt_len: int) -> str:
+    async def register(self, pages: List[int], prompt_len: int) -> str:
+        """Hold the pages under a fresh transfer handle.  When the PJRT
+        transfer API is available (device DMA — ICI/DCN on pods), the
+        page blocks are gathered device-side and armed for remote pull;
+        the gather is a device op, hence async."""
+        from .device_transfer import arm_dma, register_local
+
         tid = uuid.uuid4().hex
-        self._held[tid] = _Held(
+        held = _Held(
             pages=list(pages), prompt_len=prompt_len,
             deadline=time.monotonic() + self.ttl,
         )
+        self._held[tid] = held
+        register_local(tid, self)
+        if getattr(self.engine, "mesh", None) is None:
+            from .device_transfer import _pow2, dma_server
+
+            if dma_server(self.host) is not None:
+                import jax.numpy as jnp
+
+                engine = self.engine
+                n = len(pages)
+                padded = np.zeros((_pow2(n),), np.int32)
+                padded[:n] = pages
+
+                def gather():
+                    k, v = engine._export_fn(  # noqa: SLF001
+                        engine.kv, jnp.asarray(padded)
+                    )
+                    return k[:, :n], v[:, :n]
+
+                try:
+                    k_blocks, v_blocks = await engine._device_op(gather)  # noqa: SLF001
+                    held.dma_addr = arm_dma(tid, [k_blocks, v_blocks])
+                except Exception:  # noqa: BLE001 — host lane still works
+                    logger.exception("dma arming failed; host lane only")
         return tid
 
     def descriptor(self, tid: str) -> Dict[str, Any]:
         """What rides the request path: a handle, page count, and where the
         data plane lives — never the data."""
+        from .device_transfer import process_token
+
         held = self._held[tid]
         return {
             "transfer_id": tid,
             "addr": self.address,
+            # colocated clients (same process) skip the socket and move
+            # the pages device-to-device (device_transfer.py)
+            "proc": process_token(),
+            # armed PJRT transfer-server address (cross-process device
+            # pull) — None when the platform lacks the API
+            "dma_addr": held.dma_addr,
             "num_pages": len(held.pages),
             "prompt_len": held.prompt_len,
             "layout": self.layout.to_dict(),  # also in the registry; carried
             # inline so a fetch can proceed before the watcher catches up
         }
 
-    async def _release(self, tid: str) -> None:
+    async def _release(self, tid: str, dma_claimed: bool = False) -> None:
+        from .device_transfer import drain_dma_arm, unregister_local
+
+        unregister_local(tid)
         held = self._held.pop(tid, None)
-        if held is None or not held.pages:
+        if held is None:
+            return
+        if held.dma_addr and not dma_claimed:
+            # nothing can cancel an armed await_pull: self-pull the arrays
+            # so the transfer server drops its references (otherwise every
+            # unclaimed arm — TTL expiry, colocated/host-lane consumption —
+            # leaks a full prompt-KV device copy)
+            await asyncio.get_running_loop().run_in_executor(
+                None, drain_dma_arm, tid, self.layout, len(held.pages),
+            )
+        if not held.pages:
             return
         pages = held.pages
 
@@ -208,7 +260,10 @@ class KvTransferSource:
                 if frame.kind == K_REQ and frame.header.get("op") == "fetch":
                     await self._serve_fetch(frame, writer)
                 elif frame.kind == K_CTRL and frame.header.get("op") == "release":
-                    await self._release(frame.header.get("transfer_id", ""))
+                    await self._release(
+                        frame.header.get("transfer_id", ""),
+                        dma_claimed=bool(frame.header.get("dma_claimed")),
+                    )
                     write_frame(writer, Frame(K_END, frame.stream_id, {}, b""))
                     await writer.drain()
                 elif frame.kind == K_CTRL and frame.header.get("op") == "layout":
@@ -262,15 +317,31 @@ class TransferStats:
     ms: float = 0.0
     src_pages: int = 0
     dest_pages: int = 0
+    lane: str = "host"  # "host" (TCP staging) | "device" (colocated DMA)
 
 
 class KvTransferClient:
     """Decode-side: fetch a registered transfer into the local engine's
-    pool, re-paging between source and destination layouts on the fly."""
+    pool, re-paging between source and destination layouts on the fly.
 
-    def __init__(self, engine):
+    Three lanes, tried in order:
+    - "colocated": source in the same process (single-process disagg
+      graphs) — jitted device re-page, no host staging, no sockets;
+    - "dma": the source armed a PJRT transfer-server pull (the NIXL
+      analog; ICI/DCN on pods) — pages land in local device buffers;
+    - "host": TCP page-chunk streaming with host staging (always works,
+      any layout, any platform).
+    `lanes` restricts the order (tests pin single lanes);
+    `allow_device_lane=False` is shorthand for host-only."""
+
+    def __init__(self, engine, allow_device_lane: bool = True,
+                 lanes: Optional[Tuple[str, ...]] = None):
         self.engine = engine
         self.dest_layout = KvLayout.of_engine(engine)
+        if lanes is None:
+            lanes = (("colocated", "dma", "host") if allow_device_lane
+                     else ("host",))
+        self.lanes = lanes
 
     async def fetch(self, descriptor: Dict[str, Any]) -> Tuple[List[int], TransferStats]:
         """Returns (dest page ids holding the prompt KV, stats).  Raises on
@@ -283,6 +354,26 @@ class KvTransferClient:
             raise ValueError(
                 f"incompatible KV layouts: src {src} vs dst {dst}"
             )
+        if "colocated" in self.lanes:
+            from .device_transfer import fetch_colocated, local_source
+
+            source = local_source(descriptor)
+            if source is not None:
+                dest_pages, n_dst = await fetch_colocated(
+                    self, source, descriptor
+                )
+                return dest_pages, TransferStats(
+                    # logical bytes moved (in HBM; nothing crossed the host)
+                    bytes=n_dst * dst.bytes_per_page,
+                    ms=(time.perf_counter() - t0) * 1000.0,
+                    src_pages=int(descriptor["num_pages"]),
+                    dest_pages=n_dst,
+                    lane="device",
+                )
+        if "dma" in self.lanes and descriptor.get("dma_addr"):
+            pages_stats = await self._fetch_dma(descriptor, src, dst, t0)
+            if pages_stats is not None:
+                return pages_stats
         prompt_len = int(descriptor["prompt_len"])
         n_dest = -(-prompt_len // dst.page_size)
         dest_pages = await self.engine.alloc_pages(n_dest)
@@ -306,10 +397,73 @@ class KvTransferClient:
         stats.ms = (time.perf_counter() - t0) * 1000.0
         return dest_pages, stats
 
-    async def _release_remote(self, descriptor: Dict[str, Any]) -> None:
+    async def _fetch_dma(self, descriptor, src: KvLayout, dst: KvLayout,
+                         t0: float):
+        """Cross-process device pull (PJRT transfer server): pull the
+        armed page blocks into local device buffers, re-page on device,
+        import.  Returns None to fall through to the host lane."""
+        import asyncio as _asyncio
+
+        from .device_transfer import (
+            device_repage_blocks,
+            dma_pull,
+            probe_jax_transfer,
+        )
+
+        if not probe_jax_transfer() or getattr(self.engine, "mesh", None) is not None:
+            return None
+        import jax
+        import jax.numpy as jnp
+
+        prompt_len = int(descriptor["prompt_len"])
+        n = int(descriptor["num_pages"])
+        shape = (src.layers, n, src.page_size, src.n_kv_heads, src.head_dim)
+        sharding = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+        structs = [
+            jax.ShapeDtypeStruct(shape, jnp.dtype(src.dtype),
+                                 sharding=sharding)
+        ] * 2
+        try:
+            k_blocks, v_blocks = await _asyncio.get_running_loop().run_in_executor(
+                None, dma_pull, descriptor["dma_addr"],
+                descriptor["transfer_id"], structs,
+            )
+        except Exception as e:  # noqa: BLE001 — host lane still works
+            logger.warning("dma pull failed (%s); host lane", e)
+            return None
+        n_dst = -(-prompt_len // dst.page_size)
+        dest_pages = await self.engine.alloc_pages(n_dst)
+        try:
+            engine = self.engine
+
+            def op():
+                return device_repage_blocks(
+                    k_blocks, v_blocks, dst.page_size, prompt_len,
+                    engine._kv_dtype,  # noqa: SLF001
+                )
+
+            kc, vc = await engine._device_op(op)  # noqa: SLF001
+            await engine.import_page_chunk(
+                dest_pages, kc[:, :n_dst], vc[:, :n_dst]
+            )
+        except BaseException:
+            await self.engine.free_pages(dest_pages)
+            raise
+        await self._release_remote(descriptor, dma_claimed=True)
+        return dest_pages, TransferStats(
+            bytes=2 * int(np.prod(shape)) * np.dtype(src.dtype).itemsize,
+            ms=(time.perf_counter() - t0) * 1000.0,
+            src_pages=n,
+            dest_pages=n_dst,
+            lane="dma",
+        )
+
+    async def _release_remote(self, descriptor: Dict[str, Any],
+                              dma_claimed: bool = False) -> None:
         """Best-effort: tell the source to drop its hold now rather than
         waiting out the TTL (failed fetches would otherwise park pages on
-        the prefill worker for minutes)."""
+        the prefill worker for minutes).  `dma_claimed` tells the source
+        its armed DMA pull was consumed (no self-drain needed)."""
         try:
             host, port = descriptor["addr"]
             reader, writer = await asyncio.wait_for(
@@ -317,7 +471,8 @@ class KvTransferClient:
             )
             write_frame(writer, Frame(
                 K_CTRL, 1,
-                {"op": "release", "transfer_id": descriptor["transfer_id"]},
+                {"op": "release", "transfer_id": descriptor["transfer_id"],
+                 "dma_claimed": dma_claimed},
                 b"",
             ))
             await asyncio.wait_for(writer.drain(), timeout=2.0)

@@ -35,6 +35,13 @@ class EngineConfig:
     # (host RTT) overlaps the next block's compute.  1 = no chaining.
     decode_chain: int = 1
 
+    # chain the first decode block straight off a prompt-completing
+    # prefill's device-side sampled tokens (skips the prefill fetch
+    # barrier — one host round-trip saved per request); falls back to
+    # the separate prefill/decode steps whenever the batch is not
+    # eligible (chunking mid-prompt, penalties, multihost, pool pressure)
+    fuse_prefill_decode: bool = True
+
     enable_prefix_caching: bool = True
     block_hash_salt: str = ""
 

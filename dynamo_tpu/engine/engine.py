@@ -118,7 +118,7 @@ def _lockstep_out_shardings(mesh, *extra):
 def _build_prefill_step(cfg: ModelConfig, with_top: bool = False,
                         attn_impl: str = "xla", lockstep_mesh=None,
                         with_embeds: bool = False):
-    kw = ({"out_shardings": _lockstep_out_shardings(lockstep_mesh)}
+    kw = ({"out_shardings": _lockstep_out_shardings(lockstep_mesh, P())}
           if lockstep_mesh is not None else {})
 
     @partial(jax.jit, donate_argnums=(1,), **kw)
@@ -132,7 +132,9 @@ def _build_prefill_step(cfg: ModelConfig, with_top: bool = False,
         )
         out = sample_tokens(logits, samp, seeds, counters)
         logp = compute_logprobs(logits, out)
-        return _pack_out(out, logp, logits if with_top else None), kv
+        # `out` rides back as a separate device int32 so a fused decode
+        # chain can consume it without waiting for the packed host fetch
+        return _pack_out(out, logp, logits if with_top else None), out, kv
 
     return step
 
@@ -144,7 +146,7 @@ def _build_prefill_step_sp(cfg: ModelConfig, mesh, with_top: bool = False,
     attention; sampling happens on the gathered last-position logits."""
     from ..parallel.sp_prefill import forward_prefill_sp
 
-    kw = ({"out_shardings": _lockstep_out_shardings(mesh)}
+    kw = ({"out_shardings": _lockstep_out_shardings(mesh, P())}
           if lockstep else {})
 
     @partial(jax.jit, donate_argnums=(1,), **kw)
@@ -155,7 +157,7 @@ def _build_prefill_step_sp(cfg: ModelConfig, mesh, with_top: bool = False,
         )
         out = sample_tokens(logits, samp, seeds, counters)
         logp = compute_logprobs(logits, out)
-        return _pack_out(out, logp, logits if with_top else None), kv
+        return _pack_out(out, logp, logits if with_top else None), out, kv
 
     return step
 
@@ -866,24 +868,128 @@ class JaxEngine:
                 "arrays": [tokens, table, prefix, chunk,
                            *[np.asarray(a) for a in samp], seeds, counters],
             })
-        packed_d = self._dispatch_prefill(
+        packed_d, tok_d = self._dispatch_prefill(
             tokens, table, prefix, chunk, samp, seeds, counters, with_top,
             mm=mm,
         )
-        out, logp, tids, tlps = _unpack_out(
-            np.asarray(jax.device_get(packed_d)), B, with_top
+        # start the host copy of the prefill result BEFORE the fused
+        # decode dispatches enqueue: on a FIFO-ish transfer path the copy
+        # then rides right behind the prefill, keeping TTFT at prefill
+        # latency instead of the whole fused chain's
+        try:
+            packed_d.copy_to_host_async()
+        except Exception:  # noqa: BLE001 — sharded arrays may not support it
+            pass
+        # the dispatch is committed: account the computed tokens NOW so a
+        # fused decode chain plans from current positions (errors reset
+        # all state via _recover_after_error anyway).  Planned items may
+        # have been PREEMPTED by a later item's page reservation in the
+        # same schedule() pass — those rows compute into the trash page
+        # and must not be accounted (their num_computed was reset)
+        for it in items:
+            if it.seq.status == "running":
+                it.seq.num_computed += it.chunk_len
+        fused = self._maybe_fuse_decode(items, B, tok_d, samp, seeds,
+                                        counters, with_top)
+        # frees must be deferred while the fused chain's dispatches are in
+        # flight: a prefill-token EOS finishing a sequence must not hand
+        # its pages back under an in-flight decode table
+        deferred = [] if fused else None
+        self.scheduler.deferred_free = deferred
+        try:
+            out, logp, tids, tlps = _unpack_out(
+                np.asarray(jax.device_get(packed_d)), B, with_top
+            )
+            for i, it in enumerate(items):
+                s = it.seq
+                if s.status != "running":  # preempted after planning
+                    continue
+                self.scheduler.commit_full_pages(s)
+                if it.samples:
+                    self._append_token(
+                        s, int(out[i]), float(logp[i]),
+                        _tops_for(s, tids, tlps, i),
+                    )
+            if fused:
+                self._consume_decode(fused, [it.seq for it in items], B,
+                                     with_top)
+        finally:
+            self.scheduler.deferred_free = None
+            if deferred:
+                self.pool.free(deferred)
+
+    def _maybe_fuse_decode(self, items, B, tok_d, samp, seeds, counters,
+                           with_top):
+        """Dispatch the first decode chain straight off the prefill's
+        device-side sampled tokens, skipping the prefill fetch barrier
+        (one round-trip saved per request on remote-attached TPUs — the
+        prefill result and the first decode block come back together).
+        Returns the decode dispatches, or [] when the batch is not
+        eligible."""
+        seqs = [it.seq for it in items]
+        T = self.cfg.decode_steps
+        hard_cap = self.cfg.hard_cap
+        if (
+            not self.cfg.fuse_prefill_decode
+            or self._multihost  # followers replay from host arrays only
+            or not items
+            or not all(it.samples for it in items)
+            or any(s.status != "running" for s in seqs)  # preempted rows
+            or B not in self.cfg.decode_batch_buckets  # tok_d has B rows
+            or any(s.opts.penalized for s in seqs)  # counts need the
+            # prefill token; take the plain path
+            or any(s.opts.max_tokens <= 1 for s in seqs)
+            or any(s.num_computed >= hard_cap for s in seqs)
+        ):
+            return []
+        # same gating as _chain_ok block 0: nothing else needs the pump,
+        # and every sequence's pages extend without preemption
+        if (self._pending_aborts or self._pending_ops
+                or self.scheduler.waiting):
+            return []
+        if self.tiered is not None and self.tiered.pending_offloads:
+            return []
+        if not all(
+            self.scheduler.try_extend_pages(
+                s, min(s.num_computed + T, hard_cap)
+            )
+            for s in seqs
+        ):
+            return []
+        chain_len = 1
+        while (chain_len < max(1, self.cfg.decode_chain)
+               and self._chain_ok(seqs, chain_len, T, hard_cap)):
+            chain_len += 1
+        positions = np.zeros((B,), np.int32)
+        decode_ctr = np.zeros((B,), np.int32)
+        for i, s in enumerate(seqs):
+            positions[i] = s.num_computed
+            decode_ctr[i] = counters[i] + 1  # past the prefill sample
+        table = self._table_array(seqs, rows=B)  # includes extended pages
+        return self._dispatch_decode(
+            tok_d, positions, decode_ctr, None, table, samp, seeds,
+            False, with_top, chain_len,
         )
-        for i, it in enumerate(items):
-            s = it.seq
-            if s.status != "running":  # preempted after planning
-                continue
-            s.num_computed += it.chunk_len
-            self.scheduler.commit_full_pages(s)
-            if it.samples:
-                self._append_token(
-                    s, int(out[i]), float(logp[i]),
-                    _tops_for(s, tids, tlps, i),
-                )
+
+    def _consume_decode(self, dispatches, seqs, Bb, with_top) -> None:
+        """Fetch + account a decode chain's outputs (callers manage
+        deferred frees around in-flight dispatches)."""
+        for packed_d in dispatches:
+            out, logp, tids, tlps = _unpack_out(
+                np.asarray(jax.device_get(packed_d)), Bb, with_top
+            )  # [T, B] each
+            for i, s in enumerate(seqs):
+                if s.status != "running":
+                    continue
+                for t in range(out.shape[0]):
+                    s.num_computed += 1
+                    self.scheduler.commit_full_pages(s)
+                    self._append_token(
+                        s, int(out[t, i]), float(logp[t, i]),
+                        _tops_for(s, tids, tlps, (t, i)),
+                    )
+                    if s.status != "running":
+                        break  # stop hit mid-block; rest discarded
 
     def _attach_mm(self, seq, request) -> Optional[str]:
         """Validate + attach multimodal pixels to a sequence; returns an
@@ -963,8 +1069,10 @@ class JaxEngine:
 
     def _dispatch_prefill(self, tokens, table, prefix, chunk, samp, seeds,
                           counters, with_top, mm=()):
-        """Issue the jitted prefill (identical on leader and followers)."""
-        packed_d, kv = self._get_prefill_step(with_top, bool(mm))(
+        """Issue the jitted prefill (identical on leader and followers).
+        Returns (packed_d, tok_d): the packed host-fetchable result and
+        the sampled tokens as a device int32 carry."""
+        packed_d, tok_d, kv = self._get_prefill_step(with_top, bool(mm))(
             self.params,
             self.kv,
             self._put(tokens, "dp", None),
@@ -978,7 +1086,7 @@ class JaxEngine:
               else self._put(m, "dp", None, None) for m in mm),
         )
         self.kv = kv
-        return packed_d
+        return packed_d, tok_d
 
     def _chain_ok(self, seqs: List[Sequence], k: int, T: int, hard_cap: int) -> bool:
         """May decode block k be dispatched before block k-1's results are
@@ -1055,22 +1163,7 @@ class JaxEngine:
         deferred = [] if len(dispatches) > 1 else None
         self.scheduler.deferred_free = deferred
         try:
-            for packed_d in dispatches:
-                out, logp, tids, tlps = _unpack_out(
-                    np.asarray(jax.device_get(packed_d)), Bb, with_top
-                )  # [T, B] each
-                for i, s in enumerate(seqs):
-                    if s.status != "running":
-                        continue
-                    for t in range(out.shape[0]):
-                        s.num_computed += 1
-                        self.scheduler.commit_full_pages(s)
-                        self._append_token(
-                            s, int(out[t, i]), float(logp[t, i]),
-                            _tops_for(s, tids, tlps, (t, i)),
-                        )
-                        if s.status != "running":
-                            break  # stop hit mid-block; rest discarded
+            self._consume_decode(dispatches, seqs, Bb, with_top)
         finally:
             self.scheduler.deferred_free = None
             if deferred:
@@ -1267,13 +1360,23 @@ class JaxEngine:
         await self._device_op(op)
 
     async def import_page_chunk(self, pages: List[int], k_chunk, v_chunk) -> None:
-        """Write host KV pages into the pool at the given page ids (padding
-        rows go to trash page 0)."""
+        """Write KV pages into the pool at the given page ids (padding
+        rows go to trash page 0).  Chunks may be host numpy (the TCP data
+        plane) or device arrays (the colocated device lane — padding then
+        happens on device and the data never visits the host)."""
         def op():
             n = len(pages)
             width = self._pow2_width(n)
             padded = np.zeros((width,), np.int32)
             padded[:n] = pages
+            if isinstance(k_chunk, jax.Array):
+                pad = ((0, 0), (0, width - n), (0, 0), (0, 0), (0, 0))
+                kpad = jnp.pad(k_chunk, pad)
+                vpad = jnp.pad(v_chunk, pad)
+                self.kv = self._import_fn(
+                    self.kv, kpad, vpad, jnp.asarray(padded)
+                )
+                return
             kpad = np.zeros((k_chunk.shape[0], width, *k_chunk.shape[2:]),
                             k_chunk.dtype)
             vpad = np.zeros_like(kpad)
@@ -1326,7 +1429,7 @@ class JaxEngine:
             return {"error": "prefill produced no token"}
         if transfer_source is not None:
             pages, seq.pages = list(seq.pages), []
-            tid = transfer_source.register(pages, seq.prompt_len)
+            tid = await transfer_source.register(pages, seq.prompt_len)
             return {
                 "token_ids": [first_token],
                 "kv_descriptor": transfer_source.descriptor(tid),

@@ -209,11 +209,13 @@ async def _run(args) -> None:
             namespace=args.namespace, component=args.component,
         )
         _COUNTERS = ("num_requests_total", "kv_transfer_count",
+                     "kv_transfer_device_count",
                      "kv_transfer_ms_total", "kv_transfer_bytes_total",
                      "kvbm_onboarded_blocks_total")
         # prometheus appends _total to counter families: name them so the
         # exposed series match the dashboard queries exactly
-        _RENAME = {"kv_transfer_count": "kv_transfers_total"}
+        _RENAME = {"kv_transfer_count": "kv_transfers_total",
+                   "kv_transfer_device_count": "kv_transfers_device_total"}
 
         class _EngineCollector:
             def collect(self):

@@ -290,3 +290,52 @@ async def test_top_logprobs_delivered(engine_setup):
             seen.append(tops)
     assert len(seen) == 4
     await engine.shutdown()
+
+
+async def test_fused_prefill_decode_matches_unfused():
+    """The fused prefill→decode dispatch (first decode chain fed by the
+    prefill's device-side sampled token) must be output-invisible:
+    identical streams with the fusion on and off, including EOS stops
+    landing on the prefill-sampled token and max_tokens cutoffs."""
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.models import init_params, tiny_config
+
+    cfg = tiny_config()
+    params = init_params(cfg, jax.random.PRNGKey(4), dtype=jnp.float32)
+
+    def ecfg(fuse):
+        return EngineConfig(
+            page_size=8, num_pages=128, max_num_seqs=4,
+            max_prefill_tokens=64, max_model_len=128,
+            decode_steps=4, decode_chain=2,
+            decode_batch_buckets=[1, 2, 4],
+            fuse_prefill_decode=fuse,
+        )
+
+    async def collect(engine):
+        outs = []
+        for i in range(4):
+            prompt = [(i * 17 + j) % cfg.vocab_size for j in range(5 + 6 * i)]
+            req = {
+                "token_ids": prompt,
+                "sampling_options": {"temperature": 0.0},
+                # one request stops on an early max_tokens, others run long
+                "stop_conditions": {"max_tokens": 2 if i == 1 else 11,
+                                    "ignore_eos": True},
+            }
+            toks = []
+            async for out in engine.generate(req):
+                assert out.get("finish_reason") != "error", out
+                toks += out["token_ids"]
+            outs.append(toks)
+        await engine.shutdown()
+        return outs
+
+    fused = await collect(JaxEngine(cfg, params, ecfg(True),
+                                    kv_dtype=jnp.float32))
+    plain = await collect(JaxEngine(cfg, params, ecfg(False),
+                                    kv_dtype=jnp.float32))
+    assert fused == plain
+    assert len(fused[1]) == 2 and len(fused[0]) == 11

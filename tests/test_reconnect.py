@@ -108,8 +108,10 @@ async def test_kv_router_resyncs_after_stream_gap():
         # case 2: snapshot at offset 25 → resume from it, then catch up
         snap_index = RadixIndex()
         snap_index.apply_stored(1, list(range(1, 26)))
+        from dynamo_tpu.router.publisher import KV_WIRE_VERSION
+
         await runtime.control.obj_put(
-            SNAPSHOT_BUCKET, "ns.comp",
+            SNAPSHOT_BUCKET, f"ns.comp@{KV_WIRE_VERSION}",
             pack({
                 "workers": {str(w): hs
                             for w, hs in snap_index.snapshot().items()},
