@@ -379,6 +379,11 @@ class JaxEngine:
                         "expert dispatch inside the sp shard_map is not "
                         "implemented; use tp-only for MoE)"
                     )
+                if model_cfg.sliding_window or model_cfg.attention_sinks:
+                    raise ValueError(
+                        "sp > 1 does not support sliding-window/sink "
+                        "attention models yet"
+                    )
                 # the sp shard_map's param specs shard heads, the ffn dim
                 # AND the vocab over tp — catch uneven splits here with a
                 # clear message instead of an opaque shard_map shape error

@@ -36,6 +36,15 @@ class ModelConfig:
     rope_scaling: Optional[dict] = None
     tie_word_embeddings: bool = False
     attention_bias: bool = False
+    # sliding-window attention (Mistral/GPT-OSS family): tokens attend to
+    # at most the last `sliding_window` positions.  `layer_types` (HF
+    # convention: "sliding_attention" / "full_attention" per layer) mixes
+    # windowed and full layers; None = every layer windowed.
+    sliding_window: Optional[int] = None
+    layer_types: Optional[tuple] = None
+    # learnable per-head attention-sink logits (GPT-OSS): an extra column
+    # in the softmax denominator that soaks up attention mass
+    attention_sinks: bool = False
     # MoE (0 = dense)
     num_experts: int = 0
     num_experts_per_tok: int = 2
@@ -68,6 +77,23 @@ class ModelConfig:
     @property
     def is_moe(self) -> bool:
         return self.num_experts > 0
+
+    def layer_windows(self) -> list:
+        """Per-layer attention window (0 = full attention)."""
+        L = self.num_hidden_layers
+        if not self.sliding_window:
+            return [0] * L
+        if self.layer_types is None:
+            return [self.sliding_window] * L
+        if len(self.layer_types) != L:
+            raise ValueError(
+                f"layer_types has {len(self.layer_types)} entries for "
+                f"{L} layers"
+            )
+        return [
+            self.sliding_window if "sliding" in t else 0
+            for t in self.layer_types
+        ]
 
     def num_params(self) -> int:
         """Approximate parameter count (for memory planning)."""
@@ -111,6 +137,18 @@ class ModelConfig:
             num_experts=num_experts,
             num_experts_per_tok=d.get("num_experts_per_tok", 2),
             moe_intermediate_size=d.get("moe_intermediate_size"),
+            # Qwen2.5 ships sliding_window=131072 with
+            # use_sliding_window=false — HF only engages the window when
+            # the flag is on (absent = on, the Mistral convention)
+            sliding_window=(d.get("sliding_window")
+                            if d.get("use_sliding_window", True) else None),
+            layer_types=(tuple(d["layer_types"])
+                         if d.get("layer_types") else None),
+            # GPT-OSS attention always carries learnable sinks (HF
+            # GptOssAttention `sinks` parameter)
+            attention_sinks=d.get(
+                "attention_sinks", d.get("model_type") == "gpt_oss"
+            ),
             model_type=d.get("model_type", "llama"),
             name=name or d.get("_name_or_path", "llama"),
         )
@@ -227,6 +265,21 @@ MIXTRAL_8X7B = ModelConfig(
     name="mixtral-8x7b",
 )
 
+MISTRAL_7B = ModelConfig(
+    vocab_size=32000,
+    hidden_size=4096,
+    intermediate_size=14336,
+    num_hidden_layers=32,
+    num_attention_heads=32,
+    num_key_value_heads=8,
+    max_position_embeddings=32768,
+    rms_norm_eps=1e-5,
+    rope_theta=10000.0,
+    sliding_window=4096,
+    model_type="mistral",
+    name="mistral-7b",
+)
+
 QWEN2_5_7B = ModelConfig(
     vocab_size=152064,
     hidden_size=3584,
@@ -261,5 +314,5 @@ QWEN2_5_0_5B = ModelConfig(
 CONFIGS = {
     c.name: c
     for c in [LLAMA_3_2_1B, LLAMA_3_1_8B, LLAMA_3_70B, MIXTRAL_8X7B,
-              QWEN2_5_7B, QWEN2_5_0_5B]
+              MISTRAL_7B, QWEN2_5_7B, QWEN2_5_0_5B]
 }

@@ -100,6 +100,8 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
                 "bv": w(next(ks), L, nkv * hd, scale=0.02),
             }
         )
+    if cfg.attention_sinks:  # gpt-oss learnable per-head sink logits
+        layers["sinks"] = w(next(ks), L, nh, scale=1.0)
     if cfg.is_moe:
         fm = cfg.moe_intermediate_size or f
         E = cfg.num_experts
@@ -152,6 +154,8 @@ def param_pspecs(cfg: ModelConfig, tp_axis: str = "tp", ep_axis: str = "tp") -> 
                 "bv": P(None, tp_axis),
             }
         )
+    if cfg.attention_sinks:
+        layers["sinks"] = P(None, tp_axis)
     if cfg.is_moe:
         layers.update(
             {
@@ -364,6 +368,7 @@ def _layer_prefill(
     cfg: ModelConfig,
     inv_freq: jax.Array,
     attn_impl: str = "xla",
+    window=None,  # per-layer sliding window (scalar; <= 0 → full)
 ):
     B, S, h = x.shape
     nh, nkv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim_
@@ -379,7 +384,7 @@ def _layer_prefill(
 
     attn = prefill_attention(
         q, k, v, k_pages, v_pages, page_table, prefix_lens, chunk_lens,
-        impl=attn_impl,
+        impl=attn_impl, window=window, sink=lp.get("sinks"),
     )
     k_pages, v_pages = write_kv_pages(
         k_pages, v_pages, k, v, page_table, prefix_lens, chunk_lens
@@ -404,6 +409,7 @@ def _layer_decode(
     cfg: ModelConfig,
     inv_freq: jax.Array,
     attn_impl: str = "xla",
+    window=None,  # per-layer sliding window (scalar; <= 0 → full)
 ):
     B, h = x.shape
     nh, nkv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim_
@@ -421,7 +427,10 @@ def _layer_decode(
     k_pages, v_pages = write_kv_pages(
         k_pages, v_pages, k, v, page_table, positions, jnp.ones_like(positions)
     )
-    attn = decode_attention(q, k_pages, v_pages, page_table, seq_lens, impl=attn_impl)
+    attn = decode_attention(
+        q, k_pages, v_pages, page_table, seq_lens, impl=attn_impl,
+        window=window, sink=lp.get("sinks"),
+    )
     attn_out = matmul_any(
         attn.reshape(B, nh * hd), lp["wo"], "bd,dh->bh"
     ).astype(x.dtype)
@@ -433,6 +442,16 @@ def _layer_decode(
     else:
         mlp_out = _mlp(lp, mlp_in[:, None])[:, 0]
     return x + mlp_out, (k_pages, v_pages)
+
+
+def _window_xs(cfg: ModelConfig):
+    """Per-layer window operands for the layer scans: a single (L,) int32
+    array appended to the scan xs when the model is windowed, () otherwise
+    (bodies read `xs[3] if wins else None`).  One definition so the three
+    forward paths cannot drift."""
+    if not cfg.sliding_window:
+        return ()
+    return (jnp.asarray(cfg.layer_windows(), jnp.int32),)
 
 
 def _lm_logits(params: Params, cfg: ModelConfig, x: jax.Array) -> jax.Array:
@@ -474,17 +493,21 @@ def forward_prefill(
     x = params["embed"][tokens]  # [B, S, h]
     if extra_embeds is not None:
         x = jnp.where(extra_mask[..., None], extra_embeds.astype(x.dtype), x)
+    wins = _window_xs(cfg)
 
     def body(carry, xs):
         h = carry
-        lp, k_pages, v_pages = xs
+        lp, k_pages, v_pages = xs[:3]
         h, (k_pages, v_pages) = _layer_prefill(
             lp, (k_pages, v_pages), h, positions, page_table,
             prefix_lens, chunk_lens, cfg, inv_freq, attn_impl,
+            window=xs[3] if wins else None,
         )
         return h, (k_pages, v_pages)
 
-    x, (k_new, v_new) = jax.lax.scan(body, x, (params["layers"], kv.k, kv.v))
+    x, (k_new, v_new) = jax.lax.scan(
+        body, x, (params["layers"], kv.k, kv.v, *wins)
+    )
     last = jnp.maximum(chunk_lens - 1, 0)
     x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]  # [B, h]
     return _lm_logits(params, cfg, x_last), KVCache(k_new, v_new)
@@ -511,17 +534,18 @@ def forward_embed(
     positions = jnp.arange(S)[None, :].repeat(B, 0)
     prefix = jnp.zeros((B,), jnp.int32)
     x = params["embed"][tokens]
+    wins = _window_xs(cfg)
 
     def body(carry, xs):
         h = carry
-        lp, k_pages, v_pages = xs
+        lp, k_pages, v_pages = xs[:3]
         h, (k_pages, v_pages) = _layer_prefill(
             lp, (k_pages, v_pages), h, positions, table, prefix, lens,
-            cfg, inv_freq,
+            cfg, inv_freq, window=xs[3] if wins else None,
         )
         return h, (k_pages, v_pages)
 
-    x, _ = jax.lax.scan(body, x, (params["layers"], kv.k, kv.v))
+    x, _ = jax.lax.scan(body, x, (params["layers"], kv.k, kv.v, *wins))
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     mask = (jnp.arange(S)[None, :] < lens[:, None]).astype(jnp.float32)
     pooled = (x.astype(jnp.float32) * mask[..., None]).sum(1)
@@ -545,15 +569,18 @@ def forward_decode(
     inv_freq = rope_frequencies(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
     seq_lens = positions + 1
     x = params["embed"][tokens]  # [B, h]
+    wins = _window_xs(cfg)
 
     def body(carry, xs):
         h = carry
-        lp, k_pages, v_pages = xs
+        lp, k_pages, v_pages = xs[:3]
         h, (k_pages, v_pages) = _layer_decode(
             lp, (k_pages, v_pages), h, positions, page_table, seq_lens, cfg,
-            inv_freq, attn_impl,
+            inv_freq, attn_impl, window=xs[3] if wins else None,
         )
         return h, (k_pages, v_pages)
 
-    x, (k_new, v_new) = jax.lax.scan(body, x, (params["layers"], kv.k, kv.v))
+    x, (k_new, v_new) = jax.lax.scan(
+        body, x, (params["layers"], kv.k, kv.v, *wins)
+    )
     return _lm_logits(params, cfg, x), KVCache(k_new, v_new)

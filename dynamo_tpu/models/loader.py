@@ -86,6 +86,14 @@ def load_params(path: str, cfg: ModelConfig, dtype=jnp.bfloat16):
                 "bv": stack(p + "self_attn.v_proj.bias", transpose=False),
             }
         )
+    if cfg.attention_sinks:  # gpt-oss sink logits — gate on the CONFIG
+        # (like every other consumer) so params and cfg cannot disagree
+        if not r.has("model.layers.0.self_attn.sinks"):
+            raise ValueError(
+                "config declares attention_sinks but the checkpoint has "
+                "no self_attn.sinks tensors"
+            )
+        layers["sinks"] = stack(p + "self_attn.sinks", transpose=False)
     if cfg.is_moe:
         E = cfg.num_experts
 

@@ -155,6 +155,24 @@ def _mqa_out(weights: jax.Array, v: jax.Array, dtype) -> jax.Array:
     return out.reshape(B, Sq, n_heads, v.shape[3]).astype(dtype)
 
 
+def _sink_softmax(scores: jax.Array, sink) -> jax.Array:
+    """Softmax with optional per-head attention-sink logits (GPT-OSS):
+    the sink joins the denominator as one extra virtual key but
+    contributes no value — some attention mass drains into it."""
+    if sink is None:
+        return jax.nn.softmax(scores, axis=-1)
+    col_shape = (*scores.shape[:-1], 1)
+    col = jnp.broadcast_to(
+        sink.astype(jnp.float32).reshape(
+            (1, -1) + (1,) * (scores.ndim - 3) + (1,)
+        ),
+        col_shape,
+    )
+    return jax.nn.softmax(
+        jnp.concatenate([scores, col], axis=-1), axis=-1
+    )[..., :-1]
+
+
 def prefill_attention(
     q: jax.Array,  # [B, S, n_heads, hd] — the new chunk
     k_new: jax.Array,  # [B, S, n_kv, hd]
@@ -165,10 +183,15 @@ def prefill_attention(
     prefix_lens: jax.Array,  # [B] — tokens already in cache before this chunk
     chunk_lens: jax.Array,  # [B] — valid tokens in this chunk
     impl: str = "xla",
+    window=None,  # scalar int (traced OK); <= 0 → full attention
+    sink=None,  # [n_heads] learnable sink logits; None → plain softmax
 ) -> jax.Array:
-    """Chunk attends to cached prefix + itself (causal). Returns [B,S,H,hd]."""
+    """Chunk attends to cached prefix + itself (causal; optionally only
+    the last `window` positions). Returns [B,S,H,hd]."""
     B, S, n_heads, hd = q.shape
     n_kv, page = k_pages.shape[2], k_pages.shape[1]
+    if window is not None or sink is not None:
+        impl = "xla"  # the Pallas kernels don't speak windows/sinks yet
     esize = jnp.dtype(q.dtype).itemsize
     vmem = (
         2 * S * n_heads * hd * esize        # q + o blocks
@@ -188,22 +211,28 @@ def prefill_attention(
 
     k_pre, v_pre = gather_kv(k_pages, v_pages, page_table)  # [B, Lp, n_kv, hd]
     Lp = k_pre.shape[1]
+    i = jnp.arange(S)[None, None, :, None]
+    # global query positions: prefix + row index within the chunk
+    q_pos = prefix_lens[:, None, None, None] + i
 
-    # scores over prefix
+    # scores over prefix (global key positions 0..Lp)
     s_pre = _mqa_scores(q, k_pre) * scale  # [B, H, S, Lp]
-    pre_valid = jnp.arange(Lp)[None, None, None, :] < prefix_lens[:, None, None, None]
+    p = jnp.arange(Lp)[None, None, None, :]
+    pre_valid = p < prefix_lens[:, None, None, None]
+    if window is not None:
+        pre_valid &= (p > q_pos - window) | (window <= 0)
     s_pre = jnp.where(pre_valid, s_pre, NEG_INF)
 
     # scores over the chunk itself (causal within chunk)
     s_new = _mqa_scores(q, k_new) * scale  # [B, H, S, S]
-    i = jnp.arange(S)[None, None, :, None]
     j = jnp.arange(S)[None, None, None, :]
-    causal = j <= i
-    new_valid = j < chunk_lens[:, None, None, None]
-    s_new = jnp.where(causal & new_valid, s_new, NEG_INF)
+    new_valid = (j <= i) & (j < chunk_lens[:, None, None, None])
+    if window is not None:
+        new_valid &= (j > i - window) | (window <= 0)
+    s_new = jnp.where(new_valid, s_new, NEG_INF)
 
     scores = jnp.concatenate([s_pre, s_new], axis=-1)  # [B, H, S, Lp+S]
-    weights = jax.nn.softmax(scores, axis=-1)
+    weights = _sink_softmax(scores, sink)
     w_pre, w_new = weights[..., :Lp], weights[..., Lp:]
     out = _mqa_out(w_pre, v_pre, q.dtype) + _mqa_out(w_new, v_new, q.dtype)
     return out
@@ -216,8 +245,12 @@ def decode_attention(
     page_table: jax.Array,  # [B, max_pages]
     seq_lens: jax.Array,  # [B] — context length incl. the new token
     impl: str = "xla",
+    window=None,  # scalar int (traced OK); <= 0 → full attention
+    sink=None,  # [n_heads] learnable sink logits; None → plain softmax
 ) -> jax.Array:
     """Single-token attention over the page table. Returns [B, n_heads, hd]."""
+    if window is not None or sink is not None:
+        impl = "xla"  # the Pallas kernels don't speak windows/sinks yet
     impl = _adapt(impl, page_table, k_pages.shape[1])
     if impl == "pallas":
         from .pallas_attention import decode_attention_pallas
@@ -228,8 +261,11 @@ def decode_attention(
     k, v = gather_kv(k_pages, v_pages, page_table)  # [B, L, n_kv, hd]
     L = k.shape[1]
     scores = _mqa_scores(q[:, None], k)[:, :, 0, :] * scale  # [B, H, L]
-    valid = jnp.arange(L)[None, None, :] < seq_lens[:, None, None]
+    pos = jnp.arange(L)[None, None, :]
+    valid = pos < seq_lens[:, None, None]
+    if window is not None:
+        valid &= (pos >= seq_lens[:, None, None] - window) | (window <= 0)
     scores = jnp.where(valid, scores, NEG_INF)
-    weights = jax.nn.softmax(scores, axis=-1)
+    weights = _sink_softmax(scores, sink)
     out = _mqa_out(weights[:, :, None, :], v, q.dtype)  # [B, 1, H, hd]
     return out[:, 0]

@@ -132,6 +132,11 @@ def forward_prefill_sp(
     tp = mesh.shape.get("tp", 1)
     if cfg.is_moe and tp > 1:
         raise NotImplementedError("sp prefill with tp > 1 requires a dense model")
+    if cfg.sliding_window or cfg.attention_sinks:
+        raise NotImplementedError(
+            "sp ring prefill does not implement sliding windows or "
+            "attention sinks yet"
+        )
     if cfg.num_attention_heads % tp or cfg.num_key_value_heads % tp:
         raise ValueError(
             f"tp={tp} must divide the head counts "

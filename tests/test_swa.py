@@ -1,0 +1,199 @@
+"""Sliding-window attention + attention sinks (Mistral / GPT-OSS
+families).  The reference serves these models through its engines'
+attention implementations; here the paged XLA path implements the window
+mask over global positions and sink logits in the softmax denominator.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine import EngineConfig, JaxEngine
+from dynamo_tpu.models import (
+    KVCache,
+    forward_decode,
+    forward_prefill,
+    init_params,
+    tiny_config,
+)
+from dynamo_tpu.models.config import CONFIGS, ModelConfig
+
+
+def tiny_swa(window=8, layers=2, **over):
+    return tiny_config(
+        sliding_window=window, num_hidden_layers=layers,
+        model_type="mistral", name="tiny-swa-test", **over
+    )
+
+
+def _full_prefill(cfg, params, tokens, page_size=8):
+    B, S = tokens.shape
+    pages = -(-S // page_size) + 1
+    kv = KVCache.create(cfg, 1 + B * pages, page_size, jnp.float32)
+    table = jnp.arange(1, 1 + B * pages, dtype=jnp.int32).reshape(B, pages)
+    logits, kv = forward_prefill(
+        params, cfg, kv, tokens, table,
+        jnp.zeros(B, jnp.int32), jnp.full((B,), S, jnp.int32),
+    )
+    return np.asarray(logits), kv, table
+
+
+def test_window_wider_than_context_equals_full_attention():
+    """window >= seq_len must be bit-identical to no window at all."""
+    cfg_full = tiny_config()
+    cfg_win = tiny_config(sliding_window=512, model_type="mistral")
+    params = init_params(cfg_full, jax.random.PRNGKey(0), dtype=jnp.float32)
+    tokens = jnp.arange(2 * 24, dtype=jnp.int32).reshape(2, 24) % cfg_full.vocab_size
+    a, _, _ = _full_prefill(cfg_full, params, tokens)
+    b, _, _ = _full_prefill(cfg_win, params, tokens)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_tokens_beyond_window_do_not_affect_output():
+    """Single-layer model: the last token's logits depend ONLY on the
+    last `window` positions — changing anything earlier must not move
+    them (multi-layer receptive fields grow per layer, so this strict
+    property holds at L=1)."""
+    cfg = tiny_swa(window=8, layers=1)
+    params = init_params(cfg, jax.random.PRNGKey(1), dtype=jnp.float32)
+    S = 32
+    base = np.arange(S, dtype=np.int32) % cfg.vocab_size
+    changed = base.copy()
+    changed[: S - 8] = (changed[: S - 8] + 17) % cfg.vocab_size  # outside window
+    a, _, _ = _full_prefill(cfg, params, jnp.asarray(base)[None])
+    b, _, _ = _full_prefill(cfg, params, jnp.asarray(changed)[None])
+    np.testing.assert_array_equal(a, b)
+    # sanity: changing INSIDE the window does move the logits
+    inside = base.copy()
+    inside[S - 2] = (inside[S - 2] + 1) % cfg.vocab_size
+    c, _, _ = _full_prefill(cfg, params, jnp.asarray(inside)[None])
+    assert not np.array_equal(a, c)
+
+
+def test_windowed_decode_matches_prefill():
+    """The engine-critical invariant: full prefill of S+1 tokens equals
+    prefill of S + one decode step, with the window active (the decode
+    mask uses global seq_lens; prefill uses prefix+chunk positions)."""
+    cfg = tiny_swa(window=8, layers=2)
+    params = init_params(cfg, jax.random.PRNGKey(2), dtype=jnp.float32)
+    S = 25
+    toks = (np.arange(S + 1, dtype=np.int32) * 7) % cfg.vocab_size
+    want, _, _ = _full_prefill(cfg, params, jnp.asarray(toks)[None])
+
+    got_prefill, kv, table = _full_prefill(
+        cfg, params, jnp.asarray(toks[:S])[None]
+    )
+    logits, _ = forward_decode(
+        params, cfg, kv, jnp.asarray(toks[S:]), jnp.asarray([S]), table
+    )
+    np.testing.assert_allclose(np.asarray(logits), want, rtol=2e-5, atol=2e-5)
+
+
+def test_alternating_layer_types():
+    """GPT-OSS alternates sliding and full layers; both must engage."""
+    base = dict(num_hidden_layers=2, model_type="gpt_oss")
+    params = init_params(
+        tiny_config(**base), jax.random.PRNGKey(3), dtype=jnp.float32
+    )
+    tokens = jnp.arange(40, dtype=jnp.int32)[None] % 256
+    mixed = tiny_config(sliding_window=8,
+                        layer_types=("sliding_attention", "full_attention"),
+                        **base)
+    all_win = tiny_config(sliding_window=8, **base)
+    full = tiny_config(**base)
+    a, _, _ = _full_prefill(mixed, params, tokens)
+    b, _, _ = _full_prefill(all_win, params, tokens)
+    c, _, _ = _full_prefill(full, params, tokens)
+    assert not np.array_equal(a, b) and not np.array_equal(a, c)
+    with pytest.raises(ValueError, match="layer_types"):
+        tiny_config(sliding_window=8, layer_types=("sliding_attention",),
+                    **base).layer_windows()
+
+
+def test_attention_sinks_shift_mass():
+    """Sink logits join the softmax denominator: zero-valued sinks must
+    change outputs vs no sinks (exp(0)=1 extra mass), while very
+    negative sinks converge to the sink-free model."""
+    cfg_plain = tiny_config(num_hidden_layers=1)
+    cfg_sink = tiny_config(num_hidden_layers=1, attention_sinks=True,
+                           model_type="gpt_oss")
+    params = init_params(cfg_sink, jax.random.PRNGKey(4), dtype=jnp.float32)
+    assert "sinks" in params["layers"]
+    tokens = jnp.arange(16, dtype=jnp.int32)[None] % 256
+
+    plain_params = dict(params)
+    plain_params["layers"] = {
+        k: v for k, v in params["layers"].items() if k != "sinks"
+    }
+    plain, _, _ = _full_prefill(cfg_plain, plain_params, tokens)
+
+    zeroed = dict(params)
+    zeroed["layers"] = {**params["layers"],
+                       "sinks": jnp.zeros_like(params["layers"]["sinks"])}
+    with_sink, _, _ = _full_prefill(cfg_sink, zeroed, tokens)
+    assert not np.allclose(plain, with_sink)
+
+    muted = dict(params)
+    muted["layers"] = {**params["layers"],
+                      "sinks": jnp.full_like(params["layers"]["sinks"], -1e9)}
+    almost_plain, _, _ = _full_prefill(cfg_sink, muted, tokens)
+    np.testing.assert_allclose(almost_plain, plain, rtol=1e-5, atol=1e-5)
+
+
+async def test_engine_serves_swa_model_consistently():
+    """Chunked prefill + prefix cache + fused decode must agree with a
+    one-shot configuration for a windowed+sinked model (different
+    chunkings change nothing observable)."""
+    cfg = tiny_swa(window=8, layers=2, attention_sinks=True)
+    params = init_params(cfg, jax.random.PRNGKey(5), dtype=jnp.float32)
+
+    async def run(ecfg):
+        engine = JaxEngine(cfg, params, ecfg, kv_dtype=jnp.float32)
+        outs = []
+        for i in range(3):
+            req = {
+                "token_ids": [(i * 13 + j) % cfg.vocab_size
+                              for j in range(30 + 5 * i)],
+                "sampling_options": {"temperature": 0.0},
+                "stop_conditions": {"max_tokens": 6, "ignore_eos": True},
+            }
+            toks = []
+            async for out in engine.generate(req):
+                assert out.get("finish_reason") != "error", out
+                toks += out["token_ids"]
+            outs.append(toks)
+        await engine.shutdown()
+        return outs
+
+    one_shot = await run(EngineConfig(
+        page_size=8, num_pages=128, max_num_seqs=4,
+        max_prefill_tokens=64, max_model_len=128,
+    ))
+    chunked = await run(EngineConfig(
+        page_size=16, num_pages=64, max_num_seqs=2,
+        max_prefill_tokens=16, max_model_len=128,  # forces chunked prefill
+        decode_steps=2, decode_chain=2,
+    ))
+    assert one_shot == chunked
+
+
+def test_mistral_config_registered():
+    assert CONFIGS["mistral-7b"].sliding_window == 4096
+    hf = ModelConfig.from_hf_config({
+        "model_type": "gpt_oss", "vocab_size": 1000, "hidden_size": 64,
+        "num_hidden_layers": 2, "num_attention_heads": 4,
+        "num_key_value_heads": 2, "intermediate_size": 128,
+        "sliding_window": 128,
+        "layer_types": ["sliding_attention", "full_attention"],
+    })
+    assert hf.attention_sinks and hf.layer_windows() == [128, 0]
+    # Qwen2.5 ships sliding_window=131072 but use_sliding_window=false —
+    # the window must stay OFF (HF only engages it behind the flag)
+    qwen = ModelConfig.from_hf_config({
+        "model_type": "qwen2", "vocab_size": 1000, "hidden_size": 64,
+        "num_hidden_layers": 2, "num_attention_heads": 4,
+        "num_key_value_heads": 2, "intermediate_size": 128,
+        "sliding_window": 131072, "use_sliding_window": False,
+    })
+    assert qwen.sliding_window is None
