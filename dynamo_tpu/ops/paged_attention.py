@@ -190,8 +190,8 @@ def prefill_attention(
     the last `window` positions). Returns [B,S,H,hd]."""
     B, S, n_heads, hd = q.shape
     n_kv, page = k_pages.shape[2], k_pages.shape[1]
-    if window is not None or sink is not None:
-        impl = "xla"  # the Pallas kernels don't speak windows/sinks yet
+    if sink is not None:
+        impl = "xla"  # sink logits aren't in the kernels yet
     esize = jnp.dtype(q.dtype).itemsize
     vmem = (
         2 * S * n_heads * hd * esize        # q + o blocks
@@ -205,7 +205,8 @@ def prefill_attention(
         from .pallas_attention import prefill_attention_pallas
 
         return prefill_attention_pallas(
-            q, k_new, v_new, k_pages, v_pages, page_table, prefix_lens, chunk_lens
+            q, k_new, v_new, k_pages, v_pages, page_table, prefix_lens,
+            chunk_lens, window=window,
         )
     scale = 1.0 / jnp.sqrt(hd).astype(jnp.float32)
 
@@ -249,13 +250,15 @@ def decode_attention(
     sink=None,  # [n_heads] learnable sink logits; None → plain softmax
 ) -> jax.Array:
     """Single-token attention over the page table. Returns [B, n_heads, hd]."""
-    if window is not None or sink is not None:
-        impl = "xla"  # the Pallas kernels don't speak windows/sinks yet
+    if sink is not None:
+        impl = "xla"  # sink logits aren't in the kernels yet
     impl = _adapt(impl, page_table, k_pages.shape[1])
     if impl == "pallas":
         from .pallas_attention import decode_attention_pallas
 
-        return decode_attention_pallas(q, k_pages, v_pages, page_table, seq_lens)
+        return decode_attention_pallas(
+            q, k_pages, v_pages, page_table, seq_lens, window=window
+        )
     B, n_heads, hd = q.shape
     scale = 1.0 / jnp.sqrt(hd).astype(jnp.float32)
     k, v = gather_kv(k_pages, v_pages, page_table)  # [B, L, n_kv, hd]

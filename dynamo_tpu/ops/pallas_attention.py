@@ -64,6 +64,7 @@ def _decode_kernel(
     # scalar prefetch
     pt_ref,  # [B, padded_pages] int32 page table
     len_ref,  # [B] int32 sequence lengths (incl. the new token)
+    win_ref,  # [1] int32 sliding window (0 = full attention)
     # inputs
     q_ref,  # [1, H, hd] VMEM — this sequence's query (pre-scaled)
     k_hbm,  # [P, page, n_kv*hd] HBM
@@ -89,7 +90,16 @@ def _decode_kernel(
     c = pl.program_id(1)
     T = C * page
     seq_len = len_ref[b]
-    chunk_start = c * T
+    window = win_ref[0]
+    # sliding window: chunks entirely before seq_len - window hold no
+    # attended keys — remap the grid to start at the first relevant
+    # chunk, so streamed bandwidth AND compute scale with the window,
+    # not the full context
+    first = jnp.where(
+        window > 0, jnp.maximum(seq_len - window, 0) // T, 0
+    )
+    ch = c + first
+    chunk_start = ch * T
 
     def dmas(chunk_idx, buf):
         return _page_dmas(
@@ -101,7 +111,7 @@ def _decode_kernel(
         m_scr[:] = jnp.full_like(m_scr, NEG_INF)
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
-        for cp in dmas(0, 0):
+        for cp in dmas(first, 0):
             cp.start()
 
     @pl.when(chunk_start < seq_len)
@@ -109,12 +119,12 @@ def _decode_kernel(
         buf = jax.lax.rem(c, 2)
 
         # overlap: start the next chunk's DMAs before waiting on this one
-        @pl.when((c + 1 < nc) & ((c + 1) * T < seq_len))
+        @pl.when((c + 1 < nc) & ((ch + 1) * T < seq_len))
         def _():
-            for cp in dmas(c + 1, 1 - buf):
+            for cp in dmas(ch + 1, 1 - buf):
                 cp.start()
 
-        for cp in dmas(c, buf):
+        for cp in dmas(ch, buf):
             cp.wait()
 
         q = q_ref[0]  # [H, hd]
@@ -122,6 +132,7 @@ def _decode_kernel(
         v = v_scr[buf].reshape(T, n_kv * hd)
         tpos = chunk_start + jax.lax.broadcasted_iota(jnp.int32, (1, T), 1)
         valid = tpos < seq_len  # [1, T]
+        valid &= (window <= 0) | (tpos >= seq_len - window)
 
         for kh in range(n_kv):
             hs = slice(kh * groups, (kh + 1) * groups)
@@ -161,6 +172,7 @@ def decode_attention_pallas(
     page_table: jax.Array,  # [B, max_pages] int32
     seq_lens: jax.Array,  # [B] int32 (incl. the new token)
     *,
+    window=None,  # scalar int; None/<=0 → full attention
     interpret: bool = False,
 ) -> jax.Array:
     """Flash paged-attention decode step. Returns [B, H, hd]."""
@@ -179,9 +191,10 @@ def decode_attention_pallas(
     qs = (q.astype(jnp.float32) * scale).astype(q.dtype)
     k_r = k_pages.reshape(P, page, n_kv * hd)
     v_r = v_pages.reshape(P, page, n_kv * hd)
+    win = jnp.full((1,), 0 if window is None else window, jnp.int32)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3,
         grid=(B, nc),
         in_specs=[
             pl.BlockSpec((1, H, hd), lambda b, c, *_: (b, 0, 0)),
@@ -207,7 +220,7 @@ def decode_attention_pallas(
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, H, hd), q.dtype),
         interpret=interpret,
-    )(page_table, seq_lens.astype(jnp.int32), qs, k_r, v_r)
+    )(page_table, seq_lens.astype(jnp.int32), win, qs, k_r, v_r)
 
 
 # --------------------------------------------------------------------------- #
@@ -220,6 +233,7 @@ def _prefill_kernel(
     pt_ref,  # [B, padded_pages] int32
     pre_ref,  # [B] int32 prefix lengths (tokens already in cache)
     cl_ref,  # [B] int32 chunk lengths (valid tokens in the new chunk)
+    win_ref,  # [1] int32 sliding window (0 = full attention)
     # inputs (heads flattened onto lanes)
     q_ref,  # [1, S, H*hd] VMEM (pre-scaled)
     kn_ref,  # [1, S, n_kv*hd] VMEM — the chunk's own K
@@ -249,7 +263,17 @@ def _prefill_kernel(
     T = C * page
     prefix_len = pre_ref[b]
     chunk_len = cl_ref[b]
-    chunk_start = c * T
+    window = win_ref[0]
+    # sliding window: the earliest query row (global position prefix_len)
+    # attends keys > prefix_len - window, so prefix chunks wholly before
+    # that are skipped — stream and compute scale with the window
+    first = jnp.where(
+        window > 0,
+        jnp.maximum(prefix_len - window + 1, 0) // T,
+        0,
+    )
+    ch = c + first
+    chunk_start = ch * T
 
     def dmas(chunk_idx, buf):
         return _page_dmas(
@@ -262,9 +286,13 @@ def _prefill_kernel(
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-        @pl.when(prefix_len > 0)
+        # guard on the FIRST COMPUTE CHUNK being real, not just on having
+        # a prefix: with a tiny window first*T can reach prefix_len (no
+        # prefix chunk attended at all) and a started-but-never-awaited
+        # DMA would leak its semaphore signals into the next grid row
+        @pl.when(first * T < prefix_len)
         def _():
-            for cp in dmas(0, 0):
+            for cp in dmas(first, 0):
                 cp.start()
 
     # ---- streamed prefix pages ---- #
@@ -272,18 +300,22 @@ def _prefill_kernel(
     def _():
         buf = jax.lax.rem(c, 2)
 
-        @pl.when((c + 1 < nc) & ((c + 1) * T < prefix_len))
+        @pl.when((c + 1 < nc) & ((ch + 1) * T < prefix_len))
         def _():
-            for cp in dmas(c + 1, 1 - buf):
+            for cp in dmas(ch + 1, 1 - buf):
                 cp.start()
 
-        for cp in dmas(c, buf):
+        for cp in dmas(ch, buf):
             cp.wait()
 
         k = k_scr[buf].reshape(T, n_kv * hd)
         v = v_scr[buf].reshape(T, n_kv * hd)
-        tpos = chunk_start + jax.lax.broadcasted_iota(jnp.int32, (1, T), 1)
-        valid = tpos < prefix_len  # [1, T] — same mask for every query row
+        # per-row mask: key position validity + sliding window around the
+        # row's global query position (prefix_len + row)
+        rows = jax.lax.broadcasted_iota(jnp.int32, (S, T), 0)
+        tpos = chunk_start + jax.lax.broadcasted_iota(jnp.int32, (S, T), 1)
+        valid = tpos < prefix_len
+        valid &= (window <= 0) | (tpos > prefix_len + rows - window)
 
         for kh in range(n_kv):
             ds = slice(kh * hd, (kh + 1) * hd)
@@ -320,6 +352,7 @@ def _prefill_kernel(
         i = jax.lax.broadcasted_iota(jnp.int32, (S, S), 0)
         j = jax.lax.broadcasted_iota(jnp.int32, (S, S), 1)
         causal = (j <= i) & (j < chunk_len)
+        causal &= (window <= 0) | (j > i - window)
 
         for kh in range(n_kv):
             kn = kn_ref[0, :, kh * hd:(kh + 1) * hd]  # [S, hd]
@@ -360,6 +393,7 @@ def prefill_attention_pallas(
     prefix_lens: jax.Array,  # [B]
     chunk_lens: jax.Array,  # [B]
     *,
+    window=None,  # scalar int; None/<=0 → full attention
     interpret: bool = False,
 ) -> jax.Array:
     """Chunked-prefill flash attention: streamed prefix pages + causal self
@@ -381,8 +415,9 @@ def prefill_attention_pallas(
     k_r = k_pages.reshape(P, page, n_kv * hd)
     v_r = v_pages.reshape(P, page, n_kv * hd)
 
+    win = jnp.full((1,), 0 if window is None else window, jnp.int32)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
+        num_scalar_prefetch=4,
         grid=(B, nc),
         in_specs=[
             pl.BlockSpec((1, S, H * hd), lambda b, c, *_: (b, 0, 0)),
@@ -414,6 +449,7 @@ def prefill_attention_pallas(
         page_table,
         prefix_lens.astype(jnp.int32),
         chunk_lens.astype(jnp.int32),
+        win,
         qs, kn, vn, k_r, v_r,
     )
     return out.reshape(B, S, H, hd)
