@@ -190,8 +190,6 @@ def prefill_attention(
     the last `window` positions). Returns [B,S,H,hd]."""
     B, S, n_heads, hd = q.shape
     n_kv, page = k_pages.shape[2], k_pages.shape[1]
-    if sink is not None:
-        impl = "xla"  # sink logits aren't in the kernels yet
     esize = jnp.dtype(q.dtype).itemsize
     vmem = (
         2 * S * n_heads * hd * esize        # q + o blocks
@@ -206,7 +204,7 @@ def prefill_attention(
 
         return prefill_attention_pallas(
             q, k_new, v_new, k_pages, v_pages, page_table, prefix_lens,
-            chunk_lens, window=window,
+            chunk_lens, window=window, sink=sink,
         )
     scale = 1.0 / jnp.sqrt(hd).astype(jnp.float32)
 
@@ -250,14 +248,13 @@ def decode_attention(
     sink=None,  # [n_heads] learnable sink logits; None → plain softmax
 ) -> jax.Array:
     """Single-token attention over the page table. Returns [B, n_heads, hd]."""
-    if sink is not None:
-        impl = "xla"  # sink logits aren't in the kernels yet
     impl = _adapt(impl, page_table, k_pages.shape[1])
     if impl == "pallas":
         from .pallas_attention import decode_attention_pallas
 
         return decode_attention_pallas(
-            q, k_pages, v_pages, page_table, seq_lens, window=window
+            q, k_pages, v_pages, page_table, seq_lens, window=window,
+            sink=sink,
         )
     B, n_heads, hd = q.shape
     scale = 1.0 / jnp.sqrt(hd).astype(jnp.float32)

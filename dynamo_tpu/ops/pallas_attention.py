@@ -40,6 +40,14 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
+def _sink_arr(sink, H: int) -> jax.Array:
+    """[1, H] f32 sink logits for the kernels; the no-sink sentinel is
+    NEG_INF — exp(sink - m) == 0 exactly, bit-identical to no sink."""
+    if sink is None:
+        return jnp.full((1, H), NEG_INF, jnp.float32)
+    return sink.astype(jnp.float32).reshape(1, H)
+
+
 def _page_dmas(pt_ref, b, chunk_idx, buf, k_hbm, v_hbm, k_scr, v_scr, sems, C):
     """The 2C async copies bringing chunk `chunk_idx`'s pages into buffer
     `buf`. Returned (not started) so callers can .start() or .wait()."""
@@ -67,6 +75,7 @@ def _decode_kernel(
     win_ref,  # [1] int32 sliding window (0 = full attention)
     # inputs
     q_ref,  # [1, H, hd] VMEM — this sequence's query (pre-scaled)
+    sink_ref,  # [1, H] f32 — per-head sink logits (NEG_INF = no sink)
     k_hbm,  # [P, page, n_kv*hd] HBM
     v_hbm,
     # outputs
@@ -161,7 +170,12 @@ def _decode_kernel(
 
     @pl.when(c == nc - 1)
     def _():
-        denom = jnp.maximum(l_scr[:, :1], 1e-30)
+        # attention sinks (GPT-OSS): a virtual no-value key whose logit
+        # joins the denominator — exactly exp(sink - m) under the online
+        # softmax's running max (NEG_INF sink → plain softmax)
+        sink = sink_ref[0, :].reshape(-1, 1)  # [H, 1]
+        l_fin = l_scr[:, :1] + jnp.exp(sink - m_scr[:, :1])
+        denom = jnp.maximum(l_fin, 1e-30)
         o_ref[0] = (acc_scr[:] / denom).astype(o_ref.dtype)
 
 
@@ -173,6 +187,7 @@ def decode_attention_pallas(
     seq_lens: jax.Array,  # [B] int32 (incl. the new token)
     *,
     window=None,  # scalar int; None/<=0 → full attention
+    sink=None,  # [H] per-head sink logits; None → plain softmax
     interpret: bool = False,
 ) -> jax.Array:
     """Flash paged-attention decode step. Returns [B, H, hd]."""
@@ -192,12 +207,14 @@ def decode_attention_pallas(
     k_r = k_pages.reshape(P, page, n_kv * hd)
     v_r = v_pages.reshape(P, page, n_kv * hd)
     win = jnp.full((1,), 0 if window is None else window, jnp.int32)
+    sink_arr = _sink_arr(sink, H)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(B, nc),
         in_specs=[
             pl.BlockSpec((1, H, hd), lambda b, c, *_: (b, 0, 0)),
+            pl.BlockSpec((1, H), lambda b, c, *_: (0, 0)),
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
@@ -220,7 +237,7 @@ def decode_attention_pallas(
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, H, hd), q.dtype),
         interpret=interpret,
-    )(page_table, seq_lens.astype(jnp.int32), win, qs, k_r, v_r)
+    )(page_table, seq_lens.astype(jnp.int32), win, qs, sink_arr, k_r, v_r)
 
 
 # --------------------------------------------------------------------------- #
@@ -236,6 +253,7 @@ def _prefill_kernel(
     win_ref,  # [1] int32 sliding window (0 = full attention)
     # inputs (heads flattened onto lanes)
     q_ref,  # [1, S, H*hd] VMEM (pre-scaled)
+    sink_ref,  # [1, H] f32 — per-head sink logits (NEG_INF = no sink)
     kn_ref,  # [1, S, n_kv*hd] VMEM — the chunk's own K
     vn_ref,
     k_hbm,  # [P, page, n_kv*hd] HBM
@@ -379,7 +397,10 @@ def _prefill_kernel(
                     preferred_element_type=jnp.float32,
                 )
                 num = acc_scr[:, h * hd:(h + 1) * hd] * corr + pv
-                denom = jnp.maximum(l_new, 1e-30)
+                # attention sink: one extra denominator term per row
+                # (NEG_INF sink → exp == 0 → plain softmax)
+                l_fin = l_new + jnp.exp(sink_ref[0, h] - m_new)
+                denom = jnp.maximum(l_fin, 1e-30)
                 o_ref[0, :, h * hd:(h + 1) * hd] = (num / denom).astype(o_ref.dtype)
 
 
@@ -394,6 +415,7 @@ def prefill_attention_pallas(
     chunk_lens: jax.Array,  # [B]
     *,
     window=None,  # scalar int; None/<=0 → full attention
+    sink=None,  # [H] per-head sink logits; None → plain softmax
     interpret: bool = False,
 ) -> jax.Array:
     """Chunked-prefill flash attention: streamed prefix pages + causal self
@@ -416,11 +438,13 @@ def prefill_attention_pallas(
     v_r = v_pages.reshape(P, page, n_kv * hd)
 
     win = jnp.full((1,), 0 if window is None else window, jnp.int32)
+    sink_arr = _sink_arr(sink, H)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,
         grid=(B, nc),
         in_specs=[
             pl.BlockSpec((1, S, H * hd), lambda b, c, *_: (b, 0, 0)),
+            pl.BlockSpec((1, H), lambda b, c, *_: (0, 0)),
             pl.BlockSpec((1, S, n_kv * hd), lambda b, c, *_: (b, 0, 0)),
             pl.BlockSpec((1, S, n_kv * hd), lambda b, c, *_: (b, 0, 0)),
             pl.BlockSpec(memory_space=pl.ANY),
@@ -450,6 +474,6 @@ def prefill_attention_pallas(
         prefix_lens.astype(jnp.int32),
         chunk_lens.astype(jnp.int32),
         win,
-        qs, kn, vn, k_r, v_r,
+        qs, sink_arr, kn, vn, k_r, v_r,
     )
     return out.reshape(B, S, H, hd)

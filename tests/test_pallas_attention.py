@@ -216,3 +216,48 @@ def test_prefill_windowed_remap_skips_leading_chunks():
                 np.asarray(ref[b, :n], np.float32),
                 atol=5e-4, rtol=5e-4,
             )
+
+
+def test_sinks_match_xla():
+    """Attention-sink logits in the kernels (denominator-only virtual
+    key, folded into the flash finalization) vs the XLA sink softmax —
+    decode and windowed prefill."""
+    B, H, n_kv, hd, page, maxp = 3, 8, 2, 64, 16, 12
+    sink = jnp.linspace(-2.0, 3.0, H).astype(jnp.float32)
+
+    seq_lens = jnp.array([5, 60, 150], jnp.int32)
+    P = 1 + int(sum(-(-int(s) // page) for s in seq_lens))
+    k_pages, v_pages = _make_pool(jax.random.PRNGKey(9), P, page, n_kv, hd,
+                                  jnp.float32)
+    table = _page_table(B, maxp, seq_lens, page)
+    q = jax.random.normal(jax.random.PRNGKey(10), (B, H, hd), jnp.float32) * 0.5
+    ref = decode_attention(q, k_pages, v_pages, table, seq_lens, sink=sink)
+    out = decode_attention_pallas(
+        q, k_pages, v_pages, table, seq_lens, sink=sink, interpret=True
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+    S = 64
+    prefix_lens = jnp.array([48, 0, 96], jnp.int32)
+    chunk_lens = jnp.array([S, S - 9, 3], jnp.int32)
+    P2 = 1 + B * maxp
+    k2, v2 = _make_pool(jax.random.PRNGKey(11), P2, page, n_kv, hd, jnp.float32)
+    table2 = _page_table(B, maxp, jnp.full((B,), maxp * page), page)
+    ks = jax.random.split(jax.random.PRNGKey(12), 3)
+    qp = jax.random.normal(ks[0], (B, S, H, hd), jnp.float32) * 0.5
+    kn = jax.random.normal(ks[1], (B, S, n_kv, hd), jnp.float32) * 0.3
+    vn = jax.random.normal(ks[2], (B, S, n_kv, hd), jnp.float32) * 0.3
+    for window in (None, jnp.int32(16)):
+        ref = prefill_attention(qp, kn, vn, k2, v2, table2, prefix_lens,
+                                chunk_lens, window=window, sink=sink)
+        out = prefill_attention_pallas(
+            qp, kn, vn, k2, v2, table2, prefix_lens, chunk_lens,
+            window=window, sink=sink, interpret=True,
+        )
+        for b in range(B):
+            n = int(chunk_lens[b])
+            np.testing.assert_allclose(
+                np.asarray(out[b, :n]), np.asarray(ref[b, :n]),
+                atol=2e-5, rtol=2e-5,
+            )
