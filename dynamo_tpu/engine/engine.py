@@ -373,11 +373,12 @@ class JaxEngine:
                     raise ValueError(
                         f"chunk buckets {bad} not divisible by sp={self._sp}"
                     )
-                if parallel.tp > 1 and model_cfg.is_moe:
+                if (parallel.tp > 1 and model_cfg.is_moe
+                        and (model_cfg.moe_impl != "ragged"
+                             or model_cfg.num_experts % parallel.tp)):
                     raise ValueError(
-                        "sp > 1 with tp > 1 requires a dense model (MoE "
-                        "expert dispatch inside the sp shard_map is not "
-                        "implemented; use tp-only for MoE)"
+                        "sp×tp MoE requires moe_impl='ragged' and "
+                        "num_experts divisible by tp"
                     )
                 if model_cfg.sliding_window or model_cfg.attention_sinks:
                     raise ValueError(

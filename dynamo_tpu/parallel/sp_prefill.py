@@ -23,8 +23,10 @@ Design constraints (v1, enforced by the engine):
   sp/dp replica bit-identical without a pool-sized collective;
 - the sequence bucket must divide by sp, the batch by dp, and the
   q/kv head counts by tp;
-- MoE models require tp == 1 under sp (expert dispatch inside shard_map
-  is not implemented; the GSPMD tp path covers MoE without sp).
+- MoE under sp×tp uses the ragged dispatch with experts sharded over
+  tp (`_moe_ragged_ep`): the globally-sorted assignment list is rotated
+  so each shard's contiguous expert slice sits at the front for
+  `ragged_dot`, and a tp psum combines the per-expert partials.
 """
 
 from __future__ import annotations
@@ -97,7 +99,10 @@ def _layer_sp(lp, kv_layer, x, positions, table_full, chunk_full, cfg, inv_freq,
     x = x + attn_out
     mlp_in = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
     if cfg.is_moe:
-        mlp_out = _moe(lp, mlp_in, cfg)  # tp == 1 (enforced below)
+        if tp > 1:
+            mlp_out = _moe_ragged_ep(lp, mlp_in, cfg)
+        else:
+            mlp_out = _moe(lp, mlp_in, cfg)
     else:
         mlp_out = jax.lax.psum(_mlp_partial(lp, mlp_in), "tp")
     return x + mlp_out.astype(dt), (k_pages, v_pages)
@@ -111,6 +116,62 @@ def _mlp_partial(lp, x):
     up = matmul_any(x, lp["w_up"], "bsh,hf->bsf")
     act = jax.nn.silu(gate) * up
     return matmul_any(act.astype(x.dtype), lp["w_down"], "bsf,fh->bsh")
+
+
+def _moe_ragged_ep(lp, x, cfg):
+    """Dropless ragged-dot MoE with the EXPERTS sharded over the tp axis
+    (expert parallelism inside the sp shard_map).
+
+    Tokens are already sequence-sharded (sp) and replicated across tp;
+    each tp shard owns a contiguous expert slice [e0, e0+El).  Routing
+    is computed in full (router weights replicated), assignments are
+    sorted by expert globally, and the local slice — contiguous after
+    the sort — is rotated to the front so `jax.lax.ragged_dot` computes
+    exactly the local experts' rows.  A psum over tp combines the
+    per-expert partial outputs (non-local assignments contribute zero).
+    """
+    B, S, h = x.shape
+    E, k = cfg.num_experts, cfg.num_experts_per_tok
+    El = lp["w_gate"].shape[0]  # local experts (static, from the shard)
+    e0 = jax.lax.axis_index("tp") * El
+    T = B * S
+    A = T * k
+
+    xf = x.reshape(T, h)
+    router_logits = jnp.einsum(
+        "th,he->te", xf, lp["router"], preferred_element_type=jnp.float32
+    )
+    weights, selected = jax.lax.top_k(router_logits, k)  # [T, k]
+    weights = jax.nn.softmax(weights, axis=-1)
+
+    expert_of = selected.reshape(A)
+    order = jnp.argsort(expert_of, stable=True)
+    sorted_experts = expert_of[order]
+    # rotate the (contiguous) local expert segment to the front
+    offset = jnp.searchsorted(sorted_experts, e0)
+    rolled = jnp.roll(order, -offset)
+    tok_rolled = rolled // k
+    xs = xf[tok_rolled]  # [A, h] — local segment first
+    gs_full = jnp.bincount(expert_of, length=E)
+    gs_local = jax.lax.dynamic_slice(gs_full, (e0,), (El,))
+
+    gate = jax.lax.ragged_dot(
+        xs, lp["w_gate"], gs_local, preferred_element_type=jnp.float32
+    )
+    up = jax.lax.ragged_dot(
+        xs, lp["w_up"], gs_local, preferred_element_type=jnp.float32
+    )
+    act = (jax.nn.silu(gate) * up).astype(x.dtype)
+    ys = jax.lax.ragged_dot(
+        act, lp["w_down"], gs_local, preferred_element_type=jnp.float32
+    )  # [A, h] — rows past the local assignment count are garbage
+
+    exp_rolled = expert_of[rolled]
+    local = (exp_rolled >= e0) & (exp_rolled < e0 + El)
+    wf = weights.reshape(A)[rolled].astype(jnp.float32) * local
+    out = jnp.zeros((T, h), jnp.float32).at[tok_rolled].add(ys * wf[:, None])
+    out = jax.lax.psum(out, "tp")
+    return out.reshape(B, S, h).astype(x.dtype)
 
 
 def forward_prefill_sp(
@@ -131,7 +192,15 @@ def forward_prefill_sp(
     """
     tp = mesh.shape.get("tp", 1)
     if cfg.is_moe and tp > 1:
-        raise NotImplementedError("sp prefill with tp > 1 requires a dense model")
+        if cfg.moe_impl != "ragged":
+            raise NotImplementedError(
+                "sp×tp MoE implements the ragged dispatch only "
+                f"(moe_impl={cfg.moe_impl!r})"
+            )
+        if cfg.num_experts % tp:
+            raise ValueError(
+                f"tp={tp} must evenly divide num_experts={cfg.num_experts}"
+            )
     if cfg.sliding_window or cfg.attention_sinks:
         raise NotImplementedError(
             "sp ring prefill does not implement sliding windows or "

@@ -154,13 +154,15 @@ def test_engine_sp_validation():
                   max_model_len=256),
             parallel=ParallelConfig(dp=2, sp=4),
         )
+    # sp×tp MoE is allowed for ragged dispatch with E % tp == 0; an
+    # uneven expert split still fails fast
     from dynamo_tpu.models import tiny_moe_config
 
-    with pytest.raises(ValueError, match="dense model"):
+    odd = tiny_moe_config(num_experts=3, num_experts_per_tok=2)
+    with pytest.raises(ValueError, match="ragged|divisible"):
         JaxEngine(
-            tiny_moe_config(),
-            init_params(tiny_moe_config(), jax.random.PRNGKey(0),
-                        dtype=jnp.float32),
+            odd,
+            init_params(odd, jax.random.PRNGKey(0), dtype=jnp.float32),
             _ecfg(enable_prefix_caching=False, max_prefill_tokens=256,
                   max_model_len=256),
             parallel=ParallelConfig(dp=2, sp=2, tp=2),
@@ -194,3 +196,42 @@ async def test_engine_sp_tp_composed():
     await par.shutdown()
 
     assert out_par == out_ref
+
+
+async def test_engine_sp_tp_moe():
+    """sp×tp MoE: ring-attention prefill over sp with EXPERTS sharded
+    over tp (ragged dispatch rotated to the local expert slice inside
+    the shard_map) — greedy equal to single-device."""
+    cfg = tiny_moe_config()  # 4 experts, ragged dispatch
+    params = init_params(cfg, jax.random.PRNGKey(6), dtype=jnp.float32)
+    prompts = _prompts(cfg, n=3)
+
+    def ecfg():
+        return _ecfg(
+            enable_prefix_caching=False,
+            max_prefill_tokens=256,
+            max_model_len=256,
+        )
+
+    ref = JaxEngine(cfg, params, ecfg(), kv_dtype=jnp.float32)
+    out_ref = await _collect(ref, prompts)
+    await ref.shutdown()
+
+    par = JaxEngine(
+        cfg, params, ecfg(), kv_dtype=jnp.float32,
+        parallel=ParallelConfig(dp=2, sp=2, tp=2),
+    )
+    out_par = await _collect(par, prompts)
+    await par.shutdown()
+
+    assert out_par == out_ref
+
+    # capacity-dispatch MoE stays rejected under sp×tp
+    import dataclasses
+
+    cap = dataclasses.replace(cfg, moe_impl="capacity")
+    with pytest.raises(ValueError, match="ragged"):
+        JaxEngine(
+            cap, params, ecfg(), kv_dtype=jnp.float32,
+            parallel=ParallelConfig(dp=2, sp=2, tp=2),
+        )
