@@ -161,6 +161,13 @@ def main():
     value = round(total / dt, 2)
     prev = previous_round_value()
     vs = round(value / prev, 3) if prev else 1.0
+    # hardware-utilization proxy: decode at small batch is bound by
+    # reading every weight once per step, so steps/s * param-bytes is
+    # the floor on HBM bandwidth actually sustained (bf16 weights)
+    from dynamo_tpu.models.config import LLAMA_3_2_1B
+
+    param_bytes = LLAMA_3_2_1B.num_params() * 2
+    steps_per_s = (total / BATCH) / dt
     print(json.dumps({
         "metric": "llama1b_serve_decode_throughput",
         "value": value,
@@ -169,6 +176,7 @@ def main():
         "ttft_p50_ms": round(ttft_p50 * 1000, 1),
         "itl_p50_ms": round(itl_p50 * 1000, 2),
         "int8_tok_s": round(int8_tps, 2),
+        "weight_read_gbps": round(param_bytes * steps_per_s / 1e9, 1),
         "prefix_cache_ttft_ms": {
             "cold": round(cold_ttft * 1000, 1),
             "warm": round(warm_ttft * 1000, 1),

@@ -385,16 +385,19 @@ class JaxEngine:
                         "sp > 1 does not support sliding-window/sink "
                         "attention models yet"
                     )
-                # the sp shard_map's param specs shard heads, the ffn dim
-                # AND the vocab over tp — catch uneven splits here with a
-                # clear message instead of an opaque shard_map shape error
-                # at first prefill
+                # the sp shard_map's param specs shard heads, the vocab,
+                # and (dense models) the ffn dim over tp — catch uneven
+                # splits here with a clear message instead of an opaque
+                # shard_map shape error at first prefill.  MoE shards the
+                # EXPERT dim instead (checked above), so its ffn width
+                # need not divide
                 uneven = {
                     "q heads": model_cfg.num_attention_heads,
                     "kv heads": model_cfg.num_key_value_heads,
                     "vocab_size": model_cfg.vocab_size,
-                    "intermediate_size": model_cfg.intermediate_size,
                 }
+                if not model_cfg.is_moe:
+                    uneven["intermediate_size"] = model_cfg.intermediate_size
                 bad_dims = [k for k, v in uneven.items() if v % parallel.tp]
                 if bad_dims:
                     raise ValueError(

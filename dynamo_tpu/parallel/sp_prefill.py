@@ -168,8 +168,12 @@ def _moe_ragged_ep(lp, x, cfg):
 
     exp_rolled = expert_of[rolled]
     local = (exp_rolled >= e0) & (exp_rolled < e0 + El)
-    wf = weights.reshape(A)[rolled].astype(jnp.float32) * local
-    out = jnp.zeros((T, h), jnp.float32).at[tok_rolled].add(ys * wf[:, None])
+    wf = weights.reshape(A)[rolled].astype(jnp.float32)
+    # where(), not multiply-by-zero: rows past sum(gs_local) are
+    # UNSPECIFIED ragged_dot output and may be non-finite on TPU —
+    # NaN * 0 would poison the scatter-add and spread via the psum
+    contrib = jnp.where(local[:, None], ys * wf[:, None], 0.0)
+    out = jnp.zeros((T, h), jnp.float32).at[tok_rolled].add(contrib)
     out = jax.lax.psum(out, "tp")
     return out.reshape(B, S, h).astype(x.dtype)
 
