@@ -80,16 +80,21 @@ async def main_async():
             max_model_len=PROMPT_LEN + GEN_TOKENS + 16,
             decode_batch_buckets=[BATCH],
             chunk_buckets=[PROMPT_LEN],
-            decode_steps=32,
+            # measured sweep on the tunneled chip (steps × chain):
+            # 32×4 1058, 64×2 1129, 16×8 961, 64×4 1179 tok/s — bigger
+            # blocks beat deeper chains once prefill→decode fusion
+            # removes the fetch barrier
+            decode_steps=64,
             decode_chain=4,  # chained dispatches hide the ~83ms axon RTT
             enable_prefix_caching=False,  # raw compute, not cache hits
             quantization=quant,
         )
 
-    async def median_of(engine, rounds=3):
-        """One measured round is ~0.6s and tunnel jitter is 5-10%; the
-        MEDIAN round is robust to one bad sample without inflating the
-        number the way a best-of would (prior rounds were single-round)."""
+    async def median_of(engine, rounds=5):
+        """One measured round is ~0.6s and the tunnel occasionally has
+        whole SLOW PHASES (±20%); the MEDIAN of five rounds is robust to
+        a couple of bad samples without inflating the number the way a
+        best-of would (prior rounds were single-round)."""
         await run_round(engine, seed_base=0)  # warmup compiles
         results = [
             await run_round(engine, seed_base=5000 + 999 * r)
