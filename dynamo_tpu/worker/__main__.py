@@ -36,6 +36,21 @@ def main() -> None:
     ap.add_argument("--max-model-len", type=int, default=4096)
     ap.add_argument("--dtype", default="bfloat16",
                     choices=["bfloat16", "float32"])
+    # engine tuning (mirrors EngineConfig; defaults match the dataclass
+    # so unchanged launch commands keep their behavior)
+    ap.add_argument("--quantization", default="none",
+                    choices=["none", "int8"],
+                    help="weight-only int8 halves decode's weight reads")
+    ap.add_argument("--attention-impl", default="auto",
+                    choices=["auto", "adaptive", "pallas", "xla"])
+    ap.add_argument("--decode-steps", type=int, default=1,
+                    help="tokens decoded per device dispatch (lax.scan); "
+                         "stops are applied after the block, so up to N-1 "
+                         "tokens past a stop are computed and discarded. "
+                         "Raise on remote-attached chips (bench.py sweep)")
+    ap.add_argument("--decode-chain", type=int, default=1,
+                    help="decode dispatches in flight before fetching")
+    ap.add_argument("--no-prefix-caching", action="store_true")
     ap.add_argument("--disagg-role", default="both",
                     choices=["both", "prefill", "decode"])
     # distributed KVBM: shared host/disk/object-store KV tiers
@@ -92,6 +107,12 @@ def main() -> None:
         ap.error(str(e))
     if args.kvbm and getattr(args, "mock", False):
         ap.error("--kvbm requires a real JAX engine (incompatible with --mock)")
+    if args.mock and (args.quantization != "none"
+                      or args.attention_impl != "auto"
+                      or args.decode_steps != 1 or args.decode_chain != 1
+                      or args.no_prefix_caching or args.vision):
+        ap.error("engine-tuning/vision flags require a real JAX engine "
+                 "(incompatible with --mock)")
     if args.dp_ranks > 1:
         # DpRankEngine serves the plain generate/embed surface only; the
         # disagg handlers, KVBM worker, mock branch, and multihost
@@ -272,6 +293,11 @@ def _build_engine(args):
         max_num_seqs=args.max_num_seqs,
         max_prefill_tokens=args.max_prefill_tokens,
         max_model_len=args.max_model_len,
+        quantization=args.quantization,
+        attention_impl=args.attention_impl,
+        decode_steps=args.decode_steps,
+        decode_chain=args.decode_chain,
+        enable_prefix_caching=not args.no_prefix_caching,
     )
     if args.mock:
         from ..mocker import MockEngine, MockEngineArgs

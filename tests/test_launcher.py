@@ -7,6 +7,7 @@ import os
 import signal
 import subprocess
 import sys
+import time
 
 import pytest
 import yaml
@@ -159,3 +160,93 @@ async def test_standalone_router_service():
         for rt in rts:
             await rt.shutdown(graceful=False)
         await control.stop()
+
+
+@pytest.mark.timeout(300)
+def test_worker_cli_engine_tuning_flags():
+    """The engine-tuning CLI surface (--quantization int8,
+    --attention-impl, --decode-steps/-chain, --no-prefix-caching) must
+    build a serving worker that answers requests — the int8 path is
+    otherwise unreachable from the CLIs."""
+    import socket as _socket
+    import threading
+    import urllib.request
+
+    def free_port():
+        s = _socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    cp_port = free_port()
+    http_port = free_port()
+    procs = []
+    logs = {}
+
+    def spawn(args):
+        p = subprocess.Popen(
+            [sys.executable, "-u", *args],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=ENV, cwd=ROOT,
+        )
+        procs.append(p)
+        buf = logs.setdefault(args[1], [])
+        for line in p.stdout:
+            buf.append(line)
+            if "READY" in line:
+                break
+        else:
+            raise AssertionError(f"{args} exited without READY:\n{''.join(buf)}")
+        # keep draining so a chatty child can't fill the pipe and wedge
+        threading.Thread(
+            target=lambda: [buf.append(l) for l in p.stdout], daemon=True
+        ).start()
+        return p
+
+    try:
+        spawn(["-m", "dynamo_tpu.runtime", "--port", str(cp_port),
+               "--host", "127.0.0.1"])
+        control = f"127.0.0.1:{cp_port}"
+        spawn(["-m", "dynamo_tpu.worker", "--control", control,
+               "--model", "tiny", "--dtype", "float32", "--platform", "cpu",
+               "--page-size", "8", "--num-pages", "96",
+               "--max-prefill-tokens", "64", "--max-model-len", "128",
+               "--quantization", "int8", "--attention-impl", "xla",
+               "--decode-steps", "4", "--decode-chain", "2",
+               "--no-prefix-caching"])
+        spawn(["-m", "dynamo_tpu.frontend", "--control", control,
+               "--host", "127.0.0.1", "--port", str(http_port)])
+        body = json.dumps({
+            "model": "tiny-chat",
+            "messages": [{"role": "user", "content": "hello"}],
+            "max_tokens": 6, "temperature": 0, "nvext": {"ignore_eos": True},
+        }).encode()
+        deadline = time.time() + 60
+        last_err = None
+        while True:
+            try:
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{http_port}/v1/chat/completions",
+                    body, {"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(req, timeout=60) as r:
+                    out = json.load(r)
+                break
+            except Exception as e:  # noqa: BLE001 — may still be registering
+                last_err = e
+                assert time.time() < deadline, (
+                    f"no successful response before deadline; last error: "
+                    f"{last_err!r}\nworker log tail:\n"
+                    + "".join(logs.get("dynamo_tpu.worker", [])[-30:])
+                )
+                time.sleep(0.5)
+        assert out["usage"]["completion_tokens"] == 6
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
