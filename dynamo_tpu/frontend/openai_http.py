@@ -127,30 +127,40 @@ class HttpService:
         self.audit = audit if audit is not None else AuditBus.from_env()
         self.app = web.Application()
         # per-route enable flags (reference service_v2.rs per-route
-        # builder flags); health/live/metrics/models always serve
+        # builder flags); health/live/metrics/models always serve.
+        # ONE table drives both route registration and the OpenAPI doc
+        # so the two can never drift.
         optional = {
-            "chat": web.post("/v1/chat/completions", self.chat_completions),
-            "completions": web.post("/v1/completions", self.completions),
-            "embeddings": web.post("/v1/embeddings", self.embeddings),
-            "responses": web.post("/v1/responses", self.responses),
+            "chat": ("/v1/chat/completions", self.chat_completions,
+                     "OpenAI chat completion (set 'stream' for SSE)"),
+            "completions": ("/v1/completions", self.completions,
+                            "OpenAI legacy completion"),
+            "embeddings": ("/v1/embeddings", self.embeddings,
+                           "OpenAI embeddings"),
+            "responses": ("/v1/responses", self.responses,
+                          "OpenAI responses"),
         }
         if enabled_routes is not None:
             unknown = set(enabled_routes) - set(optional)
             if unknown:
                 raise ValueError(f"unknown routes {sorted(unknown)}; "
                                  f"known: {sorted(optional)}")
-        routes = [
-            r for name, r in optional.items()
+        enabled = {
+            name: spec for name, spec in optional.items()
             if enabled_routes is None or name in enabled_routes
-        ]
+        }
+        routes = [web.post(path, handler)
+                  for path, handler, _ in enabled.values()]
         routes += [
             web.get("/v1/models", self.list_models),
             web.get("/health", self.health),
             web.get("/live", self.live),
             web.get("/metrics", self.prometheus),
+            web.get("/openapi.json", self.openapi),
             web.post("/clear_kv_blocks", self.clear_kv_blocks),
         ]
         self.app.add_routes(routes)
+        self._openapi_doc = self._build_openapi(enabled)
         self._runner: Optional[web.AppRunner] = None
 
     # -- lifecycle ----------------------------------------------------------- #
@@ -181,6 +191,47 @@ class HttpService:
 
     async def live(self, request: web.Request) -> web.Response:
         return web.json_response({"status": "live"})
+
+    @staticmethod
+    def _build_openapi(enabled: dict) -> dict:
+        """OpenAPI 3.1 description of the ENABLED surface (reference:
+        http/service/openapi_docs.rs), built once from the same table
+        that registered the routes so the document always matches what
+        this process actually serves."""
+        paths = {}
+        for path, _handler, summary in enabled.values():
+            paths[path] = {"post": {
+                "summary": summary,
+                "requestBody": {"content": {"application/json": {
+                    "schema": {"type": "object"}}}},
+                "responses": {"200": {"description": "completion"},
+                              "400": {"description": "invalid request"},
+                              "404": {"description": "unknown model"},
+                              "503": {"description": "all workers busy"}},
+            }}
+        for path, summary in [
+            ("/v1/models", "list served models"),
+            ("/health", "aggregate health"),
+            ("/live", "liveness"),
+            ("/metrics", "Prometheus exposition"),
+            ("/openapi.json", "this document"),
+        ]:
+            paths[path] = {"get": {
+                "summary": summary,
+                "responses": {"200": {"description": "ok"}},
+            }}
+        paths["/clear_kv_blocks"] = {"post": {
+            "summary": "evict every model's cached KV blocks",
+            "responses": {"200": {"description": "pages cleared per model"}},
+        }}
+        return {
+            "openapi": "3.1.0",
+            "info": {"title": "dynamo_tpu frontend", "version": "0.1"},
+            "paths": paths,
+        }
+
+    async def openapi(self, request: web.Request) -> web.Response:
+        return web.json_response(self._openapi_doc)
 
     async def prometheus(self, request: web.Request) -> web.Response:
         return web.Response(

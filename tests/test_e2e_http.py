@@ -304,3 +304,35 @@ async def test_mixed_models_on_shared_component_route_correctly(model_setup):
         for rt in (front_rt, rt_a, rt_b):
             await rt.shutdown(graceful=False)
         await control.stop()
+
+
+async def test_openapi_document_matches_enabled_routes(model_setup):
+    """/openapi.json describes exactly the surface this process serves:
+    per-route enable flags prune the disabled paths (reference
+    openapi_docs.rs)."""
+    control, worker_rt, front_rt, engine, watcher, http = await start_stack(model_setup)
+    limited = await HttpService(
+        ModelManager(), host="127.0.0.1", port=0, enabled_routes={"chat"},
+    ).start()
+    try:
+        async with aiohttp.ClientSession() as session:
+            async with session.get(
+                f"http://127.0.0.1:{http.port}/openapi.json"
+            ) as r:
+                assert r.status == 200
+                doc = await r.json()
+            assert doc["openapi"].startswith("3.")
+            assert "/v1/chat/completions" in doc["paths"]
+            assert "/v1/embeddings" in doc["paths"]
+            assert "/v1/models" in doc["paths"]
+
+            async with session.get(
+                f"http://127.0.0.1:{limited.port}/openapi.json"
+            ) as r:
+                slim = await r.json()
+            assert "/v1/chat/completions" in slim["paths"]
+            assert "/v1/embeddings" not in slim["paths"]
+            assert "/v1/completions" not in slim["paths"]
+    finally:
+        await limited.stop()
+        await stop_stack(control, worker_rt, front_rt, engine, watcher, http)
