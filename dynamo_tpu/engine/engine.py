@@ -1154,12 +1154,24 @@ class JaxEngine:
         else:
             counts = None
         if self._multihost:
+            # penalized plans carry the output tokens SPARSELY (flat list +
+            # row offsets) — broadcasting the dense [B, vocab] histogram
+            # would put ~4MB/step on the plan channel at a 128k vocab
+            sparse = None
+            if penalized:
+                flat, offs = [], [0]
+                for i in range(Bb):
+                    if i < len(seqs):
+                        flat.extend(seqs[i].output_tokens)
+                    offs.append(len(flat))
+                sparse = [np.asarray(flat, np.int32),
+                          np.asarray(offs, np.int64)]
             self._lockstep_send({
                 "kind": "decode", "penalized": penalized,
                 "with_top": with_top, "chain_len": chain_len,
                 "arrays": [tokens, positions, counters, table,
                            *[np.asarray(a) for a in samp], seeds],
-                "counts": counts,
+                "counts_sparse": sparse,
             })
         dispatches = self._dispatch_decode(
             tokens, positions, counters, counts, table, samp, seeds,
@@ -1257,8 +1269,19 @@ class JaxEngine:
                     )
                 elif kind == "decode":
                     a = desc["arrays"]
+                    counts = None
+                    if desc.get("counts_sparse") is not None:
+                        flat, offs = desc["counts_sparse"]
+                        counts = np.zeros(
+                            (a[0].shape[0], self.model_cfg.vocab_size),
+                            np.float32,
+                        )
+                        for i in range(counts.shape[0]):
+                            np.add.at(
+                                counts[i], flat[offs[i]:offs[i + 1]], 1.0
+                            )
                     self._dispatch_decode(
-                        a[0], a[1], a[2], desc["counts"], a[3],
+                        a[0], a[1], a[2], counts, a[3],
                         SamplingParams(*a[4:4 + samp_n]), a[4 + samp_n],
                         desc["penalized"], desc["with_top"],
                         desc["chain_len"],

@@ -78,13 +78,26 @@ def load_params(path: str, cfg: ModelConfig, dtype=jnp.bfloat16):
         "attn_norm": stack(p + "input_layernorm.weight", transpose=False),
         "mlp_norm": stack(p + "post_attention_layernorm.weight", transpose=False),
     }
-    if r.has("model.layers.0.self_attn.q_proj.bias"):  # qwen2-style
+    if cfg.attention_bias:  # qwen2-style — gate on the CONFIG so the
+        # param tree always matches param_pspecs/init_params (a checkpoint/
+        # config mismatch must be a load error, not a tp tree-map error)
+        if not r.has("model.layers.0.self_attn.q_proj.bias"):
+            raise ValueError(
+                "config declares attention_bias but the checkpoint has "
+                "no self_attn.*_proj.bias tensors"
+            )
         layers.update(
             {
                 "bq": stack(p + "self_attn.q_proj.bias", transpose=False),
                 "bk": stack(p + "self_attn.k_proj.bias", transpose=False),
                 "bv": stack(p + "self_attn.v_proj.bias", transpose=False),
             }
+        )
+    elif r.has("model.layers.0.self_attn.q_proj.bias"):
+        raise ValueError(
+            "checkpoint has self_attn.*_proj.bias tensors but the config "
+            "does not declare attention_bias — refusing to silently drop "
+            "them"
         )
     if cfg.attention_sinks:  # gpt-oss sink logits — gate on the CONFIG
         # (like every other consumer) so params and cfg cannot disagree

@@ -253,11 +253,20 @@ class KvRouter:
         await self.client.wait_for_instances(timeout=5.0)
         workers = self._live_workers()
         if allowed:
-            scoped = {
+            workers = {
                 wid: st for wid, st in workers.items()
                 if unpack_worker(wid)[0] in allowed
             }
-            workers = scoped or workers  # card watcher may lag briefly
+            if not workers:
+                # NOT a fallback to every worker: unscoped workers on a
+                # shared endpoint may serve a different model — routing
+                # there would return wrong-model completions
+                from ..runtime.client import ServiceUnavailable
+
+                raise ServiceUnavailable(
+                    f"no live worker among the {len(allowed)} instances "
+                    "serving this model"
+                )
         if self.busy_threshold > 0:
             free = {
                 wid: st for wid, st in workers.items()

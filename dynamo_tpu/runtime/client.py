@@ -111,12 +111,17 @@ class Client:
         """Live instances, optionally restricted to an id set (several
         models can share one endpoint; a model's requests must only
         reach instances that serve it).  An allowed set with no live
-        member falls back to every instance — the caller's view (card
-        watcher) may briefly lag this client's endpoint watch."""
+        member is a 503, NOT a fallback to every instance — other
+        instances on the endpoint may serve a different model, and
+        routing there would return wrong-model completions."""
         insts = self.instances()
         if allowed:
-            scoped = [i for i in insts if i.instance_id in allowed]
-            insts = scoped or insts
+            insts = [i for i in insts if i.instance_id in allowed]
+            if not insts:
+                raise ServiceUnavailable(
+                    f"no live instance among the {len(allowed)} allowed for "
+                    f"{self.endpoint.wire_name}"
+                )
         if not insts:
             raise ServiceUnavailable(f"no instances for {self.endpoint.wire_name}")
         return insts

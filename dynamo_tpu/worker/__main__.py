@@ -381,6 +381,17 @@ def _build_engine(args):
             image_patches=vcfg.num_patches,
             image_size=vcfg.image_size,
         )
+    if args.dp_ranks > 1 and ecfg.quantization == "int8":
+        # quantize ONCE before constructing replicas: each JaxEngine would
+        # otherwise quantize independently, materializing dp_ranks distinct
+        # weight copies in HBM instead of sharing one
+        import dataclasses as _dc
+
+        from ..models.quantization import quantize_params
+
+        params = quantize_params(params)
+        ecfg = _dc.replace(ecfg, quantization="none")
+
     def make_engine():
         return JaxEngine(cfg, params, ecfg, eos_token_ids=eos,
                          kv_dtype=dtype, parallel=parallel, vision=vision)

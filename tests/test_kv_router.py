@@ -336,9 +336,13 @@ async def test_busy_shed_returns_503_through_http():
             async with session.post(f"{base}/v1/chat/completions", json=body) as r:
                 assert r.status == 200
 
-            # saturate: the router sees only a busy worker
-            busy = {wid: WorkerState(worker_id=wid, kv_usage=0.99,
-                                     kv_total_pages=127)}
+            # saturate: the router sees only a busy worker (keyed by the
+            # PACKED (instance, dp_rank) id like real worker_states)
+            from dynamo_tpu.router.worker_key import pack_worker
+
+            pw = pack_worker(wid)
+            busy = {pw: WorkerState(worker_id=pw, kv_usage=0.99,
+                                    kv_total_pages=127)}
             entry.kv_chooser._live_workers = lambda: busy
             async with session.post(f"{base}/v1/chat/completions", json=body) as r:
                 assert r.status == 503, await r.text()

@@ -196,8 +196,13 @@ if rank == 0:
         outs = []
         for i in range(3):
             p = [(i * 13 + j) % cfg.vocab_size for j in range(5 + 3 * i)]
+            # request 1 is penalized: exercises the sparse counts
+            # broadcast + follower-side histogram rebuild
+            so = {"temperature": 0.0}
+            if i == 1:
+                so["frequency_penalty"] = 0.7
             req = {"token_ids": p,
-                   "sampling_options": {"temperature": 0.0},
+                   "sampling_options": so,
                    "stop_conditions": {"max_tokens": 6, "ignore_eos": True}}
             toks = []
             async for out in engine.generate(req):
@@ -233,8 +238,11 @@ async def run():
     outs = []
     for i in range(3):
         p = [(i * 13 + j) % cfg.vocab_size for j in range(5 + 3 * i)]
+        so = {"temperature": 0.0}
+        if i == 1:
+            so["frequency_penalty"] = 0.7
         req = {"token_ids": p,
-               "sampling_options": {"temperature": 0.0},
+               "sampling_options": so,
                "stop_conditions": {"max_tokens": 6, "ignore_eos": True}}
         toks = []
         async for out in engine.generate(req):
