@@ -42,6 +42,15 @@ class EngineConfig:
     # eligible (chunking mid-prompt, penalties, multihost, pool pressure)
     fuse_prefill_decode: bool = True
 
+    # mixed scheduling: when running decodes coexist with pending
+    # prefills, ONE dispatch runs a bounded prefill chunk AND the decode
+    # scan (vLLM chunked-prefill interleave; reference mocker watermark
+    # scheduler, scheduler.rs:240).  Decodes never stall behind a
+    # prompt's full prefill, so ITL stays flat under concurrent load.
+    # Token budget for the prefill side of a mixed dispatch; None →
+    # max_prefill_tokens, 0 disables mixing (prefill-first scheduling)
+    mixed_prefill_tokens: Optional[int] = None
+
     enable_prefix_caching: bool = True
     block_hash_salt: str = ""
 
@@ -60,6 +69,8 @@ class EngineConfig:
     table_width_buckets: Optional[Sequence[int]] = None
 
     def __post_init__(self):
+        if self.mixed_prefill_tokens is None:
+            self.mixed_prefill_tokens = self.max_prefill_tokens
         if self.quantization not in ("none", "int8"):
             raise ValueError(
                 f"quantization must be none|int8, got {self.quantization!r}"

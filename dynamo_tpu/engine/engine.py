@@ -181,6 +181,64 @@ def _build_import_fn():
     return imp
 
 
+def _make_decode_scan(cfg: ModelConfig, n_steps: int, max_valid_pos: int,
+                      penalized: bool, with_top: bool, attn_impl: str):
+    """The traced decode-block body shared by the pure decode step and the
+    mixed (prefill+decode) step: scans `n_steps` forward+sample steps,
+    returning per-step packed outputs plus the carries."""
+    def body_common(kv, tok, pos, ctr, counts, page_table, samp, seeds, params):
+        ok = pos < max_valid_pos
+        safe_pos = jnp.where(ok, pos, 0)
+        # out-of-window rows use an all-trash table row
+        table = jnp.where(ok[:, None], page_table, 0)
+        logits, kv = forward_decode(
+            params, cfg, kv, tok, safe_pos, table, attn_impl=attn_impl
+        )
+        if penalized:
+            logits = apply_penalties(
+                logits, counts, samp.frequency_penalty, samp.presence_penalty
+            )
+        out = sample_tokens(logits, samp, seeds, ctr)
+        if penalized:
+            counts = counts.at[jnp.arange(out.shape[0]), out].add(1.0)
+        logp = compute_logprobs(logits, out)
+        packed = _pack_out(out, logp, logits if with_top else None)
+        return kv, out, counts, packed
+
+    if penalized:
+        def scan(params, kv, tokens, positions, counters, counts,
+                 page_table, samp, seeds):
+            def body(carry, _):
+                kv, tok, pos, ctr, cts = carry
+                kv, out, cts, packed = body_common(
+                    kv, tok, pos, ctr, cts, page_table, samp, seeds, params
+                )
+                return (kv, out, pos + 1, ctr + 1, cts), packed
+
+            (kv, tok, pos, ctr, cts), packed = jax.lax.scan(
+                body, (kv, tokens, positions, counters, counts),
+                None, length=n_steps,
+            )
+            return packed, tok, pos, ctr, cts, kv
+    else:
+        def scan(params, kv, tokens, positions, counters, counts,
+                 page_table, samp, seeds):
+            del counts
+            def body(carry, _):
+                kv, tok, pos, ctr = carry
+                kv, out, _, packed = body_common(
+                    kv, tok, pos, ctr, None, page_table, samp, seeds, params
+                )
+                return (kv, out, pos + 1, ctr + 1), packed
+
+            (kv, tok, pos, ctr), packed = jax.lax.scan(
+                body, (kv, tokens, positions, counters), None, length=n_steps
+            )
+            return packed, tok, pos, ctr, kv
+
+    return scan
+
+
 def _build_decode_step(cfg: ModelConfig, n_steps: int, max_valid_pos: int,
                        penalized: bool = False, with_top: bool = False,
                        attn_impl: str = "xla", lockstep_mesh=None):
@@ -201,25 +259,8 @@ def _build_decode_step(cfg: ModelConfig, n_steps: int, max_valid_pos: int,
     [B, V] output-token count array through the scan for frequency/
     presence penalties; `with_top` packs top-TOPLP logprobs per step.
     """
-    def body_common(kv, tok, pos, ctr, counts, page_table, samp, seeds, params):
-        ok = pos < max_valid_pos
-        safe_pos = jnp.where(ok, pos, 0)
-        # out-of-window rows use an all-trash table row
-        table = jnp.where(ok[:, None], page_table, 0)
-        logits, kv = forward_decode(
-            params, cfg, kv, tok, safe_pos, table, attn_impl=attn_impl
-        )
-        if penalized:
-            logits = apply_penalties(
-                logits, counts, samp.frequency_penalty, samp.presence_penalty
-            )
-        out = sample_tokens(logits, samp, seeds, ctr)
-        if penalized:
-            counts = counts.at[jnp.arange(out.shape[0]), out].add(1.0)
-        logp = compute_logprobs(logits, out)
-        packed = _pack_out(out, logp, logits if with_top else None)
-        return kv, out, counts, packed
-
+    run = _make_decode_scan(cfg, n_steps, max_valid_pos, penalized,
+                            with_top, attn_impl)
     dp = P("dp")
     if penalized:
         kw = ({"out_shardings": _lockstep_out_shardings(
@@ -229,18 +270,8 @@ def _build_decode_step(cfg: ModelConfig, n_steps: int, max_valid_pos: int,
         @partial(jax.jit, donate_argnums=(1, 5), **kw)
         def step(params, kv, tokens, positions, counters, counts,
                  page_table, samp, seeds):
-            def body(carry, _):
-                kv, tok, pos, ctr, cts = carry
-                kv, out, cts, packed = body_common(
-                    kv, tok, pos, ctr, cts, page_table, samp, seeds, params
-                )
-                return (kv, out, pos + 1, ctr + 1, cts), packed
-
-            (kv, tok, pos, ctr, cts), packed = jax.lax.scan(
-                body, (kv, tokens, positions, counters, counts),
-                None, length=n_steps,
-            )
-            return packed, tok, pos, ctr, cts, kv
+            return run(params, kv, tokens, positions, counters, counts,
+                       page_table, samp, seeds)
     else:
         kw = ({"out_shardings": _lockstep_out_shardings(
             lockstep_mesh, dp, dp, dp)}
@@ -248,17 +279,45 @@ def _build_decode_step(cfg: ModelConfig, n_steps: int, max_valid_pos: int,
 
         @partial(jax.jit, donate_argnums=(1,), **kw)
         def step(params, kv, tokens, positions, counters, page_table, samp, seeds):
-            def body(carry, _):
-                kv, tok, pos, ctr = carry
-                kv, out, _, packed = body_common(
-                    kv, tok, pos, ctr, None, page_table, samp, seeds, params
-                )
-                return (kv, out, pos + 1, ctr + 1), packed
+            return run(params, kv, tokens, positions, counters, None,
+                       page_table, samp, seeds)
 
-            (kv, tok, pos, ctr), packed = jax.lax.scan(
-                body, (kv, tokens, positions, counters), None, length=n_steps
-            )
-            return packed, tok, pos, ctr, kv
+    return step
+
+
+def _build_mixed_step(cfg: ModelConfig, n_steps: int, max_valid_pos: int,
+                      penalized: bool = False, with_top: bool = False,
+                      attn_impl: str = "xla", lockstep_mesh=None):
+    """One dispatch = one bounded prefill chunk + one decode block
+    (chunked-prefill interleave, the TPU form: both forwards live in one
+    XLA program, so running decodes pay zero extra host round-trips for
+    a concurrent prompt's prefill — reference behavior: vLLM mixed
+    batches / mocker watermark scheduler, scheduler.rs:240).
+
+    The prefill side runs first (its page writes are disjoint from the
+    decode rows'), then the decode scan; both packed outputs return in
+    one fetch."""
+    run = _make_decode_scan(cfg, n_steps, max_valid_pos, penalized,
+                            with_top, attn_impl)
+    kw = ({"out_shardings": _lockstep_out_shardings(lockstep_mesh, P())}
+          if lockstep_mesh is not None else {})
+
+    @partial(jax.jit, donate_argnums=(1,), **kw)
+    def step(params, kv,
+             p_tokens, p_table, p_prefix, p_chunk, p_samp, p_seeds, p_ctr,
+             d_tokens, d_pos, d_ctr, d_counts, d_table, d_samp, d_seeds):
+        logits, kv = forward_prefill(
+            params, cfg, kv, p_tokens, p_table, p_prefix, p_chunk,
+            attn_impl=attn_impl,
+        )
+        p_out = sample_tokens(logits, p_samp, p_seeds, p_ctr)
+        p_logp = compute_logprobs(logits, p_out)
+        p_packed = _pack_out(p_out, p_logp, logits if with_top else None)
+        d_packed, *_, kv = run(
+            params, kv, d_tokens, d_pos, d_ctr, d_counts, d_table,
+            d_samp, d_seeds,
+        )
+        return p_packed, d_packed, kv
 
     return step
 
@@ -354,7 +413,11 @@ class JaxEngine:
             self._sp = parallel.sp
             if self._sp > 1:
                 # sp prefill is whole-prompt ring attention: no cached
-                # prefixes, no chunking, buckets divisible by sp
+                # prefixes, no chunking (mixed dispatches would chunk),
+                # buckets divisible by sp
+                self.cfg = dataclasses.replace(
+                    self.cfg, mixed_prefill_tokens=0
+                )
                 if self.cfg.enable_prefix_caching:
                     raise ValueError(
                         "sp > 1 requires enable_prefix_caching=False "
@@ -442,6 +505,7 @@ class JaxEngine:
         # with_top for prefill
         self._prefill_steps: Dict[bool, Callable] = {}
         self._decode_steps: Dict[tuple, Callable] = {}
+        self._mixed_steps: Dict[tuple, Callable] = {}
         self._export_fn = _build_export_fn()
         self._import_fn = _build_import_fn()
         # device ops queued by the loop thread, executed by the pump between
@@ -601,6 +665,17 @@ class JaxEngine:
                 lockstep_mesh=self.mesh if self._multihost else None,
             )
         return self._decode_steps[key]
+
+    def _get_mixed_step(self, penalized: bool, with_top: bool):
+        key = (penalized, with_top)
+        if key not in self._mixed_steps:
+            self._mixed_steps[key] = _build_mixed_step(
+                self.model_cfg, self.cfg.decode_steps, self.cfg.hard_cap,
+                penalized=penalized, with_top=with_top,
+                attn_impl=self._attn_impl,
+                lockstep_mesh=self.mesh if self._multihost else None,
+            )
+        return self._mixed_steps[key]
 
     # -- events -------------------------------------------------------------- #
 
@@ -799,6 +874,8 @@ class JaxEngine:
             try:
                 if plan.kind == "prefill":
                     await loop.run_in_executor(None, self._run_prefill, plan.prefill)
+                elif plan.kind == "mixed":
+                    await loop.run_in_executor(None, self._run_mixed, plan)
                 else:
                     await loop.run_in_executor(None, self._run_decode, plan.decode)
             except Exception:  # noqa: BLE001
@@ -840,22 +917,56 @@ class JaxEngine:
             [s.opts.presence_penalty for s in seqs] + [0.0] * pad,
         )
 
-    def _run_prefill(self, items: List[PrefillItem]) -> None:
-        B = self._pad_batch(len(items))
+    def _prefill_arrays(self, items: List[PrefillItem], B: int):
+        """(tokens [B, chunk_bucket], prefix [B], chunk [B]) for a prefill
+        batch.  dp-pad rows run a 1-token chunk into the trash page (a
+        fully masked row would softmax over -inf only)."""
         chunk_bucket = bucket_for(
             max(it.chunk_len for it in items), self.cfg.chunk_buckets
         )
         tokens = np.zeros((B, chunk_bucket), np.int32)
         prefix = np.zeros((B,), np.int32)
-        # dp-pad rows run a 1-token chunk into the trash page (a fully
-        # masked row would softmax over -inf only)
         chunk = np.ones((B,), np.int32)
         for i, it in enumerate(items):
-            s = it.seq
-            toks = s.prompt[it.chunk_start : it.chunk_start + it.chunk_len]
+            toks = it.seq.prompt[it.chunk_start : it.chunk_start + it.chunk_len]
             tokens[i, : len(toks)] = toks
             prefix[i] = it.chunk_start
             chunk[i] = it.chunk_len
+        return tokens, prefix, chunk, chunk_bucket
+
+    def _decode_arrays(self, seqs: List[Sequence], Bb: int):
+        """(last tokens [Bb], positions [Bb]) for a decode batch."""
+        tokens = np.zeros((Bb,), np.int32)
+        positions = np.zeros((Bb,), np.int32)
+        for i, s in enumerate(seqs):
+            tokens[i] = s.output_tokens[-1] if s.output_tokens else (
+                s.prompt[-1] if s.prompt else 0
+            )
+            positions[i] = s.num_computed
+        return tokens, positions
+
+    def _counts_array(self, seqs: List[Sequence], Bb: int) -> np.ndarray:
+        """Dense [Bb, vocab] output-token histograms (prompt tokens are
+        not penalized)."""
+        counts = np.zeros((Bb, self.model_cfg.vocab_size), np.float32)
+        for i, s in enumerate(seqs):
+            if s.output_tokens:
+                np.add.at(counts[i], s.output_tokens, 1.0)
+        return counts
+
+    def _encode_counts_sparse(self, seqs: List[Sequence], b: int):
+        """Sparse (flat token list + row offsets) form of `_counts_array`
+        for the lockstep plan channel (inverse: `_counts_from_sparse`)."""
+        flat, offs = [], [0]
+        for i in range(b):
+            if i < len(seqs):
+                flat.extend(seqs[i].output_tokens)
+            offs.append(len(flat))
+        return [np.asarray(flat, np.int32), np.asarray(offs, np.int64)]
+
+    def _run_prefill(self, items: List[PrefillItem]) -> None:
+        B = self._pad_batch(len(items))
+        tokens, prefix, chunk, chunk_bucket = self._prefill_arrays(items, B)
         seqs = [it.seq for it in items]
         if self._sp > 1 and prefix.any():
             # cannot happen with prefix caching off + whole-prompt chunks;
@@ -1000,6 +1111,91 @@ class JaxEngine:
                     if s.status != "running":
                         break  # stop hit mid-block; rest discarded
 
+    def _run_mixed(self, plan: StepPlan) -> None:
+        """One dispatch: bounded prefill chunk + decode block (the mixed
+        plan).  Decode rows' pages were reserved preemptively at planning;
+        prefill rows extended non-preemptively, so the two sides cannot
+        invalidate each other."""
+        items, dseqs = plan.prefill, plan.decode
+        # prefill side (same array construction as _run_prefill)
+        Bp = self._pad_batch(len(items))
+        p_tokens, p_prefix, p_chunk, _ = self._prefill_arrays(items, Bp)
+        pseqs = [it.seq for it in items]
+        p_table = self._table_array(pseqs, rows=Bp)
+        p_seeds, p_ctr = self._seed_arrays(pseqs, Bp)
+        p_samp = self._samp_arrays(pseqs, Bp)
+        # decode side (same as _run_decode, chain_len fixed at 1)
+        Bd = bucket_for(len(dseqs), self.cfg.decode_batch_buckets)
+        d_tokens, d_pos = self._decode_arrays(dseqs, Bd)
+        d_seeds, d_ctr = self._seed_arrays(dseqs, Bd)
+        d_table = self._table_array(dseqs, rows=Bd)
+        penalized = any(s.opts.penalized for s in dseqs)
+        with_top = any(
+            s.opts.top_logprobs > 0 for s in pseqs + dseqs
+        )
+        d_samp = self._samp_arrays(dseqs, Bd)
+        counts = self._counts_array(dseqs, Bd) if penalized else None
+        if self._multihost:
+            sparse = (self._encode_counts_sparse(dseqs, Bd)
+                      if penalized else None)
+            self._lockstep_send({
+                "kind": "mixed", "penalized": penalized,
+                "with_top": with_top,
+                "arrays": [p_tokens, p_table, p_prefix, p_chunk,
+                           *[np.asarray(a) for a in p_samp], p_seeds, p_ctr,
+                           d_tokens, d_pos, d_ctr, d_table,
+                           *[np.asarray(a) for a in d_samp], d_seeds],
+                "counts_sparse": sparse,
+            })
+        p_packed_d, d_packed_d = self._dispatch_mixed(
+            p_tokens, p_table, p_prefix, p_chunk, p_samp, p_seeds, p_ctr,
+            d_tokens, d_pos, d_ctr, counts, d_table, d_samp, d_seeds,
+            penalized, with_top,
+        )
+        # dispatch committed: account prefill chunks now (consume order
+        # below matches the device program: prefill first, then decode)
+        for it in items:
+            if it.seq.status == "running":
+                it.seq.num_computed += it.chunk_len
+        p_out, p_logp, p_tids, p_tlps = _unpack_out(
+            np.asarray(jax.device_get(p_packed_d)), Bp, with_top
+        )
+        for i, it in enumerate(items):
+            s = it.seq
+            if s.status != "running":
+                continue
+            self.scheduler.commit_full_pages(s)
+            if it.samples:
+                self._append_token(
+                    s, int(p_out[i]), float(p_logp[i]),
+                    _tops_for(s, p_tids, p_tlps, i),
+                )
+        self._consume_decode([d_packed_d], dseqs, Bd, with_top)
+
+    def _dispatch_mixed(self, p_tokens, p_table, p_prefix, p_chunk, p_samp,
+                        p_seeds, p_ctr, d_tokens, d_pos, d_ctr, d_counts,
+                        d_table, d_samp, d_seeds, penalized, with_top):
+        """Issue the jitted mixed step (identical on leader and followers);
+        returns the two packed device outputs."""
+        step = self._get_mixed_step(penalized, with_top)
+        cts_d = self._put(d_counts, "dp", None) if penalized else None
+        p_packed, d_packed, self.kv = step(
+            self.params, self.kv,
+            self._put(p_tokens, "dp", None), self._put(p_table, "dp", None),
+            self._put(p_prefix, "dp"), self._put(p_chunk, "dp"),
+            self._put_samp(p_samp), self._put(p_seeds, "dp"),
+            self._put(p_ctr, "dp"),
+            self._put(d_tokens, "dp"), self._put(d_pos, "dp"),
+            self._put(d_ctr, "dp"), cts_d, self._put(d_table, "dp", None),
+            self._put_samp(d_samp), self._put(d_seeds, "dp"),
+        )
+        for a in (p_packed, d_packed):
+            try:  # start both host copies; they ride back in fetch order
+                a.copy_to_host_async()
+            except Exception:  # noqa: BLE001 — sharded arrays may not support it
+                pass
+        return p_packed, d_packed
+
     def _attach_mm(self, seq, request) -> Optional[str]:
         """Validate + attach multimodal pixels to a sequence; returns an
         error string instead of raising (engine errors are streamed)."""
@@ -1132,40 +1328,20 @@ class JaxEngine:
                and self._chain_ok(seqs, chain_len, T, hard_cap)):
             chain_len += 1
         Bb = bucket_for(len(seqs), self.cfg.decode_batch_buckets)
-        tokens = np.zeros((Bb,), np.int32)
-        positions = np.zeros((Bb,), np.int32)
-        for i, s in enumerate(seqs):
-            tokens[i] = s.output_tokens[-1] if s.output_tokens else (
-                s.prompt[-1] if s.prompt else 0
-            )
-            positions[i] = s.num_computed
+        tokens, positions = self._decode_arrays(seqs, Bb)
         seeds, counters = self._seed_arrays(seqs, Bb)
         table = self._table_array(seqs, rows=Bb)
         penalized = any(s.opts.penalized for s in seqs)
         with_top = any(s.opts.top_logprobs > 0 for s in seqs)
         samp = self._samp_arrays(seqs, Bb)
-        if penalized:
-            # output-token histograms (prompt tokens are not penalized);
-            # updated on-device within and across chained blocks
-            counts = np.zeros((Bb, self.model_cfg.vocab_size), np.float32)
-            for i, s in enumerate(seqs):
-                if s.output_tokens:
-                    np.add.at(counts[i], s.output_tokens, 1.0)
-        else:
-            counts = None
+        # histograms updated on-device within and across chained blocks
+        counts = self._counts_array(seqs, Bb) if penalized else None
         if self._multihost:
             # penalized plans carry the output tokens SPARSELY (flat list +
             # row offsets) — broadcasting the dense [B, vocab] histogram
             # would put ~4MB/step on the plan channel at a 128k vocab
-            sparse = None
-            if penalized:
-                flat, offs = [], [0]
-                for i in range(Bb):
-                    if i < len(seqs):
-                        flat.extend(seqs[i].output_tokens)
-                    offs.append(len(flat))
-                sparse = [np.asarray(flat, np.int32),
-                          np.asarray(offs, np.int64)]
+            sparse = (self._encode_counts_sparse(seqs, Bb)
+                      if penalized else None)
             self._lockstep_send({
                 "kind": "decode", "penalized": penalized,
                 "with_top": with_top, "chain_len": chain_len,
@@ -1224,6 +1400,17 @@ class JaxEngine:
 
     # -- multihost lockstep --------------------------------------------------- #
 
+    def _counts_from_sparse(self, sparse, b: int):
+        """Rebuild the [B, vocab] penalty histogram a penalized plan
+        broadcasts sparsely (flat token list + row offsets)."""
+        if sparse is None:
+            return None
+        flat, offs = sparse
+        counts = np.zeros((b, self.model_cfg.vocab_size), np.float32)
+        for i in range(b):
+            np.add.at(counts[i], flat[offs[i]:offs[i + 1]], 1.0)
+        return counts
+
     def _lockstep_send(self, desc: Dict[str, Any]) -> None:
         from ..parallel.multihost import broadcast_plan
 
@@ -1269,22 +1456,30 @@ class JaxEngine:
                     )
                 elif kind == "decode":
                     a = desc["arrays"]
-                    counts = None
-                    if desc.get("counts_sparse") is not None:
-                        flat, offs = desc["counts_sparse"]
-                        counts = np.zeros(
-                            (a[0].shape[0], self.model_cfg.vocab_size),
-                            np.float32,
-                        )
-                        for i in range(counts.shape[0]):
-                            np.add.at(
-                                counts[i], flat[offs[i]:offs[i + 1]], 1.0
-                            )
+                    counts = self._counts_from_sparse(
+                        desc.get("counts_sparse"), a[0].shape[0]
+                    )
                     self._dispatch_decode(
                         a[0], a[1], a[2], counts, a[3],
                         SamplingParams(*a[4:4 + samp_n]), a[4 + samp_n],
                         desc["penalized"], desc["with_top"],
                         desc["chain_len"],
+                    )
+                elif kind == "mixed":
+                    a = desc["arrays"]
+                    i = 4
+                    p_samp = SamplingParams(*a[i:i + samp_n]); i += samp_n
+                    p_seeds, p_ctr = a[i], a[i + 1]; i += 2
+                    d_tokens, d_pos, d_ctr, d_table = a[i:i + 4]; i += 4
+                    d_samp = SamplingParams(*a[i:i + samp_n]); i += samp_n
+                    d_seeds = a[i]
+                    counts = self._counts_from_sparse(
+                        desc.get("counts_sparse"), d_tokens.shape[0]
+                    )
+                    self._dispatch_mixed(
+                        a[0], a[1], a[2], a[3], p_samp, p_seeds, p_ctr,
+                        d_tokens, d_pos, d_ctr, counts, d_table, d_samp,
+                        d_seeds, desc["penalized"], desc["with_top"],
                     )
             except Exception:  # noqa: BLE001
                 logger.exception(

@@ -108,7 +108,7 @@ class PrefillItem:
 
 @dataclass
 class StepPlan:
-    kind: str  # "prefill" | "decode" | "idle"
+    kind: str  # "prefill" | "decode" | "mixed" | "idle"
     prefill: List[PrefillItem] = field(default_factory=list)
     decode: List[Sequence] = field(default_factory=list)
 
@@ -211,8 +211,42 @@ class Scheduler:
         if not self.running:
             return StepPlan("idle")
 
-        # prefill pass (iterate a copy: _ensure_pages may preempt members)
-        budget = self.cfg.max_prefill_tokens
+        # mixed scheduling: when decodes are already running AND prompts
+        # are pending, plan BOTH into one dispatch — decodes keep their
+        # ITL, the prefill side advances by a bounded chunk budget.
+        # Decode rows get page priority (preemptive); the mixed prefill
+        # side allocates non-preemptively (it must not invalidate a
+        # decode row planned into the same dispatch).  Multimodal
+        # prompts take the pure-prefill path (their embed injection
+        # arrays only exist there).
+        has_pending_prefill = any(
+            not s.prefill_done for s in self.running
+        )
+        mixed_budget = self.cfg.mixed_prefill_tokens
+        if has_pending_prefill and mixed_budget > 0 and any(
+            s.prefill_done for s in self.running
+        ) and not any(
+            s.mm_embeds is not None or s.mm_pixels is not None
+            for s in self.running if not s.prefill_done
+        ):
+            decodable = self._plan_decode()
+            if decodable:
+                items = self._plan_prefill(mixed_budget, preempt=False)
+                if items:
+                    return StepPlan("mixed", prefill=items, decode=decodable)
+                return StepPlan("decode", decode=decodable)
+
+        items = self._plan_prefill(self.cfg.max_prefill_tokens, preempt=True)
+        if items:
+            return StepPlan("prefill", prefill=items)
+        decodable = self._plan_decode()
+        if decodable:
+            return StepPlan("decode", decode=decodable)
+        return StepPlan("idle")
+
+    def _plan_prefill(self, budget: int, preempt: bool) -> List[PrefillItem]:
+        """Plan prefill chunks under a token budget (iterate a copy:
+        preemptive page growth may preempt members)."""
         items: List[PrefillItem] = []
         for seq in list(self.running):
             if seq.prefill_done or budget <= 0:
@@ -220,8 +254,26 @@ class Scheduler:
             if len(items) >= self.cfg.prefill_batch_size:
                 break
             chunk = min(seq.prompt_len - seq.num_computed, budget)
-            if not self._ensure_pages(seq, seq.num_computed + chunk):
-                continue  # seq may have been preempted
+            if preempt:
+                if not self._ensure_pages(seq, seq.num_computed + chunk):
+                    continue  # seq may have been preempted/errored
+            else:
+                need = seq.pages_needed(
+                    seq.num_computed + chunk, self.cfg.page_size
+                ) - len(seq.pages)
+                if seq.preemptions >= 2:
+                    # anti-thrash: a sequence decode growth has evicted
+                    # twice only re-prefills with real headroom (enough
+                    # pages that the running decodes' next growth will
+                    # not immediately evict it again)
+                    n_decoding = sum(
+                        1 for s in self.running if s.prefill_done
+                    )
+                    if (self.pool.available_pages
+                            < need + self._watermark_pages() + n_decoding):
+                        continue
+                if not self.try_extend_pages(seq, seq.num_computed + chunk):
+                    continue  # pool tight — decode-only this round
             items.append(
                 PrefillItem(
                     seq,
@@ -231,24 +283,22 @@ class Scheduler:
                 )
             )
             budget -= chunk
-        if items:
-            return StepPlan("prefill", prefill=items)
+        return items
 
-        # decode pass: every running sequence advances decode_steps tokens
-        # (page reservation clamped to the model window so the table never
-        # outgrows its largest bucket)
+    def _plan_decode(self) -> List[Sequence]:
+        """Every prefill-done running sequence advances decode_steps
+        tokens (page reservation clamped to the model window so the
+        table never outgrows its largest bucket)."""
         hard_cap = self.cfg.hard_cap
         decodable: List[Sequence] = []
         for seq in list(self.running):
-            if seq.status != "running":
+            if seq.status != "running" or not seq.prefill_done:
                 continue
             target = min(seq.num_computed + self.cfg.decode_steps, hard_cap)
             if not self._ensure_pages(seq, target):
                 continue
             decodable.append(seq)
-        if decodable:
-            return StepPlan("decode", decode=decodable[: self.cfg.max_num_seqs])
-        return StepPlan("idle")
+        return decodable[: self.cfg.max_num_seqs]
 
     def _ensure_pages(self, seq: Sequence, upto_tokens: int) -> bool:
         """Grow seq's page list to cover `upto_tokens`, preempting others

@@ -50,6 +50,11 @@ def main() -> None:
                          "Raise on remote-attached chips (bench.py sweep)")
     ap.add_argument("--decode-chain", type=int, default=1,
                     help="decode dispatches in flight before fetching")
+    ap.add_argument("--mixed-prefill-tokens", type=int, default=None,
+                    help="prefill token budget inside a mixed "
+                         "(prefill+decode) dispatch; default = "
+                         "max_prefill_tokens, 0 disables mixing "
+                         "(prefill-first scheduling)")
     ap.add_argument("--no-prefix-caching", action="store_true")
     ap.add_argument("--disagg-role", default="both",
                     choices=["both", "prefill", "decode"])
@@ -297,6 +302,7 @@ def _build_engine(args):
         attention_impl=args.attention_impl,
         decode_steps=args.decode_steps,
         decode_chain=args.decode_chain,
+        mixed_prefill_tokens=args.mixed_prefill_tokens,
         enable_prefix_caching=not args.no_prefix_caching,
     )
     if args.mock:

@@ -49,10 +49,16 @@ def pytest_configure(config):
                 capture_output=True, timeout=120,
             )
         except (subprocess.CalledProcessError, subprocess.TimeoutExpired) as e:
+            # warn, don't abort: pure-Python suites must stay runnable on a
+            # half-broken toolchain; the native tests themselves then skip
             out = getattr(e, "stderr", b"") or b""
-            raise RuntimeError(
-                f"native build failed: {out.decode(errors='replace')[-2000:]}"
-            ) from e
+            import warnings
+
+            warnings.warn(
+                f"native build failed (native tests will skip): "
+                f"{out.decode(errors='replace')[-500:]}",
+                stacklevel=1,
+            )
 
 
 @pytest.hookimpl(tryfirst=True)
