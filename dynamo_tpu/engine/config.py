@@ -63,6 +63,14 @@ class EngineConfig:
     # einsum path otherwise; "pallas"/"xla" force one
     attention_impl: str = "auto"
 
+    # partition the KV pool across the mesh's dp×sp shards: num_pages
+    # becomes PER-SHARD (per-device HBM is fixed), aggregate capacity
+    # scales with the mesh, sequences pin to one shard's pool, and the
+    # engine runs its steps under a manual-over-(dp,sp) shard_map so all
+    # page gathers stay device-local (reference capability: engines
+    # shard KV across TP/DP ranks, disagg_serving.md:110-120)
+    kv_partition: bool = False
+
     # model limits
     max_model_len: int = 1024
 
@@ -71,6 +79,11 @@ class EngineConfig:
     def __post_init__(self):
         if self.mixed_prefill_tokens is None:
             self.mixed_prefill_tokens = self.max_prefill_tokens
+        # chunk buckets are sized from max_prefill_tokens; a larger mixed
+        # budget would plan chunks no bucket can hold
+        self.mixed_prefill_tokens = min(
+            self.mixed_prefill_tokens, self.max_prefill_tokens
+        )
         if self.quantization not in ("none", "int8"):
             raise ValueError(
                 f"quantization must be none|int8, got {self.quantization!r}"

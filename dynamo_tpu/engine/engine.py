@@ -140,24 +140,49 @@ def _build_prefill_step(cfg: ModelConfig, with_top: bool = False,
 
 
 def _build_prefill_step_sp(cfg: ModelConfig, mesh, with_top: bool = False,
-                           lockstep: bool = False):
+                           lockstep: bool = False, pool_axes=None):
     """Sequence-parallel whole-prompt prefill (parallel/sp_prefill.py):
     the prompt is sharded over the sp axis and attention runs as ring
-    attention; sampling happens on the gathered last-position logits."""
+    attention; sampling happens on the gathered last-position logits.
+    With `pool_axes` the KV pool is partitioned over (dp, sp): the step
+    takes an extra per-row `owner` array (the sp slot owning the row's
+    pages) and tables carry local ids."""
+    from ..models import kv_cache_pspec
     from ..parallel.sp_prefill import forward_prefill_sp
 
-    kw = ({"out_shardings": _lockstep_out_shardings(mesh, P())}
-          if lockstep else {})
-
-    @partial(jax.jit, donate_argnums=(1,), **kw)
-    def step(params, kv, tokens, page_table, prefix_lens, chunk_lens, samp, seeds, counters):
-        del prefix_lens  # whole-prompt prefill: enforced zero host-side
-        logits, kv = forward_prefill_sp(
-            params, cfg, kv, tokens, page_table, chunk_lens, mesh
+    if lockstep:
+        rep = NamedSharding(mesh, P())
+        kvsh = jax.tree.map(
+            lambda s: NamedSharding(mesh, s),
+            kv_cache_pspec(pool_axes=pool_axes),
         )
-        out = sample_tokens(logits, samp, seeds, counters)
-        logp = compute_logprobs(logits, out)
-        return _pack_out(out, logp, logits if with_top else None), out, kv
+        kw = {"out_shardings": (rep, rep, kvsh)}
+    else:
+        kw = {}
+
+    if pool_axes is None:
+        @partial(jax.jit, donate_argnums=(1,), **kw)
+        def step(params, kv, tokens, page_table, prefix_lens, chunk_lens,
+                 samp, seeds, counters):
+            del prefix_lens  # whole-prompt prefill: enforced zero host-side
+            logits, kv = forward_prefill_sp(
+                params, cfg, kv, tokens, page_table, chunk_lens, mesh
+            )
+            out = sample_tokens(logits, samp, seeds, counters)
+            logp = compute_logprobs(logits, out)
+            return _pack_out(out, logp, logits if with_top else None), out, kv
+    else:
+        @partial(jax.jit, donate_argnums=(1,), **kw)
+        def step(params, kv, tokens, page_table, prefix_lens, chunk_lens,
+                 samp, seeds, counters, owner):
+            del prefix_lens
+            logits, kv = forward_prefill_sp(
+                params, cfg, kv, tokens, page_table, chunk_lens, mesh,
+                owner=owner, pool_axes=pool_axes,
+            )
+            out = sample_tokens(logits, samp, seeds, counters)
+            logp = compute_logprobs(logits, out)
+            return _pack_out(out, logp, logits if with_top else None), out, kv
 
     return step
 
@@ -322,6 +347,164 @@ def _build_mixed_step(cfg: ModelConfig, n_steps: int, max_valid_pos: int,
     return step
 
 
+# -- partitioned-pool (kv_partition) step builders -------------------------- #
+# The pool's page axis is sharded over the mesh's (dp, sp) shards; batches
+# arrive as R contiguous per-rank row blocks with LOCAL page tables, so the
+# whole step runs under a shard_map that is MANUAL over the pool axes and
+# AUTO (GSPMD) over tp — every page gather/scatter stays device-local while
+# tp keeps its megatron collectives (scaling-book layout; reference
+# capability: engines shard KV over their ranks, disagg_serving.md:110).
+
+
+def _pool_linear_index(mesh, pool_axes):
+    idx = jax.lax.axis_index(pool_axes[0])
+    for ax in pool_axes[1:]:
+        idx = idx * mesh.shape[ax] + jax.lax.axis_index(ax)
+    return idx
+
+
+def _pooled_specs(pool_axes):
+    kvs = P(None, pool_axes, None, None, None)
+    return KVCache(kvs, kvs), P(pool_axes), P(pool_axes, None)
+
+
+def _lockstep_pooled_kw(mesh, pool_axes, out_specs):
+    """jit out_shardings for a pooled lockstep step: packed outputs
+    (leading P() spec entries... none here) — we simply replicate the
+    FIRST output (the packed result) and keep the rest sharded."""
+    from ..models import kv_cache_pspec
+
+    def shard(s):
+        return jax.tree.map(lambda sp: NamedSharding(mesh, sp), s)
+
+    rep = NamedSharding(mesh, P())
+    rest = [shard(s) for s in out_specs[1:-1]]
+    kv = shard(kv_cache_pspec(pool_axes=pool_axes))
+    return {"out_shardings": (rep, *rest, kv)}
+
+
+def _build_prefill_step_pooled(cfg: ModelConfig, mesh, pool_axes,
+                               with_top: bool = False, attn_impl: str = "xla",
+                               lockstep: bool = False):
+    from ..parallel._compat import shard_map
+
+    kvspec, bx, bx2 = _pooled_specs(pool_axes)
+
+    def body(params, kv, tokens, page_table, prefix_lens, chunk_lens, samp,
+             seeds, counters):
+        logits, kv = forward_prefill(
+            params, cfg, kv, tokens, page_table, prefix_lens, chunk_lens,
+            attn_impl=attn_impl,
+        )
+        out = sample_tokens(logits, samp, seeds, counters)
+        logp = compute_logprobs(logits, out)
+        return _pack_out(out, logp, logits if with_top else None), out, kv
+
+    # the packed result is 1-D PER SHARD ([tok|logp|...] over local rows),
+    # so the global array is a concatenation of per-rank blocks — the
+    # host unpacks with `_unpack_rows(..., blocks=R)`
+    out_specs = (bx, bx, kvspec)
+    sm = shard_map(
+        body, mesh=mesh,
+        in_specs=(P(), kvspec, bx2, bx2, bx, bx, bx, bx, bx),
+        out_specs=out_specs,
+        axis_names=set(pool_axes),
+    )
+    kw = _lockstep_pooled_kw(mesh, pool_axes, out_specs) if lockstep else {}
+    return partial(jax.jit, donate_argnums=(1,), **kw)(sm)
+
+
+def _build_decode_step_pooled(cfg: ModelConfig, mesh, pool_axes, n_steps: int,
+                              max_valid_pos: int, penalized: bool = False,
+                              with_top: bool = False, attn_impl: str = "xla",
+                              lockstep: bool = False):
+    from ..parallel._compat import shard_map
+
+    run = _make_decode_scan(cfg, n_steps, max_valid_pos, penalized,
+                            with_top, attn_impl)
+    kvspec, bx, bx2 = _pooled_specs(pool_axes)
+    # per-step packed results are 1-D per shard → [T, R * local] global
+    packed_spec = P(None, pool_axes)
+
+    def body(params, kv, tokens, positions, counters, counts, table, samp,
+             seeds):
+        return run(params, kv, tokens, positions, counters, counts, table,
+                   samp, seeds)
+
+    if penalized:
+        out_specs = (packed_spec, bx, bx, bx, bx2, kvspec)
+        donate = (1, 5)
+    else:
+        out_specs = (packed_spec, bx, bx, bx, kvspec)
+        donate = (1,)
+    sm = shard_map(
+        body, mesh=mesh,
+        in_specs=(P(), kvspec, bx, bx, bx, bx2 if penalized else P(),
+                  bx2, bx, bx),
+        out_specs=out_specs,
+        axis_names=set(pool_axes),
+    )
+    kw = _lockstep_pooled_kw(mesh, pool_axes, out_specs) if lockstep else {}
+    step = partial(jax.jit, donate_argnums=donate, **kw)(sm)
+    if penalized:
+        return step
+    # present the same call shape as _build_decode_step's plain variant
+    return lambda params, kv, tokens, positions, counters, table, samp, \
+        seeds: step(params, kv, tokens, positions, counters, None, table,
+                    samp, seeds)
+
+
+def _build_export_fn_pooled(cfg: ModelConfig, mesh, pool_axes):
+    """Export LOCAL page ids from ONE pool rank: every shard gathers its
+    local candidates, the owner's survive a mask + psum, and the result
+    comes back replicated over the pool axes (still tp-sharded on
+    kv-heads; single-process callers can device_get it directly)."""
+    from ..parallel._compat import shard_map
+
+    kvspec, _, _ = _pooled_specs(pool_axes)
+
+    def body(kv, pages, rank):
+        r = _pool_linear_index(mesh, pool_axes)
+        m = (r == rank)
+        k = jnp.where(m, kv.k[:, pages], 0)
+        v = jnp.where(m, kv.v[:, pages], 0)
+        return (jax.lax.psum(k, pool_axes), jax.lax.psum(v, pool_axes))
+
+    sm = shard_map(
+        body, mesh=mesh,
+        in_specs=(kvspec, P(), P()),
+        out_specs=(P(), P()),
+        axis_names=set(pool_axes),
+    )
+    return jax.jit(sm)
+
+
+def _build_import_fn_pooled(cfg: ModelConfig, mesh, pool_axes):
+    """Write a replicated (k, v) blob into ONE pool rank's local pages;
+    other ranks rewrite their current values (padding rows hit each
+    rank's local trash page 0)."""
+    from ..parallel._compat import shard_map
+
+    kvspec, _, _ = _pooled_specs(pool_axes)
+
+    def body(kv, k_blob, v_blob, pages, rank):
+        r = _pool_linear_index(mesh, pool_axes)
+        m = (r == rank)
+        k_new = jnp.where(m, k_blob.astype(kv.k.dtype), kv.k[:, pages])
+        v_new = jnp.where(m, v_blob.astype(kv.v.dtype), kv.v[:, pages])
+        return type(kv)(
+            kv.k.at[:, pages].set(k_new), kv.v.at[:, pages].set(v_new)
+        )
+
+    sm = shard_map(
+        body, mesh=mesh,
+        in_specs=(kvspec, P(), P(), P(), P()),
+        out_specs=kvspec,
+        axis_names=set(pool_axes),
+    )
+    return partial(jax.jit, donate_argnums=(0,))(sm)
+
+
 # -- multihost lockstep plan codec ----------------------------------------- #
 # The leader (rank 0) broadcasts one step descriptor per dispatch; follower
 # ranks replay it so every process issues identical jitted steps in the same
@@ -392,6 +575,12 @@ class JaxEngine:
         self.mesh = None
         self._dp = 1
         self._sp = 1
+        # kv_partition: pool pages sharded over the mesh's (dp, sp)
+        # shards — capacity scales with the mesh (engine.page_pool
+        # ShardedPagePool); steps run manual-over-(dp,sp) via shard_map
+        self._pooled = False
+        self._pool_ranks = 1
+        self._bax = "dp"  # batch-axis spec entry ("dp" | ("dp","sp"))
         # multihost lockstep: rank 0 leads, others replay (follower_loop)
         self._multihost = jax.process_count() > 1
         self._lockstep_leader = jax.process_index() == 0
@@ -467,14 +656,44 @@ class JaxEngine:
                         f"tp={parallel.tp} must evenly divide "
                         f"{', '.join(bad_dims)} for sp×tp prefill"
                     )
-            # every batch shape must divide dp (rows beyond the real batch
-            # are trash-page padding)
-            self.cfg = dataclasses.replace(
-                self.cfg,
-                decode_batch_buckets=sorted(
-                    {-(-b // self._dp) * self._dp
-                     for b in self.cfg.decode_batch_buckets}
-                ),
+            if self.cfg.kv_partition:
+                # sharded pool: one partition per (dp, sp) shard; batches
+                # are laid out as R uniform per-rank blocks (buckets stay
+                # PER-RANK, so no dp-divisibility rounding), and the
+                # fused/mixed fast paths are disabled (their row layouts
+                # assume a flat dp-sharded batch)
+                self._pooled = True
+                self._pool_ranks = self._dp * self._sp
+                if self._sp > 1:
+                    self._bax = ("dp", "sp")
+                self.cfg = dataclasses.replace(
+                    self.cfg, fuse_prefill_decode=False,
+                    mixed_prefill_tokens=0,
+                )
+                if tiered is not None:
+                    raise ValueError(
+                        "KV tiering (kvbm) is not supported with a "
+                        "partitioned (kv_partition) pool yet"
+                    )
+                if vision is not None:
+                    raise ValueError(
+                        "the vision tower is not supported with a "
+                        "partitioned (kv_partition) pool yet"
+                    )
+            else:
+                # every batch shape must divide dp (rows beyond the real
+                # batch are trash-page padding)
+                self.cfg = dataclasses.replace(
+                    self.cfg,
+                    decode_batch_buckets=sorted(
+                        {-(-b // self._dp) * self._dp
+                         for b in self.cfg.decode_batch_buckets}
+                    ),
+                )
+        elif self.cfg.kv_partition:
+            raise ValueError(
+                "kv_partition requires a serving mesh (ParallelConfig "
+                "with dp*sp > 1)"
             )
         self._attn_impl = resolve_attention_impl(
             self.cfg.attention_impl, meshed=self.mesh is not None
@@ -497,17 +716,23 @@ class JaxEngine:
         self._extra_event_sinks: List[Callable[[KvEvent], None]] = []
         if event_sink:
             self._extra_event_sinks.append(event_sink)
-        self.pool = PagePool(
-            self.cfg.num_pages, self.cfg.page_size, event_sink=self._emit_event
-        )
+        self.pool = self._make_pool()
         self.scheduler = Scheduler(self.cfg, self.pool)
         # step variants compiled lazily: (penalized, with_top) for decode,
         # with_top for prefill
         self._prefill_steps: Dict[bool, Callable] = {}
         self._decode_steps: Dict[tuple, Callable] = {}
         self._mixed_steps: Dict[tuple, Callable] = {}
-        self._export_fn = _build_export_fn()
-        self._import_fn = _build_import_fn()
+        if self._pooled:
+            self._export_fn = _build_export_fn_pooled(
+                self.model_cfg, self.mesh, self._pool_axes
+            )
+            self._import_fn = _build_import_fn_pooled(
+                self.model_cfg, self.mesh, self._pool_axes
+            )
+        else:
+            self._export_fn = _build_export_fn()
+            self._import_fn = _build_import_fn()
         # device ops queued by the loop thread, executed by the pump between
         # steps (self.kv is only ever touched between steps)
         self._pending_ops: List = []
@@ -562,10 +787,7 @@ class JaxEngine:
                 pages.append(page)
         if not pages:
             return [], None, None
-        width = self._pow2_width(len(pages))
-        padded = np.zeros((width,), np.int32)
-        padded[: len(pages)] = pages
-        k, v = self._export_fn(self.kv, jnp.asarray(padded))
+        k, v = self._export_dev(pages)
         k = np.asarray(jax.device_get(k))[:, : len(pages)]
         v = np.asarray(jax.device_get(v))[:, : len(pages)]
         return resolved, k, v
@@ -578,17 +800,13 @@ class JaxEngine:
             return []
         pages = self.pool.allocate(len(blocks))
         width = self._pow2_width(len(pages))
-        padded = np.zeros((width,), np.int32)
-        padded[: len(pages)] = pages
         k0 = blocks[0][2]
         kpad = np.zeros((k0.shape[0], width, *k0.shape[1:]), k0.dtype)
         vpad = np.zeros_like(kpad)
         for i, (_, _, k, v) in enumerate(blocks):
             kpad[:, i] = k
             vpad[:, i] = v
-        self.kv = self._import_fn(
-            self.kv, jnp.asarray(kpad), jnp.asarray(vpad), jnp.asarray(padded)
-        )
+        self._import_dev(pages, kpad, vpad)
         for (h, parent, _, _), page in zip(blocks, pages):
             self.pool.commit(page, h, parent)
         return pages
@@ -602,16 +820,35 @@ class JaxEngine:
 
         return shard_params(params, self.model_cfg, self.mesh)
 
+    def _make_pool(self):
+        if self._pooled:
+            from .page_pool import ShardedPagePool
+
+            return ShardedPagePool(
+                self._pool_ranks, self.cfg.num_pages, self.cfg.page_size,
+                event_sink=self._emit_event,
+            )
+        return PagePool(
+            self.cfg.num_pages, self.cfg.page_size, event_sink=self._emit_event
+        )
+
+    @property
+    def _pool_axes(self):
+        return ("dp", "sp") if self._sp > 1 else ("dp",)
+
     def _make_kv(self) -> KVCache:
         kv = KVCache.create(
-            self.model_cfg, self.cfg.num_pages, self.cfg.page_size,
-            self._kv_dtype,
+            self.model_cfg, self._pool_ranks * self.cfg.num_pages,
+            self.cfg.page_size, self._kv_dtype,
         )
         if self.mesh is None:
             return kv
         from ..parallel import shard_kv_cache
 
-        return shard_kv_cache(kv, self.mesh)
+        return shard_kv_cache(
+            kv, self.mesh,
+            pool_axes=self._pool_axes if self._pooled else None,
+        )
 
     def _put(self, arr, *axes):
         """Host array → device, batch axis sharded over dp when meshed.
@@ -625,12 +862,13 @@ class JaxEngine:
             return host_array_to_global(self.mesh, P(*axes), np.asarray(arr))
         return jax.device_put(arr, NamedSharding(self.mesh, P(*axes)))
 
-    def _put_samp(self, samp: SamplingParams) -> SamplingParams:
+    def _put_samp(self, samp: SamplingParams, axes=None) -> SamplingParams:
         if self.mesh is None:
             return samp
+        axes = axes if axes is not None else self._bax
         if self._multihost:
-            return jax.tree.map(lambda a: self._put(np.asarray(a), "dp"), samp)
-        return jax.device_put(samp, NamedSharding(self.mesh, P("dp")))
+            return jax.tree.map(lambda a: self._put(np.asarray(a), axes), samp)
+        return jax.device_put(samp, NamedSharding(self.mesh, P(axes)))
 
     def _pad_batch(self, n: int) -> int:
         """Round a batch size up to a dp multiple (pad rows hit the trash
@@ -646,6 +884,13 @@ class JaxEngine:
                 self._prefill_steps[key] = _build_prefill_step_sp(
                     self.model_cfg, self.mesh, with_top,
                     lockstep=self._multihost,
+                    pool_axes=self._pool_axes if self._pooled else None,
+                )
+            elif self._pooled:
+                self._prefill_steps[key] = _build_prefill_step_pooled(
+                    self.model_cfg, self.mesh, self._pool_axes,
+                    with_top=with_top, attn_impl=self._attn_impl,
+                    lockstep=self._multihost,
                 )
             else:
                 self._prefill_steps[key] = _build_prefill_step(
@@ -658,12 +903,20 @@ class JaxEngine:
     def _get_decode_step(self, penalized: bool, with_top: bool):
         key = (penalized, with_top)
         if key not in self._decode_steps:
-            self._decode_steps[key] = _build_decode_step(
-                self.model_cfg, self.cfg.decode_steps, self.cfg.hard_cap,
-                penalized=penalized, with_top=with_top,
-                attn_impl=self._attn_impl,
-                lockstep_mesh=self.mesh if self._multihost else None,
-            )
+            if self._pooled:
+                self._decode_steps[key] = _build_decode_step_pooled(
+                    self.model_cfg, self.mesh, self._pool_axes,
+                    self.cfg.decode_steps, self.cfg.hard_cap,
+                    penalized=penalized, with_top=with_top,
+                    attn_impl=self._attn_impl, lockstep=self._multihost,
+                )
+            else:
+                self._decode_steps[key] = _build_decode_step(
+                    self.model_cfg, self.cfg.decode_steps, self.cfg.hard_cap,
+                    penalized=penalized, with_top=with_top,
+                    attn_impl=self._attn_impl,
+                    lockstep_mesh=self.mesh if self._multihost else None,
+                )
         return self._decode_steps[key]
 
     def _get_mixed_step(self, penalized: bool, with_top: bool):
@@ -697,7 +950,8 @@ class JaxEngine:
             active_seqs=running,
             waiting_seqs=waiting,
             kv_usage=self.pool.usage(),
-            kv_total_pages=self.cfg.usable_pages,
+            # partitioned pools aggregate capacity across their ranks
+            kv_total_pages=self.cfg.usable_pages * self.pool.ranks,
             num_requests_total=self._requests_total,
         )
         if self.tiered is not None:
@@ -886,111 +1140,207 @@ class JaxEngine:
 
     # -- device steps (worker thread) ---------------------------------------- #
 
-    def _seed_arrays(self, seqs: List[Sequence], pad_to: int):
-        pad = pad_to - len(seqs)
-        seeds = [getattr(s, "seed", 0) for s in seqs] + [0] * pad
-        counters = [len(s.output_tokens) for s in seqs] + [0] * pad
+    def _unpack_rows(self, packed: np.ndarray, B: int, with_top: bool,
+                     blocks: int = 1):
+        """`_unpack_out` over a row layout.  Partitioned-pool steps emit
+        the packed result as a concatenation of per-rank blocks (each
+        rank packs its own rows), so unpack block-wise and stitch."""
+        if blocks <= 1:
+            return _unpack_out(packed, B, with_top)
+        L = packed.shape[-1] // blocks
+        Br = B // blocks
+        pr = packed.reshape(*packed.shape[:-1], blocks, L)
+        parts = [
+            _unpack_out(pr[..., r, :], Br, with_top) for r in range(blocks)
+        ]
+        toks = np.concatenate([p[0] for p in parts], axis=-1)
+        logp = np.concatenate([p[1] for p in parts], axis=-1)
+        if not with_top:
+            return toks, logp, None, None
+        tids = np.concatenate([p[2] for p in parts], axis=-2)
+        tlps = np.concatenate([p[3] for p in parts], axis=-2)
+        return toks, logp, tids, tlps
+
+    @property
+    def _prefill_blocks(self) -> int:
+        """Packed-layout block count for prefill results (sp prefill
+        samples at the jit level, so its layout is flat)."""
+        return self._pool_ranks if (self._pooled and self._sp == 1) else 1
+
+    @property
+    def _decode_blocks(self) -> int:
+        return self._pool_ranks if self._pooled else 1
+
+    # Batch ROW LAYOUTS: every per-step array builder takes a `rows` list
+    # (Sequence | None, None = padding row).  Unpartitioned engines use
+    # the identity layout (live rows first, pad tail); a partitioned pool
+    # lays rows out as R contiguous per-rank blocks of uniform width so
+    # the batch axis shards over (dp, sp) with each row on the shard that
+    # owns its pages.
+
+    def _decode_rows(self, seqs: List[Sequence]) -> List[Optional[Sequence]]:
+        if not self._pooled:
+            Bb = bucket_for(len(seqs), self.cfg.decode_batch_buckets)
+            return list(seqs) + [None] * (Bb - len(seqs))
+        by_rank: List[List[Sequence]] = [[] for _ in range(self._pool_ranks)]
+        for s in seqs:
+            by_rank[s.kv_rank].append(s)
+        Br = bucket_for(
+            max([1] + [len(g) for g in by_rank]),
+            self.cfg.decode_batch_buckets,
+        )
+        rows: List[Optional[Sequence]] = []
+        for g in by_rank:
+            rows.extend(g)
+            rows.extend([None] * (Br - len(g)))
+        return rows
+
+    def _prefill_rows(self, items: List[PrefillItem]) -> List[Optional[PrefillItem]]:
+        if not self._pooled:
+            B = self._pad_batch(len(items))
+            return list(items) + [None] * (B - len(items))
+        if self._sp > 1:
+            # sp ring prefill shards ROWS over dp only (the sequence axis
+            # rides sp): group by dp shard; each row's sp slot goes in
+            # the per-row `owner` array instead of the layout
+            groups, key = self._dp, (lambda it: it.seq.kv_rank // self._sp)
+        else:
+            groups, key = self._pool_ranks, (lambda it: it.seq.kv_rank)
+        by_rank: List[List[PrefillItem]] = [[] for _ in range(groups)]
+        for it in items:
+            by_rank[key(it)].append(it)
+        Br = max([1] + [len(g) for g in by_rank])
+        rows: List[Optional[PrefillItem]] = []
+        for g in by_rank:
+            rows.extend(g)
+            rows.extend([None] * (Br - len(g)))
+        return rows
+
+    def _seed_arrays(self, rows: List[Optional[Sequence]]):
+        seeds = [getattr(s, "seed", 0) if s else 0 for s in rows]
+        counters = [len(s.output_tokens) if s else 0 for s in rows]
         return (
             np.asarray(seeds, np.uint32),
             np.asarray(counters, np.int32),
         )
 
-    def _table_array(self, seqs: List[Sequence], rows: Optional[int] = None) -> np.ndarray:
+    def _table_array(self, rows: List[Optional[Sequence]]) -> np.ndarray:
         """Page-table batch, width bucketed to the longest sequence present
         (attention/gather cost scales with width, so short-context batches
-        stay cheap)."""
-        need = max((len(s.pages) for s in seqs), default=1)
+        stay cheap).  Partitioned pools store LOCAL ids (each shard's page
+        0 is its own trash page)."""
+        need = max((len(s.pages) for s in rows if s), default=1)
         width = bucket_for(max(need, 1), self.cfg.table_width_buckets)
-        table = np.zeros((rows or len(seqs), width), np.int32)
-        for i, s in enumerate(seqs):
+        table = np.zeros((len(rows), width), np.int32)
+        npp = self.cfg.num_pages
+        for i, s in enumerate(rows):
+            if s is None:
+                continue
             n = min(len(s.pages), width)
-            table[i, :n] = s.pages[:n]
+            if self._pooled:
+                table[i, :n] = [p % npp for p in s.pages[:n]]
+            else:
+                table[i, :n] = s.pages[:n]
         return table
 
-    def _samp_arrays(self, seqs: List[Sequence], pad_to: Optional[int] = None) -> SamplingParams:
-        pad = (pad_to or len(seqs)) - len(seqs)
+    def _samp_arrays(self, rows: List[Optional[Sequence]]) -> SamplingParams:
         return SamplingParams.make(
-            [s.opts.temperature for s in seqs] + [0.0] * pad,
-            [s.opts.top_k for s in seqs] + [0] * pad,
-            [s.opts.top_p for s in seqs] + [1.0] * pad,
-            [s.opts.frequency_penalty for s in seqs] + [0.0] * pad,
-            [s.opts.presence_penalty for s in seqs] + [0.0] * pad,
+            [s.opts.temperature if s else 0.0 for s in rows],
+            [s.opts.top_k if s else 0 for s in rows],
+            [s.opts.top_p if s else 1.0 for s in rows],
+            [s.opts.frequency_penalty if s else 0.0 for s in rows],
+            [s.opts.presence_penalty if s else 0.0 for s in rows],
         )
 
-    def _prefill_arrays(self, items: List[PrefillItem], B: int):
+    def _prefill_arrays(self, item_rows: List[Optional[PrefillItem]]):
         """(tokens [B, chunk_bucket], prefix [B], chunk [B]) for a prefill
-        batch.  dp-pad rows run a 1-token chunk into the trash page (a
+        row layout.  Pad rows run a 1-token chunk into the trash page (a
         fully masked row would softmax over -inf only)."""
+        B = len(item_rows)
         chunk_bucket = bucket_for(
-            max(it.chunk_len for it in items), self.cfg.chunk_buckets
+            max(it.chunk_len for it in item_rows if it), self.cfg.chunk_buckets
         )
         tokens = np.zeros((B, chunk_bucket), np.int32)
         prefix = np.zeros((B,), np.int32)
         chunk = np.ones((B,), np.int32)
-        for i, it in enumerate(items):
+        for i, it in enumerate(item_rows):
+            if it is None:
+                continue
             toks = it.seq.prompt[it.chunk_start : it.chunk_start + it.chunk_len]
             tokens[i, : len(toks)] = toks
             prefix[i] = it.chunk_start
             chunk[i] = it.chunk_len
         return tokens, prefix, chunk, chunk_bucket
 
-    def _decode_arrays(self, seqs: List[Sequence], Bb: int):
-        """(last tokens [Bb], positions [Bb]) for a decode batch."""
-        tokens = np.zeros((Bb,), np.int32)
-        positions = np.zeros((Bb,), np.int32)
-        for i, s in enumerate(seqs):
+    def _decode_arrays(self, rows: List[Optional[Sequence]]):
+        """(last tokens [B], positions [B]) for a decode row layout."""
+        B = len(rows)
+        tokens = np.zeros((B,), np.int32)
+        positions = np.zeros((B,), np.int32)
+        for i, s in enumerate(rows):
+            if s is None:
+                continue
             tokens[i] = s.output_tokens[-1] if s.output_tokens else (
                 s.prompt[-1] if s.prompt else 0
             )
             positions[i] = s.num_computed
         return tokens, positions
 
-    def _counts_array(self, seqs: List[Sequence], Bb: int) -> np.ndarray:
-        """Dense [Bb, vocab] output-token histograms (prompt tokens are
+    def _counts_array(self, rows: List[Optional[Sequence]]) -> np.ndarray:
+        """Dense [B, vocab] output-token histograms (prompt tokens are
         not penalized)."""
-        counts = np.zeros((Bb, self.model_cfg.vocab_size), np.float32)
-        for i, s in enumerate(seqs):
-            if s.output_tokens:
+        counts = np.zeros((len(rows), self.model_cfg.vocab_size), np.float32)
+        for i, s in enumerate(rows):
+            if s is not None and s.output_tokens:
                 np.add.at(counts[i], s.output_tokens, 1.0)
         return counts
 
-    def _encode_counts_sparse(self, seqs: List[Sequence], b: int):
+    def _encode_counts_sparse(self, rows: List[Optional[Sequence]]):
         """Sparse (flat token list + row offsets) form of `_counts_array`
         for the lockstep plan channel (inverse: `_counts_from_sparse`)."""
         flat, offs = [], [0]
-        for i in range(b):
-            if i < len(seqs):
-                flat.extend(seqs[i].output_tokens)
+        for s in rows:
+            if s is not None:
+                flat.extend(s.output_tokens)
             offs.append(len(flat))
         return [np.asarray(flat, np.int32), np.asarray(offs, np.int64)]
 
     def _run_prefill(self, items: List[PrefillItem]) -> None:
-        B = self._pad_batch(len(items))
-        tokens, prefix, chunk, chunk_bucket = self._prefill_arrays(items, B)
+        item_rows = self._prefill_rows(items)
+        B = len(item_rows)
+        seq_rows = [it.seq if it else None for it in item_rows]
+        tokens, prefix, chunk, chunk_bucket = self._prefill_arrays(item_rows)
         seqs = [it.seq for it in items]
         if self._sp > 1 and prefix.any():
             # cannot happen with prefix caching off + whole-prompt chunks;
             # guards scheduler regressions from silently corrupting sp runs
             raise RuntimeError("sp prefill requires prefix_lens == 0")
         with_top = any(s.opts.top_logprobs > 0 for s in seqs)
-        table = self._table_array(seqs, rows=B)
-        seeds, counters = self._seed_arrays(seqs, B)
-        samp = self._samp_arrays(seqs, B)
+        table = self._table_array(seq_rows)
+        seeds, counters = self._seed_arrays(seq_rows)
+        samp = self._samp_arrays(seq_rows)
         for s in seqs:  # encode pending vision inputs (step thread)
             if s.mm_pixels is not None:
                 self._encode_mm(s)
         mm = ()
         if any(s.mm_embeds is not None for s in seqs):
-            mm = self._mm_arrays(items, B, chunk_bucket)
+            mm = self._mm_arrays(item_rows, B, chunk_bucket)
+        owner = None
+        if self._pooled and self._sp > 1:
+            owner = np.zeros((B,), np.int32)
+            for i, it in enumerate(item_rows):
+                if it is not None:
+                    owner[i] = it.seq.kv_rank % self._sp
         if self._multihost:
             self._lockstep_send({
                 "kind": "prefill", "with_top": with_top,
                 "arrays": [tokens, table, prefix, chunk,
                            *[np.asarray(a) for a in samp], seeds, counters],
+                "owner": owner,
             })
         packed_d, tok_d = self._dispatch_prefill(
             tokens, table, prefix, chunk, samp, seeds, counters, with_top,
-            mm=mm,
+            mm=mm, owner=owner,
         )
         # start the host copy of the prefill result BEFORE the fused
         # decode dispatches enqueue: on a FIFO-ish transfer path the copy
@@ -1017,10 +1367,13 @@ class JaxEngine:
         deferred = [] if fused else None
         self.scheduler.deferred_free = deferred
         try:
-            out, logp, tids, tlps = _unpack_out(
-                np.asarray(jax.device_get(packed_d)), B, with_top
+            out, logp, tids, tlps = self._unpack_rows(
+                np.asarray(jax.device_get(packed_d)), B, with_top,
+                blocks=self._prefill_blocks,
             )
-            for i, it in enumerate(items):
+            for i, it in enumerate(item_rows):
+                if it is None:
+                    continue
                 s = it.seq
                 if s.status != "running":  # preempted after planning
                     continue
@@ -1031,8 +1384,7 @@ class JaxEngine:
                         _tops_for(s, tids, tlps, i),
                     )
             if fused:
-                self._consume_decode(fused, [it.seq for it in items], B,
-                                     with_top)
+                self._consume_decode(fused, seq_rows, B, with_top)
         finally:
             self.scheduler.deferred_free = None
             if deferred:
@@ -1085,21 +1437,26 @@ class JaxEngine:
         for i, s in enumerate(seqs):
             positions[i] = s.num_computed
             decode_ctr[i] = counters[i] + 1  # past the prefill sample
-        table = self._table_array(seqs, rows=B)  # includes extended pages
+        # fusion runs only on identity row layouts (disabled when pooled),
+        # so the prefill rows double as decode rows
+        table = self._table_array(
+            seqs + [None] * (B - len(seqs))
+        )  # includes extended pages
         return self._dispatch_decode(
             tok_d, positions, decode_ctr, None, table, samp, seeds,
             False, with_top, chain_len,
         )
 
-    def _consume_decode(self, dispatches, seqs, Bb, with_top) -> None:
-        """Fetch + account a decode chain's outputs (callers manage
-        deferred frees around in-flight dispatches)."""
+    def _consume_decode(self, dispatches, rows, Bb, with_top) -> None:
+        """Fetch + account a decode chain's outputs over a row layout
+        (callers manage deferred frees around in-flight dispatches)."""
         for packed_d in dispatches:
-            out, logp, tids, tlps = _unpack_out(
-                np.asarray(jax.device_get(packed_d)), Bb, with_top
+            out, logp, tids, tlps = self._unpack_rows(
+                np.asarray(jax.device_get(packed_d)), Bb, with_top,
+                blocks=self._decode_blocks,
             )  # [T, B] each
-            for i, s in enumerate(seqs):
-                if s.status != "running":
+            for i, s in enumerate(rows):
+                if s is None or s.status != "running":
                     continue
                 for t in range(out.shape[0]):
                     s.num_computed += 1
@@ -1118,25 +1475,28 @@ class JaxEngine:
         invalidate each other."""
         items, dseqs = plan.prefill, plan.decode
         # prefill side (same array construction as _run_prefill)
-        Bp = self._pad_batch(len(items))
-        p_tokens, p_prefix, p_chunk, _ = self._prefill_arrays(items, Bp)
+        item_rows = self._prefill_rows(items)
+        Bp = len(item_rows)
+        pseq_rows = [it.seq if it else None for it in item_rows]
+        p_tokens, p_prefix, p_chunk, _ = self._prefill_arrays(item_rows)
         pseqs = [it.seq for it in items]
-        p_table = self._table_array(pseqs, rows=Bp)
-        p_seeds, p_ctr = self._seed_arrays(pseqs, Bp)
-        p_samp = self._samp_arrays(pseqs, Bp)
+        p_table = self._table_array(pseq_rows)
+        p_seeds, p_ctr = self._seed_arrays(pseq_rows)
+        p_samp = self._samp_arrays(pseq_rows)
         # decode side (same as _run_decode, chain_len fixed at 1)
-        Bd = bucket_for(len(dseqs), self.cfg.decode_batch_buckets)
-        d_tokens, d_pos = self._decode_arrays(dseqs, Bd)
-        d_seeds, d_ctr = self._seed_arrays(dseqs, Bd)
-        d_table = self._table_array(dseqs, rows=Bd)
+        d_rows = self._decode_rows(dseqs)
+        Bd = len(d_rows)
+        d_tokens, d_pos = self._decode_arrays(d_rows)
+        d_seeds, d_ctr = self._seed_arrays(d_rows)
+        d_table = self._table_array(d_rows)
         penalized = any(s.opts.penalized for s in dseqs)
         with_top = any(
             s.opts.top_logprobs > 0 for s in pseqs + dseqs
         )
-        d_samp = self._samp_arrays(dseqs, Bd)
-        counts = self._counts_array(dseqs, Bd) if penalized else None
+        d_samp = self._samp_arrays(d_rows)
+        counts = self._counts_array(d_rows) if penalized else None
         if self._multihost:
-            sparse = (self._encode_counts_sparse(dseqs, Bd)
+            sparse = (self._encode_counts_sparse(d_rows)
                       if penalized else None)
             self._lockstep_send({
                 "kind": "mixed", "penalized": penalized,
@@ -1157,10 +1517,13 @@ class JaxEngine:
         for it in items:
             if it.seq.status == "running":
                 it.seq.num_computed += it.chunk_len
-        p_out, p_logp, p_tids, p_tlps = _unpack_out(
-            np.asarray(jax.device_get(p_packed_d)), Bp, with_top
+        p_out, p_logp, p_tids, p_tlps = self._unpack_rows(
+            np.asarray(jax.device_get(p_packed_d)), Bp, with_top,
+            blocks=self._prefill_blocks,
         )
-        for i, it in enumerate(items):
+        for i, it in enumerate(item_rows):
+            if it is None:
+                continue
             s = it.seq
             if s.status != "running":
                 continue
@@ -1170,7 +1533,7 @@ class JaxEngine:
                     s, int(p_out[i]), float(p_logp[i]),
                     _tops_for(s, p_tids, p_tlps, i),
                 )
-        self._consume_decode([d_packed_d], dseqs, Bd, with_top)
+        self._consume_decode([d_packed_d], d_rows, Bd, with_top)
 
     def _dispatch_mixed(self, p_tokens, p_table, p_prefix, p_chunk, p_samp,
                         p_seeds, p_ctr, d_tokens, d_pos, d_ctr, d_counts,
@@ -1178,16 +1541,16 @@ class JaxEngine:
         """Issue the jitted mixed step (identical on leader and followers);
         returns the two packed device outputs."""
         step = self._get_mixed_step(penalized, with_top)
-        cts_d = self._put(d_counts, "dp", None) if penalized else None
+        cts_d = self._put(d_counts, self._bax, None) if penalized else None
         p_packed, d_packed, self.kv = step(
             self.params, self.kv,
-            self._put(p_tokens, "dp", None), self._put(p_table, "dp", None),
-            self._put(p_prefix, "dp"), self._put(p_chunk, "dp"),
-            self._put_samp(p_samp), self._put(p_seeds, "dp"),
-            self._put(p_ctr, "dp"),
-            self._put(d_tokens, "dp"), self._put(d_pos, "dp"),
-            self._put(d_ctr, "dp"), cts_d, self._put(d_table, "dp", None),
-            self._put_samp(d_samp), self._put(d_seeds, "dp"),
+            self._put(p_tokens, self._bax, None), self._put(p_table, self._bax, None),
+            self._put(p_prefix, self._bax), self._put(p_chunk, self._bax),
+            self._put_samp(p_samp), self._put(p_seeds, self._bax),
+            self._put(p_ctr, self._bax),
+            self._put(d_tokens, self._bax), self._put(d_pos, self._bax),
+            self._put(d_ctr, self._bax), cts_d, self._put(d_table, self._bax, None),
+            self._put_samp(d_samp), self._put(d_seeds, self._bax),
         )
         for a in (p_packed, d_packed):
             try:  # start both host copies; they ride back in fetch order
@@ -1250,14 +1613,16 @@ class JaxEngine:
         )
         seq.mm_pixels = None
 
-    def _mm_arrays(self, items, B, chunk_bucket):
+    def _mm_arrays(self, item_rows, B, chunk_bucket):
         """Build (extra_embeds [B,S,h], mask [B,S]) covering every image
         patch run intersecting this chunk (chunked prefill may slice
         through a run)."""
         h = self.model_cfg.hidden_size
         extra = np.zeros((B, chunk_bucket, h), np.float32)
         mask = np.zeros((B, chunk_bucket), bool)
-        for i, it in enumerate(items):
+        for i, it in enumerate(item_rows):
+            if it is None:
+                continue
             s = it.seq
             if s.mm_embeds is None:
                 continue
@@ -1273,22 +1638,32 @@ class JaxEngine:
         return extra, mask
 
     def _dispatch_prefill(self, tokens, table, prefix, chunk, samp, seeds,
-                          counters, with_top, mm=()):
+                          counters, with_top, mm=(), owner=None):
         """Issue the jitted prefill (identical on leader and followers).
         Returns (packed_d, tok_d): the packed host-fetchable result and
-        the sampled tokens as a device int32 carry."""
+        the sampled tokens as a device int32 carry.  `owner` rides along
+        only for partitioned-pool sp prefill (rows shard over dp; the
+        owner array names each row's sp slot)."""
+        extra = ()
+        # sp prefill shards batch ROWS over dp only (the sequence axis
+        # rides sp), so pooled-sp prefill arrays must not demand a
+        # (dp, sp)-divisible batch
+        bax = "dp" if self._sp > 1 else self._bax
+        if self._pooled and self._sp > 1:
+            extra = (self._put(owner, "dp"),)
         packed_d, tok_d, kv = self._get_prefill_step(with_top, bool(mm))(
             self.params,
             self.kv,
-            self._put(tokens, "dp", None),
-            self._put(table, "dp", None),
-            self._put(prefix, "dp"),
-            self._put(chunk, "dp"),
-            self._put_samp(samp),
-            self._put(seeds, "dp"),
-            self._put(counters, "dp"),
-            *(self._put(m, "dp", None) if m.ndim == 2
-              else self._put(m, "dp", None, None) for m in mm),
+            self._put(tokens, bax, None),
+            self._put(table, bax, None),
+            self._put(prefix, bax),
+            self._put(chunk, bax),
+            self._put_samp(samp, axes=bax),
+            self._put(seeds, bax),
+            self._put(counters, bax),
+            *(self._put(m, bax, None) if m.ndim == 2
+              else self._put(m, bax, None, None) for m in mm),
+            *extra,
         )
         self.kv = kv
         return packed_d, tok_d
@@ -1327,20 +1702,21 @@ class JaxEngine:
         while (chain_len < max(1, self.cfg.decode_chain)
                and self._chain_ok(seqs, chain_len, T, hard_cap)):
             chain_len += 1
-        Bb = bucket_for(len(seqs), self.cfg.decode_batch_buckets)
-        tokens, positions = self._decode_arrays(seqs, Bb)
-        seeds, counters = self._seed_arrays(seqs, Bb)
-        table = self._table_array(seqs, rows=Bb)
+        rows = self._decode_rows(seqs)
+        Bb = len(rows)
+        tokens, positions = self._decode_arrays(rows)
+        seeds, counters = self._seed_arrays(rows)
+        table = self._table_array(rows)
         penalized = any(s.opts.penalized for s in seqs)
         with_top = any(s.opts.top_logprobs > 0 for s in seqs)
-        samp = self._samp_arrays(seqs, Bb)
+        samp = self._samp_arrays(rows)
         # histograms updated on-device within and across chained blocks
-        counts = self._counts_array(seqs, Bb) if penalized else None
+        counts = self._counts_array(rows) if penalized else None
         if self._multihost:
             # penalized plans carry the output tokens SPARSELY (flat list +
             # row offsets) — broadcasting the dense [B, vocab] histogram
             # would put ~4MB/step on the plan channel at a 128k vocab
-            sparse = (self._encode_counts_sparse(seqs, Bb)
+            sparse = (self._encode_counts_sparse(rows)
                       if penalized else None)
             self._lockstep_send({
                 "kind": "decode", "penalized": penalized,
@@ -1360,7 +1736,7 @@ class JaxEngine:
         deferred = [] if len(dispatches) > 1 else None
         self.scheduler.deferred_free = deferred
         try:
-            self._consume_decode(dispatches, seqs, Bb, with_top)
+            self._consume_decode(dispatches, rows, Bb, with_top)
         finally:
             self.scheduler.deferred_free = None
             if deferred:
@@ -1371,14 +1747,14 @@ class JaxEngine:
         """Issue the chained decode dispatches (identical on leader and
         followers); returns the per-block packed outputs."""
         step = self._get_decode_step(penalized, with_top)
-        tok_d = self._put(tokens, "dp")
-        pos_d = self._put(positions, "dp")
-        ctr_d = self._put(counters, "dp")
-        table_d = self._put(table, "dp", None)
+        tok_d = self._put(tokens, self._bax)
+        pos_d = self._put(positions, self._bax)
+        ctr_d = self._put(counters, self._bax)
+        table_d = self._put(table, self._bax, None)
         samp_d = self._put_samp(samp)
-        seeds_d = self._put(seeds, "dp")
+        seeds_d = self._put(seeds, self._bax)
         if penalized:
-            cts_d = self._put(counts, "dp", None)
+            cts_d = self._put(counts, self._bax, None)
         dispatches = []
         for _ in range(chain_len):
             if penalized:
@@ -1453,6 +1829,7 @@ class JaxEngine:
                         a[0], a[1], a[2], a[3],
                         SamplingParams(*a[4:4 + samp_n]),
                         a[4 + samp_n], a[5 + samp_n], desc["with_top"],
+                        owner=desc.get("owner"),
                     )
                 elif kind == "decode":
                     a = desc["arrays"]
@@ -1559,14 +1936,45 @@ class JaxEngine:
     def _pow2_width(n: int) -> int:
         return 1 << max(0, n - 1).bit_length()
 
+    def _export_dev(self, pages: List[int], width: Optional[int] = None):
+        """jit export of page ids → (k, v) device arrays [L, width, ...].
+        Partitioned pools take LOCAL ids + the owning rank (a sequence's
+        pages always share one rank)."""
+        width = width or self._pow2_width(len(pages))
+        padded = np.zeros((width,), np.int32)
+        if self._pooled:
+            rank = self.pool.rank_of(pages[0]) if pages else 0
+            padded[: len(pages)] = [p % self.cfg.num_pages for p in pages]
+            return self._export_fn(
+                self.kv, jnp.asarray(padded), jnp.int32(rank)
+            )
+        padded[: len(pages)] = pages
+        return self._export_fn(self.kv, jnp.asarray(padded))
+
+    def _import_dev(self, pages: List[int], kpad, vpad) -> None:
+        """jit import of padded (k, v) blobs into the given page ids
+        (padding rows hit the trash page)."""
+        width = kpad.shape[1]
+        padded = np.zeros((width,), np.int32)
+        if self._pooled:
+            rank = self.pool.rank_of(pages[0]) if pages else 0
+            padded[: len(pages)] = [p % self.cfg.num_pages for p in pages]
+            self.kv = self._import_fn(
+                self.kv, jnp.asarray(kpad), jnp.asarray(vpad),
+                jnp.asarray(padded), jnp.int32(rank),
+            )
+        else:
+            padded[: len(pages)] = pages
+            self.kv = self._import_fn(
+                self.kv, jnp.asarray(kpad), jnp.asarray(vpad),
+                jnp.asarray(padded),
+            )
+
     async def export_pages(self, pages: List[int]):
         """Copy the given pages device->host: ([L,n,page,kv,hd], same) —
         one jit variant per pow2 width."""
         def op():
-            width = self._pow2_width(len(pages))
-            padded = np.zeros((width,), np.int32)
-            padded[: len(pages)] = pages
-            k, v = self._export_fn(self.kv, jnp.asarray(padded))
+            k, v = self._export_dev(pages)
             return (
                 np.asarray(jax.device_get(k))[:, : len(pages)],
                 np.asarray(jax.device_get(v))[:, : len(pages)],
@@ -1594,24 +2002,17 @@ class JaxEngine:
         def op():
             n = len(pages)
             width = self._pow2_width(n)
-            padded = np.zeros((width,), np.int32)
-            padded[:n] = pages
             if isinstance(k_chunk, jax.Array):
                 pad = ((0, 0), (0, width - n), (0, 0), (0, 0), (0, 0))
-                kpad = jnp.pad(k_chunk, pad)
-                vpad = jnp.pad(v_chunk, pad)
-                self.kv = self._import_fn(
-                    self.kv, kpad, vpad, jnp.asarray(padded)
-                )
+                self._import_dev(pages, jnp.pad(k_chunk, pad),
+                                 jnp.pad(v_chunk, pad))
                 return
             kpad = np.zeros((k_chunk.shape[0], width, *k_chunk.shape[2:]),
                             k_chunk.dtype)
             vpad = np.zeros_like(kpad)
             kpad[:, :n] = k_chunk
             vpad[:, :n] = v_chunk
-            self.kv = self._import_fn(
-                self.kv, jnp.asarray(kpad), jnp.asarray(vpad), jnp.asarray(padded)
-            )
+            self._import_dev(pages, kpad, vpad)
 
         await self._device_op(op)
 
@@ -1663,11 +2064,9 @@ class JaxEngine:
             }
         pages = list(seq.pages)
         width = bucket_for(max(len(pages), 1), self.cfg.table_width_buckets)
-        padded = np.zeros((width,), np.int32)
-        padded[: len(pages)] = pages
 
         def export_op():
-            k, v = self._export_fn(self.kv, jnp.asarray(padded))
+            k, v = self._export_dev(pages, width=width)
             k = np.asarray(jax.device_get(k))[:, : len(pages)]
             v = np.asarray(jax.device_get(v))[:, : len(pages)]
             # release the held pages now that the copy is out
@@ -1712,16 +2111,11 @@ class JaxEngine:
 
         def import_op():
             pages = self.pool.allocate(n_pages)
-            padded = np.zeros((width,), np.int32)
-            padded[:n_pages] = pages
             kpad = np.zeros((shape[0], width, *shape[2:]), dtype)
             vpad = np.zeros_like(kpad)
             kpad[:, :n_pages] = k
             vpad[:, :n_pages] = v
-            self.kv = self._import_fn(
-                self.kv, jnp.asarray(kpad), jnp.asarray(vpad),
-                jnp.asarray(padded),
-            )
+            self._import_dev(pages, kpad, vpad)
             return pages
 
         try:
@@ -1752,6 +2146,8 @@ class JaxEngine:
         seq = Sequence(context.id, prompt, opts)
         seq.seed = opts.seed if opts.seed is not None else self._py_rng.getrandbits(31)
         seq.pages = pages
+        if self._pooled and pages:
+            seq.kv_rank = self.pool.rank_of(pages[0])
         seq.num_computed = len(prompt)
         seq.num_cached = len(prompt)
         seq.output_tokens = [first_token]
@@ -1805,9 +2201,7 @@ class JaxEngine:
             # keep followers lockstep: they rebuild their KV shards too
             self._lockstep_send({"kind": "recover"})
         self.kv = self._make_kv()
-        self.pool = PagePool(
-            self.cfg.num_pages, self.cfg.page_size, event_sink=self._emit_event
-        )
+        self.pool = self._make_pool()
         self._emit_event(KvEvent("cleared", []))
         self.scheduler.pool = self.pool
         for seq in self.scheduler.waiting:

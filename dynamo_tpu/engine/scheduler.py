@@ -70,6 +70,8 @@ class Sequence:
         self.mm_embeds = None  # np [N, patches, h] (engine fills)
         self.cache_salt = ""
         self.pages: List[int] = []
+        self.kv_rank = 0  # pool partition this sequence's pages live on
+        self._admit_hashes: Optional[List[int]] = None  # scheduler cache
         self.num_cached = 0  # prompt tokens satisfied from prefix cache
         self.num_computed = 0  # tokens whose KV is written
         self.output_tokens: List[int] = []
@@ -169,13 +171,39 @@ class Scheduler:
             seq = self.waiting[0]
             first_chunk = min(seq.prompt_len, self.cfg.max_prefill_tokens)
             need = seq.pages_needed(first_chunk, self.cfg.page_size)
-            if self.pool.available_pages < need + self._watermark_pages():
+            if seq.num_computed > 0 or self.pool.ranks == 1:
+                # imported KV keeps the rank its pages live on; single
+                # pools skip partition scoring entirely
+                rank = seq.kv_rank
+            else:
+                # pick the pool partition: longest cached prefix wins,
+                # ties spread by availability
+                rank, _ = self.pool.best_rank(self._seq_hashes(seq))
+            if self.pool.available_on(rank) < need + self._watermark_pages():
                 break
+            seq.kv_rank = rank
             self.waiting.popleft()
             if self.cfg.enable_prefix_caching:
                 self._apply_prefix_cache(seq)
             seq.status = "running"
             self.running.append(seq)
+
+    def _seq_hashes(self, seq: Sequence) -> List[int]:
+        """Block-hash chain for admission-time cache scoring (never hits
+        the whole-prompt block — its last token must be recomputed).
+        Cached on the sequence: the prompt never changes, and a waiting
+        head-of-queue sequence is re-examined every pump tick."""
+        if not self.cfg.enable_prefix_caching:
+            return []
+        if getattr(seq, "_admit_hashes", None) is None:
+            ps = self.cfg.page_size
+            hashes = compute_block_hash_for_seq(
+                seq.prompt, ps, self.cfg.block_hash_salt + seq.cache_salt
+            )
+            if seq.prompt_len % ps == 0 and hashes:
+                hashes = hashes[:-1]
+            seq._admit_hashes = hashes
+        return seq._admit_hashes
 
     def add_imported(self, seq: Sequence) -> None:
         """Admit a sequence whose KV was injected externally (disagg decode
@@ -188,12 +216,8 @@ class Scheduler:
         ps = self.cfg.page_size
         # never cache-hit the *entire* prompt: the last token must be
         # recomputed so prefill produces logits to sample from.
-        hashes = compute_block_hash_for_seq(
-            seq.prompt, ps, self.cfg.block_hash_salt + seq.cache_salt
-        )
-        if seq.prompt_len % ps == 0 and hashes:
-            hashes = hashes[:-1]
-        hit_pages = self.pool.lookup(hashes)
+        hashes = self._seq_hashes(seq)
+        hit_pages = self.pool.lookup_on(seq.kv_rank, hashes)
         if self.onboard_fn is not None and len(hit_pages) < len(hashes):
             # onboard() returns pages already holding this sequence's ref
             hit_pages.extend(self.onboard_fn(hashes[len(hit_pages):]))
@@ -267,9 +291,10 @@ class Scheduler:
                     # pages that the running decodes' next growth will
                     # not immediately evict it again)
                     n_decoding = sum(
-                        1 for s in self.running if s.prefill_done
+                        1 for s in self.running
+                        if s.prefill_done and s.kv_rank == seq.kv_rank
                     )
-                    if (self.pool.available_pages
+                    if (self.pool.available_on(seq.kv_rank)
                             < need + self._watermark_pages() + n_decoding):
                         continue
                 if not self.try_extend_pages(seq, seq.num_computed + chunk):
@@ -309,10 +334,10 @@ class Scheduler:
             return True
         while True:
             try:
-                seq.pages.extend(self.pool.allocate(need))
+                seq.pages.extend(self.pool.allocate_on(seq.kv_rank, need))
                 return True
             except NoPagesError:
-                victim = self._pick_victim(exclude=seq)
+                victim = self._pick_victim(exclude=seq, rank=seq.kv_rank)
                 if victim is None:
                     # nothing left to evict: with the pool to itself the
                     # sequence can never fit — error it out instead of the
@@ -329,14 +354,16 @@ class Scheduler:
         need = seq.pages_needed(upto_tokens, self.cfg.page_size) - len(seq.pages)
         if need <= 0:
             return True
-        if self.pool.available_pages < need:
+        if self.pool.available_on(seq.kv_rank) < need:
             return False
-        seq.pages.extend(self.pool.allocate(need))
+        seq.pages.extend(self.pool.allocate_on(seq.kv_rank, need))
         return True
 
-    def _pick_victim(self, exclude: Sequence) -> Optional[Sequence]:
+    def _pick_victim(self, exclude: Sequence, rank: int = 0) -> Optional[Sequence]:
+        """Youngest running sequence on the SAME pool partition (evicting
+        another rank's pages cannot unblock this allocation)."""
         for seq in reversed(self.running):  # youngest first
-            if seq is not exclude:
+            if seq is not exclude and seq.kv_rank == rank:
                 return seq
         return None
 

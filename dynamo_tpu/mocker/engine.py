@@ -212,6 +212,12 @@ class MockEngine:
             self.step_log.append(plan.kind)
             if plan.kind == "prefill":
                 await self._run_prefill(plan.prefill)
+            elif plan.kind == "mixed":
+                # one device dispatch runs both halves back to back; the
+                # simulated duration is the serial sum, matching the real
+                # engine's mixed program
+                await self._run_prefill(plan.prefill)
+                await self._run_decode(plan.decode)
             else:
                 await self._run_decode(plan.decode)
             await asyncio.sleep(0)

@@ -183,9 +183,11 @@ def param_pspecs(cfg: ModelConfig, tp_axis: str = "tp", ep_axis: str = "tp") -> 
     return specs
 
 
-def kv_cache_pspec(tp_axis: str = "tp") -> KVCache:
-    """KV pages shard on kv-heads (axis 3) under TP."""
-    spec = P(None, None, None, tp_axis, None)
+def kv_cache_pspec(tp_axis: str = "tp", pool_axes=None) -> KVCache:
+    """KV pages shard on kv-heads (axis 3) under TP; with `pool_axes`
+    (e.g. ("dp", "sp")) the PAGE axis additionally shards across those
+    mesh axes — the partitioned pool layout (engine kv_partition)."""
+    spec = P(None, pool_axes, None, tp_axis, None)
     return KVCache(spec, spec)
 
 

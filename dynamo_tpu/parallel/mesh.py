@@ -68,10 +68,10 @@ def shard_params(params, cfg: ModelConfig, mesh: Mesh):
     )
 
 
-def shard_kv_cache(kv, mesh: Mesh):
+def shard_kv_cache(kv, mesh: Mesh, pool_axes=None):
     from .multihost import host_array_to_global
 
-    spec = kv_cache_pspec()
+    spec = kv_cache_pspec(pool_axes=pool_axes)
     return jax.tree.map(
         lambda x, s: host_array_to_global(mesh, s, x), kv, spec
     )

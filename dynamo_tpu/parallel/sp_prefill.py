@@ -58,11 +58,16 @@ def _embed_sp(embed_local: jax.Array, tokens: jax.Array) -> jax.Array:
 
 
 def _layer_sp(lp, kv_layer, x, positions, table_full, chunk_full, cfg, inv_freq,
-              tp: int):
+              tp: int, owner_l=None, table_l=None, chunk_l=None):
     """One decoder layer on a [Bl, Sl] shard holding heads/tp: ring
     attention over sp on the local heads, KV head-slice written to the
     tp-sharded pool from the sp/dp-gathered chunk, tp psums after the
-    attention and MLP output projections."""
+    attention and MLP output projections.
+
+    With `owner_l` (partitioned pool): each (dp, sp) shard owns its own
+    page range, so the write gathers the chunk over sp ONLY and each
+    shard scatters just the rows it owns (non-owned rows write the
+    shard's local trash page 0) — no dp gather, no replication."""
     Bl, Sl, h = x.shape
     nh = cfg.num_attention_heads // tp
     nkv = cfg.num_key_value_heads // tp
@@ -79,18 +84,27 @@ def _layer_sp(lp, kv_layer, x, positions, table_full, chunk_full, cfg, inv_freq,
 
     attn = ring_attention_local(q, k, v, axis_name="sp", causal=True)
 
-    # the pool write must be identical on every sp/dp replica (the pool
-    # is head-sharded over tp, so each tp shard scatters its own slice):
-    # gather the full chunk (sp → sequence axis, dp → batch axis) and
-    # scatter all rows
     k_full = jax.lax.all_gather(k, "sp", axis=1, tiled=True)
     v_full = jax.lax.all_gather(v, "sp", axis=1, tiled=True)
-    k_full = jax.lax.all_gather(k_full, "dp", axis=0, tiled=True)
-    v_full = jax.lax.all_gather(v_full, "dp", axis=0, tiled=True)
-    zeros = jnp.zeros((k_full.shape[0],), jnp.int32)
-    k_pages, v_pages = write_kv_pages(
-        k_pages, v_pages, k_full, v_full, table_full, zeros, chunk_full
-    )
+    if owner_l is not None:
+        # partitioned pool: local rows only, owner-masked local tables
+        mine = (owner_l == jax.lax.axis_index("sp"))[:, None]
+        masked = jnp.where(mine, table_l, 0)
+        zeros = jnp.zeros((Bl,), jnp.int32)
+        k_pages, v_pages = write_kv_pages(
+            k_pages, v_pages, k_full, v_full, masked, zeros, chunk_l
+        )
+    else:
+        # replicated pool: the write must be identical on every sp/dp
+        # replica (the pool is head-sharded over tp, so each tp shard
+        # scatters its own slice): gather the full chunk (sp → sequence
+        # axis, dp → batch axis) and scatter all rows
+        k_full = jax.lax.all_gather(k_full, "dp", axis=0, tiled=True)
+        v_full = jax.lax.all_gather(v_full, "dp", axis=0, tiled=True)
+        zeros = jnp.zeros((k_full.shape[0],), jnp.int32)
+        k_pages, v_pages = write_kv_pages(
+            k_pages, v_pages, k_full, v_full, table_full, zeros, chunk_full
+        )
 
     attn_out = matmul_any(
         attn.reshape(Bl, Sl, nh * hd), lp["wo"], "bsd,dh->bsh"
@@ -186,13 +200,18 @@ def forward_prefill_sp(
     page_table: jax.Array,  # [B, max_pages]
     chunk_lens: jax.Array,  # [B] valid tokens (prompt starts at position 0)
     mesh: Mesh,
+    owner: jax.Array = None,  # [B] sp-slot owning each row's pages
+    pool_axes=None,  # e.g. ("dp","sp") — partitioned-pool kv layout
 ) -> Tuple[jax.Array, KVCache]:
     """Whole-prompt prefill with the sequence sharded over `sp` and heads
     over `tp`.
 
-    Returns (last-position logits [B, V], updated KVCache) — the pool
-    comes back in the decode path's layout (sp/dp-replicated,
-    head-sharded over tp), ready for the ordinary decode step.
+    Returns (last-position logits [B, V], updated KVCache).  Without
+    `owner` the pool comes back in the replicated decode layout (sp/dp-
+    replicated, head-sharded over tp).  With `owner`/`pool_axes` the pool
+    is PARTITIONED over (dp, sp): `page_table` carries LOCAL ids and each
+    row's KV is written only on the (dp, sp) shard that owns it — HBM
+    capacity scales with the mesh (engine kv_partition).
     """
     tp = mesh.shape.get("tp", 1)
     if cfg.is_moe and tp > 1:
@@ -217,14 +236,19 @@ def forward_prefill_sp(
         )
     inv_freq = rope_frequencies(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
 
-    def body(params, kv_k, kv_v, tokens_l, table_l, chunk_l):
+    pooled = owner is not None
+
+    def body(params, kv_k, kv_v, tokens_l, table_l, chunk_l, owner_l):
         sp_i = jax.lax.axis_index("sp")
         Bl, Sl = tokens_l.shape
         positions = sp_i * Sl + jnp.arange(Sl)[None, :] + jnp.zeros(
             (Bl, 1), jnp.int32
         )
-        table_full = jax.lax.all_gather(table_l, "dp", axis=0, tiled=True)
-        chunk_full = jax.lax.all_gather(chunk_l, "dp", axis=0, tiled=True)
+        if pooled:
+            table_full = chunk_full = None
+        else:
+            table_full = jax.lax.all_gather(table_l, "dp", axis=0, tiled=True)
+            chunk_full = jax.lax.all_gather(chunk_l, "dp", axis=0, tiled=True)
 
         x = _embed_sp(params["embed"], tokens_l)
 
@@ -234,6 +258,8 @@ def forward_prefill_sp(
             h, (k_pages, v_pages) = _layer_sp(
                 lp, (k_pages, v_pages), h, positions, table_full,
                 chunk_full, cfg, inv_freq, tp,
+                owner_l=owner_l if pooled else None,
+                table_l=table_l, chunk_l=chunk_l,
             )
             return h, (k_pages, v_pages)
 
@@ -254,11 +280,14 @@ def forward_prefill_sp(
         return logits, k_new, v_new
 
     pspec = quantize_pspecs(params, param_pspecs(cfg))
-    kv_spec = kv_cache_pspec().k
+    kv_spec = kv_cache_pspec(pool_axes=pool_axes).k
+    if owner is None:
+        owner = jnp.zeros(tokens.shape[:1], jnp.int32)
     logits, k_new, v_new = shard_map(
         body,
         mesh=mesh,
-        in_specs=(pspec, kv_spec, kv_spec, P("dp", "sp"), P("dp", None), P("dp")),
+        in_specs=(pspec, kv_spec, kv_spec, P("dp", "sp"), P("dp", None),
+                  P("dp"), P("dp")),
         out_specs=(P("dp", "tp"), kv_spec, kv_spec),
-    )(params, kv.k, kv.v, tokens, page_table, chunk_lens)
+    )(params, kv.k, kv.v, tokens, page_table, chunk_lens, owner)
     return logits, KVCache(k_new, v_new)

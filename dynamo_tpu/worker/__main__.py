@@ -56,6 +56,10 @@ def main() -> None:
                          "max_prefill_tokens, 0 disables mixing "
                          "(prefill-first scheduling)")
     ap.add_argument("--no-prefix-caching", action="store_true")
+    ap.add_argument("--kv-partition", action="store_true",
+                    help="partition the KV pool across the mesh's dp*sp "
+                         "shards (num_pages becomes per-shard; aggregate "
+                         "capacity scales with the mesh)")
     ap.add_argument("--disagg-role", default="both",
                     choices=["both", "prefill", "decode"])
     # distributed KVBM: shared host/disk/object-store KV tiers
@@ -303,6 +307,7 @@ def _build_engine(args):
         decode_steps=args.decode_steps,
         decode_chain=args.decode_chain,
         mixed_prefill_tokens=args.mixed_prefill_tokens,
+        kv_partition=args.kv_partition,
         enable_prefix_caching=not args.no_prefix_caching,
     )
     if args.mock:
