@@ -358,6 +358,14 @@ class KvTransferClient:
             from .device_transfer import fetch_colocated, local_source
 
             source = local_source(descriptor)
+            if source is not None and (
+                getattr(self.engine, "_multihost", False)
+                or getattr(source.engine, "_multihost", False)
+            ):
+                # a multihost engine's device ops must ride its lockstep
+                # plan channel; the colocated lane's raw jits would run
+                # on one rank of a multi-process array — host lane instead
+                source = None
             if source is not None:
                 dest_pages, n_dst = await fetch_colocated(
                     self, source, descriptor

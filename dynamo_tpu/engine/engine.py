@@ -187,8 +187,16 @@ def _build_prefill_step_sp(cfg: ModelConfig, mesh, with_top: bool = False,
     return step
 
 
-def _build_export_fn():
-    @jax.jit
+def _build_export_fn(replicate_mesh=None):
+    """`replicate_mesh` (multihost lockstep): gather the result to every
+    process — the leader could not read a tp-sharded export whose shards
+    live on other hosts."""
+    kw = {}
+    if replicate_mesh is not None:
+        rep = NamedSharding(replicate_mesh, P())
+        kw["out_shardings"] = (rep, rep)
+
+    @partial(jax.jit, **kw)
     def export(kv, pages):  # pages [N] int32 → (k,v) [L, N, page, n_kv, hd]
         return kv.k[:, pages], kv.v[:, pages]
 
@@ -454,11 +462,13 @@ def _build_decode_step_pooled(cfg: ModelConfig, mesh, pool_axes, n_steps: int,
                     samp, seeds)
 
 
-def _build_export_fn_pooled(cfg: ModelConfig, mesh, pool_axes):
+def _build_export_fn_pooled(cfg: ModelConfig, mesh, pool_axes,
+                            replicate_out: bool = False):
     """Export LOCAL page ids from ONE pool rank: every shard gathers its
     local candidates, the owner's survive a mask + psum, and the result
     comes back replicated over the pool axes (still tp-sharded on
-    kv-heads; single-process callers can device_get it directly)."""
+    kv-heads; single-process callers can device_get it directly —
+    multihost lockstep sets `replicate_out` to gather tp too)."""
     from ..parallel._compat import shard_map
 
     kvspec, _, _ = _pooled_specs(pool_axes)
@@ -476,7 +486,11 @@ def _build_export_fn_pooled(cfg: ModelConfig, mesh, pool_axes):
         out_specs=(P(), P()),
         axis_names=set(pool_axes),
     )
-    return jax.jit(sm)
+    kw = {}
+    if replicate_out:
+        rep = NamedSharding(mesh, P())
+        kw["out_shardings"] = (rep, rep)
+    return jax.jit(sm, **kw)
 
 
 def _build_import_fn_pooled(cfg: ModelConfig, mesh, pool_axes):
@@ -563,6 +577,9 @@ class JaxEngine:
         parallel=None,  # parallel.ParallelConfig — dp×tp serving mesh
         devices=None,
         vision=None,  # (vision_params, models.vision.VisionConfig)
+        multihost: Optional[bool] = None,  # override process-count
+        # detection (a process-local auxiliary engine inside a multihost
+        # job passes False and pins its devices)
     ):
         self.model_cfg = model_cfg
         self.cfg = engine_cfg or EngineConfig()
@@ -582,7 +599,8 @@ class JaxEngine:
         self._pool_ranks = 1
         self._bax = "dp"  # batch-axis spec entry ("dp" | ("dp","sp"))
         # multihost lockstep: rank 0 leads, others replay (follower_loop)
-        self._multihost = jax.process_count() > 1
+        self._multihost = (jax.process_count() > 1 if multihost is None
+                           else multihost)
         self._lockstep_leader = jax.process_index() == 0
         if self._multihost and (parallel is None or parallel.world <= 1):
             raise ValueError(
@@ -706,6 +724,7 @@ class JaxEngine:
         # prefill of the sequence, injected in place of placeholder tokens
         self.vision = vision
         self._encode_fn = None
+        self._embed_fn = None
         if vision is not None and (self._multihost or self._sp > 1):
             raise ValueError(
                 "the vision tower is not supported under multihost "
@@ -725,13 +744,16 @@ class JaxEngine:
         self._mixed_steps: Dict[tuple, Callable] = {}
         if self._pooled:
             self._export_fn = _build_export_fn_pooled(
-                self.model_cfg, self.mesh, self._pool_axes
+                self.model_cfg, self.mesh, self._pool_axes,
+                replicate_out=self._multihost,
             )
             self._import_fn = _build_import_fn_pooled(
                 self.model_cfg, self.mesh, self._pool_axes
             )
         else:
-            self._export_fn = _build_export_fn()
+            self._export_fn = _build_export_fn(
+                self.mesh if self._multihost else None
+            )
             self._import_fn = _build_import_fn()
         # device ops queued by the loop thread, executed by the pump between
         # steps (self.kv is only ever touched between steps)
@@ -1858,6 +1880,14 @@ class JaxEngine:
                         d_tokens, d_pos, d_ctr, counts, d_table, d_samp,
                         d_seeds, desc["penalized"], desc["with_top"],
                     )
+                elif kind == "kv_export":
+                    self._export_replay(desc["padded"], desc["rank"])
+                elif kind == "kv_import":
+                    self._import_replay(
+                        desc["padded"], desc["rank"], desc["k"], desc["v"]
+                    )
+                elif kind == "embed":
+                    self._embed_replay(desc["tokens"], desc["lens"])
             except Exception:  # noqa: BLE001
                 logger.exception(
                     "follower dispatch failed; awaiting leader recover"
@@ -1886,15 +1916,12 @@ class JaxEngine:
             tokens[i, : len(t)] = t
             lens[i] = len(t)
 
-        if not hasattr(self, "_embed_fn"):
-            cfg = self.model_cfg
-            self._embed_fn = jax.jit(
-                lambda p, tok, ln: forward_embed(p, cfg, tok, ln)
-            )
-
         def op():
-            out = self._embed_fn(self.params, jnp.asarray(tokens), jnp.asarray(lens))
-            return np.asarray(jax.device_get(out))
+            if self._multihost:
+                self._lockstep_send(
+                    {"kind": "embed", "tokens": tokens, "lens": lens}
+                )
+            return self._embed_replay(tokens, lens)
 
         vecs = await self._device_op(op)
         return {
@@ -1902,13 +1929,27 @@ class JaxEngine:
             "prompt_tokens": int(lens.sum()),
         }
 
-    async def _device_op(self, op):
-        """Run a device op between pump steps (never concurrent with them)."""
-        if self._multihost:
-            raise RuntimeError(
-                "leader-local device ops (disagg KV export/import, embed) "
-                "are not supported under multihost lockstep yet"
+    def _embed_replay(self, tokens: np.ndarray, lens: np.ndarray) -> np.ndarray:
+        """The device half of an embed op (leader and followers run this
+        identically; multihost gathers the result to every process)."""
+        if self._embed_fn is None:
+            cfg = self.model_cfg
+            kw = ({"out_shardings": NamedSharding(self.mesh, P())}
+                  if self._multihost else {})
+            self._embed_fn = jax.jit(
+                lambda p, tok, ln: forward_embed(p, cfg, tok, ln), **kw
             )
+        out = self._embed_fn(
+            self.params, self._put(tokens), self._put(lens)
+        )
+        return np.asarray(jax.device_get(out))
+
+    async def _device_op(self, op):
+        """Run a device op between pump steps (never concurrent with
+        them).  Under multihost lockstep the typed device ops (KV
+        export/import, embed) broadcast themselves on the plan channel
+        from inside the op; pool-only ops stay leader-local (followers
+        hold no scheduler/pool state)."""
         self._ensure_pump()
         fut = self._loop.create_future()
         self._pending_ops.append((op, fut))
@@ -1939,35 +1980,69 @@ class JaxEngine:
     def _export_dev(self, pages: List[int], width: Optional[int] = None):
         """jit export of page ids → (k, v) device arrays [L, width, ...].
         Partitioned pools take LOCAL ids + the owning rank (a sequence's
-        pages always share one rank)."""
+        pages always share one rank).  Under multihost lockstep the op is
+        broadcast so every rank issues the same jit (disagg composes with
+        multihost — reference: disagg_serving.md:110-120)."""
         width = width or self._pow2_width(len(pages))
         padded = np.zeros((width,), np.int32)
         if self._pooled:
             rank = self.pool.rank_of(pages[0]) if pages else 0
             padded[: len(pages)] = [p % self.cfg.num_pages for p in pages]
-            return self._export_fn(
-                self.kv, jnp.asarray(padded), jnp.int32(rank)
+        else:
+            rank = None
+            padded[: len(pages)] = pages
+        if self._multihost:
+            self._lockstep_send(
+                {"kind": "kv_export", "padded": padded, "rank": rank}
             )
-        padded[: len(pages)] = pages
-        return self._export_fn(self.kv, jnp.asarray(padded))
+        return self._export_replay(padded, rank)
+
+    def _export_replay(self, padded: np.ndarray, rank: Optional[int]):
+        """The device half of an export (leader and followers run this
+        identically)."""
+        if rank is not None:
+            return self._export_fn(
+                self.kv, self._put(padded), self._put(np.int32(rank))
+            )
+        return self._export_fn(self.kv, self._put(padded))
 
     def _import_dev(self, pages: List[int], kpad, vpad) -> None:
         """jit import of padded (k, v) blobs into the given page ids
-        (padding rows hit the trash page)."""
+        (padding rows hit the trash page).  Multihost: the blob rides the
+        lockstep plan so every rank writes its own KV shards."""
         width = kpad.shape[1]
         padded = np.zeros((width,), np.int32)
         if self._pooled:
             rank = self.pool.rank_of(pages[0]) if pages else 0
             padded[: len(pages)] = [p % self.cfg.num_pages for p in pages]
+        else:
+            rank = None
+            padded[: len(pages)] = pages
+        if self._multihost:
+            if isinstance(kpad, jax.Array):
+                kpad = np.asarray(jax.device_get(kpad))
+                vpad = np.asarray(jax.device_get(vpad))
+            self._lockstep_send({
+                "kind": "kv_import", "padded": padded, "rank": rank,
+                "k": np.ascontiguousarray(kpad),
+                "v": np.ascontiguousarray(vpad),
+            })
+        self._import_replay(padded, rank, kpad, vpad)
+
+    def _import_replay(self, padded: np.ndarray, rank: Optional[int],
+                       kpad, vpad) -> None:
+        if isinstance(kpad, jax.Array):
+            k_d, v_d = kpad, vpad  # colocated device lane (single-process)
+        else:
+            k_d, v_d = self._put(kpad), self._put(vpad)
+        if rank is not None:
             self.kv = self._import_fn(
-                self.kv, jnp.asarray(kpad), jnp.asarray(vpad),
-                jnp.asarray(padded), jnp.int32(rank),
+                self.kv, k_d, v_d, self._put(padded),
+                self._put(np.int32(rank)),
             )
         else:
-            padded[: len(pages)] = pages
             self.kv = self._import_fn(
-                self.kv, jnp.asarray(kpad), jnp.asarray(vpad),
-                jnp.asarray(padded),
+                self.kv, k_d, v_d, self._put(padded)
             )
 
     async def export_pages(self, pages: List[int]):
