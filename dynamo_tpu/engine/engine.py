@@ -187,6 +187,49 @@ def _build_prefill_step_sp(cfg: ModelConfig, mesh, with_top: bool = False,
     return step
 
 
+def _build_prefill_step_pp(cfg: ModelConfig, mesh, with_top: bool = False,
+                           attn_impl: str = "xla"):
+    """Prefill through the GPipe-staged pipeline (parallel/pp_engine.py);
+    sampling happens at the jit level on the replicated last-position
+    logits."""
+    from ..parallel.pp_engine import forward_prefill_pp
+
+    @partial(jax.jit, donate_argnums=(1,))
+    def step(params, kv, tokens, page_table, prefix_lens, chunk_lens, samp,
+             seeds, counters):
+        logits, kv = forward_prefill_pp(
+            params, cfg, kv, tokens, page_table, prefix_lens, chunk_lens,
+            mesh, attn_impl,
+        )
+        out = sample_tokens(logits, samp, seeds, counters)
+        logp = compute_logprobs(logits, out)
+        return _pack_out(out, logp, logits if with_top else None), out, kv
+
+    return step
+
+
+def _build_decode_step_pp(cfg: ModelConfig, mesh, n_steps: int,
+                          max_valid_pos: int, attn_impl: str = "xla"):
+    """Multi-token decode with the pipeline kept full (the ring schedule
+    of parallel/pp_engine.py); packs [T, 2B] = [tok | logp] per step —
+    penalties/top-logprobs are rejected at request validation."""
+    from ..parallel.pp_engine import forward_decode_pp
+
+    @partial(jax.jit, donate_argnums=(1,))
+    def step(params, kv, tokens, positions, counters, page_table, samp,
+             seeds):
+        toks, logp, kv = forward_decode_pp(
+            params, cfg, kv, tokens, positions, page_table, samp, seeds,
+            counters, n_steps, max_valid_pos, mesh, attn_impl,
+        )
+        packed = jnp.concatenate(
+            [jax.lax.bitcast_convert_type(toks, jnp.float32), logp], axis=-1
+        )
+        return packed, toks[-1], positions + n_steps, counters + n_steps, kv
+
+    return step
+
+
 def _build_export_fn(replicate_mesh=None):
     """`replicate_mesh` (multihost lockstep): gather the result to every
     process — the leader could not read a tp-sharded export whose shards
@@ -612,12 +655,43 @@ class JaxEngine:
                 "KV tiering (kvbm) is not supported under multihost "
                 "lockstep yet — offload device ops are leader-local"
             )
+        self._pp = 1
         if parallel is not None and parallel.world > 1:
             from ..parallel import make_mesh
 
             self.mesh = make_mesh(parallel, devices)
             self._dp = parallel.dp
             self._sp = parallel.sp
+            self._pp = parallel.pp
+            if self._pp > 1:
+                if model_cfg.num_hidden_layers % self._pp:
+                    raise ValueError(
+                        f"pp={self._pp} must divide num_hidden_layers="
+                        f"{model_cfg.num_hidden_layers}"
+                    )
+                if self.cfg.kv_partition:
+                    raise ValueError(
+                        "pp does not compose with kv_partition yet (the "
+                        "KV layer axis is already sharded over pp)"
+                    )
+                if self._multihost:
+                    raise ValueError("pp is single-host for now")
+                if vision is not None or tiered is not None:
+                    raise ValueError(
+                        "pp does not support the vision tower or KVBM "
+                        "tiering yet"
+                    )
+                # decode microbatches the batch into pp groups, and the
+                # fused/mixed fast paths assume the flat dispatch shape
+                self.cfg = dataclasses.replace(
+                    self.cfg,
+                    fuse_prefill_decode=False,
+                    mixed_prefill_tokens=0,
+                    decode_batch_buckets=sorted({
+                        -(-b // (self._dp * self._pp)) * self._dp * self._pp
+                        for b in self.cfg.decode_batch_buckets
+                    }),
+                )
             if self._sp > 1:
                 # sp prefill is whole-prompt ring attention: no cached
                 # prefixes, no chunking (mixed dispatches would chunk),
@@ -838,6 +912,10 @@ class JaxEngine:
     def _shard_params(self, params):
         if self.mesh is None:
             return params
+        if self._pp > 1:
+            from ..parallel.pp_engine import shard_params_pp
+
+            return shard_params_pp(params, self.model_cfg, self.mesh)
         from ..parallel import shard_params
 
         return shard_params(params, self.model_cfg, self.mesh)
@@ -865,6 +943,14 @@ class JaxEngine:
         )
         if self.mesh is None:
             return kv
+        if self._pp > 1:
+            from ..parallel.multihost import host_array_to_global
+            from ..parallel.pp_engine import kv_pspec_pp
+
+            return jax.tree.map(
+                lambda x, s: host_array_to_global(self.mesh, s, x),
+                kv, kv_pspec_pp(),
+            )
         from ..parallel import shard_kv_cache
 
         return shard_kv_cache(
@@ -908,6 +994,11 @@ class JaxEngine:
                     lockstep=self._multihost,
                     pool_axes=self._pool_axes if self._pooled else None,
                 )
+            elif self._pp > 1:
+                self._prefill_steps[key] = _build_prefill_step_pp(
+                    self.model_cfg, self.mesh, with_top=with_top,
+                    attn_impl=self._attn_impl,
+                )
             elif self._pooled:
                 self._prefill_steps[key] = _build_prefill_step_pooled(
                     self.model_cfg, self.mesh, self._pool_axes,
@@ -925,7 +1016,17 @@ class JaxEngine:
     def _get_decode_step(self, penalized: bool, with_top: bool):
         key = (penalized, with_top)
         if key not in self._decode_steps:
-            if self._pooled:
+            if self._pp > 1:
+                if penalized or with_top:
+                    # generate() rejects these requests up front
+                    raise RuntimeError(
+                        "pp decode does not support penalties/top_logprobs"
+                    )
+                self._decode_steps[key] = _build_decode_step_pp(
+                    self.model_cfg, self.mesh, self.cfg.decode_steps,
+                    self.cfg.hard_cap, attn_impl=self._attn_impl,
+                )
+            elif self._pooled:
                 self._decode_steps[key] = _build_decode_step_pooled(
                     self.model_cfg, self.mesh, self._pool_axes,
                     self.cfg.decode_steps, self.cfg.hard_cap,
@@ -1020,6 +1121,13 @@ class JaxEngine:
             return
         if opts.max_tokens <= 0:
             yield {"token_ids": [], "finish_reason": "length"}
+            return
+        if self._pp > 1 and (opts.penalized or opts.top_logprobs > 0):
+            yield {
+                "token_ids": [], "finish_reason": "error",
+                "error": "pipeline-parallel workers do not support "
+                         "frequency/presence penalties or top_logprobs yet",
+            }
             return
         seq = Sequence(context.id, prompt, opts)
         seq.seed = opts.seed if opts.seed is not None else self._py_rng.getrandbits(31)

@@ -470,6 +470,69 @@ def _lm_logits(params: Params, cfg: ModelConfig, x: jax.Array) -> jax.Array:
     return matmul_any(x, head, "...h,hv->...v")
 
 
+def prefill_layers(
+    layers: Params,
+    cfg: ModelConfig,
+    kv: KVCache,
+    x: jax.Array,  # [B, S, h] — embedded input
+    positions: jax.Array,  # [B, S]
+    page_table: jax.Array,
+    prefix_lens: jax.Array,
+    chunk_lens: jax.Array,
+    attn_impl: str = "xla",
+    wins: Optional[Tuple[jax.Array, ...]] = None,  # per-layer windows xs
+) -> Tuple[jax.Array, KVCache]:
+    """Scan a STACK of decoder layers over an embedded chunk (the body of
+    `forward_prefill`, exposed so pipeline stages can run their local
+    layer slice — parallel/pp_engine.py)."""
+    inv_freq = rope_frequencies(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
+    if wins is None:
+        wins = _window_xs(cfg)
+
+    def body(carry, xs):
+        h = carry
+        lp, k_pages, v_pages = xs[:3]
+        h, (k_pages, v_pages) = _layer_prefill(
+            lp, (k_pages, v_pages), h, positions, page_table,
+            prefix_lens, chunk_lens, cfg, inv_freq, attn_impl,
+            window=xs[3] if wins else None,
+        )
+        return h, (k_pages, v_pages)
+
+    x, (k_new, v_new) = jax.lax.scan(body, x, (layers, kv.k, kv.v, *wins))
+    return x, KVCache(k_new, v_new)
+
+
+def decode_layers(
+    layers: Params,
+    cfg: ModelConfig,
+    kv: KVCache,
+    x: jax.Array,  # [B, h] — embedded last token
+    positions: jax.Array,  # [B]
+    page_table: jax.Array,
+    attn_impl: str = "xla",
+    wins: Optional[Tuple[jax.Array, ...]] = None,
+) -> Tuple[jax.Array, KVCache]:
+    """Scan a STACK of decoder layers for one decode step (the body of
+    `forward_decode`, exposed for pipeline stages)."""
+    inv_freq = rope_frequencies(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
+    seq_lens = positions + 1
+    if wins is None:
+        wins = _window_xs(cfg)
+
+    def body(carry, xs):
+        h = carry
+        lp, k_pages, v_pages = xs[:3]
+        h, (k_pages, v_pages) = _layer_decode(
+            lp, (k_pages, v_pages), h, positions, page_table, seq_lens, cfg,
+            inv_freq, attn_impl, window=xs[3] if wins else None,
+        )
+        return h, (k_pages, v_pages)
+
+    x, (k_new, v_new) = jax.lax.scan(body, x, (layers, kv.k, kv.v, *wins))
+    return x, KVCache(k_new, v_new)
+
+
 def forward_prefill(
     params: Params,
     cfg: ModelConfig,
@@ -490,29 +553,17 @@ def forward_prefill(
     embeddings to its engines, sglang/request_handlers/multimodal/
     encode_worker_handler.py)."""
     B, S = tokens.shape
-    inv_freq = rope_frequencies(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
     positions = prefix_lens[:, None] + jnp.arange(S)[None, :]
     x = params["embed"][tokens]  # [B, S, h]
     if extra_embeds is not None:
         x = jnp.where(extra_mask[..., None], extra_embeds.astype(x.dtype), x)
-    wins = _window_xs(cfg)
-
-    def body(carry, xs):
-        h = carry
-        lp, k_pages, v_pages = xs[:3]
-        h, (k_pages, v_pages) = _layer_prefill(
-            lp, (k_pages, v_pages), h, positions, page_table,
-            prefix_lens, chunk_lens, cfg, inv_freq, attn_impl,
-            window=xs[3] if wins else None,
-        )
-        return h, (k_pages, v_pages)
-
-    x, (k_new, v_new) = jax.lax.scan(
-        body, x, (params["layers"], kv.k, kv.v, *wins)
+    x, kv = prefill_layers(
+        params["layers"], cfg, kv, x, positions, page_table, prefix_lens,
+        chunk_lens, attn_impl,
     )
     last = jnp.maximum(chunk_lens - 1, 0)
     x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]  # [B, h]
-    return _lm_logits(params, cfg, x_last), KVCache(k_new, v_new)
+    return _lm_logits(params, cfg, x_last), kv
 
 
 def forward_embed(
@@ -568,21 +619,8 @@ def forward_decode(
     attn_impl: str = "xla",
 ) -> Tuple[jax.Array, KVCache]:
     """One decode step for the whole batch; returns logits [B, V]."""
-    inv_freq = rope_frequencies(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
-    seq_lens = positions + 1
     x = params["embed"][tokens]  # [B, h]
-    wins = _window_xs(cfg)
-
-    def body(carry, xs):
-        h = carry
-        lp, k_pages, v_pages = xs[:3]
-        h, (k_pages, v_pages) = _layer_decode(
-            lp, (k_pages, v_pages), h, positions, page_table, seq_lens, cfg,
-            inv_freq, attn_impl, window=xs[3] if wins else None,
-        )
-        return h, (k_pages, v_pages)
-
-    x, (k_new, v_new) = jax.lax.scan(
-        body, x, (params["layers"], kv.k, kv.v, *wins)
+    x, kv = decode_layers(
+        params["layers"], cfg, kv, x, positions, page_table, attn_impl
     )
-    return _lm_logits(params, cfg, x), KVCache(k_new, v_new)
+    return _lm_logits(params, cfg, x), kv

@@ -14,4 +14,13 @@ except ImportError:  # pragma: no cover — older jax
 
     def shard_map(f, **kw):
         kw.setdefault("check_rep", False)
+        kw.pop("check_vma", None)
+        # new-API partial-manual axis_names → old-API auto complement
+        if "axis_names" in kw:
+            manual = set(kw.pop("axis_names"))
+            mesh = kw.get("mesh")
+            if manual and mesh is not None:
+                auto = frozenset(set(mesh.axis_names) - manual)
+                if auto:
+                    kw["auto"] = auto
         return _shard_map_old(f, **kw)

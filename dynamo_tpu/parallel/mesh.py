@@ -30,21 +30,32 @@ class ParallelConfig:
     # (ring attention, parallel/sp_prefill.py).  Composes with tp: the
     # mesh becomes dp×sp×tp, heads sharded over tp within each sp shard.
     sp: int = 1
+    # pipeline parallelism: pp > 1 stages the layer stack (params AND the
+    # KV cache's layer axis) over a pp mesh axis (parallel/pp_engine.py).
+    # v1 composes with dp only (tp == sp == 1 when pp > 1).
+    pp: int = 1
 
     @property
     def world(self) -> int:
-        return self.dp * self.tp * self.sp
+        return self.dp * self.tp * self.sp * self.pp
 
     def validate(self, n_devices: int) -> None:
         if self.world != n_devices:
             raise ValueError(
-                f"dp*tp*sp = {self.world} != available devices {n_devices}"
+                f"dp*tp*sp*pp = {self.world} != available devices {n_devices}"
+            )
+        if self.pp > 1 and (self.tp > 1 or self.sp > 1):
+            raise ValueError(
+                "pp composes with dp only for now (set tp = sp = 1)"
             )
 
 
 def make_mesh(pcfg: ParallelConfig, devices: Optional[Sequence] = None) -> Mesh:
     devices = list(devices if devices is not None else jax.devices())
     pcfg.validate(len(devices))
+    if pcfg.pp > 1:
+        arr = np.array(devices).reshape(pcfg.dp, pcfg.pp)
+        return Mesh(arr, axis_names=("dp", "pp"))
     if pcfg.sp > 1:
         # sp meshes always carry a tp axis (size 1 when unused) so param
         # and KV specs are one convention everywhere

@@ -1,0 +1,324 @@
+"""Pipeline parallelism for the SERVING ENGINE: the real llama layer
+stack staged over a `pp` mesh axis.
+
+The reference reaches PP by passing `pipeline_parallel_size` through to
+its engines (wide_ep_decode.yaml:25, SURVEY.md §2.6); here it is native:
+
+- per-layer params AND the paged KV cache shard their layer axis over
+  `pp` — each device holds L/pp contiguous layers and those layers' KV
+  pages (HBM for weights and cache both scale with the pp degree);
+- prefill runs the GPipe schedule: one batch row per microbatch flows
+  through the stages over a `lax.scan` of ticks with `lax.ppermute` ring
+  shifts (S + B - 1 ticks);
+- decode keeps the pipeline FULL across the multi-token scan: the batch
+  splits into pp microbatches; the LAST stage samples each microbatch's
+  token and sends its embedding around the ring to stage 0, which feeds
+  it straight back in as the next decode step's input — steady state has
+  every stage busy every tick (T*M + pp - 1 ticks for T steps);
+- every device runs the same SPMD program; bubble ticks compute into
+  each stage's local trash page and are masked out.
+
+Composes with dp: the shard_map is manual over pp ONLY — dp stays auto
+(GSPMD), microbatches interleave across the dp blocks so every tick's
+compute partitions over dp, and the dp-replicated KV page axis keeps its
+replicas consistent exactly like the non-pp engine.  tp/sp within a
+stage are future work (v1 requires tp == sp == 1).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models import KVCache, ModelConfig
+from ..models.llama import (
+    _lm_logits,
+    decode_layers,
+    param_pspecs,
+    prefill_layers,
+)
+from ..ops import compute_logprobs, sample_tokens
+from ._compat import shard_map
+
+
+def param_pspecs_pp(cfg: ModelConfig, pp_axis: str = "pp"):
+    """Layer-stacked params shard axis 0 over pp (each stage holds its
+    layer slice); embeddings/head/norms replicate (v1 pp meshes keep
+    tp == 1)."""
+    base = param_pspecs(cfg)
+
+    def drop_tp(spec):  # replace every named entry with None
+        return P(*([None] * len(spec)))
+
+    out = {
+        "embed": drop_tp(base["embed"]),
+        "final_norm": drop_tp(base["final_norm"]),
+        "layers": {
+            k: P(pp_axis, *([None] * (len(s) - 1)))
+            for k, s in base["layers"].items()
+        },
+    }
+    if "lm_head" in base:
+        out["lm_head"] = drop_tp(base["lm_head"])
+    return out
+
+
+def kv_pspec_pp() -> KVCache:
+    """KV pages shard their LAYER axis over pp (stage-local cache)."""
+    s = P("pp", None, None, None, None)
+    return KVCache(s, s)
+
+
+def shard_params_pp(params, cfg: ModelConfig, mesh: Mesh):
+    from ..models.quantization import quantize_pspecs
+    from .multihost import host_array_to_global
+
+    specs = quantize_pspecs(params, param_pspecs_pp(cfg))
+    return jax.tree.map(
+        lambda x, s: host_array_to_global(mesh, s, x), params, specs
+    )
+
+
+def _local_wins(cfg: ModelConfig, l_local: int):
+    """This stage's slice of the per-layer sliding-window xs ((), or a
+    1-tuple of (L_local,) int32)."""
+    if not cfg.sliding_window:
+        return ()
+    full = jnp.asarray(cfg.layer_windows(), jnp.int32)
+    s = jax.lax.axis_index("pp")
+    return (jax.lax.dynamic_slice(full, (s * l_local,), (l_local,)),)
+
+
+def _pp_specs(cfg: ModelConfig):
+    from ..models.quantization import quantize_pspecs
+
+    def pspec_of(params):
+        return quantize_pspecs(params, param_pspecs_pp(cfg))
+
+    return pspec_of, kv_pspec_pp()
+
+
+def forward_prefill_pp(
+    params,
+    cfg: ModelConfig,
+    kv: KVCache,
+    tokens: jax.Array,  # [B, S]
+    page_table: jax.Array,  # [B, W]
+    prefix_lens: jax.Array,  # [B]
+    chunk_lens: jax.Array,  # [B]
+    mesh: Mesh,
+    attn_impl: str = "xla",
+) -> Tuple[jax.Array, KVCache]:
+    """GPipe prefill of a chunk batch: microbatch = one row.  Returns
+    (last-position logits [B, V] — sampling happens at the jit level —
+    and the updated stage-local KV)."""
+    stages = mesh.shape["pp"]
+    pspec_of, kvspec = _pp_specs(cfg)
+    # manual over pp ONLY: dp stays auto (GSPMD), so the KV page axis —
+    # replicated across dp — keeps its replicas consistent exactly like
+    # the non-pp engine (a manual dp axis would let each dp shard write
+    # only its own rows and silently diverge the "replicated" cache)
+    bx, bx2 = P(), P()
+
+    D = mesh.shape.get("dp", 1)
+
+    def body(params, kv_k, kv_v, tokens_l, table_l, prefix_l, chunk_l):
+        s = jax.lax.axis_index("pp")
+        Bl, S = tokens_l.shape
+        W = table_l.shape[1]
+        Bpd = Bl // D  # microbatch = one row PER dp shard, so each
+        # tick's [D, S, h] compute partitions over the auto dp axis
+        h = params["embed"].shape[-1]
+        layers = params["layers"]
+        l_local = jax.tree.leaves(layers)[0].shape[0]
+        wins = _local_wins(cfg, l_local)
+        x_in = params["embed"][tokens_l]  # [Bl, S, h] (embed replicated)
+        dt = x_in.dtype
+        positions = prefix_l[:, None] + jnp.arange(S)[None, :]
+        x_r = x_in.reshape(D, Bpd, S, h)
+        pos_r = positions.reshape(D, Bpd, S)
+        tbl_r = table_l.reshape(D, Bpd, W)
+        pre_r = prefix_l.reshape(D, Bpd)
+        chu_r = chunk_l.reshape(D, Bpd)
+        perm = [(i, (i + 1) % stages) for i in range(stages)]
+
+        def tick(carry, t):
+            state, kvk, kvv, out_buf = carry
+            m = t - s  # microbatch this stage handles at tick t
+            valid = (m >= 0) & (m < Bpd)
+            mi = jnp.clip(m, 0, Bpd - 1)
+            h_in = jnp.where(s == 0, x_r[:, mi], state)  # [D, S, h]
+            # invalid ticks write into this stage's trash page
+            table_m = jnp.where(valid, tbl_r[:, mi], 0)
+            h_out, kvc = prefill_layers(
+                layers, cfg, KVCache(kvk, kvv), h_in,
+                pos_r[:, mi], table_m, pre_r[:, mi],
+                chu_r[:, mi], attn_impl, wins=wins,
+            )
+            last = jnp.maximum(chu_r[:, mi] - 1, 0)  # [D]
+            x_last = jnp.take_along_axis(
+                h_out, last[:, None, None], axis=1
+            )[:, 0]  # [D, h]
+            write = (s == stages - 1) & valid
+            out_buf = out_buf.at[:, mi].set(
+                jnp.where(write, x_last, out_buf[:, mi])
+            )
+            state = jax.lax.ppermute(h_out, "pp", perm)
+            return (state, kvc.k, kvc.v, out_buf), None
+
+        init = (
+            jnp.zeros((D, S, h), dt),
+            kv_k, kv_v,
+            jnp.zeros((D, Bpd, h), dt),
+        )
+        (_, kvk, kvv, out_buf), _ = jax.lax.scan(
+            tick, init, jnp.arange(Bpd + stages - 1)
+        )
+        # only the last stage holds real hidden states — replicate them
+        out_buf = jax.lax.psum(
+            jnp.where(s == stages - 1, out_buf, jnp.zeros_like(out_buf)),
+            "pp",
+        ).astype(dt)
+        logits = _lm_logits(params, cfg, out_buf.reshape(Bl, h))  # [Bl, V]
+        return logits, kvk, kvv
+
+    logits, k_new, v_new = shard_map(
+        body, mesh=mesh,
+        in_specs=(pspec_of(params), kvspec.k, kvspec.v, bx2, bx2, bx, bx),
+        out_specs=(bx2, kvspec.k, kvspec.v),
+        axis_names={"pp"},
+    )(params, kv.k, kv.v, tokens, page_table, prefix_lens, chunk_lens)
+    return logits, KVCache(k_new, v_new)
+
+
+def forward_decode_pp(
+    params,
+    cfg: ModelConfig,
+    kv: KVCache,
+    tokens: jax.Array,  # [B] last sampled token per row
+    positions: jax.Array,  # [B]
+    page_table: jax.Array,  # [B, W]
+    samp,  # ops.SamplingParams of [B] arrays
+    seeds: jax.Array,  # [B] uint32
+    counters: jax.Array,  # [B]
+    n_steps: int,
+    max_valid_pos: int,
+    mesh: Mesh,
+    attn_impl: str = "xla",
+) -> Tuple[jax.Array, jax.Array, KVCache]:
+    """`n_steps` decode steps with the pipeline kept full: the batch
+    splits into pp microbatches; the last stage samples and ships the
+    next token's embedding around the ring to stage 0.  Requires
+    B_local % pp == 0 (the engine rounds its decode buckets).  Returns
+    (tokens [T, B], logprobs [T, B], kv)."""
+    stages = mesh.shape["pp"]
+    pspec_of, kvspec = _pp_specs(cfg)
+    bx, bx2 = P(), P()  # batch arrays: dp auto (see forward_prefill_pp)
+
+    D = mesh.shape.get("dp", 1)
+
+    def body(params, kv_k, kv_v, tok, pos, table, samp, seeds, ctr):
+        s = jax.lax.axis_index("pp")
+        Bl = tok.shape[0]
+        M = stages
+        # microbatches INTERLEAVE across dp blocks ([D, M, Bmd] grouping)
+        # so each tick's [D*Bmd] compute spans every auto-dp shard
+        Bmd = Bl // (D * M)
+        Bm = D * Bmd
+        h = params["embed"].shape[-1]
+        layers = params["layers"]
+        l_local = jax.tree.leaves(layers)[0].shape[0]
+        wins = _local_wins(cfg, l_local)
+        dt = params["embed"].dtype
+        W = table.shape[1]
+
+        def grp(a):  # [Bl, ...] → [D, M, Bmd, ...]
+            return a.reshape(D, M, Bmd, *a.shape[1:])
+
+        def mb_slice(a_g, mb):  # [D, M, Bmd, ...] → [D*Bmd, ...]
+            sl = a_g[:, mb]
+            return sl.reshape(Bm, *sl.shape[2:])
+
+        tok_g, pos_g, table_g = grp(tok), grp(pos), grp(table)
+        samp_g = jax.tree.map(grp, samp)
+        seeds_g, ctr_g = grp(seeds), grp(ctr)
+        perm = [(i, (i + 1) % stages) for i in range(stages)]
+        T = n_steps
+
+        def embed(t):
+            return params["embed"][t].astype(dt)
+
+        def tick(carry, t):
+            state, kvk, kvv, toks_out, logp_out = carry
+            g = t - s
+            mb = jnp.clip(g % M, 0, M - 1)
+            step = jnp.clip(g // M, 0, T - 1)
+            valid = (g >= 0) & (g < T * M)
+            first = (g >= 0) & (g < M)  # step 0: inject the input token
+            h_in = jnp.where(
+                (s == 0) & first, embed(mb_slice(tok_g, mb)), state
+            )
+            p = mb_slice(pos_g, mb) + step
+            ok = valid & (p < max_valid_pos)
+            safe_pos = jnp.where(ok, p, 0)
+            tbl = jnp.where(ok[:, None], mb_slice(table_g, mb), 0)
+            h_out, kvc = decode_layers(
+                layers, cfg, KVCache(kvk, kvv), h_in, safe_pos, tbl,
+                attn_impl, wins=wins,
+            )
+            logits = _lm_logits(params, cfg, h_out)  # [Bm, V]
+            tok_new = sample_tokens(
+                logits, jax.tree.map(lambda a: mb_slice(a, mb), samp_g),
+                mb_slice(seeds_g, mb), mb_slice(ctr_g, mb) + step,
+            )
+            logp = compute_logprobs(logits, tok_new)
+            write = (s == stages - 1) & valid
+            toks_out = toks_out.at[step, mb].set(
+                jnp.where(write, tok_new, toks_out[step, mb])
+            )
+            logp_out = logp_out.at[step, mb].set(
+                jnp.where(write, logp, logp_out[step, mb])
+            )
+            # the ring: interior stages forward activations; the last
+            # stage forwards the NEXT token's embedding to stage 0
+            send = jnp.where(s == stages - 1, embed(tok_new), h_out)
+            state = jax.lax.ppermute(send, "pp", perm)
+            return (state, kvc.k, kvc.v, toks_out, logp_out), None
+
+        init = (
+            jnp.zeros((Bm, h), dt),
+            kv_k, kv_v,
+            jnp.zeros((T, M, Bm), jnp.int32),
+            jnp.zeros((T, M, Bm), jnp.float32),
+        )
+        (_, kvk, kvv, toks_out, logp_out), _ = jax.lax.scan(
+            tick, init, jnp.arange(T * M + stages - 1)
+        )
+        toks_out = jax.lax.psum(
+            jnp.where(s == stages - 1, toks_out, jnp.zeros_like(toks_out)),
+            "pp",
+        )
+        logp_out = jax.lax.psum(
+            jnp.where(s == stages - 1, logp_out,
+                      jnp.zeros_like(logp_out)), "pp",
+        )
+
+        def ungrp(o):  # [T, M, D*Bmd] → [T, Bl] (invert the grouping)
+            return o.reshape(T, M, D, Bmd).transpose(0, 2, 1, 3).reshape(
+                T, Bl
+            )
+
+        return ungrp(toks_out), ungrp(logp_out), kvk, kvv
+
+    toks, logp, k_new, v_new = shard_map(
+        body, mesh=mesh,
+        in_specs=(pspec_of(params), kvspec.k, kvspec.v, bx, bx, bx2,
+                  bx, bx, bx),
+        out_specs=(P(), P(), kvspec.k, kvspec.v),
+        axis_names={"pp"},
+    )(params, kv.k, kv.v, tokens, positions, page_table, samp, seeds,
+      counters)
+    return toks, logp, KVCache(k_new, v_new)

@@ -82,6 +82,9 @@ def main() -> None:
     ap.add_argument("--tp", type=int, default=1, help="tensor-parallel degree")
     ap.add_argument("--sp", type=int, default=1,
                     help="sequence-parallel degree (ring-attention prefill)")
+    ap.add_argument("--pp", type=int, default=1,
+                    help="pipeline-parallel degree (layer stack + KV layer "
+                         "axis staged over pp; composes with dp)")
     ap.add_argument("--dp-ranks", type=int, default=1,
                     help="independent engine replicas behind this endpoint "
                          "(per-rank KV pools + events; the router targets "
@@ -369,10 +372,11 @@ def _build_engine(args):
         eos = list(tok.eos_token_ids)
 
     parallel = None
-    if args.dp * args.tp * args.sp > 1:
+    if args.dp * args.tp * args.sp * args.pp > 1:
         from ..parallel import ParallelConfig
 
-        parallel = ParallelConfig(dp=args.dp, tp=args.tp, sp=args.sp)
+        parallel = ParallelConfig(dp=args.dp, tp=args.tp, sp=args.sp,
+                                  pp=args.pp)
     vision = None
     mm_fields = {}
     if args.vision:

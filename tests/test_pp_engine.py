@@ -1,0 +1,107 @@
+"""Pipeline parallelism in the SERVING ENGINE with the real model:
+`ParallelConfig(pp=N)` stages the llama layer stack (params + KV layer
+axis) over a pp mesh axis — GPipe prefill, ring-full decode
+(parallel/pp_engine.py).  Greedy outputs must equal a single-device
+engine bit for bit (VERDICT r2 item 4)."""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dynamo_tpu.engine import EngineConfig, JaxEngine
+from dynamo_tpu.models import init_params, tiny_config
+from dynamo_tpu.parallel import ParallelConfig
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = tiny_config()  # 2 layers
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    return cfg, params
+
+
+def make_engine(setup, parallel=None, **over):
+    cfg, params = setup
+    defaults = dict(
+        page_size=8, num_pages=96, max_num_seqs=8,
+        max_prefill_tokens=32, max_model_len=128, decode_steps=2,
+    )
+    defaults.update(over)
+    return JaxEngine(cfg, params, EngineConfig(**defaults),
+                     eos_token_ids=[], kv_dtype=jnp.float32,
+                     parallel=parallel)
+
+
+def req(tokens, max_tokens=6, **so):
+    return {
+        "token_ids": tokens,
+        "sampling_options": {"temperature": 0.0, **so},
+        "stop_conditions": {"max_tokens": max_tokens, "ignore_eos": True},
+    }
+
+
+async def collect(engine, request):
+    out = []
+    async for d in engine.generate(request):
+        assert d.get("finish_reason") != "error", d
+        out.extend(d["token_ids"])
+    return out
+
+
+PROMPTS = [
+    [1, 2, 3, 4, 5],
+    [(7 * j) % 101 + 1 for j in range(40)],  # chunked prefill
+    [9, 8, 7],
+    [(3 * j) % 97 + 1 for j in range(18)],
+    [11] * 12,
+]
+
+
+async def _run_all(engine):
+    return await asyncio.gather(*[collect(engine, req(p)) for p in PROMPTS])
+
+
+async def test_pp_matches_single_device(setup):
+    ref = make_engine(setup)
+    want = await _run_all(ref)
+    await ref.shutdown()
+
+    eng = make_engine(setup, parallel=ParallelConfig(pp=2, dp=4))
+    assert eng._pp == 2
+    got = await _run_all(eng)
+    await eng.shutdown()
+    assert got == want
+
+
+async def test_pp_sampled_and_rejections(setup):
+    """Seeded sampling equality through the pp ring decode, and a clean
+    error (not a crash) for the unsupported penalized path."""
+    ref = make_engine(setup)
+    p = [(5 * j) % 89 + 1 for j in range(14)]
+    want = await collect(ref, req(p, max_tokens=8, temperature=0.8, seed=7))
+    await ref.shutdown()
+
+    eng = make_engine(setup, parallel=ParallelConfig(pp=2, dp=4))
+    got = await collect(eng, req(p, max_tokens=8, temperature=0.8, seed=7))
+    assert got == want
+
+    outs = []
+    async for d in eng.generate(req(p, frequency_penalty=0.5)):
+        outs.append(d)
+    assert outs[-1]["finish_reason"] == "error"
+    await eng.shutdown()
+
+
+async def test_pp_kv_layer_axis_sharded(setup):
+    """The cache genuinely shards its layer axis over pp (each stage
+    holds L/pp layers' pages — weight+cache HBM scale with pp)."""
+    eng = make_engine(setup, parallel=ParallelConfig(pp=2, dp=4))
+    from jax.sharding import PartitionSpec as P
+
+    assert eng.kv.k.sharding.spec == P("pp", None, None, None, None)
+    lay = eng.params["layers"]
+    leaf = jax.tree.leaves(lay)[0]
+    assert leaf.sharding.spec[0] == "pp"
+    await eng.shutdown()
