@@ -163,10 +163,10 @@ def _build_prefill_step_sp(cfg: ModelConfig, mesh, with_top: bool = False,
     if pool_axes is None:
         @partial(jax.jit, donate_argnums=(1,), **kw)
         def step(params, kv, tokens, page_table, prefix_lens, chunk_lens,
-                 samp, seeds, counters):
-            del prefix_lens  # whole-prompt prefill: enforced zero host-side
+                 samp, seeds, counters, prefix_table):
             logits, kv = forward_prefill_sp(
-                params, cfg, kv, tokens, page_table, chunk_lens, mesh
+                params, cfg, kv, tokens, page_table, chunk_lens, mesh,
+                prefix_lens=prefix_lens, prefix_table=prefix_table,
             )
             out = sample_tokens(logits, samp, seeds, counters)
             logp = compute_logprobs(logits, out)
@@ -693,16 +693,21 @@ class JaxEngine:
                     }),
                 )
             if self._sp > 1:
-                # sp prefill is whole-prompt ring attention: no cached
-                # prefixes, no chunking (mixed dispatches would chunk),
-                # buckets divisible by sp
+                # sp prefill is whole-remainder ring attention: no
+                # chunking (mixed dispatches would chunk), buckets
+                # divisible by sp.  Cached prefixes ARE supported (the
+                # ring starts at the prefix boundary) — except with a
+                # partitioned pool, whose prefix pages live on one
+                # (dp, sp) shard only and cannot feed the other shards'
+                # ring blocks
                 self.cfg = dataclasses.replace(
                     self.cfg, mixed_prefill_tokens=0
                 )
-                if self.cfg.enable_prefix_caching:
+                if self.cfg.enable_prefix_caching and self.cfg.kv_partition:
                     raise ValueError(
-                        "sp > 1 requires enable_prefix_caching=False "
-                        "(ring prefill assumes the prompt starts at 0)"
+                        "sp > 1 with kv_partition requires "
+                        "enable_prefix_caching=False (prefix pages are "
+                        "owner-shard-local)"
                     )
                 if (self.cfg.max_prefill_tokens
                         < self.cfg.max_model_len * self.cfg.prefill_batch_size):
@@ -723,11 +728,6 @@ class JaxEngine:
                     raise ValueError(
                         "sp×tp MoE requires moe_impl='ragged' and "
                         "num_experts divisible by tp"
-                    )
-                if model_cfg.sliding_window or model_cfg.attention_sinks:
-                    raise ValueError(
-                        "sp > 1 does not support sliding-window/sink "
-                        "attention models yet"
                     )
                 # the sp shard_map's param specs shard heads, the vocab,
                 # and (dense models) the ffn dim over tp — catch uneven
@@ -1441,7 +1441,8 @@ class JaxEngine:
         seq_rows = [it.seq if it else None for it in item_rows]
         tokens, prefix, chunk, chunk_bucket = self._prefill_arrays(item_rows)
         seqs = [it.seq for it in items]
-        if self._sp > 1 and prefix.any():
+        if (self._sp > 1 and prefix.any()
+                and not self.cfg.enable_prefix_caching):
             # cannot happen with prefix caching off + whole-prompt chunks;
             # guards scheduler regressions from silently corrupting sp runs
             raise RuntimeError("sp prefill requires prefix_lens == 0")
@@ -1781,6 +1782,17 @@ class JaxEngine:
         bax = "dp" if self._sp > 1 else self._bax
         if self._pooled and self._sp > 1:
             extra = (self._put(owner, "dp"),)
+        elif self._sp > 1:
+            # cached-prefix pages, width-bucketed to the batch's LONGEST
+            # prefix (width 0 → the prefix path compiles out entirely)
+            maxp = int(prefix.max()) if prefix.size else 0
+            wp = (0 if maxp == 0 else bucket_for(
+                -(-maxp // self.cfg.page_size),
+                self.cfg.table_width_buckets,
+            ))
+            wp = min(wp, table.shape[1])
+            extra = (self._put(np.ascontiguousarray(table[:, :wp]),
+                               "dp", None),)
         packed_d, tok_d, kv = self._get_prefill_step(with_top, bool(mm))(
             self.params,
             self.kv,

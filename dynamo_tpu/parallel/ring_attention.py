@@ -64,25 +64,55 @@ def ring_attention_local(
     v: jax.Array,
     axis_name: str = "sp",
     causal: bool = True,
+    q_offset: Optional[jax.Array] = None,  # [B] per-row global offset of
+    # position 0 of the ring (cached-prefix prefill starts the ring at
+    # the prefix boundary)
+    window=None,  # traced scalar; <= 0 → full attention (SWA models)
+    sink: Optional[jax.Array] = None,  # [H] learnable sink logits
+    prefix_k: Optional[jax.Array] = None,  # [B, Lp, Hkv, D] cached-prefix
+    prefix_v: Optional[jax.Array] = None,  # KV (global positions 0..Lp)
+    prefix_lens: Optional[jax.Array] = None,  # [B] valid prefix tokens
 ) -> jax.Array:
-    """Per-device body (call under shard_map). Returns [B, Sq_local, H, D]."""
+    """Per-device body (call under shard_map). Returns [B, Sq_local, H, D].
+
+    Flash-accumulates an optional cached-prefix block first (its keys sit
+    at global positions 0..prefix_lens), then the ring; per-layer sliding
+    windows and GPT-OSS attention sinks match `ops.paged_attention`
+    semantics (sink joins the softmax denominator as one virtual key)."""
     n = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
 
-    # global token positions of my queries and the current K/V block
-    q_pos = my * Sq + jnp.arange(Sq)  # [Sq]
+    # global token positions of my queries ([B, Sq] — per-row offsets)
+    off = jnp.zeros((B,), jnp.int32) if q_offset is None else q_offset
+    q_pos = off[:, None] + my * Sq + jnp.arange(Sq)[None, :]
+
+    def win_ok(k_pos):  # broadcastable against q_pos[, :, None]
+        if window is None:
+            return True
+        return (k_pos > q_pos[..., None] - window) | (window <= 0)
+
+    if prefix_k is not None:
+        Lp = prefix_k.shape[1]
+        p = jnp.arange(Lp)[None, None, :]
+        mask = (p < prefix_lens[:, None, None]) & win_ok(p)
+        m0, l0, o0 = _block_attn(q, prefix_k, prefix_v, mask[:, None])
+    else:
+        m0 = jnp.full((B, H, Sq), -1e29, jnp.float32)
+        l0 = jnp.zeros((B, H, Sq), jnp.float32)
+        o0 = jnp.zeros((B, H, Sq, D), jnp.float32)
 
     def step(carry, r):
         k_blk, v_blk, m_acc, l_acc, o_acc = carry
         src = (my - r) % n  # whose K/V block we hold at round r
-        k_pos = src * Sk + jnp.arange(Sk)
+        k_pos = off[:, None, None] + src * Sk + jnp.arange(Sk)[None, None, :]
         if causal:
-            mask = (k_pos[None, :] <= q_pos[:, None])[None, None]
+            mask = k_pos <= q_pos[:, :, None]
         else:
-            mask = jnp.ones((1, 1, Sq, Sk), bool)
-        m_blk, l_blk, o_blk = _block_attn(q, k_blk, v_blk, mask)
+            mask = jnp.ones((B, Sq, Sk), bool)
+        mask = mask & win_ok(k_pos)
+        m_blk, l_blk, o_blk = _block_attn(q, k_blk, v_blk, mask[:, None])
         # flash accumulation
         m_new = jnp.maximum(m_acc, m_blk)
         a = jnp.exp(m_acc - m_new)
@@ -95,12 +125,15 @@ def ring_attention_local(
         v_nxt = jax.lax.ppermute(v_blk, axis_name, perm)
         return (k_nxt, v_nxt, m_new, l_new, o_new), None
 
-    m0 = jnp.full((B, H, Sq), -1e29, jnp.float32)
-    l0 = jnp.zeros((B, H, Sq), jnp.float32)
-    o0 = jnp.zeros((B, H, Sq, D), jnp.float32)
     (k, v, m, l, o), _ = jax.lax.scan(
         step, (k, v, m0, l0, o0), jnp.arange(n)
     )
+    if sink is not None:
+        s = sink.astype(jnp.float32)[None, :, None]  # [1, H, 1]
+        m_f = jnp.maximum(m, s)
+        scale = jnp.exp(m - m_f)
+        l = l * scale + jnp.exp(s - m_f)
+        o = o * scale[..., None]
     out = o / jnp.maximum(l, 1e-20)[..., None]  # [B,H,Sq,D]
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
 

@@ -14,9 +14,11 @@ attention is per-head, so the ring rotates only the local head slice
 over `sp` while `tp` psums reduce the attention/MLP outputs — the two
 axes never talk to each other.
 
-Design constraints (v1, enforced by the engine):
-- whole-prompt prefill (no cached prefix, no chunking): ring causality
-  assumes the chunk starts at position 0;
+Design constraints (enforced by the engine):
+- whole-REMAINDER prefill (no chunking): a row's uncached tokens are
+  planned as one chunk; cached prefixes are supported — the ring starts
+  at the prefix boundary and the prefix KV is flash-accumulated from the
+  pool first (not with kv_partition: prefix pages are owner-shard-local);
 - the KV pool is REPLICATED over sp and dp but SHARDED on kv-heads over
   tp (the same layout decode uses): each device all-gathers the new
   chunk's K/V over sp/dp and scatters its own head slice, keeping every
@@ -58,7 +60,9 @@ def _embed_sp(embed_local: jax.Array, tokens: jax.Array) -> jax.Array:
 
 
 def _layer_sp(lp, kv_layer, x, positions, table_full, chunk_full, cfg, inv_freq,
-              tp: int, owner_l=None, table_l=None, chunk_l=None):
+              tp: int, owner_l=None, table_l=None, chunk_l=None,
+              prefix_l=None, prefix_full=None, window=None,
+              prefix_table_l=None):
     """One decoder layer on a [Bl, Sl] shard holding heads/tp: ring
     attention over sp on the local heads, KV head-slice written to the
     tp-sharded pool from the sp/dp-gathered chunk, tp psums after the
@@ -67,7 +71,13 @@ def _layer_sp(lp, kv_layer, x, positions, table_full, chunk_full, cfg, inv_freq,
     With `owner_l` (partitioned pool): each (dp, sp) shard owns its own
     page range, so the write gathers the chunk over sp ONLY and each
     shard scatters just the rows it owns (non-owned rows write the
-    shard's local trash page 0) — no dp gather, no replication."""
+    shard's local trash page 0) — no dp gather, no replication.
+
+    With a non-empty `prefix_table_l`: rows may carry a cached prefix
+    (prefix_l tokens already in the pool); the ring starts at the prefix
+    boundary and the prefix KV is flash-accumulated from those pages
+    first.  Per-layer sliding `window`s and sink logits follow
+    ops.paged_attention."""
     Bl, Sl, h = x.shape
     nh = cfg.num_attention_heads // tp
     nkv = cfg.num_key_value_heads // tp
@@ -82,7 +92,24 @@ def _layer_sp(lp, kv_layer, x, positions, table_full, chunk_full, cfg, inv_freq,
     q = apply_rope(q, positions, inv_freq)
     k = apply_rope(k, positions, inv_freq)
 
-    attn = ring_attention_local(q, k, v, axis_name="sp", causal=True)
+    pk = pv = None
+    use_prefix = prefix_table_l is not None and prefix_table_l.shape[1] > 0
+    if use_prefix:
+        # gather this shard's rows' cached pages (pool replicated over
+        # sp/dp, head-sharded over tp — matches the local head slice).
+        # prefix_table_l is width-bucketed to the batch's LONGEST prefix
+        # host-side, so cache-miss batches (width 0) skip this entirely
+        page = k_pages.shape[1]
+        Wp = prefix_table_l.shape[1]
+        pk = k_pages[prefix_table_l].reshape(Bl, Wp * page, nkv, hd)
+        pv = v_pages[prefix_table_l].reshape(Bl, Wp * page, nkv, hd)
+    attn = ring_attention_local(
+        q, k, v, axis_name="sp", causal=True,
+        q_offset=prefix_l if use_prefix else None,
+        window=window, sink=lp.get("sinks"),
+        prefix_k=pk, prefix_v=pv,
+        prefix_lens=prefix_l if use_prefix else None,
+    )
 
     k_full = jax.lax.all_gather(k, "sp", axis=1, tiled=True)
     v_full = jax.lax.all_gather(v, "sp", axis=1, tiled=True)
@@ -98,12 +125,13 @@ def _layer_sp(lp, kv_layer, x, positions, table_full, chunk_full, cfg, inv_freq,
         # replicated pool: the write must be identical on every sp/dp
         # replica (the pool is head-sharded over tp, so each tp shard
         # scatters its own slice): gather the full chunk (sp → sequence
-        # axis, dp → batch axis) and scatter all rows
+        # axis, dp → batch axis) and scatter all rows — at the row's
+        # prefix offset (cached-prefix rows append after their prefix)
         k_full = jax.lax.all_gather(k_full, "dp", axis=0, tiled=True)
         v_full = jax.lax.all_gather(v_full, "dp", axis=0, tiled=True)
-        zeros = jnp.zeros((k_full.shape[0],), jnp.int32)
         k_pages, v_pages = write_kv_pages(
-            k_pages, v_pages, k_full, v_full, table_full, zeros, chunk_full
+            k_pages, v_pages, k_full, v_full, table_full, prefix_full,
+            chunk_full,
         )
 
     attn_out = matmul_any(
@@ -202,6 +230,10 @@ def forward_prefill_sp(
     mesh: Mesh,
     owner: jax.Array = None,  # [B] sp-slot owning each row's pages
     pool_axes=None,  # e.g. ("dp","sp") — partitioned-pool kv layout
+    prefix_lens: jax.Array = None,  # [B] cached-prefix tokens per row
+    prefix_table: jax.Array = None,  # [B, Wp] pages covering the batch's
+    # longest prefix (width-bucketed host-side; Wp == 0 → no cached
+    # prefixes this step, the prefix path compiles out)
 ) -> Tuple[jax.Array, KVCache]:
     """Whole-prompt prefill with the sequence sharded over `sp` and heads
     over `tp`.
@@ -224,11 +256,6 @@ def forward_prefill_sp(
             raise ValueError(
                 f"tp={tp} must evenly divide num_experts={cfg.num_experts}"
             )
-    if cfg.sliding_window or cfg.attention_sinks:
-        raise NotImplementedError(
-            "sp ring prefill does not implement sliding windows or "
-            "attention sinks yet"
-        )
     if cfg.num_attention_heads % tp or cfg.num_key_value_heads % tp:
         raise ValueError(
             f"tp={tp} must divide the head counts "
@@ -238,33 +265,41 @@ def forward_prefill_sp(
 
     pooled = owner is not None
 
-    def body(params, kv_k, kv_v, tokens_l, table_l, chunk_l, owner_l):
+    def body(params, kv_k, kv_v, tokens_l, table_l, chunk_l, owner_l,
+             prefix_l, prefix_table_l):
         sp_i = jax.lax.axis_index("sp")
         Bl, Sl = tokens_l.shape
-        positions = sp_i * Sl + jnp.arange(Sl)[None, :] + jnp.zeros(
-            (Bl, 1), jnp.int32
-        )
+        # the ring starts at each row's prefix boundary (0 with no cache)
+        positions = (prefix_l[:, None] + sp_i * Sl
+                     + jnp.arange(Sl)[None, :] + jnp.zeros((Bl, 1), jnp.int32))
         if pooled:
-            table_full = chunk_full = None
+            table_full = chunk_full = prefix_full = None
         else:
             table_full = jax.lax.all_gather(table_l, "dp", axis=0, tiled=True)
             chunk_full = jax.lax.all_gather(chunk_l, "dp", axis=0, tiled=True)
+            prefix_full = jax.lax.all_gather(prefix_l, "dp", axis=0, tiled=True)
 
         x = _embed_sp(params["embed"], tokens_l)
+        from ..models.llama import _window_xs
+
+        wins = _window_xs(cfg)
 
         def layer(carry, xs):
             h = carry
-            lp, k_pages, v_pages = xs
+            lp, k_pages, v_pages = xs[:3]
             h, (k_pages, v_pages) = _layer_sp(
                 lp, (k_pages, v_pages), h, positions, table_full,
                 chunk_full, cfg, inv_freq, tp,
                 owner_l=owner_l if pooled else None,
                 table_l=table_l, chunk_l=chunk_l,
+                prefix_l=prefix_l, prefix_full=prefix_full,
+                window=xs[3] if wins else None,
+                prefix_table_l=prefix_table_l,
             )
             return h, (k_pages, v_pages)
 
         x, (k_new, v_new) = jax.lax.scan(
-            layer, x, (params["layers"], kv_k, kv_v)
+            layer, x, (params["layers"], kv_k, kv_v, *wins)
         )
         # the row's last valid hidden state lives on ONE sp shard: each
         # shard contributes its masked candidate and a psum combines them
@@ -283,11 +318,16 @@ def forward_prefill_sp(
     kv_spec = kv_cache_pspec(pool_axes=pool_axes).k
     if owner is None:
         owner = jnp.zeros(tokens.shape[:1], jnp.int32)
+    if prefix_lens is None:
+        prefix_lens = jnp.zeros(tokens.shape[:1], jnp.int32)
+    if prefix_table is None:
+        prefix_table = jnp.zeros((tokens.shape[0], 0), jnp.int32)
     logits, k_new, v_new = shard_map(
         body,
         mesh=mesh,
         in_specs=(pspec, kv_spec, kv_spec, P("dp", "sp"), P("dp", None),
-                  P("dp"), P("dp")),
+                  P("dp"), P("dp"), P("dp"), P("dp", None)),
         out_specs=(P("dp", "tp"), kv_spec, kv_spec),
-    )(params, kv.k, kv.v, tokens, page_table, chunk_lens, owner)
+    )(params, kv.k, kv.v, tokens, page_table, chunk_lens, owner,
+      prefix_lens, prefix_table)
     return logits, KVCache(k_new, v_new)

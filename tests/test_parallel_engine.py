@@ -140,11 +140,14 @@ async def test_engine_sp_sequence_parallel_prefill():
 def test_engine_sp_validation():
     cfg = tiny_config()
     params = init_params(cfg, jax.random.PRNGKey(2), dtype=jnp.float32)
+    # sp + prefix caching is supported (the ring starts at the prefix
+    # boundary) — EXCEPT with a partitioned pool, whose prefix pages are
+    # owner-shard-local
     with pytest.raises(ValueError, match="prefix_caching"):
         JaxEngine(
             cfg, params,
             _ecfg(enable_prefix_caching=True, max_prefill_tokens=256,
-                  max_model_len=256),
+                  max_model_len=256, kv_partition=True),
             parallel=ParallelConfig(dp=2, sp=4),
         )
     with pytest.raises(ValueError, match="max_prefill_tokens"):
