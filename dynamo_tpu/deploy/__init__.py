@@ -2,12 +2,16 @@
 Kubernetes manifests (the reference's operator/CRD layer, redesigned as a
 renderer + launcher)."""
 
+from .controller import GraphController, K8sActuator, LocalActuator
 from .graph import ComponentSpec, GraphSpec, LocalLauncher, format_commands
 from .k8s import render_manifests
 
 __all__ = [
     "ComponentSpec",
+    "GraphController",
     "GraphSpec",
+    "K8sActuator",
+    "LocalActuator",
     "LocalLauncher",
     "format_commands",
     "render_manifests",

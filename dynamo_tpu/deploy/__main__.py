@@ -23,6 +23,15 @@ def main() -> None:
     ap.add_argument("--dry-run", action="store_true",
                     help="print the rendered commands and exit")
     ap.add_argument("--render", choices=["local", "k8s"], default="local")
+    ap.add_argument("--controller", action="store_true",
+                    help="run the reconcile loop: converge live replicas "
+                         "on the spec + planner targets (restart crashes, "
+                         "realize /planner/{ns}/targets scale decisions)")
+    ap.add_argument("--k8s-actuate", action="store_true",
+                    help="with --controller: patch k8s Deployment replicas "
+                         "via kubectl instead of managing local processes")
+    ap.add_argument("--interval", type=float, default=1.0,
+                    help="controller reconcile interval (seconds)")
     ap.add_argument("--log-level", default="info")
     args = ap.parse_args()
     logging.basicConfig(level=args.log_level.upper())
@@ -35,6 +44,51 @@ def main() -> None:
         return
     if args.dry_run:
         print(format_commands(spec, args.control))
+        return
+
+    if args.controller:
+        import asyncio
+
+        from ..runtime import DistributedRuntime
+        from .controller import GraphController, K8sActuator
+
+        async def run_controller():
+            control = args.control
+            launcher = None
+            if not control:
+                # bring up JUST the control plane (components=[]); the
+                # CONTROLLER owns the component replicas
+                launcher = LocalLauncher(
+                    GraphSpec(namespace=spec.namespace,
+                              control_plane=spec.control_plane or {},
+                              components=[]),
+                    control="",
+                )
+                control = launcher.start()
+            rt = await DistributedRuntime.connect(control)
+            actuator = (K8sActuator(spec.namespace)
+                        if args.k8s_actuate else None)
+            ctl = GraphController(
+                spec, control, runtime=rt, actuator=actuator,
+                interval=args.interval,
+            )
+            await ctl.start()
+            print(f"READY controller control={control} "
+                  f"components={len(spec.components)}", flush=True)
+            stop = asyncio.Event()
+            asyncio.get_running_loop().add_signal_handler(
+                signal.SIGTERM, stop.set
+            )
+            asyncio.get_running_loop().add_signal_handler(
+                signal.SIGINT, stop.set
+            )
+            await stop.wait()
+            await ctl.stop()
+            await rt.shutdown(graceful=False)
+            if launcher is not None:
+                launcher.stop()
+
+        asyncio.run(run_controller())
         return
 
     launcher = LocalLauncher(spec, control=args.control)

@@ -1,0 +1,251 @@
+"""Operator-lite controller: a level-triggered reconcile loop that keeps
+a deployment graph's ACTUAL state (live replicas) converged on its
+DESIRED state (the spec, plus runtime scale overrides from the planner).
+
+The reference ships a Kubernetes operator whose controller watches
+`DynamoGraphDeployment` resources and reconciles per-service replica
+counts (/root/reference/deploy/cloud/operator/api/v1alpha1/
+dynamographdeployment_types.go:31, controller_common.go).  Here the same
+reconcile semantics run as a first-party loop over two actuators:
+
+- `LocalActuator` — replicas are OS processes on this host (spawn /
+  SIGTERM); crashed replicas are detected by `poll()` and respawned.
+- `K8sActuator` — replicas are Deployment `spec.replicas` patched
+  through `kubectl` against the manifests `deploy.k8s` rendered (the
+  actuation path of the reference's KubernetesConnector,
+  components/src/dynamo/planner/kubernetes_connector.py:48).
+
+Desired-state inputs, merged every tick:
+1. the graph spec's per-component `replicas`;
+2. the planner's targets key `/planner/{namespace}/targets` in the
+   control-plane KV (written by `planner.connectors.VirtualConnector`) —
+   entries name a component directly, or a disagg role ("prefill" /
+   "decode") that maps onto the component with that `disagg-role` arg.
+
+This closes the planner's actuation loop without Kubernetes: planner →
+control-plane KV → controller → processes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import signal
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from ..runtime import DistributedRuntime
+from ..runtime.transport.wire import unpack
+from .graph import ComponentSpec, GraphSpec
+
+logger = logging.getLogger(__name__)
+
+PLANNER_ROOT = "/planner"
+
+
+class LocalActuator:
+    """Replicas as local OS processes."""
+
+    def __init__(self, control: str, stdout=None, namespace: str = ""):
+        self.control = control
+        self.stdout = stdout
+        self.namespace = namespace
+        self._procs: Dict[str, List[subprocess.Popen]] = {}
+        # replicas scaled down but possibly still draining: tracked so a
+        # SIGTERM-ignoring worker is still reaped/killed at shutdown
+        self._stopping: List[subprocess.Popen] = []
+
+    def observed(self, comp: ComponentSpec) -> int:
+        procs = self._procs.setdefault(comp.name, [])
+        # reap exits (crash detection): a dead replica simply stops
+        # counting toward observed state and reconcile replaces it
+        dead = [p for p in procs if p.poll() is not None]
+        for p in dead:
+            logger.warning(
+                "%s replica pid %d exited rc=%s", comp.name, p.pid,
+                p.returncode,
+            )
+        procs[:] = [p for p in procs if p.poll() is None]
+        self._stopping = [p for p in self._stopping if p.poll() is None]
+        return len(procs)
+
+    def scale_to(self, comp: ComponentSpec, replicas: int) -> None:
+        procs = self._procs.setdefault(comp.name, [])
+        argv = comp.command(self.control, namespace=self.namespace)
+        while len(procs) < replicas:
+            p = subprocess.Popen(
+                argv, stdout=self.stdout, stderr=subprocess.STDOUT
+            )
+            procs.append(p)
+            logger.info("%s: spawned replica pid %d", comp.name, p.pid)
+        while len(procs) > replicas:
+            p = procs.pop()
+            p.send_signal(signal.SIGTERM)  # workers drain gracefully
+            self._stopping.append(p)
+            logger.info("%s: stopping replica pid %d", comp.name, p.pid)
+
+    def stop_all(self, timeout: float = 10.0) -> None:
+        from .graph import stop_processes
+
+        stop_processes(
+            [p for procs in self._procs.values() for p in procs]
+            + self._stopping,
+            timeout,
+        )
+
+
+class K8sActuator:
+    """Replicas as Deployment spec.replicas, patched via kubectl (the
+    deployments themselves come from `deploy.k8s.render_manifests`)."""
+
+    def __init__(self, namespace: str, kubectl: str = "kubectl"):
+        self.namespace = namespace
+        self.kubectl = kubectl
+
+    def patch_command(self, comp_name: str, replicas: int) -> List[str]:
+        return [
+            self.kubectl, "-n", self.namespace, "patch", "deployment",
+            f"dynamo-{comp_name}", "--type", "merge", "-p",
+            '{"spec": {"replicas": %d}}' % replicas,
+        ]
+
+    def observed(self, comp: ComponentSpec) -> Optional[int]:
+        # spec.replicas, NOT status.availableReplicas: the controller
+        # converges the DESIRED count; pods that are pending/crashing
+        # are the Deployment controller's job, and re-patching an
+        # already-correct spec every tick would spam the API server
+        out = subprocess.run(
+            [self.kubectl, "-n", self.namespace, "get", "deployment",
+             f"dynamo-{comp.name}", "-o", "jsonpath={.spec.replicas}"],
+            capture_output=True, text=True, timeout=15,
+        )
+        if out.returncode != 0:
+            return None
+        return int(out.stdout.strip() or 0)
+
+    def scale_to(self, comp: ComponentSpec, replicas: int) -> None:
+        subprocess.run(
+            self.patch_command(comp.name, replicas), check=True, timeout=15
+        )
+
+    def stop_all(self) -> None:  # k8s resources outlive the controller
+        pass
+
+
+class GraphController:
+    """The reconcile loop.  `await start()`, then it converges live state
+    on (spec ∪ planner targets) every `interval` seconds."""
+
+    def __init__(self, spec: GraphSpec, control: str,
+                 runtime: Optional[DistributedRuntime] = None,
+                 actuator=None, interval: float = 1.0, stdout=None):
+        self.spec = spec
+        self.control = control
+        self.runtime = runtime
+        self.actuator = actuator or LocalActuator(
+            control, stdout=stdout, namespace=spec.namespace
+        )
+        self.interval = interval
+        self.desired: Dict[str, int] = {
+            c.name: c.replicas for c in spec.components
+        }
+        self._comp: Dict[str, ComponentSpec] = {
+            c.name: c for c in spec.components
+        }
+        self._task: Optional[asyncio.Task] = None
+        self._wake = asyncio.Event()
+        self.reconciles = 0
+
+    @property
+    def targets_key(self) -> str:
+        return f"{PLANNER_ROOT}/{self.spec.namespace}/targets"
+
+    def _component_for_target(self, key: str) -> Optional[str]:
+        """Planner targets name a component, or a disagg role that maps
+        onto the component carrying that role."""
+        if key in self._comp:
+            return key
+        for name, comp in self._comp.items():
+            if comp.args.get("disagg-role") == key or comp.args.get(
+                "disagg_role"
+            ) == key:
+                return name
+        return None
+
+    async def _merge_planner_targets(self) -> None:
+        if self.runtime is None:
+            return
+        try:
+            data = await self.runtime.control.get(self.targets_key)
+        except (ConnectionError, RuntimeError):
+            return
+        if not data:
+            return
+        targets = unpack(data)
+        for key, val in targets.items():
+            if key == "updated_at":
+                continue
+            name = self._component_for_target(str(key))
+            if name is None:
+                logger.warning("planner target %r matches no component", key)
+                continue
+            val = max(0, int(val))
+            if self.desired.get(name) != val:
+                logger.info("planner target: %s -> %d replicas", name, val)
+                self.desired[name] = val
+
+    async def reconcile(self) -> Dict[str, Dict[str, int]]:
+        """One level-triggered pass; returns the post-pass status.
+        Actuator calls run on an executor thread — kubectl against a
+        slow API server (or a SIGTERM drain wait) must not stall the
+        event loop carrying the control-plane connection."""
+        await self._merge_planner_targets()
+        loop = asyncio.get_running_loop()
+        status = {}
+        for name, comp in self._comp.items():
+            want = self.desired[name]
+            have = await loop.run_in_executor(
+                None, self.actuator.observed, comp
+            )
+            if have is not None and have != want:
+                await loop.run_in_executor(
+                    None, self.actuator.scale_to, comp, want
+                )
+            status[name] = {"desired": want, "observed": have}
+        self.reconciles += 1
+        return status
+
+    async def scale(self, name: str, replicas: int) -> None:
+        if name not in self._comp:
+            raise KeyError(f"unknown component {name!r}")
+        self.desired[name] = max(0, int(replicas))
+        self._wake.set()
+
+    async def start(self) -> "GraphController":
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+        return self
+
+    async def _loop(self) -> None:
+        while True:
+            try:
+                await self.reconcile()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — the loop must survive
+                logger.exception("reconcile pass failed")
+            self._wake.clear()
+            try:
+                await asyncio.wait_for(self._wake.wait(), self.interval)
+            except asyncio.TimeoutError:
+                pass
+
+    async def stop(self, stop_replicas: bool = True) -> None:
+        if self._task:
+            self._task.cancel()
+            await asyncio.gather(self._task, return_exceptions=True)
+        if stop_replicas:
+            await asyncio.get_running_loop().run_in_executor(
+                None, self.actuator.stop_all
+            )

@@ -54,7 +54,7 @@ class ComponentSpec:
     replicas: int = 1
     args: Dict[str, Any] = field(default_factory=dict)
 
-    def command(self, control: str) -> List[str]:
+    def command(self, control: str, namespace: str = "") -> List[str]:
         """The process argv for one replica (reference: per-service pod
         command in DynamoComponentDeployment)."""
         if self.kind not in _KIND_MODULE:
@@ -72,6 +72,8 @@ class ComponentSpec:
                 continue
             else:
                 argv += [flag, str(value)]
+        if namespace and "--namespace" not in argv:
+            argv += ["--namespace", namespace]
         return argv
 
 
@@ -112,9 +114,7 @@ class GraphSpec:
         """Flat list of argvs, replicas expanded, namespace injected."""
         out = []
         for comp in self.components:
-            argv = comp.command(control)
-            if "--namespace" not in argv:
-                argv += ["--namespace", self.namespace]
+            argv = comp.command(control, namespace=self.namespace)
             for _ in range(comp.replicas):
                 out.append(list(argv))
         return out
@@ -166,17 +166,26 @@ class LocalLauncher:
         }
 
     def stop(self, timeout: float = 10.0) -> None:
-        import signal as _signal
+        stop_processes(
+            self.procs + ([self._control_proc] if self._control_proc else []),
+            timeout,
+        )
 
-        for p in self.procs + ([self._control_proc] if self._control_proc else []):
-            if p.poll() is None:
-                p.send_signal(_signal.SIGTERM)
-        deadline = time.time() + timeout
-        for p in self.procs + ([self._control_proc] if self._control_proc else []):
-            while p.poll() is None and time.time() < deadline:
-                time.sleep(0.1)
-            if p.poll() is None:
-                p.kill()
+
+def stop_processes(procs: List[subprocess.Popen], timeout: float = 10.0) -> None:
+    """SIGTERM every live process, then kill whatever outlives the
+    deadline (shared by the launcher and the controller's actuator)."""
+    import signal as _signal
+
+    for p in procs:
+        if p.poll() is None:
+            p.send_signal(_signal.SIGTERM)
+    deadline = time.time() + timeout
+    for p in procs:
+        while p.poll() is None and time.time() < deadline:
+            time.sleep(0.1)
+        if p.poll() is None:
+            p.kill()
 
 
 def format_commands(spec: GraphSpec, control: str) -> str:

@@ -1,0 +1,130 @@
+"""Operator-lite controller (deploy/controller.py): the reconcile loop
+that converges live replicas on the graph spec + planner targets — the
+planner's actuation path without Kubernetes (VERDICT r2 item 6;
+reference: DynamoGraphDeployment controller reconcile semantics)."""
+
+import asyncio
+import os
+import sys
+
+from dynamo_tpu.deploy import GraphController, GraphSpec, K8sActuator
+from dynamo_tpu.planner.connectors import VirtualConnector
+from dynamo_tpu.runtime import DistributedRuntime
+from dynamo_tpu.runtime.transport.control_plane import ControlPlaneServer
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+GRAPH = """
+namespace: ctlns
+components:
+  decode:
+    kind: worker
+    replicas: 1
+    args: {model: tiny, mock: true, component: backend, platform: cpu}
+  prefill:
+    kind: worker
+    replicas: 0
+    args: {model: tiny, mock: true, component: prefill, platform: cpu}
+"""
+
+
+async def _instances(rt, ns, comp, n, timeout=60.0):
+    """Wait until exactly n live instances are registered."""
+    ep = rt.namespace(ns).component(comp).endpoint("generate")
+    client = ep.client()
+    await client.start()
+    deadline = asyncio.get_running_loop().time() + timeout
+    while asyncio.get_running_loop().time() < deadline:
+        ids = client.instance_ids()
+        if len(ids) == n:
+            await client.stop()
+            return ids
+        await asyncio.sleep(0.25)
+    await client.stop()
+    raise AssertionError(f"expected {n} instances for {comp}, have {ids}")
+
+
+async def test_controller_reconciles_planner_targets():
+    os.environ.setdefault("PYTHONPATH", ROOT)
+    control = await ControlPlaneServer().start()
+    rt = await DistributedRuntime.connect(control.address)
+    spec = GraphSpec.parse(GRAPH)
+    ctl = GraphController(spec, control.address, runtime=rt, interval=0.3)
+    await ctl.start()
+    try:
+        # spec state: 1 decode replica comes up and registers
+        await _instances(rt, "ctlns", "backend", 1)
+
+        # planner scales decode to 2 and prefill to 1 through the
+        # control-plane targets key — the controller must realize both
+        conn = VirtualConnector(rt, namespace="ctlns")
+        await conn.scale("decode", 2)
+        await conn.scale("prefill", 1)
+        await _instances(rt, "ctlns", "backend", 2)
+        await _instances(rt, "ctlns", "prefill", 1)
+
+        # crash recovery: kill a decode replica; the reconcile loop
+        # replaces it (lease expiry reaps the dead instance)
+        procs = ctl.actuator._procs["decode"]
+        procs[0].kill()
+        await _instances(rt, "ctlns", "backend", 2, timeout=90.0)
+
+        # scale down through the same path
+        await conn.scale("decode", 1)
+        await _instances(rt, "ctlns", "backend", 1, timeout=90.0)
+        assert ctl.reconciles > 3
+    finally:
+        await ctl.stop()
+        await rt.shutdown(graceful=False)
+        await control.stop()
+
+
+def test_target_role_mapping():
+    """Planner role targets ("prefill"/"decode") map onto the component
+    carrying that disagg-role arg when no component shares the name."""
+    from dynamo_tpu.deploy import ComponentSpec
+
+    spec = GraphSpec(namespace="x", components=[
+        ComponentSpec("workers-a", "worker",
+                      args={"disagg-role": "prefill"}),
+        ComponentSpec("workers-b", "worker",
+                      args={"disagg-role": "decode"}),
+    ])
+    ctl = GraphController(spec, "127.0.0.1:1")
+    assert ctl._component_for_target("prefill") == "workers-a"
+    assert ctl._component_for_target("decode") == "workers-b"
+    assert ctl._component_for_target("workers-a") == "workers-a"
+    assert ctl._component_for_target("nope") is None
+
+
+def test_k8s_actuator_patch_command():
+    act = K8sActuator("prodns")
+    cmd = act.patch_command("decode", 7)
+    assert cmd[:4] == ["kubectl", "-n", "prodns", "patch"]
+    assert "dynamo-decode" in cmd
+    assert '{"spec": {"replicas": 7}}' in cmd[-1]
+
+
+async def test_controller_scale_api_and_unknown_target():
+    control = await ControlPlaneServer().start()
+    rt = await DistributedRuntime.connect(control.address)
+    spec = GraphSpec.parse(GRAPH)
+    ctl = GraphController(spec, control.address, runtime=rt, interval=0.2)
+    await ctl.start()
+    try:
+        await ctl.scale("decode", 2)
+        await _instances(rt, "ctlns", "backend", 2)
+        # unknown planner target is ignored, not fatal
+        conn = VirtualConnector(rt, namespace="ctlns")
+        await conn.scale("nonexistent", 5)
+        await asyncio.sleep(0.6)
+        assert ctl.desired.get("nonexistent") is None
+        try:
+            await ctl.scale("nope", 1)
+            raise AssertionError("expected KeyError")
+        except KeyError:
+            pass
+    finally:
+        await ctl.stop()
+        await rt.shutdown(graceful=False)
+        await control.stop()
