@@ -2199,8 +2199,22 @@ class JaxEngine:
             width = self._pow2_width(n)
             if isinstance(k_chunk, jax.Array):
                 pad = ((0, 0), (0, width - n), (0, 0), (0, 0), (0, 0))
-                self._import_dev(pages, jnp.pad(k_chunk, pad),
-                                 jnp.pad(v_chunk, pad))
+                kpad = jnp.pad(k_chunk, pad)
+                vpad = jnp.pad(v_chunk, pad)
+                # colocated transfers may arrive sharded over ANOTHER
+                # engine's mesh (disagg roles on disjoint device sets in
+                # one process — the resharding transfer NIXL performs);
+                # device_put moves shards device-to-device (ICI on TPU),
+                # never staging through host numpy
+                mine = set(self.kv.k.devices())
+                if set(kpad.devices()) != mine:
+                    if self.mesh is not None:
+                        target = NamedSharding(self.mesh, P())
+                    else:
+                        target = next(iter(mine))
+                    kpad = jax.device_put(kpad, target)
+                    vpad = jax.device_put(vpad, target)
+                self._import_dev(pages, kpad, vpad)
                 return
             kpad = np.zeros((k_chunk.shape[0], width, *k_chunk.shape[2:]),
                             k_chunk.dtype)

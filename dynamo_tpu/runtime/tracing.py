@@ -109,7 +109,41 @@ class TraceFormatter(logging.Formatter):
 # file named by DYN_OTEL_FILE; any OTLP/HTTP collector can replay them,
 # and tests can assert cross-process trace joins from the file.
 
-_EXPORTER: Optional["SpanFileExporter"] = None
+_EXPORTER = None
+
+
+def _otlp_span(name: str, ctx: TraceContext, parent_span: str,
+               start_ns: int, end_ns: int, attrs: dict) -> dict:
+    span = {
+        "traceId": ctx.trace_id,
+        "spanId": ctx.span_id,
+        "name": name,
+        "kind": 1,
+        "startTimeUnixNano": str(start_ns),
+        "endTimeUnixNano": str(end_ns),
+        "attributes": [
+            {"key": k, "value": {"stringValue": str(v)}}
+            for k, v in attrs.items()
+        ],
+    }
+    if parent_span:
+        span["parentSpanId"] = parent_span
+    return span
+
+
+def _otlp_envelope(service_name: str, spans: list) -> dict:
+    return {
+        "resourceSpans": [{
+            "resource": {"attributes": [{
+                "key": "service.name",
+                "value": {"stringValue": service_name},
+            }]},
+            "scopeSpans": [{
+                "scope": {"name": "dynamo_tpu.tracing"},
+                "spans": spans,
+            }],
+        }],
+    }
 
 
 class SpanFileExporter:
@@ -120,32 +154,10 @@ class SpanFileExporter:
 
     def export(self, name: str, ctx: TraceContext, parent_span: str,
                start_ns: int, end_ns: int, attrs: dict) -> None:
-        span = {
-            "traceId": ctx.trace_id,
-            "spanId": ctx.span_id,
-            "name": name,
-            "kind": 1,
-            "startTimeUnixNano": str(start_ns),
-            "endTimeUnixNano": str(end_ns),
-            "attributes": [
-                {"key": k, "value": {"stringValue": str(v)}}
-                for k, v in attrs.items()
-            ],
-        }
-        if parent_span:
-            span["parentSpanId"] = parent_span
-        self._f.write(json.dumps({
-            "resourceSpans": [{
-                "resource": {"attributes": [{
-                    "key": "service.name",
-                    "value": {"stringValue": self.service_name},
-                }]},
-                "scopeSpans": [{
-                    "scope": {"name": "dynamo_tpu.tracing"},
-                    "spans": [span],
-                }],
-            }],
-        }) + "\n")
+        span = _otlp_span(name, ctx, parent_span, start_ns, end_ns, attrs)
+        self._f.write(
+            json.dumps(_otlp_envelope(self.service_name, [span])) + "\n"
+        )
 
     def close(self) -> None:
         try:
@@ -154,19 +166,114 @@ class SpanFileExporter:
             pass
 
 
-def get_exporter() -> Optional[SpanFileExporter]:
+class SpanHttpExporter:
+    """Live OTLP/HTTP push (the reference's collector export,
+    OTEL_EXPORT_ENABLED → OTLP endpoint).  Spans buffer in memory and a
+    daemon thread POSTs OTLP/JSON batches to `{endpoint}` (point it at a
+    collector's /v1/traces) — the span() hot path never blocks on the
+    network."""
+
+    def __init__(self, endpoint: str, service_name: str = "dynamo_tpu",
+                 flush_interval: float = 2.0, max_batch: int = 256):
+        import queue
+        import threading
+
+        self.endpoint = endpoint
+        self.service_name = service_name
+        self.flush_interval = flush_interval
+        self.max_batch = max_batch
+        self.dropped = 0
+        self.sent = 0
+        self._warned = False
+        self._q: "queue.Queue" = queue.Queue(maxsize=4096)
+        self._closed = threading.Event()
+        self._thread = threading.Thread(
+            target=self._pump, name="otlp-push", daemon=True
+        )
+        self._thread.start()
+
+    def export(self, name: str, ctx: TraceContext, parent_span: str,
+               start_ns: int, end_ns: int, attrs: dict) -> None:
+        span = _otlp_span(name, ctx, parent_span, start_ns, end_ns, attrs)
+        try:
+            self._q.put_nowait(span)
+        except Exception:  # noqa: BLE001 — full queue: drop, never block
+            self.dropped += 1
+
+    def _drain(self):
+        import queue
+
+        spans = []
+        while len(spans) < self.max_batch:
+            try:
+                spans.append(self._q.get_nowait())
+            except queue.Empty:
+                break
+        return spans
+
+    def _post(self, spans) -> None:
+        import urllib.request
+
+        try:
+            body = json.dumps(
+                _otlp_envelope(self.service_name, spans)
+            ).encode()
+            req = urllib.request.Request(
+                self.endpoint, data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                resp.read()
+            self.sent += len(spans)
+        except Exception:  # noqa: BLE001 — a bad endpoint/collector must
+            # never kill the pump thread; drop the batch and keep going
+            self.dropped += len(spans)
+            if not self._warned:
+                self._warned = True
+                logging.getLogger(__name__).warning(
+                    "otlp push to %s failed; dropping spans",
+                    self.endpoint, exc_info=True,
+                )
+
+    def _flush_all(self) -> None:
+        while True:
+            spans = self._drain()
+            if not spans:
+                return
+            self._post(spans)
+
+    def _pump(self) -> None:
+        while not self._closed.is_set():
+            self._closed.wait(self.flush_interval)
+            self._flush_all()
+
+    def close(self) -> None:
+        self._closed.set()
+        self._thread.join(timeout=10)
+        self._flush_all()  # whatever the thread left behind
+
+
+def get_exporter():
+    """DYN_OTEL_ENDPOINT (live OTLP/HTTP push) wins over DYN_OTEL_FILE
+    (replayable OTLP/JSON lines); None disables span export."""
     global _EXPORTER
     if _EXPORTER is None:
         from .config import env_str
 
-        path = env_str("DYN_OTEL_FILE")
-        if path:
-            import os as _os
+        import os as _os
 
-            _EXPORTER = SpanFileExporter(
-                path, service_name=env_str("DYN_SERVICE_NAME")
-                or _os.path.basename(sys.argv[0]) or "dynamo_tpu",
-            )
+        service = (env_str("DYN_SERVICE_NAME")
+                   or _os.path.basename(sys.argv[0]) or "dynamo_tpu")
+        endpoint = env_str("DYN_OTEL_ENDPOINT")
+        path = env_str("DYN_OTEL_FILE")
+        if endpoint:
+            import atexit
+
+            _EXPORTER = SpanHttpExporter(endpoint, service_name=service)
+            # short-lived processes must not lose the final flush window
+            atexit.register(_EXPORTER.close)
+        elif path:
+            _EXPORTER = SpanFileExporter(path, service_name=service)
     return _EXPORTER
 
 

@@ -14,6 +14,7 @@ from dynamo_tpu.runtime.tracing import (
     JsonlFormatter,
     current_trace,
     new_trace,
+    reset_trace,
     set_trace,
     trace_from_headers,
     trace_headers,
@@ -271,3 +272,58 @@ async def test_otel_span_file_export(tmp_path, monkeypatch):
              for a in spans["http.chat"]["attributes"]}
     assert attrs["path"] == "/v1/chat/completions"
     tracing._EXPORTER = None  # do not leak the sink into other tests
+
+
+async def test_otel_span_http_push(monkeypatch):
+    """Live OTLP/HTTP push: spans batch in a daemon thread and POST as
+    OTLP/JSON to DYN_OTEL_ENDPOINT (the reference's collector export);
+    the span() hot path never blocks on the network."""
+    import json as _json
+
+    from aiohttp import web
+
+    import dynamo_tpu.runtime.tracing as tracing
+
+    received = []
+
+    async def collect(request):
+        received.append(await request.json())
+        return web.Response(status=200)
+
+    app = web.Application()
+    app.router.add_post("/v1/traces", collect)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]  # noqa: SLF001
+
+    monkeypatch.setenv("DYN_OTEL_ENDPOINT",
+                       f"http://127.0.0.1:{port}/v1/traces")
+    monkeypatch.delenv("DYN_OTEL_FILE", raising=False)
+    monkeypatch.setattr(tracing, "_EXPORTER", None)  # re-read env
+    try:
+        tok = set_trace(new_trace("push-e2e"))
+        try:
+            with tracing.span("a.root"):
+                with tracing.span("b.child", k="v"):
+                    pass
+        finally:
+            reset_trace(tok)
+        exp = tracing.get_exporter()
+        assert type(exp).__name__ == "SpanHttpExporter"
+        # close() forces the final flush (the loop flushes every 2s)
+        await asyncio.get_running_loop().run_in_executor(None, exp.close)
+        assert exp.sent == 2 and exp.dropped == 0
+        spans = {}
+        for batch in received:
+            for rs in batch["resourceSpans"]:
+                for sc in rs["scopeSpans"]:
+                    for sp in sc["spans"]:
+                        spans[sp["name"]] = sp
+        assert {"a.root", "b.child"} <= set(spans)
+        assert spans["b.child"]["parentSpanId"] == spans["a.root"]["spanId"]
+        assert {s["traceId"] for s in spans.values()} == {"push-e2e"}
+    finally:
+        monkeypatch.setattr(tracing, "_EXPORTER", None)
+        await runner.cleanup()

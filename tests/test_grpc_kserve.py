@@ -95,3 +95,86 @@ async def test_kserve_grpc_surface(model_setup):  # noqa: F811
     finally:
         await kserve.stop()
         await stop_stack(*stack)
+
+
+async def test_kserve_grpc_error_paths_and_cancel(model_setup):  # noqa: F811
+    """The surface the reference's tonic service hardens: missing input
+    tensors, metadata for unknown models, raw length-prefixed BYTES
+    packing, stream errors as messages (not transport failure), and
+    client cancellation mid-stream."""
+    import struct
+
+    stack = await start_stack(model_setup)
+    manager = stack[-1].manager
+    kserve = await KserveGrpcService(manager, host="127.0.0.1", port=0).start()
+    try:
+        async with grpc.aio.insecure_channel(
+            f"127.0.0.1:{kserve.port}"
+        ) as channel:
+            # no text_input tensor → INVALID_ARGUMENT
+            empty = pb.ModelInferRequest(model_name="tiny-chat")
+            with pytest.raises(grpc.aio.AioRpcError) as ei:
+                await _rpc(channel, "ModelInfer", pb.ModelInferRequest,
+                           pb.ModelInferResponse)(empty)
+            assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+            # metadata for an unknown model → NOT_FOUND
+            with pytest.raises(grpc.aio.AioRpcError) as ei:
+                await _rpc(channel, "ModelMetadata", pb.ModelMetadataRequest,
+                           pb.ModelMetadataResponse)(
+                    pb.ModelMetadataRequest(name="ghost"))
+            assert ei.value.code() == grpc.StatusCode.NOT_FOUND
+
+            # raw_input_contents (Triton length-prefixed BYTES packing)
+            raw = pb.ModelInferRequest(model_name="tiny-chat", id="raw1")
+            raw.inputs.add(name="text_input", datatype="BYTES", shape=[1])
+            payload = b"9999 9999"
+            raw.raw_input_contents.append(
+                struct.pack("<I", len(payload)) + payload
+            )
+            raw.parameters["max_tokens"].int64_param = 4
+            resp = await _rpc(channel, "ModelInfer", pb.ModelInferRequest,
+                              pb.ModelInferResponse)(raw)
+            assert resp.outputs[0].contents.bytes_contents[0]
+
+            # stream: unknown model yields an error MESSAGE (stream ok)
+            stream = channel.stream_stream(
+                f"/{SERVICE}/ModelStreamInfer",
+                request_serializer=pb.ModelInferRequest.SerializeToString,
+                response_deserializer=pb.ModelStreamInferResponse.FromString,
+            )
+            badreq = pb.ModelInferRequest(model_name="ghost")
+            bt = badreq.inputs.add(name="text_input", datatype="BYTES",
+                                   shape=[1])
+            bt.contents.bytes_contents.append(b"x")
+            chunks = [c async for c in stream(iter([badreq]))]
+            assert len(chunks) == 1 and "not found" in chunks[0].error_message
+
+            # client cancellation mid-stream must not wedge the service
+            longreq = pb.ModelInferRequest(model_name="tiny-chat", id="c1")
+            lt = longreq.inputs.add(name="text_input", datatype="BYTES",
+                                    shape=[1])
+            lt.contents.bytes_contents.append(b"9999 9999 9999")
+            longreq.parameters["max_tokens"].int64_param = 400
+            call = stream(iter([longreq]))
+            got_one = False
+            async for chunk in call:
+                assert not chunk.error_message, chunk.error_message
+                got_one = True
+                call.cancel()
+                break
+            assert got_one
+            # the service keeps serving after the cancel
+            live = await _rpc(channel, "ServerLive", pb.ServerLiveRequest,
+                              pb.ServerLiveResponse)(pb.ServerLiveRequest())
+            assert live.live
+            ok = pb.ModelInferRequest(model_name="tiny-chat", id="c2")
+            ot = ok.inputs.add(name="text_input", datatype="BYTES", shape=[1])
+            ot.contents.bytes_contents.append(b"9999 9999")
+            ok.parameters["max_tokens"].int64_param = 3
+            resp2 = await _rpc(channel, "ModelInfer", pb.ModelInferRequest,
+                               pb.ModelInferResponse)(ok)
+            assert resp2.outputs[0].contents.bytes_contents[0]
+    finally:
+        await kserve.stop()
+        await stop_stack(*stack)
