@@ -723,11 +723,19 @@ class JaxEngine:
                         f"chunk buckets {bad} not divisible by sp={self._sp}"
                     )
                 if (parallel.tp > 1 and model_cfg.is_moe
-                        and (model_cfg.moe_impl != "ragged"
+                        and (model_cfg.moe_impl not in ("ragged", "a2a")
                              or model_cfg.num_experts % parallel.tp)):
                     raise ValueError(
-                        "sp×tp MoE requires moe_impl='ragged' and "
+                        "sp×tp MoE requires moe_impl='ragged'|'a2a' and "
                         "num_experts divisible by tp"
+                    )
+                if (model_cfg.is_moe and model_cfg.moe_impl == "a2a"
+                        and self.cfg.enable_prefix_caching):
+                    raise ValueError(
+                        "moe_impl='a2a' requires enable_prefix_caching="
+                        "False: its capacity drops depend on batch "
+                        "composition, so cached KV would not be "
+                        "reproducible across batches"
                     )
                 # the sp shard_map's param specs shard heads, the vocab,
                 # and (dense models) the ffn dim over tp — catch uneven
@@ -2209,7 +2217,11 @@ class JaxEngine:
                 mine = set(self.kv.k.devices())
                 if set(kpad.devices()) != mine:
                     if self.mesh is not None:
-                        target = NamedSharding(self.mesh, P())
+                        # shard kv-heads like the pool so the cross-mesh
+                        # copy moves 1/tp of the blob per device
+                        spec = (P(None, None, None, "tp", None)
+                                if "tp" in self.mesh.axis_names else P())
+                        target = NamedSharding(self.mesh, spec)
                     else:
                         target = next(iter(mine))
                     kpad = jax.device_put(kpad, target)

@@ -279,14 +279,17 @@ def _moe_ragged(lp: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
 
 
 def _moe(lp: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
-    if cfg.moe_impl == "ragged":
+    if cfg.moe_impl in ("ragged", "a2a"):
+        # "a2a" (the wide-EP all-to-all, parallel/wide_ep.py) only exists
+        # inside an explicit expert-sharded shard_map; outside one the
+        # dropless ragged dispatch is the same math on one shard
         return _moe_ragged(lp, x, cfg)
     if cfg.moe_impl == "dense":
         return _moe_dense(lp, x, cfg)
     if cfg.moe_impl == "capacity":
         return _moe_capacity(lp, x, cfg)
     raise ValueError(
-        f"moe_impl must be ragged|capacity|dense, got {cfg.moe_impl!r}"
+        f"moe_impl must be ragged|a2a|capacity|dense, got {cfg.moe_impl!r}"
     )
 
 

@@ -141,7 +141,11 @@ def _layer_sp(lp, kv_layer, x, positions, table_full, chunk_full, cfg, inv_freq,
     x = x + attn_out
     mlp_in = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
     if cfg.is_moe:
-        if tp > 1:
+        if tp > 1 and cfg.moe_impl == "a2a":
+            # wide-EP: local routing + expert all-to-all (the DeepEP
+            # analog — scales past the replicated-routing ragged path)
+            mlp_out = _moe_a2a_tp(lp, mlp_in, cfg)
+        elif tp > 1:
             mlp_out = _moe_ragged_ep(lp, mlp_in, cfg)
         else:
             mlp_out = _moe(lp, mlp_in, cfg)
@@ -158,6 +162,32 @@ def _mlp_partial(lp, x):
     up = matmul_any(x, lp["w_up"], "bsh,hf->bsf")
     act = jax.nn.silu(gate) * up
     return matmul_any(act.astype(x.dtype), lp["w_down"], "bsf,fh->bsh")
+
+
+def _moe_a2a_tp(lp, x, cfg):
+    """wide_ep.moe_all_to_all_ep adapted to the sp×tp layer body, where
+    tokens arrive TP-REPLICATED (attention/psum outputs): each tp shard
+    routes a disjoint 1/tp slice of the tokens — without the slice every
+    shard would ship identical peer blocks and the owners would compute
+    each assignment tp times — and an all-gather re-replicates the
+    result for the residual add."""
+    from .wide_ep import moe_all_to_all_ep
+
+    B, S, h = x.shape
+    i = jax.lax.axis_index("tp")
+    tp = jax.lax.psum(1, "tp")
+    T = B * S
+    Tp = -(-T // tp) * tp
+    xf = x.reshape(T, h)
+    if Tp != T:
+        xf = jnp.pad(xf, ((0, Tp - T), (0, 0)))
+    xl = jax.lax.dynamic_slice(xf, (i * (Tp // tp), 0), (Tp // tp, h))
+    out_l = moe_all_to_all_ep(
+        lp, xl[None], cfg, axis="tp",
+        capacity_factor=cfg.moe_capacity_factor or 2.0,
+    )[0]  # [Tp/tp, h]
+    out = jax.lax.all_gather(out_l, "tp", axis=0, tiled=True)  # [Tp, h]
+    return out[:T].reshape(B, S, h)
 
 
 def _moe_ragged_ep(lp, x, cfg):
@@ -247,9 +277,9 @@ def forward_prefill_sp(
     """
     tp = mesh.shape.get("tp", 1)
     if cfg.is_moe and tp > 1:
-        if cfg.moe_impl != "ragged":
+        if cfg.moe_impl not in ("ragged", "a2a"):
             raise NotImplementedError(
-                "sp×tp MoE implements the ragged dispatch only "
+                "sp×tp MoE implements the ragged and a2a dispatches only "
                 f"(moe_impl={cfg.moe_impl!r})"
             )
         if cfg.num_experts % tp:

@@ -1,0 +1,124 @@
+"""Wide expert parallelism: capacity-bounded ALL-TO-ALL MoE dispatch.
+
+The reference reaches wide-EP through SGLang's DeepEP integration
+(`--ep-size`, /root/reference/recipes/deepseek-r1/sglang-wideep/); the
+TPU-native equivalent is the GShard/DeepEP pattern over an ep mesh axis:
+
+- each shard routes ONLY its local tokens (O(T_local * E) router work —
+  unlike `sp_prefill._moe_ragged_ep`, which replicates the full routing
+  and global sort on every shard);
+- assignments pack into per-peer capacity buffers and one
+  `lax.all_to_all` ships each token's hidden vector to the shard owning
+  its expert (this is the expert all-to-all that rides ICI);
+- the owner computes its local experts via sort + `ragged_dot`
+  (dropless within capacity) and a second all-to-all returns results;
+- tokens past a peer's capacity are dropped (standard GShard behavior —
+  their residual stream passes through unchanged); `expert_load` exposes
+  the per-expert routed-token histogram so imbalance is observable.
+
+Use inside a shard_map where tokens are data-sharded (sp/dp) and the
+expert weight stacks are sharded on their leading E axis over `axis`.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def expert_load(selected: jax.Array, num_experts: int) -> jax.Array:
+    """Routed-assignment histogram [E] (imbalance metric: a balanced
+    router keeps max(load)/mean(load) near 1)."""
+    return jnp.bincount(selected.reshape(-1), length=num_experts)
+
+
+def moe_all_to_all_ep(lp, x: jax.Array, cfg, axis: str = "tp",
+                      capacity_factor: float = 2.0):
+    """Dropless-within-capacity top-k MoE with an expert all-to-all.
+
+    `x` [B, S, h] is this shard's LOCAL tokens; `lp["w_*"]` leaves carry
+    the LOCAL expert slice [E_local, ...]; `lp["router"]` is replicated.
+    Returns [B, S, h].
+    """
+    B, S, h = x.shape
+    E, k = cfg.num_experts, cfg.num_experts_per_tok
+    n = jax.lax.psum(1, axis)
+    e_local = lp["w_gate"].shape[0]
+    T = B * S
+    A = T * k
+    # per-peer send capacity: fair share of this shard's assignments,
+    # padded by the capacity factor for imbalance
+    C = max(1, int(-(-A * capacity_factor // n)))
+
+    xf = x.reshape(T, h)
+    logits = jnp.einsum("th,he->te", xf, lp["router"],
+                        preferred_element_type=jnp.float32)
+    weights, selected = jax.lax.top_k(logits, k)  # [T, k]
+    weights = jax.nn.softmax(weights, axis=-1)
+
+    sel = selected.reshape(A)  # assignment → global expert
+    wts = weights.reshape(A).astype(jnp.float32)
+    tok = jnp.arange(A) // k  # assignment → local token
+    peer = sel // e_local  # shard owning the expert
+    local_e = sel % e_local
+
+    # slot of each assignment within its peer's capacity buffer
+    onehot = jax.nn.one_hot(peer, n, dtype=jnp.int32)  # [A, n]
+    slot = (jnp.cumsum(onehot, axis=0) - onehot)  # prior sends per peer
+    slot = (slot * onehot).sum(-1)  # [A]
+    keep = slot < C
+
+    # scatter into send buffers: tokens + (local expert, weight, source
+    # assignment) sidecars; dropped/padding slots carry expert id
+    # E_LOCAL (a sentinel group the owner computes nothing for)
+    flat = peer * C + jnp.where(keep, slot, 0)
+    send_x = jnp.zeros((n * C, h), x.dtype)
+    send_e = jnp.full((n * C,), e_local, jnp.int32)
+    upd = jnp.where(keep[:, None], xf[tok], 0)
+    send_x = send_x.at[jnp.where(keep, flat, n * C)].set(
+        upd, mode="drop"
+    )
+    send_e = send_e.at[jnp.where(keep, flat, n * C)].set(
+        local_e, mode="drop"
+    )
+
+    def a2a(v):
+        return jax.lax.all_to_all(
+            v.reshape(n, C, *v.shape[1:]), axis, split_axis=0,
+            concat_axis=0, tiled=True,
+        ).reshape(n * C, *v.shape[1:])
+
+    recv_x = a2a(send_x)  # [n*C, h] tokens for MY experts
+    recv_e = a2a(send_e)  # [n*C] local expert ids (e_local = hole)
+
+    # sort received rows by local expert so ragged_dot computes exactly
+    # the real rows per expert (holes sort to the end)
+    order = jnp.argsort(recv_e, stable=True)
+    xs = recv_x[order]
+    gs = jnp.bincount(recv_e, length=e_local + 1)[:e_local]
+
+    gate = jax.lax.ragged_dot(xs, lp["w_gate"], gs,
+                              preferred_element_type=jnp.float32)
+    up = jax.lax.ragged_dot(xs, lp["w_up"], gs,
+                            preferred_element_type=jnp.float32)
+    act = (jax.nn.silu(gate) * up).astype(x.dtype)
+    ys = jax.lax.ragged_dot(act, lp["w_down"], gs,
+                            preferred_element_type=jnp.float32)
+
+    # rows past the real assignments are UNSPECIFIED ragged output —
+    # zero them before unsorting (NaN would poison the return combine)
+    valid_sorted = recv_e[order] < e_local
+    ys = jnp.where(valid_sorted[:, None], ys, 0.0)
+    out_rows = jnp.zeros((n * C, h), jnp.float32).at[order].set(ys)
+
+    # the tiled all_to_all is an involution (block i<->j swap), so the
+    # second hop lands each assignment's result back at its send slot
+    back = a2a(out_rows.astype(jnp.float32))
+
+    # combine at the source: scatter-add weighted expert outputs per token
+    gathered = back[jnp.where(keep, flat, 0)]
+    gathered = jnp.where(keep[:, None], gathered, 0.0)
+    out = jnp.zeros((T, h), jnp.float32).at[tok].add(
+        gathered * wts[:, None]
+    )
+    return out.reshape(B, S, h).astype(x.dtype)

@@ -235,8 +235,8 @@ class SpanHttpExporter:
                     self.endpoint, exc_info=True,
                 )
 
-    def _flush_all(self) -> None:
-        while True:
+    def _flush_all(self, deadline: Optional[float] = None) -> None:
+        while deadline is None or time.monotonic() < deadline:
             spans = self._drain()
             if not spans:
                 return
@@ -250,7 +250,15 @@ class SpanHttpExporter:
     def close(self) -> None:
         self._closed.set()
         self._thread.join(timeout=10)
-        self._flush_all()  # whatever the thread left behind
+        if self._warned:
+            # the collector is already failing — don't stall process
+            # exit retrying a full queue of doomed batches
+            while True:
+                batch = self._drain()
+                if not batch:
+                    return
+                self.dropped += len(batch)
+        self._flush_all(deadline=time.monotonic() + 10.0)
 
 
 def get_exporter():

@@ -1,0 +1,134 @@
+"""Wide-EP: the capacity-bounded all-to-all MoE dispatch
+(parallel/wide_ep.py — the DeepEP/GShard analog, VERDICT r2 item 10).
+Routing is LOCAL per shard (no replicated global sort), the expert
+all-to-all ships tokens to their expert's shard, and the routed-token
+histogram exposes imbalance."""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dynamo_tpu.models import init_params, tiny_moe_config
+from dynamo_tpu.models.llama import _moe_dense
+from dynamo_tpu.parallel._compat import shard_map
+from dynamo_tpu.parallel.wide_ep import expert_load, moe_all_to_all_ep
+
+
+def _layer0(cfg):
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    lp = jax.tree.map(lambda a: a[0], params["layers"])
+    return {k: lp[k] for k in ("router", "w_gate", "w_up", "w_down")}
+
+
+def _specs():
+    return {"router": P(None, None), "w_gate": P("tp", None, None),
+            "w_up": P("tp", None, None), "w_down": P("tp", None, None)}
+
+
+def _run_a2a(cfg, lp, x, mesh, capacity_factor):
+    def body(lp, xl):
+        return moe_all_to_all_ep(lp, xl, cfg, axis="tp",
+                                 capacity_factor=capacity_factor)
+
+    return shard_map(
+        body, mesh=mesh,
+        in_specs=(_specs(), P(None, "sp", None)),
+        out_specs=P(None, "sp", None),
+    )(lp, x)
+
+
+def test_a2a_matches_dense_oracle_64_experts():
+    """64 experts over 8 devices (tokens sp-sharded, experts tp-sharded):
+    the all-to-all dispatch equals the every-expert-computes oracle at
+    top-k when capacity admits every assignment."""
+    cfg = tiny_moe_config(num_experts=64, num_experts_per_tok=4,
+                          moe_impl="a2a")
+    lp = _layer0(cfg)
+    mesh = Mesh(np.array(jax.devices()).reshape(4, 2), ("sp", "tp"))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 16, cfg.hidden_size),
+                          jnp.float32) * 0.5
+    want = _moe_dense(lp, x, cfg)
+    got = _run_a2a(cfg, lp, x, mesh, capacity_factor=8.0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_a2a_capacity_drops_pass_residual_through():
+    """Past-capacity assignments drop (GShard semantics): the output is
+    finite and each token keeps only its admitted experts' contributions
+    — never NaN, never another token's rows."""
+    cfg = tiny_moe_config(num_experts=64, num_experts_per_tok=4,
+                          moe_impl="a2a")
+    lp = _layer0(cfg)
+    mesh = Mesh(np.array(jax.devices()).reshape(4, 2), ("sp", "tp"))
+    x = jax.random.normal(jax.random.PRNGKey(2), (2, 16, cfg.hidden_size),
+                          jnp.float32)
+    tight = _run_a2a(cfg, lp, x, mesh, capacity_factor=0.25)
+    loose = _run_a2a(cfg, lp, x, mesh, capacity_factor=8.0)
+    assert np.isfinite(np.asarray(tight)).all()
+    # dropping changes outputs (so capacity is actually binding here)...
+    assert not np.allclose(np.asarray(tight), np.asarray(loose))
+    # ...and a dropped-token output has smaller norm than the full one
+    tn = np.linalg.norm(np.asarray(tight), axis=-1)
+    ln = np.linalg.norm(np.asarray(loose), axis=-1)
+    assert (tn <= ln + 1e-3).mean() > 0.9
+
+
+def test_expert_load_histogram():
+    cfg = tiny_moe_config(num_experts=64, num_experts_per_tok=4)
+    lp = _layer0(cfg)
+    x = jax.random.normal(jax.random.PRNGKey(3), (2, 16, cfg.hidden_size),
+                          jnp.float32)
+    logits = jnp.einsum("bsh,he->bse", x, lp["router"])
+    _, sel = jax.lax.top_k(logits, cfg.num_experts_per_tok)
+    load = expert_load(sel, 64)
+    assert int(load.sum()) == 2 * 16 * 4
+    assert load.shape == (64,)
+    imbalance = float(load.max()) / max(float(load.mean()), 1e-9)
+    assert imbalance >= 1.0  # the metric itself is well-formed
+
+
+async def test_engine_serves_a2a_moe_64_experts():
+    """The sp×tp serving engine prefills a 64-expert model through the
+    all-to-all dispatch and greedy-matches a single-device run."""
+    from dynamo_tpu.engine import EngineConfig, JaxEngine
+    from dynamo_tpu.parallel import ParallelConfig
+
+    cfg = tiny_moe_config(num_experts=64, num_experts_per_tok=4,
+                          moe_impl="a2a", moe_capacity_factor=8.0)
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+
+    def ecfg():
+        return EngineConfig(
+            page_size=8, num_pages=96, max_num_seqs=4,
+            max_prefill_tokens=4 * 128, prefill_batch_size=1,
+            max_model_len=128, enable_prefix_caching=False,
+        )
+
+    def req(p):
+        return {"token_ids": p,
+                "sampling_options": {"temperature": 0.0},
+                "stop_conditions": {"max_tokens": 5, "ignore_eos": True}}
+
+    async def collect(engine, p):
+        out = []
+        async for d in engine.generate(req(p)):
+            assert d.get("finish_reason") != "error", d
+            out.extend(d["token_ids"])
+        return out
+
+    prompts = [[(7 * j + i) % cfg.vocab_size for j in range(20 + 4 * i)]
+               for i in range(3)]
+    ref = JaxEngine(cfg, params, ecfg(), kv_dtype=jnp.float32)
+    want = [await collect(ref, p) for p in prompts]
+    await ref.shutdown()
+
+    eng = JaxEngine(cfg, params, ecfg(), kv_dtype=jnp.float32,
+                    parallel=ParallelConfig(dp=2, sp=2, tp=2))
+    got = [await collect(eng, p) for p in prompts]
+    await eng.shutdown()
+    assert got == want
