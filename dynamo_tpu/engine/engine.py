@@ -730,7 +730,10 @@ class JaxEngine:
                         "num_experts divisible by tp"
                     )
                 if (model_cfg.is_moe and model_cfg.moe_impl == "a2a"
+                        and parallel.tp > 1
                         and self.cfg.enable_prefix_caching):
+                    # tp == 1 never engages the all-to-all (the ragged
+                    # fallback is dropless), so caching stays legal there
                     raise ValueError(
                         "moe_impl='a2a' requires enable_prefix_caching="
                         "False: its capacity drops depend on batch "

@@ -54,12 +54,15 @@ class _ShardReader:
         return name in self.weight_map
 
 
-def load_params(path: str, cfg: ModelConfig, dtype=jnp.bfloat16):
+def load_params(path: str, cfg: ModelConfig, dtype=jnp.bfloat16,
+                prefix: str = "", reader=None):
     """Load HF weights into the stacked pytree (host RAM → device on first
-    use; callers shard with jax.device_put + NamedSharding)."""
+    use; callers shard with jax.device_put + NamedSharding).  `prefix`
+    namespaces every tensor name (VLM checkpoints nest the LLM under
+    "language_model."); `reader` reuses an open _ShardReader."""
     if safe_open is None:
         raise RuntimeError("safetensors not available")
-    r = _ShardReader(path)
+    r = reader or _ShardReader(path)
     L = cfg.num_hidden_layers
 
     def stack(fmt: str, transpose: bool = True) -> jnp.ndarray:
@@ -69,7 +72,7 @@ def load_params(path: str, cfg: ModelConfig, dtype=jnp.bfloat16):
             mats.append(w.T if transpose else w)
         return jnp.asarray(np.stack(mats), dtype)
 
-    p = "model.layers.{i}."
+    p = prefix + "model.layers.{i}."
     layers = {
         "wq": stack(p + "self_attn.q_proj.weight"),
         "wk": stack(p + "self_attn.k_proj.weight"),
@@ -81,7 +84,7 @@ def load_params(path: str, cfg: ModelConfig, dtype=jnp.bfloat16):
     if cfg.attention_bias:  # qwen2-style — gate on the CONFIG so the
         # param tree always matches param_pspecs/init_params (a checkpoint/
         # config mismatch must be a load error, not a tp tree-map error)
-        if not r.has("model.layers.0.self_attn.q_proj.bias"):
+        if not r.has(prefix + "model.layers.0.self_attn.q_proj.bias"):
             raise ValueError(
                 "config declares attention_bias but the checkpoint has "
                 "no self_attn.*_proj.bias tensors"
@@ -93,7 +96,7 @@ def load_params(path: str, cfg: ModelConfig, dtype=jnp.bfloat16):
                 "bv": stack(p + "self_attn.v_proj.bias", transpose=False),
             }
         )
-    elif r.has("model.layers.0.self_attn.q_proj.bias"):
+    elif r.has(prefix + "model.layers.0.self_attn.q_proj.bias"):
         raise ValueError(
             "checkpoint has self_attn.*_proj.bias tensors but the config "
             "does not declare attention_bias — refusing to silently drop "
@@ -101,7 +104,7 @@ def load_params(path: str, cfg: ModelConfig, dtype=jnp.bfloat16):
         )
     if cfg.attention_sinks:  # gpt-oss sink logits — gate on the CONFIG
         # (like every other consumer) so params and cfg cannot disagree
-        if not r.has("model.layers.0.self_attn.sinks"):
+        if not r.has(prefix + "model.layers.0.self_attn.sinks"):
             raise ValueError(
                 "config declares attention_sinks but the checkpoint has "
                 "no self_attn.sinks tensors"
@@ -115,7 +118,7 @@ def load_params(path: str, cfg: ModelConfig, dtype=jnp.bfloat16):
             for i in range(L):
                 per = [
                     r.get(
-                        f"model.layers.{i}.block_sparse_moe.experts.{e}.{sub}.weight"
+                        prefix + f"model.layers.{i}.block_sparse_moe.experts.{e}.{sub}.weight"
                     ).T
                     for e in range(E)
                 ]
@@ -139,13 +142,13 @@ def load_params(path: str, cfg: ModelConfig, dtype=jnp.bfloat16):
             }
         )
     params = {
-        "embed": jnp.asarray(r.get("model.embed_tokens.weight"), dtype),
-        "final_norm": jnp.asarray(r.get("model.norm.weight"), dtype),
+        "embed": jnp.asarray(r.get(prefix + "model.embed_tokens.weight"), dtype),
+        "final_norm": jnp.asarray(r.get(prefix + "model.norm.weight"), dtype),
         "layers": layers,
     }
     if not cfg.tie_word_embeddings:
-        if r.has("lm_head.weight"):
-            params["lm_head"] = jnp.asarray(r.get("lm_head.weight").T, dtype)
+        if r.has(prefix + "lm_head.weight"):
+            params["lm_head"] = jnp.asarray(r.get(prefix + "lm_head.weight").T, dtype)
         else:
             params["lm_head"] = params["embed"].T
     return params

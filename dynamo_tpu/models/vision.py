@@ -31,6 +31,20 @@ class VisionConfig:
     num_attention_heads: int = 4
     out_hidden_size: int = 64  # LLM hidden size (projector output)
     layer_norm_eps: float = 1e-6
+    # CLIP-checkpoint parity (llava towers): qkv/out projection biases,
+    # a learned class token (position 0; dropped from the output patch
+    # run, llava's "default" feature select), a pre-encoder layernorm,
+    # and a 2-layer gelu projector (llava's multi_modal_projector)
+    attention_bias: bool = False
+    use_cls_token: bool = False
+    pre_layernorm: bool = False
+    projector_hidden: int = 0  # 0 → single linear projector
+    # HF `vision_feature_layer`: 0 runs every layer + post_layernorm
+    # (this tower's native shape); a negative value indexes HF's
+    # hidden_states list (-2, the llava default, stops BEFORE the last
+    # layer and skips post_layernorm — HF CLIP only post-norms the
+    # pooled CLS, so trained projectors expect un-normed features)
+    feature_layer: int = 0
 
     @property
     def num_patches(self) -> int:
@@ -60,27 +74,48 @@ def init_vision_params(cfg: VisionConfig, key, dtype=jnp.float32) -> Params:
         scale = scale if scale is not None else (shape[-2] ** -0.5)
         return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
 
-    return {
+    layers = {
+        "ln1_scale": jnp.ones((L, h), dtype),
+        "ln1_bias": jnp.zeros((L, h), dtype),
+        "wq": w(next(ks), L, h, h),
+        "wk": w(next(ks), L, h, h),
+        "wv": w(next(ks), L, h, h),
+        "wo": w(next(ks), L, h, h),
+        "ln2_scale": jnp.ones((L, h), dtype),
+        "ln2_bias": jnp.zeros((L, h), dtype),
+        "w1": w(next(ks), L, h, f),
+        "b1": jnp.zeros((L, f), dtype),
+        "w2": w(next(ks), L, f, h),
+        "b2": jnp.zeros((L, h), dtype),
+    }
+    if cfg.attention_bias:
+        layers.update({
+            "bq": jnp.zeros((L, h), dtype),
+            "bk": jnp.zeros((L, h), dtype),
+            "bv": jnp.zeros((L, h), dtype),
+            "bo": jnp.zeros((L, h), dtype),
+        })
+    n_pos = cfg.num_patches + (1 if cfg.use_cls_token else 0)
+    out = {
         "patch_proj": w(next(ks), patch_dim, h),
-        "pos_embed": w(next(ks), cfg.num_patches, h, scale=0.02),
-        "layers": {
-            "ln1_scale": jnp.ones((L, h), dtype),
-            "ln1_bias": jnp.zeros((L, h), dtype),
-            "wq": w(next(ks), L, h, h),
-            "wk": w(next(ks), L, h, h),
-            "wv": w(next(ks), L, h, h),
-            "wo": w(next(ks), L, h, h),
-            "ln2_scale": jnp.ones((L, h), dtype),
-            "ln2_bias": jnp.zeros((L, h), dtype),
-            "w1": w(next(ks), L, h, f),
-            "b1": jnp.zeros((L, f), dtype),
-            "w2": w(next(ks), L, f, h),
-            "b2": jnp.zeros((L, h), dtype),
-        },
+        "pos_embed": w(next(ks), n_pos, h, scale=0.02),
+        "layers": layers,
         "post_ln_scale": jnp.ones((h,), dtype),
         "post_ln_bias": jnp.zeros((h,), dtype),
-        "proj": w(next(ks), h, cfg.out_hidden_size),
+        "proj": w(next(ks), h,
+                  cfg.projector_hidden or cfg.out_hidden_size),
     }
+    if cfg.use_cls_token:
+        out["cls_token"] = w(next(ks), h, scale=0.02)
+    if cfg.pre_layernorm:
+        out["pre_ln_scale"] = jnp.ones((h,), dtype)
+        out["pre_ln_bias"] = jnp.zeros((h,), dtype)
+    if cfg.projector_hidden:
+        out["proj_b1"] = jnp.zeros((cfg.projector_hidden,), dtype)
+        out["proj2"] = w(next(ks), cfg.projector_hidden,
+                         cfg.out_hidden_size)
+        out["proj_b2"] = jnp.zeros((cfg.out_hidden_size,), dtype)
+    return out
 
 
 def _layer_norm(x, scale, bias, eps):
@@ -93,15 +128,20 @@ def _layer_norm(x, scale, bias, eps):
 def _vit_layer(lp, x, cfg: VisionConfig):
     N, S, h = x.shape
     nh, hd = cfg.num_attention_heads, cfg.head_dim
+
+    def proj(a, wkey, bkey):
+        y = a @ lp[wkey]
+        return y + lp[bkey] if bkey in lp else y
+
     a = _layer_norm(x, lp["ln1_scale"], lp["ln1_bias"], cfg.layer_norm_eps)
-    q = (a @ lp["wq"]).reshape(N, S, nh, hd)
-    k = (a @ lp["wk"]).reshape(N, S, nh, hd)
-    v = (a @ lp["wv"]).reshape(N, S, nh, hd)
+    q = proj(a, "wq", "bq").reshape(N, S, nh, hd)
+    k = proj(a, "wk", "bk").reshape(N, S, nh, hd)
+    v = proj(a, "wv", "bv").reshape(N, S, nh, hd)
     s = jnp.einsum("nqhd,nkhd->nhqk", q, k,
                    preferred_element_type=jnp.float32) * (hd ** -0.5)
     p = jax.nn.softmax(s, axis=-1)
     o = jnp.einsum("nhqk,nkhd->nqhd", p, v.astype(jnp.float32))
-    x = x + (o.reshape(N, S, h).astype(x.dtype) @ lp["wo"])
+    x = x + proj(o.reshape(N, S, h).astype(x.dtype), "wo", "bo")
     m = _layer_norm(x, lp["ln2_scale"], lp["ln2_bias"], cfg.layer_norm_eps)
     m = jax.nn.gelu(m @ lp["w1"] + lp["b1"]) @ lp["w2"] + lp["b2"]
     return x + m.astype(x.dtype)
@@ -117,12 +157,32 @@ def encode_images(params: Params, cfg: VisionConfig,
     # patchify: [N, g, p, g, p, 3] → [N, g*g, p*p*3]
     x = pixels.reshape(N, g, p, g, p, 3).transpose(0, 1, 3, 2, 4, 5)
     x = x.reshape(N, g * g, p * p * 3).astype(params["patch_proj"].dtype)
-    x = x @ params["patch_proj"] + params["pos_embed"][None]
+    x = x @ params["patch_proj"]
+    if cfg.use_cls_token:
+        cls = jnp.broadcast_to(params["cls_token"][None, None],
+                               (N, 1, x.shape[-1]))
+        x = jnp.concatenate([cls, x], axis=1)
+    x = x + params["pos_embed"][None]
+    if cfg.pre_layernorm:
+        x = _layer_norm(x, params["pre_ln_scale"], params["pre_ln_bias"],
+                        cfg.layer_norm_eps)
 
     def body(carry, lp):
         return _vit_layer(lp, carry, cfg), None
 
-    x, _ = jax.lax.scan(body, x, params["layers"])
-    x = _layer_norm(x, params["post_ln_scale"], params["post_ln_bias"],
-                    cfg.layer_norm_eps)
-    return x @ params["proj"]  # [N, num_patches, out_hidden]
+    layers = params["layers"]
+    if cfg.feature_layer:
+        # run only up to the HF hidden_states[feature_layer] features
+        n_run = cfg.num_hidden_layers + 1 + cfg.feature_layer
+        layers = jax.tree.map(lambda a: a[:n_run], layers)
+    x, _ = jax.lax.scan(body, x, layers)
+    if cfg.use_cls_token:
+        x = x[:, 1:]  # llava "default" feature select: patches only
+    if not cfg.feature_layer:
+        x = _layer_norm(x, params["post_ln_scale"], params["post_ln_bias"],
+                        cfg.layer_norm_eps)
+    out = x @ params["proj"]
+    if cfg.projector_hidden:
+        out = jax.nn.gelu(out + params["proj_b1"]) @ params["proj2"]
+        out = out + params["proj_b2"]
+    return out  # [N, num_patches, out_hidden]

@@ -1,0 +1,147 @@
+"""Real-VLM checkpoint loading: LLaVA-style HF layouts → the TPU-native
+vision tower + llama stack.
+
+Reference capability: the SGLang/vLLM backends load published VLM
+checkpoints directly (encode_worker_handler.py ships precomputed
+embeddings); here the mapping is first-party:
+
+- `vision_tower.vision_model.*` (CLIP ViT: conv patch embedding, class
+  token, pre/post layernorms, per-layer q/k/v/out projections WITH
+  biases, fc1/fc2 MLP) → `models.vision` params, with the conv kernel
+  [h, 3, p, p] re-laid to the patchify order [(ph, pw, c), h];
+- `multi_modal_projector.linear_1/linear_2` → the 2-layer gelu
+  projector (VisionConfig.projector_hidden);
+- `language_model.model.*` → the llama loader under a prefix.
+
+`load_vlm` returns (llm_params, llm_cfg, vision_params, vision_cfg)
+ready for `JaxEngine(..., vision=(vparams, vcfg))`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .config import ModelConfig
+from .loader import _ShardReader, load_params
+from .vision import VisionConfig
+
+VT = "vision_tower.vision_model."
+LAYER = VT + "encoder.layers.{i}."
+
+
+def vision_config_from_hf(d: dict, out_hidden: int,
+                          projector_hidden: int,
+                          feature_layer: int = -2) -> VisionConfig:
+    """Map an HF `vision_config` dict (CLIP shape) onto VisionConfig.
+    `feature_layer` is the top-level `vision_feature_layer` (llava
+    default -2: second-to-last hidden states, no post-layernorm)."""
+    return VisionConfig(
+        image_size=d.get("image_size", 336),
+        patch_size=d.get("patch_size", 14),
+        hidden_size=d.get("hidden_size", 1024),
+        intermediate_size=d.get("intermediate_size", 4096),
+        num_hidden_layers=d.get("num_hidden_layers", 24),
+        num_attention_heads=d.get("num_attention_heads", 16),
+        out_hidden_size=out_hidden,
+        layer_norm_eps=d.get("layer_norm_eps", 1e-5),
+        attention_bias=True,
+        use_cls_token=True,
+        pre_layernorm=True,
+        projector_hidden=projector_hidden,
+        feature_layer=feature_layer,
+    )
+
+
+def load_vision_params(path: str, vcfg: VisionConfig, dtype=jnp.float32,
+                       reader=None):
+    """LLaVA/CLIP tower weights → the tower's param pytree."""
+    r = reader or _ShardReader(path)
+    L = vcfg.num_hidden_layers
+    p = vcfg.patch_size
+
+    def stack(fmt: str, transpose: bool = True):
+        mats = []
+        for i in range(L):
+            w = r.get(fmt.format(i=i))
+            mats.append(w.T if transpose else w)
+        return jnp.asarray(np.stack(mats), dtype)
+
+    conv = r.get(VT + "embeddings.patch_embedding.weight")  # [h, 3, p, p]
+    # patchify order is (ph, pw, c): conv [h, c, ph, pw] → [(ph, pw, c), h]
+    patch_proj = np.ascontiguousarray(
+        conv.transpose(2, 3, 1, 0).reshape(p * p * 3, -1)
+    )
+    pos = r.get(VT + "embeddings.position_embedding.weight")  # [1+P, h]
+    params = {
+        "patch_proj": jnp.asarray(patch_proj, dtype),
+        "pos_embed": jnp.asarray(pos, dtype),
+        "cls_token": jnp.asarray(
+            r.get(VT + "embeddings.class_embedding").reshape(-1), dtype
+        ),
+        "pre_ln_scale": jnp.asarray(r.get(VT + "pre_layrnorm.weight"), dtype),
+        "pre_ln_bias": jnp.asarray(r.get(VT + "pre_layrnorm.bias"), dtype),
+        "layers": {
+            "ln1_scale": stack(LAYER + "layer_norm1.weight", False),
+            "ln1_bias": stack(LAYER + "layer_norm1.bias", False),
+            "wq": stack(LAYER + "self_attn.q_proj.weight"),
+            "bq": stack(LAYER + "self_attn.q_proj.bias", False),
+            "wk": stack(LAYER + "self_attn.k_proj.weight"),
+            "bk": stack(LAYER + "self_attn.k_proj.bias", False),
+            "wv": stack(LAYER + "self_attn.v_proj.weight"),
+            "bv": stack(LAYER + "self_attn.v_proj.bias", False),
+            "wo": stack(LAYER + "self_attn.out_proj.weight"),
+            "bo": stack(LAYER + "self_attn.out_proj.bias", False),
+            "ln2_scale": stack(LAYER + "layer_norm2.weight", False),
+            "ln2_bias": stack(LAYER + "layer_norm2.bias", False),
+            "w1": stack(LAYER + "mlp.fc1.weight"),
+            "b1": stack(LAYER + "mlp.fc1.bias", False),
+            "w2": stack(LAYER + "mlp.fc2.weight"),
+            "b2": stack(LAYER + "mlp.fc2.bias", False),
+        },
+        "post_ln_scale": jnp.asarray(
+            r.get(VT + "post_layernorm.weight"), dtype
+        ),
+        "post_ln_bias": jnp.asarray(r.get(VT + "post_layernorm.bias"), dtype),
+        "proj": jnp.asarray(
+            r.get("multi_modal_projector.linear_1.weight").T, dtype
+        ),
+        "proj_b1": jnp.asarray(
+            r.get("multi_modal_projector.linear_1.bias"), dtype
+        ),
+        "proj2": jnp.asarray(
+            r.get("multi_modal_projector.linear_2.weight").T, dtype
+        ),
+        "proj_b2": jnp.asarray(
+            r.get("multi_modal_projector.linear_2.bias"), dtype
+        ),
+    }
+    return params
+
+
+def load_vlm(path: str, dtype=jnp.bfloat16) -> Tuple:
+    """Load a LLaVA-layout checkpoint directory: returns
+    (llm_params, llm_cfg, vision_params, vision_cfg)."""
+    with open(os.path.join(path, "config.json")) as f:
+        hf = json.load(f)
+    text_cfg = hf.get("text_config") or hf
+    llm_cfg = ModelConfig.from_hf_config(
+        text_cfg, name=hf.get("_name_or_path", os.path.basename(path))
+    )
+    # ONE reader for the probe + both loads (a sharded checkpoint's
+    # index parses once; shard handles are shared)
+    r = _ShardReader(path)
+    projector_hidden = r.get("multi_modal_projector.linear_1.bias").shape[0]
+    vcfg = vision_config_from_hf(
+        hf.get("vision_config") or {}, out_hidden=llm_cfg.hidden_size,
+        projector_hidden=projector_hidden,
+        feature_layer=hf.get("vision_feature_layer", -2),
+    )
+    vparams = load_vision_params(path, vcfg, dtype=jnp.float32, reader=r)
+    llm_params = load_params(path, llm_cfg, dtype=dtype,
+                             prefix="language_model.", reader=r)
+    return llm_params, llm_cfg, vparams, vcfg

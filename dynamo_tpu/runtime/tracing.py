@@ -225,6 +225,7 @@ class SpanHttpExporter:
             with urllib.request.urlopen(req, timeout=5) as resp:
                 resp.read()
             self.sent += len(spans)
+            self._warned = False  # collector recovered
         except Exception:  # noqa: BLE001 — a bad endpoint/collector must
             # never kill the pump thread; drop the batch and keep going
             self.dropped += len(spans)
