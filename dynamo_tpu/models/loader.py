@@ -54,6 +54,17 @@ class _ShardReader:
         return name in self.weight_map
 
 
+def stack_layers(reader: "_ShardReader", n_layers: int, fmt: str,
+                 transpose: bool = True, dtype=jnp.bfloat16) -> jnp.ndarray:
+    """Stack per-layer tensors on axis 0 (input-major when `transpose`,
+    so forward einsums are transpose-free)."""
+    mats: List[np.ndarray] = []
+    for i in range(n_layers):
+        w = reader.get(fmt.format(i=i))
+        mats.append(w.T if transpose else w)
+    return jnp.asarray(np.stack(mats), dtype)
+
+
 def load_params(path: str, cfg: ModelConfig, dtype=jnp.bfloat16,
                 prefix: str = "", reader=None):
     """Load HF weights into the stacked pytree (host RAM → device on first
@@ -66,11 +77,7 @@ def load_params(path: str, cfg: ModelConfig, dtype=jnp.bfloat16,
     L = cfg.num_hidden_layers
 
     def stack(fmt: str, transpose: bool = True) -> jnp.ndarray:
-        mats: List[np.ndarray] = []
-        for i in range(L):
-            w = r.get(fmt.format(i=i))
-            mats.append(w.T if transpose else w)
-        return jnp.asarray(np.stack(mats), dtype)
+        return stack_layers(r, L, fmt, transpose=transpose, dtype=dtype)
 
     p = prefix + "model.layers.{i}."
     layers = {

@@ -27,7 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .config import ModelConfig
-from .loader import _ShardReader, load_params
+from .loader import _ShardReader, load_params, stack_layers
 from .vision import VisionConfig
 
 VT = "vision_tower.vision_model."
@@ -40,6 +40,27 @@ def vision_config_from_hf(d: dict, out_hidden: int,
     """Map an HF `vision_config` dict (CLIP shape) onto VisionConfig.
     `feature_layer` is the top-level `vision_feature_layer` (llava
     default -2: second-to-last hidden states, no post-layernorm)."""
+    L = d.get("num_hidden_layers", 24)
+    if not isinstance(feature_layer, int) or isinstance(feature_layer, bool):
+        raise ValueError(
+            f"vision_feature_layer={feature_layer!r} unsupported (multi-"
+            "layer/list selects are not implemented)"
+        )
+    if feature_layer >= 0:
+        # HF hidden_states[k] (k=0 → embeddings) → internal negative form
+        if feature_layer > L:
+            raise ValueError(
+                f"vision_feature_layer={feature_layer} > {L} layers"
+            )
+        feature_layer = feature_layer - (L + 1)  # -(L+1)..-1
+    elif feature_layer < -(L + 1):
+        raise ValueError(
+            f"vision_feature_layer={feature_layer} out of range for "
+            f"{L} layers"
+        )
+    # NB -1 stays -1: all encoder layers WITHOUT post-layernorm (the
+    # internal 0 — all layers + post-LN — is this tower's native shape,
+    # never what an HF llava checkpoint means)
     return VisionConfig(
         image_size=d.get("image_size", 336),
         patch_size=d.get("patch_size", 14),
@@ -65,11 +86,7 @@ def load_vision_params(path: str, vcfg: VisionConfig, dtype=jnp.float32,
     p = vcfg.patch_size
 
     def stack(fmt: str, transpose: bool = True):
-        mats = []
-        for i in range(L):
-            w = r.get(fmt.format(i=i))
-            mats.append(w.T if transpose else w)
-        return jnp.asarray(np.stack(mats), dtype)
+        return stack_layers(r, L, fmt, transpose=transpose, dtype=dtype)
 
     conv = r.get(VT + "embeddings.patch_embedding.weight")  # [h, 3, p, p]
     # patchify order is (ph, pw, c): conv [h, c, ph, pw] → [(ph, pw, c), h]
@@ -134,6 +151,13 @@ def load_vlm(path: str, dtype=jnp.bfloat16) -> Tuple:
     )
     # ONE reader for the probe + both loads (a sharded checkpoint's
     # index parses once; shard handles are shared)
+    strategy = hf.get("vision_feature_select_strategy", "default")
+    if strategy != "default":
+        raise ValueError(
+            f"vision_feature_select_strategy={strategy!r} is not "
+            "supported yet (only 'default': CLS dropped from the patch "
+            "run) — refusing to load with silently-wrong image tokens"
+        )
     r = _ShardReader(path)
     projector_hidden = r.get("multi_modal_projector.linear_1.bias").shape[0]
     vcfg = vision_config_from_hf(

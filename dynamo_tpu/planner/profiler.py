@@ -177,6 +177,7 @@ def _build_engine(args):
         prefill_batch_size=4,
         max_model_len=args.isl + args.osl + 16,
         decode_steps=8,
+        quantization=getattr(args, "quantization", "none"),
         enable_prefix_caching=False,
     ), eos_token_ids=[], kv_dtype=dtype)
 
@@ -189,6 +190,9 @@ def main(argv=None) -> None:
     ap.add_argument("--model", default="tiny",
                     help="tiny | llama-1b | checkpoint dir")
     ap.add_argument("--mock", action="store_true")
+    ap.add_argument("--quantization", default="none",
+                    choices=["none", "int8"],
+                    help="profile the weight-only int8 serving path")
     ap.add_argument("--isl", type=int, nargs="+", default=[512],
                     help="one value sweeps a single cell; several sweep "
                          "a grid (one npz per cell, reference "
