@@ -361,25 +361,16 @@ def _build_decode_step(cfg: ModelConfig, n_steps: int, max_valid_pos: int,
     return step
 
 
-def _build_mixed_step(cfg: ModelConfig, n_steps: int, max_valid_pos: int,
-                      penalized: bool = False, with_top: bool = False,
-                      attn_impl: str = "xla", lockstep_mesh=None):
-    """One dispatch = one bounded prefill chunk + one decode block
-    (chunked-prefill interleave, the TPU form: both forwards live in one
-    XLA program, so running decodes pay zero extra host round-trips for
-    a concurrent prompt's prefill — reference behavior: vLLM mixed
-    batches / mocker watermark scheduler, scheduler.rs:240).
-
-    The prefill side runs first (its page writes are disjoint from the
+def _make_mixed_body(cfg: ModelConfig, n_steps: int, max_valid_pos: int,
+                     penalized: bool, with_top: bool, attn_impl: str):
+    """The traced mixed-step body shared by the flat and pooled builders:
+    the prefill side runs first (its page writes are disjoint from the
     decode rows'), then the decode scan; both packed outputs return in
     one fetch."""
     run = _make_decode_scan(cfg, n_steps, max_valid_pos, penalized,
                             with_top, attn_impl)
-    kw = ({"out_shardings": _lockstep_out_shardings(lockstep_mesh, P())}
-          if lockstep_mesh is not None else {})
 
-    @partial(jax.jit, donate_argnums=(1,), **kw)
-    def step(params, kv,
+    def body(params, kv,
              p_tokens, p_table, p_prefix, p_chunk, p_samp, p_seeds, p_ctr,
              d_tokens, d_pos, d_ctr, d_counts, d_table, d_samp, d_seeds):
         logits, kv = forward_prefill(
@@ -395,7 +386,22 @@ def _build_mixed_step(cfg: ModelConfig, n_steps: int, max_valid_pos: int,
         )
         return p_packed, d_packed, kv
 
-    return step
+    return body
+
+
+def _build_mixed_step(cfg: ModelConfig, n_steps: int, max_valid_pos: int,
+                      penalized: bool = False, with_top: bool = False,
+                      attn_impl: str = "xla", lockstep_mesh=None):
+    """One dispatch = one bounded prefill chunk + one decode block
+    (chunked-prefill interleave, the TPU form: both forwards live in one
+    XLA program, so running decodes pay zero extra host round-trips for
+    a concurrent prompt's prefill — reference behavior: vLLM mixed
+    batches / mocker watermark scheduler, scheduler.rs:240)."""
+    body = _make_mixed_body(cfg, n_steps, max_valid_pos, penalized,
+                            with_top, attn_impl)
+    kw = ({"out_shardings": _lockstep_out_shardings(lockstep_mesh, P())}
+          if lockstep_mesh is not None else {})
+    return partial(jax.jit, donate_argnums=(1,), **kw)(body)
 
 
 # -- partitioned-pool (kv_partition) step builders -------------------------- #
@@ -419,19 +425,20 @@ def _pooled_specs(pool_axes):
     return KVCache(kvs, kvs), P(pool_axes), P(pool_axes, None)
 
 
-def _lockstep_pooled_kw(mesh, pool_axes, out_specs):
-    """jit out_shardings for a pooled lockstep step: packed outputs
-    (leading P() spec entries... none here) — we simply replicate the
-    FIRST output (the packed result) and keep the rest sharded."""
+def _lockstep_pooled_kw(mesh, pool_axes, out_specs, n_replicated: int = 1):
+    """jit out_shardings for a pooled lockstep step: the first
+    `n_replicated` outputs (packed results the leader must read) come
+    back replicated, the rest keep their stated specs, the trailing KV
+    keeps the pooled layout."""
     from ..models import kv_cache_pspec
 
     def shard(s):
         return jax.tree.map(lambda sp: NamedSharding(mesh, sp), s)
 
     rep = NamedSharding(mesh, P())
-    rest = [shard(s) for s in out_specs[1:-1]]
+    rest = [shard(s) for s in out_specs[n_replicated:-1]]
     kv = shard(kv_cache_pspec(pool_axes=pool_axes))
-    return {"out_shardings": (rep, *rest, kv)}
+    return {"out_shardings": (*[rep] * n_replicated, *rest, kv)}
 
 
 def _build_prefill_step_pooled(cfg: ModelConfig, mesh, pool_axes,
@@ -503,6 +510,38 @@ def _build_decode_step_pooled(cfg: ModelConfig, mesh, pool_axes, n_steps: int,
     return lambda params, kv, tokens, positions, counters, table, samp, \
         seeds: step(params, kv, tokens, positions, counters, None, table,
                     samp, seeds)
+
+
+def _build_mixed_step_pooled(cfg: ModelConfig, mesh, pool_axes, n_steps: int,
+                             max_valid_pos: int, penalized: bool = False,
+                             with_top: bool = False, attn_impl: str = "xla",
+                             lockstep: bool = False):
+    """Mixed (prefill chunk + decode block) step over a PARTITIONED pool:
+    the whole program runs manual-over-(dp, sp) — both sides' batches
+    arrive as R uniform per-rank row blocks with LOCAL page tables, so
+    every page gather/scatter stays on the shard owning the row's pages
+    while tp stays auto/GSPMD.  This is what lets the north-star decode
+    topology (dp×tp, kv_partition) keep its ITL flat under concurrent
+    prefills instead of falling back to prefill-stalls-decode
+    (reference analog: vLLM mixed batches / mocker scheduler.rs:240)."""
+    from ..parallel._compat import shard_map
+
+    body = _make_mixed_body(cfg, n_steps, max_valid_pos, penalized,
+                            with_top, attn_impl)
+    kvspec, bx, bx2 = _pooled_specs(pool_axes)
+    d_packed_spec = P(None, pool_axes)  # [T, R*local]
+    out_specs = (bx, d_packed_spec, kvspec)
+    sm = shard_map(
+        body, mesh=mesh,
+        in_specs=(P(), kvspec,
+                  bx2, bx2, bx, bx, bx, bx, bx,
+                  bx, bx, bx, bx2 if penalized else P(), bx2, bx, bx),
+        out_specs=out_specs,
+        axis_names=set(pool_axes),
+    )
+    kw = (_lockstep_pooled_kw(mesh, pool_axes, out_specs, n_replicated=2)
+          if lockstep else {})
+    return partial(jax.jit, donate_argnums=(1,), **kw)(sm)
 
 
 def _build_export_fn_pooled(cfg: ModelConfig, mesh, pool_axes,
@@ -762,17 +801,28 @@ class JaxEngine:
             if self.cfg.kv_partition:
                 # sharded pool: one partition per (dp, sp) shard; batches
                 # are laid out as R uniform per-rank blocks (buckets stay
-                # PER-RANK, so no dp-divisibility rounding), and the
-                # fused/mixed fast paths are disabled (their row layouts
-                # assume a flat dp-sharded batch)
+                # PER-RANK, so no dp-divisibility rounding).  The FUSED
+                # fast path stays off (it reuses prefill rows as decode
+                # rows, which only works on the identity layout) but
+                # MIXED dispatches run: the pooled mixed step takes the
+                # same per-rank block layouts both sides already use
                 self._pooled = True
                 self._pool_ranks = self._dp * self._sp
                 if self._sp > 1:
                     self._bax = ("dp", "sp")
                 self.cfg = dataclasses.replace(
                     self.cfg, fuse_prefill_decode=False,
-                    mixed_prefill_tokens=0,
                 )
+                if max(self.cfg.decode_batch_buckets) < self.cfg.max_num_seqs:
+                    # bucket_for clamps to buckets[-1]: a per-rank decode
+                    # group wider than the largest bucket would break the
+                    # R-uniform-blocks layout and land rows on the wrong
+                    # pool shard — reject the config instead
+                    raise ValueError(
+                        f"kv_partition requires max(decode_batch_buckets)"
+                        f"={max(self.cfg.decode_batch_buckets)} >= "
+                        f"max_num_seqs={self.cfg.max_num_seqs}"
+                    )
                 if tiered is not None:
                     raise ValueError(
                         "KV tiering (kvbm) is not supported with a "
@@ -1056,12 +1106,20 @@ class JaxEngine:
     def _get_mixed_step(self, penalized: bool, with_top: bool):
         key = (penalized, with_top)
         if key not in self._mixed_steps:
-            self._mixed_steps[key] = _build_mixed_step(
-                self.model_cfg, self.cfg.decode_steps, self.cfg.hard_cap,
-                penalized=penalized, with_top=with_top,
-                attn_impl=self._attn_impl,
-                lockstep_mesh=self.mesh if self._multihost else None,
-            )
+            if self._pooled:
+                self._mixed_steps[key] = _build_mixed_step_pooled(
+                    self.model_cfg, self.mesh, self._pool_axes,
+                    self.cfg.decode_steps, self.cfg.hard_cap,
+                    penalized=penalized, with_top=with_top,
+                    attn_impl=self._attn_impl, lockstep=self._multihost,
+                )
+            else:
+                self._mixed_steps[key] = _build_mixed_step(
+                    self.model_cfg, self.cfg.decode_steps, self.cfg.hard_cap,
+                    penalized=penalized, with_top=with_top,
+                    attn_impl=self._attn_impl,
+                    lockstep_mesh=self.mesh if self._multihost else None,
+                )
         return self._mixed_steps[key]
 
     # -- events -------------------------------------------------------------- #
@@ -1083,11 +1141,17 @@ class JaxEngine:
         m = ForwardPassMetrics(
             active_seqs=running,
             waiting_seqs=waiting,
-            kv_usage=self.pool.usage(),
+            # busy/capacity signals key off the FULLEST partition: one
+            # full rank blocks admission (sequences pin to a rank) even
+            # when aggregate usage looks low — reporting the aggregate
+            # here would skew router busy-shed and planner decisions
+            kv_usage=self.pool.usage_max_rank(),
             # partitioned pools aggregate capacity across their ranks
             kv_total_pages=self.cfg.usable_pages * self.pool.ranks,
             num_requests_total=self._requests_total,
         )
+        if self.pool.ranks > 1:
+            m.kv_usage_aggregate = self.pool.usage()
         if self.tiered is not None:
             # KVBM tier stats ride the same snapshot (dynamic attrs are
             # picked up by vars() consumers: /metrics.json, Prometheus)
@@ -1326,9 +1390,14 @@ class JaxEngine:
         by_rank: List[List[Sequence]] = [[] for _ in range(self._pool_ranks)]
         for s in seqs:
             by_rank[s.kv_rank].append(s)
-        Br = bucket_for(
-            max([1] + [len(g) for g in by_rank]),
-            self.cfg.decode_batch_buckets,
+        widest = max([1] + [len(g) for g in by_rank])
+        Br = bucket_for(widest, self.cfg.decode_batch_buckets)
+        # bucket_for clamps to buckets[-1]; a clamped Br < widest would
+        # silently misalign rows with their (dp, sp) pool shards (config
+        # validation rejects such bucket overrides — this is the backstop)
+        assert Br >= widest, (
+            f"per-rank decode group ({widest}) exceeds the largest decode "
+            f"batch bucket ({Br})"
         )
         rows: List[Optional[Sequence]] = []
         for g in by_rank:

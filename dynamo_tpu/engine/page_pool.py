@@ -80,6 +80,11 @@ class PagePool:
         usable = self.num_pages - 1
         return 1.0 - (self.available_pages / usable) if usable else 1.0
 
+    def usage_max_rank(self) -> float:
+        """Usage of the FULLEST partition — the admission-binding signal
+        (a single pool has one partition, so this equals `usage`)."""
+        return self.usage()
+
     # -- allocation ---------------------------------------------------------- #
 
     def allocate(self, n: int) -> List[int]:
@@ -253,8 +258,10 @@ class ShardedPagePool:
                         self._hash_ranks[h] = left
                 if gone:
                     self._event_sink(KvEvent("removed", gone))
-            else:  # cleared — only meaningful when every rank clears
-                self._event_sink(ev)
+            # "cleared" is suppressed per-rank: a rank-0 clear while ranks
+            # 1..R-1 still hold cached hashes would transiently wipe the
+            # router's view of hashes still onboard — clear_cache() emits
+            # ONE pool-wide event after every sub-pool has cleared
 
         return sink
 
@@ -291,6 +298,12 @@ class ShardedPagePool:
     def usage(self) -> float:
         usable = self.ranks * (self.num_pages - 1)
         return 1.0 - (self.available_pages / usable) if usable else 1.0
+
+    def usage_max_rank(self) -> float:
+        """One full rank blocks admission even when aggregate usage looks
+        low (sequences pin to a rank) — busy/capacity signals key off the
+        fullest partition, not the average."""
+        return max(p.usage() for p in self.pools)
 
     def available_on(self, rank: int) -> int:
         return self.pools[rank].available_pages
@@ -349,4 +362,10 @@ class ShardedPagePool:
         return rank * self.num_pages + local
 
     def clear_cache(self) -> int:
-        return sum(pool.clear_cache() for pool in self.pools)
+        # per-rank "cleared" events are suppressed in the sink (see
+        # _make_sink); the removed-event bookkeeping keeps _hash_ranks
+        # consistent for hashes that survive (referenced cached pages)
+        n = sum(pool.clear_cache() for pool in self.pools)
+        if self._event_sink is not None:
+            self._event_sink(KvEvent("cleared", []))
+        return n

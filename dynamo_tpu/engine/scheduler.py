@@ -285,17 +285,25 @@ class Scheduler:
                 need = seq.pages_needed(
                     seq.num_computed + chunk, self.cfg.page_size
                 ) - len(seq.pages)
-                if seq.preemptions >= 2:
-                    # anti-thrash: a sequence decode growth has evicted
-                    # twice only re-prefills with real headroom (enough
-                    # pages that the running decodes' next growth will
-                    # not immediately evict it again)
-                    n_decoding = sum(
-                        1 for s in self.running
-                        if s.prefill_done and s.kv_rank == seq.kv_rank
-                    )
-                    if (self.pool.available_on(seq.kv_rank)
-                            < need + self._watermark_pages() + n_decoding):
+                # a mixed prefill chunk must not drain the watermark
+                # reserve admission maintains for decode growth — doing so
+                # forces the next decode growth to preempt this very
+                # prefill (churn the watermark exists to prevent).  Chunks
+                # needing no new pages always proceed: they cost the
+                # reserve nothing
+                if need > 0:
+                    headroom = self._watermark_pages()
+                    if seq.preemptions >= 2:
+                        # anti-thrash: a sequence decode growth has
+                        # evicted twice only re-prefills with real
+                        # headroom (enough pages that the running
+                        # decodes' next growth will not immediately
+                        # evict it again)
+                        headroom += sum(
+                            1 for s in self.running
+                            if s.prefill_done and s.kv_rank == seq.kv_rank
+                        )
+                    if self.pool.available_on(seq.kv_rank) < need + headroom:
                         continue
                 if not self.try_extend_pages(seq, seq.num_computed + chunk):
                     continue  # pool tight — decode-only this round

@@ -197,6 +197,7 @@ class KvRouter:
                         active_seqs=m.get("active_seqs", 0),
                         waiting_seqs=m.get("waiting_seqs", 0),
                         kv_usage=m.get("kv_usage", 0.0),
+                        kv_usage_aggregate=m.get("kv_usage_aggregate"),
                         kv_total_pages=m.get("kv_total_pages", 0),
                     )
             except asyncio.CancelledError:

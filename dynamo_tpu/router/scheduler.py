@@ -30,8 +30,20 @@ class WorkerState:
     worker_id: int
     active_seqs: int = 0
     waiting_seqs: int = 0
+    # kv_usage is the worker's ADMISSION-binding usage (max over pool
+    # partitions — one full partition blocks admission); busy-shed keys
+    # off it.  kv_usage_aggregate is the pool-wide fraction (equal to
+    # kv_usage on unpartitioned workers) — load estimates that multiply
+    # by kv_total_pages must use the aggregate, or an imbalanced pooled
+    # worker with three near-empty partitions looks fully busy
     kv_usage: float = 0.0
+    kv_usage_aggregate: Optional[float] = None
     kv_total_pages: int = 0
+
+    @property
+    def usage_aggregate(self) -> float:
+        return (self.kv_usage if self.kv_usage_aggregate is None
+                else self.kv_usage_aggregate)
 
 
 @dataclass
@@ -67,9 +79,9 @@ class KvWorkerSelector:
             pending_prefill, resident_decode = active.load(wid)
             prefill = (request_blocks - overlap) + pending_prefill
             decode = resident_decode + request_blocks
-            # worker-published load joins the estimate: kv_usage scales the
-            # decode pressure (full workers get costlier)
-            decode += st.kv_usage * st.kv_total_pages
+            # worker-published load joins the estimate: pool-wide usage
+            # scales the decode pressure (full workers get costlier)
+            decode += st.usage_aggregate * st.kv_total_pages
             costs[wid] = self.overlap_score_weight * prefill + decode
         if not costs:
             raise RuntimeError("no workers to select from")
