@@ -209,23 +209,47 @@ def _build_prefill_step_pp(cfg: ModelConfig, mesh, with_top: bool = False,
 
 
 def _build_decode_step_pp(cfg: ModelConfig, mesh, n_steps: int,
-                          max_valid_pos: int, attn_impl: str = "xla"):
+                          max_valid_pos: int, penalized: bool = False,
+                          with_top: bool = False, attn_impl: str = "xla"):
     """Multi-token decode with the pipeline kept full (the ring schedule
-    of parallel/pp_engine.py); packs [T, 2B] = [tok | logp] per step —
-    penalties/top-logprobs are rejected at request validation."""
+    of parallel/pp_engine.py); packs per-step rows in the `_unpack_out`
+    layout ([T, 2B], or [T, B*(2+2*TOPLP)] with top-logprobs).  Penalty
+    histograms thread through the ring's last stage."""
     from ..parallel.pp_engine import forward_decode_pp
 
-    @partial(jax.jit, donate_argnums=(1,))
-    def step(params, kv, tokens, positions, counters, page_table, samp,
-             seeds):
-        toks, logp, kv = forward_decode_pp(
-            params, cfg, kv, tokens, positions, page_table, samp, seeds,
-            counters, n_steps, max_valid_pos, mesh, attn_impl,
-        )
-        packed = jnp.concatenate(
-            [jax.lax.bitcast_convert_type(toks, jnp.float32), logp], axis=-1
-        )
-        return packed, toks[-1], positions + n_steps, counters + n_steps, kv
+    def pack(toks, logp, tops):
+        parts = [jax.lax.bitcast_convert_type(toks, jnp.float32), logp]
+        if tops is not None:
+            ids, lps = tops  # [T, B, TOPLP] each
+            T = ids.shape[0]
+            parts.append(jax.lax.bitcast_convert_type(
+                ids, jnp.float32).reshape(T, -1))
+            parts.append(lps.reshape(T, -1))
+        return jnp.concatenate(parts, axis=-1)
+
+    top_k = TOPLP if with_top else 0
+    if penalized:
+        @partial(jax.jit, donate_argnums=(1, 5))
+        def step(params, kv, tokens, positions, counters, counts,
+                 page_table, samp, seeds):
+            toks, logp, tops, counts, kv = forward_decode_pp(
+                params, cfg, kv, tokens, positions, page_table, samp,
+                seeds, counters, n_steps, max_valid_pos, mesh, attn_impl,
+                counts=counts, top_k=top_k,
+            )
+            return (pack(toks, logp, tops), toks[-1], positions + n_steps,
+                    counters + n_steps, counts, kv)
+    else:
+        @partial(jax.jit, donate_argnums=(1,))
+        def step(params, kv, tokens, positions, counters, page_table,
+                 samp, seeds):
+            toks, logp, tops, _, kv = forward_decode_pp(
+                params, cfg, kv, tokens, positions, page_table, samp,
+                seeds, counters, n_steps, max_valid_pos, mesh, attn_impl,
+                top_k=top_k,
+            )
+            return (pack(toks, logp, tops), toks[-1], positions + n_steps,
+                    counters + n_steps, kv)
 
     return step
 
@@ -1078,14 +1102,10 @@ class JaxEngine:
         key = (penalized, with_top)
         if key not in self._decode_steps:
             if self._pp > 1:
-                if penalized or with_top:
-                    # generate() rejects these requests up front
-                    raise RuntimeError(
-                        "pp decode does not support penalties/top_logprobs"
-                    )
                 self._decode_steps[key] = _build_decode_step_pp(
                     self.model_cfg, self.mesh, self.cfg.decode_steps,
-                    self.cfg.hard_cap, attn_impl=self._attn_impl,
+                    self.cfg.hard_cap, penalized=penalized,
+                    with_top=with_top, attn_impl=self._attn_impl,
                 )
             elif self._pooled:
                 self._decode_steps[key] = _build_decode_step_pooled(
@@ -1196,13 +1216,6 @@ class JaxEngine:
             return
         if opts.max_tokens <= 0:
             yield {"token_ids": [], "finish_reason": "length"}
-            return
-        if self._pp > 1 and (opts.penalized or opts.top_logprobs > 0):
-            yield {
-                "token_ids": [], "finish_reason": "error",
-                "error": "pipeline-parallel workers do not support "
-                         "frequency/presence penalties or top_logprobs yet",
-            }
             return
         seq = Sequence(context.id, prompt, opts)
         seq.seed = opts.seed if opts.seed is not None else self._py_rng.getrandbits(31)

@@ -11,7 +11,7 @@ import logging
 import signal
 
 
-def main() -> None:
+def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(description="dynamo-tpu OpenAI frontend")
     from ..runtime.config import RuntimeConfig
 
@@ -46,7 +46,11 @@ def main() -> None:
                          "/health /live /metrics)")
     ap.add_argument("--log-level", default="")
     ap.add_argument("--log-jsonl", action="store_true", default=None)
-    args = ap.parse_args()
+    return ap
+
+
+def main() -> None:
+    args = build_parser().parse_args()
     from ..runtime.tracing import setup_logging
 
     setup_logging(args.log_level, args.log_jsonl)

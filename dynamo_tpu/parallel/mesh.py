@@ -32,7 +32,8 @@ class ParallelConfig:
     sp: int = 1
     # pipeline parallelism: pp > 1 stages the layer stack (params AND the
     # KV cache's layer axis) over a pp mesh axis (parallel/pp_engine.py).
-    # v1 composes with dp only (tp == sp == 1 when pp > 1).
+    # Composes with dp and tp (each stage's params/KV shard over tp via
+    # GSPMD inside the manual-over-pp program); sp stays exclusive.
     pp: int = 1
 
     @property
@@ -44,9 +45,10 @@ class ParallelConfig:
             raise ValueError(
                 f"dp*tp*sp*pp = {self.world} != available devices {n_devices}"
             )
-        if self.pp > 1 and (self.tp > 1 or self.sp > 1):
+        if self.pp > 1 and self.sp > 1:
             raise ValueError(
-                "pp composes with dp only for now (set tp = sp = 1)"
+                "pp composes with dp and tp (sp ring prefill within a "
+                "pp stage is not supported — set sp = 1)"
             )
 
 
@@ -54,8 +56,10 @@ def make_mesh(pcfg: ParallelConfig, devices: Optional[Sequence] = None) -> Mesh:
     devices = list(devices if devices is not None else jax.devices())
     pcfg.validate(len(devices))
     if pcfg.pp > 1:
-        arr = np.array(devices).reshape(pcfg.dp, pcfg.pp)
-        return Mesh(arr, axis_names=("dp", "pp"))
+        # tp innermost: a stage's tensor-parallel collectives ride the
+        # tightest ICI links; pp ring shifts cross the next ring out
+        arr = np.array(devices).reshape(pcfg.dp, pcfg.pp, pcfg.tp)
+        return Mesh(arr, axis_names=("dp", "pp", "tp"))
     if pcfg.sp > 1:
         # sp meshes always carry a tp axis (size 1 when unused) so param
         # and KV specs are one convention everywhere

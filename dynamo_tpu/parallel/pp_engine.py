@@ -18,11 +18,15 @@ its engines (wide_ep_decode.yaml:25, SURVEY.md §2.6); here it is native:
 - every device runs the same SPMD program; bubble ticks compute into
   each stage's local trash page and are masked out.
 
-Composes with dp: the shard_map is manual over pp ONLY — dp stays auto
-(GSPMD), microbatches interleave across the dp blocks so every tick's
-compute partitions over dp, and the dp-replicated KV page axis keeps its
-replicas consistent exactly like the non-pp engine.  tp/sp within a
-stage are future work (v1 requires tp == sp == 1).
+Composes with dp AND tp: the shard_map is manual over pp ONLY — dp and
+tp stay auto (GSPMD).  Microbatches interleave across the dp blocks so
+every tick's compute partitions over dp, the dp-replicated KV page axis
+keeps its replicas consistent exactly like the non-pp engine, and each
+stage's params/KV shard over tp with their usual megatron specs (XLA
+inserts the within-stage collectives).  A 70B int8 stack (~70GB) on
+16GB/chip v5e needs tp×pp ≥ 8 in some combination — this is the
+composition that makes pp serve the model it exists for.  sp within a
+stage remains future work.
 """
 
 from __future__ import annotations
@@ -46,30 +50,44 @@ from ._compat import shard_map
 
 def param_pspecs_pp(cfg: ModelConfig, pp_axis: str = "pp"):
     """Layer-stacked params shard axis 0 over pp (each stage holds its
-    layer slice); embeddings/head/norms replicate (v1 pp meshes keep
-    tp == 1)."""
+    layer slice) AND keep their megatron tp axes within the stage —
+    embeddings/head/norms keep their vocab/tp sharding.  tp stays
+    auto/GSPMD inside the manual-over-pp program (the same
+    partial-manual trick the pooled engines use), so a 70B stack can
+    take tp×pp ≥ 8 without replicating stage weights."""
     base = param_pspecs(cfg)
 
-    def drop_tp(spec):  # replace every named entry with None
+    def replicate(spec):
         return P(*([None] * len(spec)))
 
     out = {
-        "embed": drop_tp(base["embed"]),
-        "final_norm": drop_tp(base["final_norm"]),
+        # the embedding stays REPLICATED: XLA's SPMD partitioner cannot
+        # partition the token-gather over a vocab-sharded table inside
+        # the manual-over-pp program (spmd_partitioner_util CHECK), and
+        # the ring's decode ticks gather from it every tick.  Layer
+        # weights — the bulk of a 70B stack — still shard over tp
+        "embed": replicate(base["embed"]),
+        "final_norm": base["final_norm"],
         "layers": {
-            k: P(pp_axis, *([None] * (len(s) - 1)))
-            for k, s in base["layers"].items()
+            k: P(pp_axis, *s[1:]) for k, s in base["layers"].items()
         },
     }
     if "lm_head" in base:
-        out["lm_head"] = drop_tp(base["lm_head"])
+        out["lm_head"] = base["lm_head"]
     return out
 
 
 def kv_pspec_pp() -> KVCache:
-    """KV pages shard their LAYER axis over pp (stage-local cache)."""
-    s = P("pp", None, None, None, None)
+    """KV pages shard their LAYER axis over pp (stage-local cache) and
+    their kv-heads over tp, like the flat serving engine."""
+    s = P("pp", None, None, "tp", None)
     return KVCache(s, s)
+
+
+def _manual_only(spec: P, keep=("pp",)) -> P:
+    """shard_map in_specs may only name MANUAL axes: strip the auto
+    (GSPMD) axis names from a placement spec, keeping `keep`."""
+    return P(*[(e if e in keep else None) for e in spec])
 
 
 def shard_params_pp(params, cfg: ModelConfig, mesh: Mesh):
@@ -93,12 +111,18 @@ def _local_wins(cfg: ModelConfig, l_local: int):
 
 
 def _pp_specs(cfg: ModelConfig):
+    """(param-in_spec builder, kv in_spec) for the manual-over-pp
+    shard_map: placement specs with their auto (tp) names stripped."""
     from ..models.quantization import quantize_pspecs
 
     def pspec_of(params):
-        return quantize_pspecs(params, param_pspecs_pp(cfg))
+        full = quantize_pspecs(params, param_pspecs_pp(cfg))
+        return jax.tree.map(
+            _manual_only, full, is_leaf=lambda x: isinstance(x, P)
+        )
 
-    return pspec_of, kv_pspec_pp()
+    kv_in = _manual_only(kv_pspec_pp().k)
+    return pspec_of, KVCache(kv_in, kv_in)
 
 
 def forward_prefill_pp(
@@ -208,19 +232,28 @@ def forward_decode_pp(
     max_valid_pos: int,
     mesh: Mesh,
     attn_impl: str = "xla",
-) -> Tuple[jax.Array, jax.Array, KVCache]:
+    counts=None,  # [B, V] penalty histograms (None = unpenalized)
+    top_k: int = 0,  # pack top-k (ids, logprobs) per step (0 = off)
+):
     """`n_steps` decode steps with the pipeline kept full: the batch
     splits into pp microbatches; the last stage samples and ships the
     next token's embedding around the ring to stage 0.  Requires
     B_local % pp == 0 (the engine rounds its decode buckets).  Returns
-    (tokens [T, B], logprobs [T, B], kv)."""
+    (tokens [T, B], logprobs [T, B], tops, counts_out, kv) — `tops` is
+    (ids [T, B, top_k], lps [T, B, top_k]) or None; `counts_out` is the
+    updated histogram or None.  Penalties and top-k live on the LAST
+    stage only (the one with real logits); its carried histogram is
+    up to date when a microbatch's next step arrives M ticks later."""
+    from ..ops import apply_penalties, top_logprobs
+
     stages = mesh.shape["pp"]
     pspec_of, kvspec = _pp_specs(cfg)
     bx, bx2 = P(), P()  # batch arrays: dp auto (see forward_prefill_pp)
+    penalized = counts is not None
 
     D = mesh.shape.get("dp", 1)
 
-    def body(params, kv_k, kv_v, tok, pos, table, samp, seeds, ctr):
+    def body(params, kv_k, kv_v, tok, pos, table, samp, seeds, ctr, cts):
         s = jax.lax.axis_index("pp")
         Bl = tok.shape[0]
         M = stages
@@ -245,6 +278,7 @@ def forward_decode_pp(
         tok_g, pos_g, table_g = grp(tok), grp(pos), grp(table)
         samp_g = jax.tree.map(grp, samp)
         seeds_g, ctr_g = grp(seeds), grp(ctr)
+        cts_g = grp(cts) if penalized else None
         perm = [(i, (i + 1) % stages) for i in range(stages)]
         T = n_steps
 
@@ -252,7 +286,7 @@ def forward_decode_pp(
             return params["embed"][t].astype(dt)
 
         def tick(carry, t):
-            state, kvk, kvv, toks_out, logp_out = carry
+            state, kvk, kvv, toks_out, logp_out, cts_c, tops_c = carry
             g = t - s
             mb = jnp.clip(g % M, 0, M - 1)
             step = jnp.clip(g // M, 0, T - 1)
@@ -270,55 +304,100 @@ def forward_decode_pp(
                 attn_impl, wins=wins,
             )
             logits = _lm_logits(params, cfg, h_out)  # [Bm, V]
+            # gather the vocab axis before sampling: XLA's partitioner
+            # cannot partition the sampled-token gather over tp-sharded
+            # logits inside the manual-over-pp program (megatron gathers
+            # logits for sampling anyway — [Bm, V] per tick is small)
+            logits = jax.lax.with_sharding_constraint(
+                logits, jax.sharding.NamedSharding(mesh, P())
+            )
+            mb_samp = jax.tree.map(lambda a: mb_slice(a, mb), samp_g)
+            if penalized:
+                cts_mb = mb_slice(cts_c, mb)  # [Bm, V]
+                logits = apply_penalties(
+                    logits, cts_mb, mb_samp.frequency_penalty,
+                    mb_samp.presence_penalty,
+                )
             tok_new = sample_tokens(
-                logits, jax.tree.map(lambda a: mb_slice(a, mb), samp_g),
+                logits, mb_samp,
                 mb_slice(seeds_g, mb), mb_slice(ctr_g, mb) + step,
             )
             logp = compute_logprobs(logits, tok_new)
             write = (s == stages - 1) & valid
+            if penalized:
+                upd = cts_mb.at[jnp.arange(Bm), tok_new].add(1.0)
+                cts_c = cts_c.at[:, mb].set(
+                    jnp.where(write, upd, cts_mb).reshape(D, Bmd, -1)
+                )
             toks_out = toks_out.at[step, mb].set(
                 jnp.where(write, tok_new, toks_out[step, mb])
             )
             logp_out = logp_out.at[step, mb].set(
                 jnp.where(write, logp, logp_out[step, mb])
             )
+            if top_k:
+                ids_c, lps_c = tops_c
+                ids, lps = top_logprobs(logits, top_k)  # [Bm, top_k]
+                ids_c = ids_c.at[step, mb].set(
+                    jnp.where(write, ids, ids_c[step, mb])
+                )
+                lps_c = lps_c.at[step, mb].set(
+                    jnp.where(write, lps, lps_c[step, mb])
+                )
+                tops_c = (ids_c, lps_c)
             # the ring: interior stages forward activations; the last
             # stage forwards the NEXT token's embedding to stage 0
             send = jnp.where(s == stages - 1, embed(tok_new), h_out)
             state = jax.lax.ppermute(send, "pp", perm)
-            return (state, kvc.k, kvc.v, toks_out, logp_out), None
+            return (state, kvc.k, kvc.v, toks_out, logp_out, cts_c,
+                    tops_c), None
 
         init = (
             jnp.zeros((Bm, h), dt),
             kv_k, kv_v,
             jnp.zeros((T, M, Bm), jnp.int32),
             jnp.zeros((T, M, Bm), jnp.float32),
+            cts_g,
+            ((jnp.zeros((T, M, Bm, top_k), jnp.int32),
+              jnp.zeros((T, M, Bm, top_k), jnp.float32))
+             if top_k else None),
         )
-        (_, kvk, kvv, toks_out, logp_out), _ = jax.lax.scan(
+        (_, kvk, kvv, toks_out, logp_out, cts_g2, tops_g), _ = jax.lax.scan(
             tick, init, jnp.arange(T * M + stages - 1)
         )
-        toks_out = jax.lax.psum(
-            jnp.where(s == stages - 1, toks_out, jnp.zeros_like(toks_out)),
-            "pp",
-        )
-        logp_out = jax.lax.psum(
-            jnp.where(s == stages - 1, logp_out,
-                      jnp.zeros_like(logp_out)), "pp",
-        )
 
-        def ungrp(o):  # [T, M, D*Bmd] → [T, Bl] (invert the grouping)
-            return o.reshape(T, M, D, Bmd).transpose(0, 2, 1, 3).reshape(
-                T, Bl
+        def last_stage_only(o):  # real values live on the last stage
+            return jax.lax.psum(
+                jnp.where(s == stages - 1, o, jnp.zeros_like(o)), "pp"
             )
 
-        return ungrp(toks_out), ungrp(logp_out), kvk, kvv
+        toks_out = last_stage_only(toks_out)
+        logp_out = last_stage_only(logp_out)
 
-    toks, logp, k_new, v_new = shard_map(
+        def ungrp(o):  # [T, M, D*Bmd, ...] → [T, Bl, ...] (invert grouping)
+            return o.reshape(T, M, D, Bmd, *o.shape[3:]).swapaxes(1, 2) \
+                .reshape(T, Bl, *o.shape[3:])
+
+        outs = [ungrp(toks_out), ungrp(logp_out)]
+        if top_k:
+            outs.append(tuple(ungrp(last_stage_only(x)) for x in tops_g))
+        else:
+            outs.append(None)
+        if penalized:
+            outs.append(last_stage_only(cts_g2).reshape(Bl, -1))
+        else:
+            outs.append(None)
+        return (*outs, kvk, kvv)
+
+    # tops/counts_out may be None (empty pytrees) — a P() prefix is
+    # valid for any subtree, including an empty one
+    out_specs = (P(), P(), P(), P(), kvspec.k, kvspec.v)
+    toks, logp, tops, counts_out, k_new, v_new = shard_map(
         body, mesh=mesh,
         in_specs=(pspec_of(params), kvspec.k, kvspec.v, bx, bx, bx2,
-                  bx, bx, bx),
-        out_specs=(P(), P(), kvspec.k, kvspec.v),
+                  bx, bx, bx, bx2 if penalized else P()),
+        out_specs=out_specs,
         axis_names={"pp"},
     )(params, kv.k, kv.v, tokens, positions, page_table, samp, seeds,
-      counters)
-    return toks, logp, KVCache(k_new, v_new)
+      counters, counts)
+    return toks, logp, tops, counts_out, KVCache(k_new, v_new)

@@ -72,9 +72,8 @@ async def _amain(args) -> None:
     await runtime.shutdown(graceful=False)
 
 
-def main() -> None:
+def build_parser() -> argparse.ArgumentParser:
     from ..runtime.config import RuntimeConfig
-    from ..runtime.tracing import setup_logging
 
     _env_control = RuntimeConfig.from_env().control
     ap = argparse.ArgumentParser("dynamo_tpu.planner")
@@ -96,7 +95,13 @@ def main() -> None:
                     help="PerfProfile npz from the sweep profiler")
     ap.add_argument("--decode-profile", default="")
     ap.add_argument("--log-level", default="")
-    args = ap.parse_args()
+    return ap
+
+
+def main() -> None:
+    from ..runtime.tracing import setup_logging
+
+    args = build_parser().parse_args()
     setup_logging(args.log_level)
     asyncio.run(_amain(args))
 

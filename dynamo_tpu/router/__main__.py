@@ -77,7 +77,7 @@ async def _amain(args) -> None:
     await runtime.shutdown()
 
 
-def main() -> None:
+def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser("dynamo_tpu.router")
     ap.add_argument("--control", required=True)
     ap.add_argument("--namespace", default="dynamo")
@@ -92,7 +92,11 @@ def main() -> None:
     ap.add_argument("--no-kv-events", action="store_true",
                     help="use the approx indexer (workers emit no events)")
     ap.add_argument("--log-level", default="info")
-    args = ap.parse_args()
+    return ap
+
+
+def main() -> None:
+    args = build_parser().parse_args()
     logging.basicConfig(level=args.log_level.upper(),
                         format="%(asctime)s %(levelname)s %(name)s %(message)s")
     asyncio.run(_amain(args))

@@ -13,7 +13,9 @@ import logging
 import signal
 
 
-def main() -> None:
+def build_parser() -> argparse.ArgumentParser:
+    """The worker's argparse surface, exposed so deployment graphs and
+    recipe tests can validate worker argv without starting a worker."""
     ap = argparse.ArgumentParser(description="dynamo-tpu JAX worker")
     from ..runtime.config import RuntimeConfig
 
@@ -108,7 +110,12 @@ def main() -> None:
                     help="extract tool calls (hermes|mistral|json|pythonic)")
     ap.add_argument("--log-level", default="")
     ap.add_argument("--log-jsonl", action="store_true", default=None)
-    args = ap.parse_args()
+    return ap
+
+
+def check_args(ap: argparse.ArgumentParser, args) -> None:
+    """Cross-flag validation (calls ap.error on conflict) — shared by
+    main() and the recipe-validation tests."""
     # fail fast on typo'd parser names (otherwise every request 500s)
     from ..parsers import get_reasoning_parser, get_tool_parser
 
@@ -137,6 +144,33 @@ def main() -> None:
         ]:
             if bad:
                 ap.error(f"--dp-ranks > 1 is incompatible with {flag}")
+
+
+def engine_config_from_args(args):
+    """EngineConfig from parsed worker argv (raises ValueError on bad
+    combinations — the same construction the live worker performs)."""
+    from ..engine import EngineConfig
+
+    return EngineConfig(
+        page_size=args.page_size,
+        num_pages=args.num_pages,
+        max_num_seqs=args.max_num_seqs,
+        max_prefill_tokens=args.max_prefill_tokens,
+        max_model_len=args.max_model_len,
+        quantization=args.quantization,
+        attention_impl=args.attention_impl,
+        decode_steps=args.decode_steps,
+        decode_chain=args.decode_chain,
+        mixed_prefill_tokens=args.mixed_prefill_tokens,
+        kv_partition=args.kv_partition,
+        enable_prefix_caching=not args.no_prefix_caching,
+    )
+
+
+def main() -> None:
+    ap = build_parser()
+    args = ap.parse_args()
+    check_args(ap, args)
     from ..runtime.tracing import setup_logging
 
     setup_logging(args.log_level, args.log_jsonl)
@@ -296,23 +330,9 @@ async def _async_health(health) -> dict:
 
 
 def _build_engine(args):
-    from ..engine import EngineConfig
     from ..llm import ModelDeploymentCard
 
-    ecfg = EngineConfig(
-        page_size=args.page_size,
-        num_pages=args.num_pages,
-        max_num_seqs=args.max_num_seqs,
-        max_prefill_tokens=args.max_prefill_tokens,
-        max_model_len=args.max_model_len,
-        quantization=args.quantization,
-        attention_impl=args.attention_impl,
-        decode_steps=args.decode_steps,
-        decode_chain=args.decode_chain,
-        mixed_prefill_tokens=args.mixed_prefill_tokens,
-        kv_partition=args.kv_partition,
-        enable_prefix_caching=not args.no_prefix_caching,
-    )
+    ecfg = engine_config_from_args(args)
     if args.mock:
         from ..mocker import MockEngine, MockEngineArgs
 

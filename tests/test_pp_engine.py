@@ -75,32 +75,81 @@ async def test_pp_matches_single_device(setup):
     assert got == want
 
 
-async def test_pp_sampled_and_rejections(setup):
-    """Seeded sampling equality through the pp ring decode, and a clean
-    error (not a crash) for the unsupported penalized path."""
+async def test_pp_sampled_and_penalized(setup):
+    """Seeded sampling AND frequency-penalized decode through the pp
+    ring match the single-device engine (the penalty histogram rides
+    the ring's last stage)."""
     ref = make_engine(setup)
     p = [(5 * j) % 89 + 1 for j in range(14)]
     want = await collect(ref, req(p, max_tokens=8, temperature=0.8, seed=7))
+    want_pen = await collect(ref, req(p, max_tokens=8, frequency_penalty=0.5))
     await ref.shutdown()
 
     eng = make_engine(setup, parallel=ParallelConfig(pp=2, dp=4))
     got = await collect(eng, req(p, max_tokens=8, temperature=0.8, seed=7))
     assert got == want
-
-    outs = []
-    async for d in eng.generate(req(p, frequency_penalty=0.5)):
-        outs.append(d)
-    assert outs[-1]["finish_reason"] == "error"
+    got_pen = await collect(eng, req(p, max_tokens=8, frequency_penalty=0.5))
     await eng.shutdown()
+    assert got_pen == want_pen
+
+
+async def test_pp_top_logprobs(setup):
+    """top_logprobs through the pp decode matches single-device."""
+    def r(p):
+        return req(p, max_tokens=6, logprobs=True, top_logprobs=3)
+
+    async def run(engine, p):
+        toks, tops = [], []
+        async for d in engine.generate(r(p)):
+            assert d.get("finish_reason") != "error", d
+            toks += d["token_ids"]
+            tops += d.get("top_logprobs") or []
+        return toks, tops
+
+    p = [(3 * j) % 83 + 1 for j in range(11)]
+    ref = make_engine(setup)
+    want = await run(ref, p)
+    await ref.shutdown()
+    eng = make_engine(setup, parallel=ParallelConfig(pp=2, dp=4))
+    got = await run(eng, p)
+    await eng.shutdown()
+    assert got[0] == want[0]
+    for (g, w) in zip(got[1], want[1]):
+        assert [i for i, _ in g] == [i for i, _ in w]
+        for (_, lg), (_, lw) in zip(g, w):
+            assert abs(lg - lw) < 1e-4
+
+
+async def test_pp_tp_matches_single_device(setup):
+    """dp×pp×tp: each stage's params/KV shard over tp inside the
+    manual-over-pp program (VERDICT r3 item 2 — 70B needs tp×pp).
+    Greedy + penalized outputs equal the single-device engine."""
+    ref = make_engine(setup)
+    want = await _run_all(ref)
+    p = [(5 * j) % 89 + 1 for j in range(14)]
+    want_pen = await collect(ref, req(p, max_tokens=8, frequency_penalty=0.5))
+    await ref.shutdown()
+
+    eng = make_engine(setup, parallel=ParallelConfig(dp=2, pp=2, tp=2))
+    assert eng._pp == 2
+    from jax.sharding import PartitionSpec as P
+
+    assert eng.kv.k.sharding.spec == P("pp", None, None, "tp", None)
+    got = await _run_all(eng)
+    got_pen = await collect(eng, req(p, max_tokens=8, frequency_penalty=0.5))
+    await eng.shutdown()
+    assert got == want
+    assert got_pen == want_pen
 
 
 async def test_pp_kv_layer_axis_sharded(setup):
     """The cache genuinely shards its layer axis over pp (each stage
-    holds L/pp layers' pages — weight+cache HBM scale with pp)."""
+    holds L/pp layers' pages — weight+cache HBM scale with pp) and its
+    kv-heads over tp."""
     eng = make_engine(setup, parallel=ParallelConfig(pp=2, dp=4))
     from jax.sharding import PartitionSpec as P
 
-    assert eng.kv.k.sharding.spec == P("pp", None, None, None, None)
+    assert eng.kv.k.sharding.spec == P("pp", None, None, "tp", None)
     lay = eng.params["layers"]
     leaf = jax.tree.leaves(lay)[0]
     assert leaf.sharding.spec[0] == "pp"

