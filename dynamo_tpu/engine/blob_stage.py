@@ -1,0 +1,250 @@
+"""Leader-side blob staging for multihost KV imports.
+
+A multihost KV import used to broadcast the whole (k, v) blob to every
+host on the lockstep plan channel — O(hosts × blob) DCN traffic per
+disagg handoff.  Instead the leader now STAGES the blob here and
+broadcasts only a fetch descriptor; each follower pulls exactly the
+byte ranges its local devices' shards need (per-shard fetch, aggregate
+O(1×) — the role NIXL's registered-memory pull plays in the reference,
+/root/reference/lib/llm/src/block_manager/distributed/leader.rs:126).
+
+The server is a plain threaded TCP listener (the follower side of a
+lockstep engine blocks in `follower_loop`, so fetches are blocking
+socket reads, not asyncio).  Frames are length-prefixed msgpack headers
+followed by raw bytes.  Staged entries release after every follower
+acks, or on TTL for crashed peers.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import socketserver
+import struct
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+import msgpack
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_DEFAULT_TTL = 300.0
+
+
+def _send_msg(sock: socket.socket, header: dict, payload: bytes = b"") -> None:
+    hdr = msgpack.packb(header, use_bin_type=True)
+    sock.sendall(struct.pack(">II", len(hdr), len(payload)) + hdr + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("blob stage peer closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_msg(sock: socket.socket) -> Tuple[dict, bytes]:
+    hlen, plen = struct.unpack(">II", _recv_exact(sock, 8))
+    header = msgpack.unpackb(_recv_exact(sock, hlen), raw=False)
+    payload = _recv_exact(sock, plen) if plen else b""
+    return header, payload
+
+
+class _Entry:
+    def __init__(self, arrays: Dict[str, np.ndarray], acks_left: int,
+                 ttl: float):
+        self.arrays = arrays
+        self.acks_left = acks_left
+        self.deadline = time.monotonic() + ttl
+
+
+class BlobStage:
+    """Stage named numpy arrays under a transfer id; serve axis-3 (kv
+    heads) slices to followers over TCP."""
+
+    def __init__(self, host: str = "", ttl: float = _DEFAULT_TTL):
+        self.host = host or _default_host()
+        self.ttl = ttl
+        self.port = 0
+        self.bytes_staged = 0  # total staged (the would-be broadcast size)
+        self.bytes_served = 0  # total actually pulled by followers
+        self._entries: Dict[str, _Entry] = {}
+        self._lock = threading.Lock()
+        self._server: Optional[socketserver.ThreadingTCPServer] = None
+
+    # -- lifecycle ----------------------------------------------------------- #
+
+    def start(self) -> "BlobStage":
+        stage = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):  # noqa: N802 — socketserver API
+                try:
+                    while True:
+                        header, _ = _recv_msg(self.request)
+                        stage._handle(self.request, header)
+                except (ConnectionError, OSError):
+                    pass
+
+        srv = socketserver.ThreadingTCPServer(("0.0.0.0", 0), Handler)
+        srv.daemon_threads = True
+        self.port = srv.server_address[1]
+        self._server = srv
+        threading.Thread(target=srv.serve_forever, daemon=True,
+                         name="blob-stage").start()
+        # crashed peers never ack: a background timer enforces the TTL
+        # (reaping only on the next stage() would pin the last burst's
+        # blob in leader memory indefinitely)
+        self._reaper = threading.Timer(self.ttl / 4, self._reap_tick)
+        self._reaper.daemon = True
+        self._reaper.start()
+        return self
+
+    def _reap_tick(self) -> None:
+        self._reap()
+        if self._server is not None:
+            self._reaper = threading.Timer(self.ttl / 4, self._reap_tick)
+            self._reaper.daemon = True
+            self._reaper.start()
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        if getattr(self, "_reaper", None) is not None:
+            self._reaper.cancel()
+
+    @property
+    def address(self):
+        return [self.host, self.port]
+
+    # -- staging ------------------------------------------------------------- #
+
+    def stage(self, tid: str, arrays: Dict[str, np.ndarray],
+              acks: int) -> None:
+        self._reap()
+        with self._lock:
+            self.bytes_staged += sum(v.nbytes for v in arrays.values())
+            self._entries[tid] = _Entry(
+                {k: np.ascontiguousarray(v) for k, v in arrays.items()},
+                acks, self.ttl,
+            )
+
+    def _reap(self) -> None:
+        now = time.monotonic()
+        with self._lock:
+            stale = [t for t, e in self._entries.items() if e.deadline < now]
+            for t in stale:
+                logger.warning("blob stage entry %s expired unacked", t)
+                del self._entries[t]
+
+    def _handle(self, sock: socket.socket, header: dict) -> None:
+        op = header.get("op")
+        tid = header.get("tid", "")
+        if op == "ack":
+            with self._lock:
+                e = self._entries.get(tid)
+                if e is not None:
+                    e.acks_left -= 1
+                    if e.acks_left <= 0:
+                        del self._entries[tid]
+            _send_msg(sock, {"ok": True})
+            return
+        if op == "fetch":
+            with self._lock:
+                e = self._entries.get(tid)
+            if e is None or header.get("name") not in e.arrays:
+                _send_msg(sock, {"error": f"unknown blob {tid}"})
+                return
+            arr = e.arrays[header["name"]]
+            lo, hi = int(header["lo"]), int(header["hi"])
+            sl = np.ascontiguousarray(arr[:, :, :, lo:hi])
+            payload = sl.tobytes()
+            with self._lock:
+                self.bytes_served += len(payload)
+            _send_msg(
+                sock,
+                {"shape": list(sl.shape), "dtype": str(sl.dtype)},
+                payload,
+            )
+            return
+        _send_msg(sock, {"error": f"bad op {op!r}"})
+
+
+class BlobClient:
+    """Follower-side blocking fetch client; counts bytes for tests and
+    the transfer-stats surface."""
+
+    def __init__(self, addr):
+        self.addr = (addr[0], int(addr[1]))
+        self.bytes_fetched = 0
+        self._sock: Optional[socket.socket] = None
+
+    def _conn(self) -> socket.socket:
+        if self._sock is None:
+            self._sock = socket.create_connection(self.addr, timeout=60.0)
+        return self._sock
+
+    def fetch(self, tid: str, name: str, lo: int, hi: int) -> np.ndarray:
+        """Fetch arr[:, :, :, lo:hi] of the staged array `name`."""
+        sock = self._conn()
+        _send_msg(sock, {"op": "fetch", "tid": tid, "name": name,
+                         "lo": lo, "hi": hi})
+        header, payload = _recv_msg(sock)
+        if "error" in header:
+            raise RuntimeError(header["error"])
+        self.bytes_fetched += len(payload)
+        return np.frombuffer(payload, np.dtype(header["dtype"])).reshape(
+            header["shape"]
+        )
+
+    def ack(self, tid: str) -> None:
+        try:
+            sock = self._conn()
+            _send_msg(sock, {"op": "ack", "tid": tid})
+            _recv_msg(sock)
+        except (ConnectionError, OSError):  # TTL is the backstop
+            pass
+
+    def close(self) -> None:
+        if self._sock is not None:
+            self._sock.close()
+            self._sock = None
+
+
+def _default_host() -> str:
+    """An address other hosts in the job can reach.  `DYN_BLOB_STAGE_HOST`
+    overrides; otherwise the outbound-interface address (a UDP connect
+    sends no packets — it just binds the egress interface), falling back
+    to the hostname's address.  gethostbyname alone is NOT trusted first:
+    Debian/Ubuntu map the hostname to 127.0.1.1 in /etc/hosts, which
+    followers on other machines cannot reach."""
+    import os
+
+    override = os.environ.get("DYN_BLOB_STAGE_HOST", "")
+    if override:
+        return override
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect(("10.255.255.255", 1))
+            addr = s.getsockname()[0]
+        finally:
+            s.close()
+        if not addr.startswith("127."):
+            return addr
+    except OSError:
+        pass
+    try:
+        addr = socket.gethostbyname(socket.gethostname())
+        if not addr.startswith("127."):
+            return addr
+    except OSError:
+        pass
+    return "127.0.0.1"

@@ -599,13 +599,20 @@ def _build_export_fn_pooled(cfg: ModelConfig, mesh, pool_axes,
     return jax.jit(sm, **kw)
 
 
-def _build_import_fn_pooled(cfg: ModelConfig, mesh, pool_axes):
-    """Write a replicated (k, v) blob into ONE pool rank's local pages;
-    other ranks rewrite their current values (padding rows hit each
-    rank's local trash page 0)."""
+def _build_import_fn_pooled(cfg: ModelConfig, mesh, pool_axes,
+                            sharded_blob: bool = False):
+    """Write a (k, v) blob into ONE pool rank's local pages; other ranks
+    rewrite their current values (padding rows hit each rank's local
+    trash page 0).  `sharded_blob` takes the blob's page axis SHARDED
+    over the pool axes (global [L, R*width, ...], real data only in the
+    owner rank's block) — the multihost per-shard-fetch layout where
+    non-owner hosts contribute zeros they never fetched; the default
+    replicated layout serves single-process imports."""
     from ..parallel._compat import shard_map
 
     kvspec, _, _ = _pooled_specs(pool_axes)
+    blob_spec = (P(None, pool_axes, None, None, None) if sharded_blob
+                 else P())
 
     def body(kv, k_blob, v_blob, pages, rank):
         r = _pool_linear_index(mesh, pool_axes)
@@ -618,7 +625,7 @@ def _build_import_fn_pooled(cfg: ModelConfig, mesh, pool_axes):
 
     sm = shard_map(
         body, mesh=mesh,
-        in_specs=(kvspec, P(), P(), P(), P()),
+        in_specs=(kvspec, blob_spec, blob_spec, P(), P()),
         out_specs=kvspec,
         axis_names=set(pool_axes),
     )
@@ -713,11 +720,14 @@ class JaxEngine:
                 "multihost requires a ParallelConfig spanning the global "
                 "device set (dp*tp*sp == jax.device_count())"
             )
-        if self._multihost and tiered is not None:
-            raise ValueError(
-                "KV tiering (kvbm) is not supported under multihost "
-                "lockstep yet — offload device ops are leader-local"
-            )
+        # multihost blob staging (per-shard KV import fetch): lazy server
+        # on the leader, cached fetch clients on followers
+        self._blob_stage_srv = None
+        self._blob_clients: Dict[tuple, Any] = {}
+        self._blob_bytes_fetched = 0  # survive server/client close (stats)
+        self._blob_bytes_staged = 0
+        self._blob_bytes_served = 0
+        self._import_fn_sharded = None
         self._pp = 1
         if parallel is not None and parallel.world > 1:
             from ..parallel import make_mesh
@@ -847,11 +857,6 @@ class JaxEngine:
                         f"={max(self.cfg.decode_batch_buckets)} >= "
                         f"max_num_seqs={self.cfg.max_num_seqs}"
                     )
-                if tiered is not None:
-                    raise ValueError(
-                        "KV tiering (kvbm) is not supported with a "
-                        "partitioned (kv_partition) pool yet"
-                    )
                 if vision is not None:
                     raise ValueError(
                         "the vision tower is not supported with a "
@@ -944,16 +949,18 @@ class JaxEngine:
         pump_offloads / onboard).  The engine pumps its offload queue and
         routes admission-time cache misses through it — the engine-facing
         equivalent of the reference's KVConnector protocol
-        (block_manager/connector/protocol.rs)."""
-        if self._multihost:
-            raise ValueError(
-                "KV tiering (kvbm) is not supported under multihost lockstep"
-            )
+        (block_manager/connector/protocol.rs).  Composes with multihost
+        (offload/onboard device ops broadcast on the lockstep plan
+        channel like every other device op; the host/disk tiers stay
+        leader-local) and with kv_partition (onboarded pages land on
+        the admitting sequence's pool rank)."""
         self.tiered = connector
         self.add_event_sink(connector.on_event)
         # onboarding runs inside admission (pump loop thread, between
         # steps) — blocking device work, small and batched
-        self.scheduler.onboard_fn = lambda hashes: connector.onboard(self, hashes)
+        self.scheduler.onboard_fn = (
+            lambda hashes, rank=0: connector.onboard(self, hashes, rank=rank)
+        )
 
     def export_cached_blocks(self, hashes):
         """SYNC device->host export of committed blocks (pump/executor
@@ -968,18 +975,36 @@ class JaxEngine:
                 pages.append(page)
         if not pages:
             return [], None, None
+        if self._pooled:
+            # a batch of cached hashes may span pool ranks; the export
+            # jit masks to ONE rank per call — group and stitch
+            by_rank: Dict[int, List[tuple]] = {}
+            for h, p in zip(resolved, pages):
+                by_rank.setdefault(self.pool.rank_of(p), []).append((h, p))
+            out_h, ks, vs = [], [], []
+            for items in by_rank.values():
+                pg = [p for _, p in items]
+                k, v = self._export_dev(pg)
+                ks.append(np.asarray(jax.device_get(k))[:, : len(pg)])
+                vs.append(np.asarray(jax.device_get(v))[:, : len(pg)])
+                out_h.extend(h for h, _ in items)
+            return out_h, np.concatenate(ks, 1), np.concatenate(vs, 1)
         k, v = self._export_dev(pages)
         k = np.asarray(jax.device_get(k))[:, : len(pages)]
         v = np.asarray(jax.device_get(v))[:, : len(pages)]
         return resolved, k, v
 
-    def import_committed_blocks(self, blocks) -> List[int]:
+    def import_committed_blocks(self, blocks, rank: Optional[int] = None
+                                ) -> List[int]:
         """SYNC import of (hash, parent_hash, k, v) blocks into freshly
         allocated pages, committed to the prefix cache (pump/executor
-        thread only).  Returns the page ids."""
+        thread only).  Returns the page ids.  `rank` pins the pages to
+        one pool partition (onboarding for an admitting sequence must
+        land on ITS rank; None = allocator's choice)."""
         if not blocks:
             return []
-        pages = self.pool.allocate(len(blocks))
+        pages = (self.pool.allocate(len(blocks)) if rank is None
+                 else self.pool.allocate_on(rank, len(blocks)))
         width = self._pow2_width(len(pages))
         k0 = blocks[0][2]
         kpad = np.zeros((k0.shape[0], width, *k0.shape[1:]), k0.dtype)
@@ -1281,6 +1306,21 @@ class JaxEngine:
             await asyncio.get_running_loop().run_in_executor(
                 None, self._lockstep_send, {"kind": "shutdown"}
             )
+        self._close_blob_channels()
+
+    def _close_blob_channels(self) -> None:
+        """Stop the lazily-started blob stage server / fetch clients
+        (leaked listeners and sockets otherwise accumulate across engine
+        lifecycles in one process)."""
+        if self._blob_stage_srv is not None:
+            self._blob_bytes_staged += self._blob_stage_srv.bytes_staged
+            self._blob_bytes_served += self._blob_stage_srv.bytes_served
+            self._blob_stage_srv.stop()
+            self._blob_stage_srv = None
+        for client in self._blob_clients.values():
+            self._blob_bytes_fetched += client.bytes_fetched
+            client.close()
+        self._blob_clients.clear()
 
     def _plan_step(self) -> StepPlan:
         """Apply deferred scheduler mutations and plan the next step.
@@ -2046,6 +2086,7 @@ class JaxEngine:
             desc = _plan_unpack(broadcast_plan(b""))
             kind = desc["kind"]
             if kind == "shutdown":
+                self._close_blob_channels()
                 return
             if kind == "recover":
                 self.kv = self._make_kv()
@@ -2098,6 +2139,10 @@ class JaxEngine:
                 elif kind == "kv_import":
                     self._import_replay(
                         desc["padded"], desc["rank"], desc["k"], desc["v"]
+                    )
+                elif kind == "kv_import_fetch":
+                    self._import_fetch_replay(
+                        desc["padded"], desc["rank"], desc
                     )
                 elif kind == "embed":
                     self._embed_replay(desc["tokens"], desc["lens"])
@@ -2221,8 +2266,11 @@ class JaxEngine:
 
     def _import_dev(self, pages: List[int], kpad, vpad) -> None:
         """jit import of padded (k, v) blobs into the given page ids
-        (padding rows hit the trash page).  Multihost: the blob rides the
-        lockstep plan so every rank writes its own KV shards."""
+        (padding rows hit the trash page).  Multihost: the blob is
+        STAGED on the leader and the plan carries only a fetch
+        descriptor — each host pulls the byte ranges its devices' KV
+        shards need (per-shard fetch, engine/blob_stage.py) instead of
+        every host receiving the whole blob."""
         width = kpad.shape[1]
         padded = np.zeros((width,), np.int32)
         if self._pooled:
@@ -2235,12 +2283,119 @@ class JaxEngine:
             if isinstance(kpad, jax.Array):
                 kpad = np.asarray(jax.device_get(kpad))
                 vpad = np.asarray(jax.device_get(vpad))
+            kpad = np.ascontiguousarray(kpad)
+            vpad = np.ascontiguousarray(vpad)
+            tid, addr = self._stage_blob(kpad, vpad)
+            desc = {"tid": tid, "addr": addr,
+                    "shape": list(kpad.shape), "dtype": str(kpad.dtype)}
             self._lockstep_send({
-                "kind": "kv_import", "padded": padded, "rank": rank,
-                "k": np.ascontiguousarray(kpad),
-                "v": np.ascontiguousarray(vpad),
+                "kind": "kv_import_fetch", "padded": padded, "rank": rank,
+                **desc,
             })
+            self._import_fetch_replay(padded, rank, desc,
+                                      local=(kpad, vpad))
+            return
         self._import_replay(padded, rank, kpad, vpad)
+
+    # -- per-shard blob fetch (multihost imports) ----------------------------- #
+
+    def _stage_blob(self, kpad: np.ndarray, vpad: np.ndarray):
+        from .blob_stage import BlobStage
+
+        if self._blob_stage_srv is None:
+            self._blob_stage_srv = BlobStage().start()
+        import uuid
+
+        tid = uuid.uuid4().hex
+        self._blob_stage_srv.stage(
+            tid, {"k": kpad, "v": vpad}, acks=jax.process_count() - 1
+        )
+        return tid, self._blob_stage_srv.address
+
+    def _blob_client(self, addr):
+        from .blob_stage import BlobClient
+
+        key = (addr[0], int(addr[1]))
+        if key not in self._blob_clients:
+            self._blob_clients[key] = BlobClient(addr)
+        return self._blob_clients[key]
+
+    def _import_fetch_replay(self, padded: np.ndarray, rank: Optional[int],
+                             desc: Dict[str, Any], local=None) -> None:
+        """Build the sharded global import blob from per-device slices —
+        the leader reads local memory, followers TCP-fetch ONLY the
+        ranges their devices own (a non-owner host of a pooled rank
+        fetches nothing) — then run the import jit.  Aggregate DCN
+        traffic is O(1× blob) instead of O(hosts × blob)."""
+        shape = tuple(desc["shape"])  # [L, width, page, kvh, hd]
+        dtype = np.dtype(desc["dtype"])
+        L, width, ps, kvh, hd = shape
+        if self._pooled:
+            R = self._pool_ranks
+            gshape = (L, R * width, ps, kvh, hd)
+            spec = P(None, self._pool_axes, None, "tp", None)
+        else:
+            gshape = shape
+            spec = P(None, None, None, "tp", None)
+        sharding = NamedSharding(self.mesh, spec)
+        client = None if local is not None else self._blob_client(desc["addr"])
+        cache: Dict[tuple, np.ndarray] = {}
+
+        def src_slice(name: str, lo: int, hi: int) -> np.ndarray:
+            key = (name, lo, hi)
+            if key not in cache:
+                if local is not None:
+                    arr = local[0] if name == "k" else local[1]
+                    cache[key] = np.ascontiguousarray(arr[:, :, :, lo:hi])
+                else:
+                    cache[key] = client.fetch(desc["tid"], name, lo, hi)
+            return cache[key]
+
+        def build(name: str) -> jax.Array:
+            idx_map = sharding.addressable_devices_indices_map(gshape)
+            arrays = []
+            for dev, index in idx_map.items():
+                pg, hds = index[1], index[3]
+                pg_lo = pg.start or 0
+                pg_hi = gshape[1] if pg.stop is None else pg.stop
+                h_lo = hds.start or 0
+                h_hi = kvh if hds.stop is None else hds.stop
+                shard_shape = (L, pg_hi - pg_lo, ps, h_hi - h_lo, hd)
+                if self._pooled:
+                    blk_lo, blk_hi = rank * width, (rank + 1) * width
+                    if pg_lo <= blk_lo and pg_hi >= blk_hi:
+                        data = np.zeros(shard_shape, dtype)
+                        data[:, blk_lo - pg_lo: blk_hi - pg_lo] = (
+                            src_slice(name, h_lo, h_hi)
+                        )
+                    elif pg_hi <= blk_lo or pg_lo >= blk_hi:
+                        # non-owner shard: zeros, nothing fetched
+                        data = np.zeros(shard_shape, dtype)
+                    else:  # shards are width-aligned by construction
+                        raise AssertionError("unaligned pool shard")
+                else:
+                    data = src_slice(name, h_lo, h_hi)
+                arrays.append(jax.device_put(data, dev))
+            return jax.make_array_from_single_device_arrays(
+                gshape, sharding, arrays
+            )
+
+        k_blob, v_blob = build("k"), build("v")
+        pages_d = self._put(padded)
+        if self._pooled:
+            if self._import_fn_sharded is None:
+                self._import_fn_sharded = _build_import_fn_pooled(
+                    self.model_cfg, self.mesh, self._pool_axes,
+                    sharded_blob=True,
+                )
+            self.kv = self._import_fn_sharded(
+                self.kv, k_blob, v_blob, pages_d,
+                self._put(np.int32(rank)),
+            )
+        else:
+            self.kv = self._import_fn(self.kv, k_blob, v_blob, pages_d)
+        if client is not None:
+            client.ack(desc["tid"])
 
     def _import_replay(self, padded: np.ndarray, rank: Optional[int],
                        kpad, vpad) -> None:

@@ -219,8 +219,12 @@ class Scheduler:
         hashes = self._seq_hashes(seq)
         hit_pages = self.pool.lookup_on(seq.kv_rank, hashes)
         if self.onboard_fn is not None and len(hit_pages) < len(hashes):
-            # onboard() returns pages already holding this sequence's ref
-            hit_pages.extend(self.onboard_fn(hashes[len(hit_pages):]))
+            # onboard() returns pages already holding this sequence's
+            # ref, allocated on the sequence's pool rank (a sequence's
+            # pages must share one partition)
+            hit_pages.extend(
+                self.onboard_fn(hashes[len(hit_pages):], seq.kv_rank)
+            )
         if hit_pages:
             seq.pages = list(hit_pages)
             seq.num_cached = len(hit_pages) * ps

@@ -109,14 +109,18 @@ class TieredKvCache:
             out.append(blk)
         return out
 
-    def onboard(self, engine, hashes: Sequence[int]) -> List[int]:
-        """Import the leading cached run into device pages; returns page ids
-        (committed to the device prefix cache)."""
+    def onboard(self, engine, hashes: Sequence[int],
+                rank: int = 0) -> List[int]:
+        """Import the leading cached run into device pages ON the given
+        pool rank (the admitting sequence's partition — all its pages
+        must share one rank); returns page ids committed to the device
+        prefix cache."""
         run = self.lookup_run(hashes)
-        # leave headroom: don't onboard into the last free pages
-        run = run[: max(0, engine.pool.available_pages - 2)]
+        # leave headroom: don't onboard into the rank's last free pages
+        run = run[: max(0, engine.pool.available_on(rank) - 2)]
         pages = engine.import_committed_blocks(
-            [(b.block_hash, b.parent_hash, b.k, b.v) for b in run]
+            [(b.block_hash, b.parent_hash, b.k, b.v) for b in run],
+            rank=rank,
         )
         self.onboarded_blocks += len(pages)
         return pages

@@ -230,3 +230,59 @@ async def test_kvbm_barrier_rejects_layout_mismatch(model_setup):
         await rt_a.shutdown(graceful=False)
         await rt_b.shutdown(graceful=False)
         await control.stop()
+
+
+async def test_kvbm_on_partitioned_pool(model_setup, tmp_path):
+    """KV tiering composes with kv_partition (VERDICT r3 item 5): the
+    big-mesh deployments that exhaust HBM fastest get offload too.
+    Offloaded blocks may live on any pool rank (export groups by rank);
+    onboarding lands on the ADMITTING sequence's rank."""
+    from dynamo_tpu.parallel import ParallelConfig
+
+    cfg, params = model_setup
+    tiered = TieredKvCache(
+        HostBlockPool(capacity_bytes=64 << 20), DiskTier(str(tmp_path))
+    )
+    engine = JaxEngine(
+        cfg, params,
+        EngineConfig(page_size=8, num_pages=64, max_num_seqs=8,
+                     max_prefill_tokens=64, max_model_len=256,
+                     kv_partition=True),
+        eos_token_ids=[], kv_dtype=jnp.float32, tiered=tiered,
+        parallel=ParallelConfig(dp=4, tp=2),
+    )
+    assert engine._pooled
+    # several prompts spread across partitions (admission balances)
+    prompts = [[(13 * i + j) % 90 + 1 for j in range(40)] for i in range(4)]
+    want = await asyncio.gather(*[collect(engine, req(p)) for p in prompts])
+
+    deadline = asyncio.get_running_loop().time() + 8
+    while tiered.pending_offloads or len(tiered.host) == 0:
+        assert asyncio.get_running_loop().time() < deadline, "no offload"
+        await asyncio.sleep(0.05)
+    assert len(tiered.host) >= 4
+
+    engine.clear_kv_blocks()
+    assert engine.pool.evictable_pages == 0
+
+    # spy the onboard hook: every page it returns must land on the
+    # requested rank (the admitting sequence's partition)
+    orig_onboard = engine.scheduler.onboard_fn
+    onboard_calls = []
+
+    def spying_onboard(hashes, rank=0):
+        pages = orig_onboard(hashes, rank)
+        onboard_calls.append((rank, list(pages)))
+        return pages
+
+    engine.scheduler.onboard_fn = spying_onboard
+
+    got = await asyncio.gather(*[collect(engine, req(p)) for p in prompts])
+    assert got == want
+    assert tiered.onboarded_blocks >= 4
+    assert any(pages for _, pages in onboard_calls)
+    for rank, pages in onboard_calls:
+        assert all(engine.pool.rank_of(p) == rank for p in pages), (
+            rank, pages,
+        )
+    await engine.shutdown()
