@@ -46,18 +46,46 @@ PLANNER_ROOT = "/planner"
 
 
 class LocalActuator:
-    """Replicas as local OS processes."""
+    """Replicas as local OS processes.  A MULTINODE component's replica
+    is a whole GROUP of `num_hosts` rank processes spawned around a
+    fresh coordinator port — the fan-out the reference's operator gets
+    from `MultinodeSpec` nodeCount + Grove/LWS grouping.  A group lives
+    and dies together: any dead rank tears the group down (SIGTERM the
+    survivors) and reconcile respawns it whole, because lockstep state
+    cannot survive a lost rank (JaxEngine.follower_loop poisons)."""
 
     def __init__(self, control: str, stdout=None, namespace: str = ""):
         self.control = control
         self.stdout = stdout
         self.namespace = namespace
         self._procs: Dict[str, List[subprocess.Popen]] = {}
+        # multinode components: name → list of rank-process groups
+        self._groups: Dict[str, List[List[subprocess.Popen]]] = {}
         # replicas scaled down but possibly still draining: tracked so a
         # SIGTERM-ignoring worker is still reaped/killed at shutdown
         self._stopping: List[subprocess.Popen] = []
 
     def observed(self, comp: ComponentSpec) -> int:
+        self._stopping = [p for p in self._stopping if p.poll() is None]
+        if comp.multinode is not None:
+            groups = self._groups.setdefault(comp.name, [])
+            alive: List[List[subprocess.Popen]] = []
+            for group in groups:
+                dead = [p for p in group if p.poll() is not None]
+                if dead:
+                    logger.warning(
+                        "%s group lost rank(s) %s — tearing down the "
+                        "group", comp.name,
+                        [(p.pid, p.returncode) for p in dead],
+                    )
+                    for p in group:
+                        if p.poll() is None:
+                            p.send_signal(signal.SIGTERM)
+                            self._stopping.append(p)
+                else:
+                    alive.append(group)
+            groups[:] = alive
+            return len(groups)
         procs = self._procs.setdefault(comp.name, [])
         # reap exits (crash detection): a dead replica simply stops
         # counting toward observed state and reconcile replaces it
@@ -68,10 +96,37 @@ class LocalActuator:
                 p.returncode,
             )
         procs[:] = [p for p in procs if p.poll() is None]
-        self._stopping = [p for p in self._stopping if p.poll() is None]
         return len(procs)
 
     def scale_to(self, comp: ComponentSpec, replicas: int) -> None:
+        if comp.multinode is not None:
+            from .graph import _free_port
+
+            groups = self._groups.setdefault(comp.name, [])
+            while len(groups) < replicas:
+                coord = f"127.0.0.1:{_free_port()}"
+                group = []
+                for argv in comp.group_commands(
+                    self.control, coord, namespace=self.namespace
+                ):
+                    p = subprocess.Popen(
+                        argv, stdout=self.stdout, stderr=subprocess.STDOUT
+                    )
+                    group.append(p)
+                groups.append(group)
+                logger.info(
+                    "%s: spawned %d-host group pids %s (coordinator %s)",
+                    comp.name, comp.multinode.num_hosts,
+                    [p.pid for p in group], coord,
+                )
+            while len(groups) > replicas:
+                group = groups.pop()
+                for p in group:
+                    p.send_signal(signal.SIGTERM)
+                    self._stopping.append(p)
+                logger.info("%s: stopping group pids %s", comp.name,
+                            [p.pid for p in group])
+            return
         procs = self._procs.setdefault(comp.name, [])
         argv = comp.command(self.control, namespace=self.namespace)
         while len(procs) < replicas:
@@ -91,6 +146,8 @@ class LocalActuator:
 
         stop_processes(
             [p for procs in self._procs.values() for p in procs]
+            + [p for groups in self._groups.values()
+               for group in groups for p in group]
             + self._stopping,
             timeout,
         )
@@ -98,15 +155,23 @@ class LocalActuator:
 
 class K8sActuator:
     """Replicas as Deployment spec.replicas, patched via kubectl (the
-    deployments themselves come from `deploy.k8s.render_manifests`)."""
+    manifests themselves come from `deploy.k8s.render_manifests`).
+    Multinode components render as StatefulSets whose pod count is
+    groups × num_hosts (ordinal → host-id, deploy/k8s.py), so scaling
+    a group count patches `replicas = groups * num_hosts`."""
 
     def __init__(self, namespace: str, kubectl: str = "kubectl"):
         self.namespace = namespace
         self.kubectl = kubectl
 
-    def patch_command(self, comp_name: str, replicas: int) -> List[str]:
+    @staticmethod
+    def _kind_of(comp: ComponentSpec) -> str:
+        return "statefulset" if comp.multinode is not None else "deployment"
+
+    def patch_command(self, comp_name: str, replicas: int,
+                      kind: str = "deployment") -> List[str]:
         return [
-            self.kubectl, "-n", self.namespace, "patch", "deployment",
+            self.kubectl, "-n", self.namespace, "patch", kind,
             f"dynamo-{comp_name}", "--type", "merge", "-p",
             '{"spec": {"replicas": %d}}' % replicas,
         ]
@@ -114,20 +179,35 @@ class K8sActuator:
     def observed(self, comp: ComponentSpec) -> Optional[int]:
         # spec.replicas, NOT status.availableReplicas: the controller
         # converges the DESIRED count; pods that are pending/crashing
-        # are the Deployment controller's job, and re-patching an
-        # already-correct spec every tick would spam the API server
+        # are the Deployment/StatefulSet controller's job, and
+        # re-patching an already-correct spec every tick would spam the
+        # API server
         out = subprocess.run(
-            [self.kubectl, "-n", self.namespace, "get", "deployment",
+            [self.kubectl, "-n", self.namespace, "get", self._kind_of(comp),
              f"dynamo-{comp.name}", "-o", "jsonpath={.spec.replicas}"],
             capture_output=True, text=True, timeout=15,
         )
         if out.returncode != 0:
             return None
-        return int(out.stdout.strip() or 0)
+        pods = int(out.stdout.strip() or 0)
+        if comp.multinode is not None:
+            n = comp.multinode.num_hosts
+            if pods % n:
+                # a hand-scaled / partially-applied StatefulSet with a
+                # non-multiple pod count would floor-divide to the
+                # desired group count and never heal (the stray pod
+                # waits forever for group peers) — force a re-patch
+                return -1
+            return pods // n
+        return pods
 
     def scale_to(self, comp: ComponentSpec, replicas: int) -> None:
+        pods = replicas
+        if comp.multinode is not None:
+            pods = replicas * comp.multinode.num_hosts
         subprocess.run(
-            self.patch_command(comp.name, replicas), check=True, timeout=15
+            self.patch_command(comp.name, pods, self._kind_of(comp)),
+            check=True, timeout=15,
         )
 
     def stop_all(self) -> None:  # k8s resources outlive the controller

@@ -48,11 +48,56 @@ _KIND_MODULE = {
 
 
 @dataclass
+class MultinodeSpec:
+    """A worker group spanning hosts: ONE graph entry fans out to
+    `num_hosts` lockstep ranks (reference: `MultinodeSpec` nodeCount on
+    DynamoComponentDeployment,
+    dynamocomponentdeployment_types.go:105-108).  Rank 0 serves; other
+    ranks replay its dispatches (JaxEngine.follower_loop).  A group
+    lives and dies together — losing any rank tears down and respawns
+    the whole group (lockstep state cannot survive a lost rank)."""
+
+    num_hosts: int
+    coordinator_port: int = 9999
+
+    @classmethod
+    def parse(cls, d: Optional[Dict[str, Any]]) -> Optional["MultinodeSpec"]:
+        if not d:
+            return None
+        n = int(d.get("num_hosts", d.get("num-hosts", 0)))
+        if n < 2:
+            raise ValueError("multinode.num_hosts must be >= 2")
+        return cls(
+            num_hosts=n,
+            coordinator_port=int(
+                d.get("coordinator_port", d.get("coordinator-port", 9999))
+            ),
+        )
+
+
+@dataclass
 class ComponentSpec:
     name: str
     kind: str
     replicas: int = 1
     args: Dict[str, Any] = field(default_factory=dict)
+    multinode: Optional[MultinodeSpec] = None
+
+    def group_commands(self, control: str, coordinator: str,
+                       namespace: str = "") -> List[List[str]]:
+        """Per-host argvs for ONE multinode group: the same command on
+        every host plus `--coordinator/--num-hosts/--host-id`."""
+        if self.multinode is None:
+            raise ValueError(f"component {self.name!r} is not multinode")
+        if self.kind != "worker":
+            raise ValueError("multinode groups are worker components")
+        base = self.command(control, namespace=namespace)
+        return [
+            base + ["--coordinator", coordinator,
+                    "--num-hosts", str(self.multinode.num_hosts),
+                    "--host-id", str(i)]
+            for i in range(self.multinode.num_hosts)
+        ]
 
     def command(self, control: str, namespace: str = "") -> List[str]:
         """The process argv for one replica (reference: per-service pod
@@ -91,12 +136,22 @@ class GraphSpec:
         if isinstance(raw, list):  # list form: entries carry their name
             raw = {c.pop("name"): c for c in raw}
         for name, c in raw.items():
-            comps.append(ComponentSpec(
+            comp = ComponentSpec(
                 name=name,
                 kind=c.get("kind", "worker"),
                 replicas=int(c.get("replicas", 1)),
                 args=dict(c.get("args") or {}),
-            ))
+                multinode=MultinodeSpec.parse(c.get("multinode")),
+            )
+            if comp.multinode is not None and comp.kind != "worker":
+                # reject at PARSE time: an actuation-time failure inside
+                # the reconcile loop would abort every pass and starve
+                # the remaining components
+                raise ValueError(
+                    f"component {name!r}: multinode groups are worker "
+                    f"components (got kind {comp.kind!r})"
+                )
+            comps.append(comp)
         if not comps:
             raise ValueError("deployment graph has no components")
         return cls(
@@ -111,9 +166,18 @@ class GraphSpec:
             return cls.parse(f.read())
 
     def render_local(self, control: str) -> List[List[str]]:
-        """Flat list of argvs, replicas expanded, namespace injected."""
+        """Flat list of argvs, replicas expanded, namespace injected.
+        Multinode groups expand to num_hosts ranks each, with a fresh
+        local coordinator port per group."""
         out = []
         for comp in self.components:
+            if comp.multinode is not None:
+                for _ in range(comp.replicas):
+                    out.extend(comp.group_commands(
+                        control, f"127.0.0.1:{_free_port()}",
+                        namespace=self.namespace,
+                    ))
+                continue
             argv = comp.command(control, namespace=self.namespace)
             for _ in range(comp.replicas):
                 out.append(list(argv))
@@ -170,6 +234,16 @@ class LocalLauncher:
             self.procs + ([self._control_proc] if self._control_proc else []),
             timeout,
         )
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
 
 
 def stop_processes(procs: List[subprocess.Popen], timeout: float = 10.0) -> None:

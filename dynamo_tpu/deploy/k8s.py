@@ -22,12 +22,12 @@ CONTROL_PORT = 7801
 DEFAULT_IMAGE = "dynamo-tpu:latest"
 
 
-def _meta(name: str, ns: str) -> Dict[str, Any]:
+def _meta(name: str, ns: str, label: str = "") -> Dict[str, Any]:
     return {
         "name": name,
         "namespace": ns,
         "labels": {"app.kubernetes.io/part-of": "dynamo-tpu",
-                   "dynamo.component": name},
+                   "dynamo.component": label or name},
     }
 
 
@@ -67,6 +67,75 @@ def _control_manifests(ns: str, image: str) -> List[Dict[str, Any]]:
     ]
 
 
+def _add_tpu_resources(container: Dict[str, Any], comp: ComponentSpec) -> None:
+    """One chip per WORKER replica by default (GKE TPU scheduling);
+    `tpu_resources` in args overrides; non-worker kinds get none."""
+    if comp.kind != "worker":
+        return
+    tpus = comp.args.get("tpu_resources", 1)
+    if tpus:
+        container["resources"] = {"limits": {"google.com/tpu": str(tpus)}}
+
+
+def _multinode_manifest(comp: ComponentSpec, ns: str, image: str,
+                        argv: List[str]) -> List[Dict[str, Any]]:
+    """One multinode worker group entry → a StatefulSet + headless
+    Service: stable pod ordinals map to lockstep ranks (ordinal →
+    --host-id, group's rank-0 pod → --coordinator), the fan-out the
+    reference's operator performs from `MultinodeSpec` nodeCount
+    (dynamocomponentdeployment_types.go:105-108, Grove/LWS grouping).
+    Pods = replicas (groups) × num_hosts; ordinal arithmetic derives
+    (group, host_id), so scaling adds/removes whole groups."""
+    import shlex
+
+    mn = comp.multinode
+    name = f"dynamo-{comp.name}"
+    labels = {"dynamo.component": comp.name}
+    n = mn.num_hosts
+    shell = (
+        f"ORD=${{HOSTNAME##*-}}; N={n}; "
+        f"COORD={name}-$((ORD / N * N)).{name}.{ns}.svc:"
+        f"{mn.coordinator_port}; "
+        f"exec {shlex.join(argv)} "
+        f"--coordinator $COORD --num-hosts $N --host-id $((ORD % N))"
+    )
+    container: Dict[str, Any] = {
+        "name": comp.name,
+        "image": image,
+        "command": ["sh", "-c", shell],
+        "ports": [{"containerPort": mn.coordinator_port}],
+    }
+    _add_tpu_resources(container, comp)
+    return [
+        {  # headless service: stable per-pod DNS for the coordinator
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": _meta(name, ns, comp.name),
+            "spec": {
+                "clusterIP": "None",
+                "selector": labels,
+                "ports": [{"port": mn.coordinator_port,
+                           "targetPort": mn.coordinator_port}],
+            },
+        },
+        {
+            "apiVersion": "apps/v1",
+            "kind": "StatefulSet",
+            "metadata": _meta(name, ns, comp.name),
+            "spec": {
+                "serviceName": name,
+                "replicas": comp.replicas * n,
+                "podManagementPolicy": "Parallel",  # ranks start together
+                "selector": {"matchLabels": labels},
+                "template": {
+                    "metadata": {"labels": labels},
+                    "spec": {"containers": [container]},
+                },
+            },
+        },
+    ]
+
+
 def _component_manifest(comp: ComponentSpec, ns: str, image: str,
                         control: str) -> List[Dict[str, Any]]:
     argv = ["python", "-m", _KIND_MODULE[comp.kind], "--control", control,
@@ -79,6 +148,8 @@ def _component_manifest(comp: ComponentSpec, ns: str, image: str,
             continue
         else:
             argv += [flag, str(value)]
+    if comp.multinode is not None:
+        return _multinode_manifest(comp, ns, image, argv)
     labels = {"dynamo.component": comp.name}
     container: Dict[str, Any] = {
         "name": comp.name,
@@ -86,30 +157,25 @@ def _component_manifest(comp: ComponentSpec, ns: str, image: str,
         "command": argv,
     }
     out: List[Dict[str, Any]] = []
-    if comp.kind == "worker":
-        # one chip per worker replica by default (GKE TPU scheduling);
-        # tpu_resources in args overrides
-        tpus = comp.args.get("tpu_resources", 1)
-        if tpus:
-            container["resources"] = {
-                "limits": {"google.com/tpu": str(tpus)},
-            }
+    _add_tpu_resources(container, comp)
     if comp.kind == "frontend":
         port = int(comp.args.get("port", 8000))
         container["ports"] = [{"containerPort": port}]
         out.append({
             "apiVersion": "v1",
             "kind": "Service",
-            "metadata": _meta(comp.name, ns),
+            "metadata": _meta(f"dynamo-{comp.name}", ns, comp.name),
             "spec": {
                 "selector": labels,
                 "ports": [{"port": port, "targetPort": port}],
             },
         })
+    # "dynamo-" prefix matches what K8sActuator patches — the renderer
+    # and the actuator must name the same objects
     out.insert(0, {
         "apiVersion": "apps/v1",
         "kind": "Deployment",
-        "metadata": _meta(comp.name, ns),
+        "metadata": _meta(f"dynamo-{comp.name}", ns, comp.name),
         "spec": {
             "replicas": comp.replicas,
             "selector": {"matchLabels": labels},
@@ -137,6 +203,7 @@ def render_manifests(spec: GraphSpec, image: str = DEFAULT_IMAGE) -> str:
         comp = ComponentSpec(
             name=comp.name, kind=comp.kind, replicas=comp.replicas,
             args={k: v for k, v in comp.args.items()},
+            multinode=comp.multinode,
         )
         docs += _component_manifest(comp, ns, image, control)
     return yaml.safe_dump_all(docs, sort_keys=False)

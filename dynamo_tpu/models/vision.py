@@ -45,6 +45,12 @@ class VisionConfig:
     # layer and skips post_layernorm — HF CLIP only post-norms the
     # pooled CLS, so trained projectors expect un-normed features)
     feature_layer: int = 0
+    # encoder MLP activation: CLIP towers use quick_gelu
+    # (x * sigmoid(1.702x)); HF config `hidden_act` maps through.  The
+    # llava projector act is EXACT gelu (torch nn.GELU default) — the
+    # tanh approximation is ~2e-4 off, which a golden-logit comparison
+    # catches (tests/test_golden.py)
+    hidden_act: str = "quick_gelu"
 
     @property
     def num_patches(self) -> int:
@@ -143,8 +149,16 @@ def _vit_layer(lp, x, cfg: VisionConfig):
     o = jnp.einsum("nhqk,nkhd->nqhd", p, v.astype(jnp.float32))
     x = x + proj(o.reshape(N, S, h).astype(x.dtype), "wo", "bo")
     m = _layer_norm(x, lp["ln2_scale"], lp["ln2_bias"], cfg.layer_norm_eps)
-    m = jax.nn.gelu(m @ lp["w1"] + lp["b1"]) @ lp["w2"] + lp["b2"]
+    m = _act(cfg.hidden_act, m @ lp["w1"] + lp["b1"]) @ lp["w2"] + lp["b2"]
     return x + m.astype(x.dtype)
+
+
+def _act(name: str, x):
+    if name == "quick_gelu":
+        return x * jax.nn.sigmoid(1.702 * x)
+    if name in ("gelu", "gelu_pytorch_tanh"):
+        return jax.nn.gelu(x, approximate=(name != "gelu"))
+    raise ValueError(f"unsupported vision hidden_act {name!r}")
 
 
 def encode_images(params: Params, cfg: VisionConfig,
@@ -183,6 +197,8 @@ def encode_images(params: Params, cfg: VisionConfig,
                         cfg.layer_norm_eps)
     out = x @ params["proj"]
     if cfg.projector_hidden:
-        out = jax.nn.gelu(out + params["proj_b1"]) @ params["proj2"]
+        # llava projector_hidden_act "gelu" = torch nn.GELU = EXACT gelu
+        out = jax.nn.gelu(out + params["proj_b1"],
+                          approximate=False) @ params["proj2"]
         out = out + params["proj_b2"]
     return out  # [N, num_patches, out_hidden]

@@ -75,6 +75,7 @@ def vision_config_from_hf(d: dict, out_hidden: int,
         pre_layernorm=True,
         projector_hidden=projector_hidden,
         feature_layer=feature_layer,
+        hidden_act=d.get("hidden_act", "quick_gelu"),
     )
 
 

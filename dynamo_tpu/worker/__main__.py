@@ -179,9 +179,19 @@ def main() -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-    from ..parallel import initialize_multihost
+    if args.mock and args.coordinator:
+        # a MOCK multinode group never joins a jax world (there are no
+        # device dispatches to replay): rank 0 serves the simulator,
+        # other ranks just hold their group slot so controllers exercise
+        # real group lifecycle (spawn / any-rank-death / respawn)
+        if (args.host_id or 0) > 0:
+            print("READY mock-follower", flush=True)
+            signal.sigwait({signal.SIGTERM, signal.SIGINT})
+            return
+    else:
+        from ..parallel import initialize_multihost
 
-    initialize_multihost(args.coordinator, args.num_hosts, args.host_id)
+        initialize_multihost(args.coordinator, args.num_hosts, args.host_id)
     import jax
 
     if jax.process_count() > 1 and jax.process_index() != 0:

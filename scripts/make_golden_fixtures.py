@@ -1,0 +1,134 @@
+"""Generate golden-logit fixtures from transformers (CPU torch).
+
+Round-trip tests catch serialization bugs but NOT weight-mapping bugs —
+a transposed projection or mis-scaled norm survives a round trip and
+silently degrades the model.  These fixtures pin our JAX forward to the
+HF reference implementation for tiny-but-REAL configs (the accuracy
+analog of the reference's /root/reference/tests/lmcache/ MMLU harness,
+shrunk to logit equality so it runs in CI without weights egress).
+
+Run once (committed outputs live in tests/fixtures/):
+    python scripts/make_golden_fixtures.py
+"""
+
+import json
+import os
+
+import numpy as np
+import torch
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXDIR = os.path.join(ROOT, "tests", "fixtures")
+
+PROMPTS = [
+    [(7 * j) % 251 + 1 for j in range(24)],
+    [(13 * j) % 239 + 2 for j in range(13)],
+]
+DECODE_STEPS = 5
+
+
+def make_llama() -> None:
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    torch.manual_seed(0x60)
+    cfg = LlamaConfig(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=512,
+        rms_norm_eps=1e-6,
+        rope_theta=10000.0,
+        tie_word_embeddings=False,
+        attention_bias=False,
+    )
+    model = LlamaForCausalLM(cfg).eval().float()
+    out_dir = os.path.join(FIXDIR, "golden_llama")
+    model.save_pretrained(out_dir, safe_serialization=True)
+
+    logits = {}
+    with torch.no_grad():
+        for i, p in enumerate(PROMPTS):
+            # greedy-extend so decode-step logits are pinned too
+            toks = list(p)
+            steps = []
+            for _ in range(DECODE_STEPS + 1):
+                lg = model(torch.tensor([toks])).logits[0, -1].numpy()
+                steps.append(lg.astype(np.float32))
+                toks.append(int(lg.argmax()))
+            logits[f"prompt{i}"] = np.asarray(PROMPTS[i], np.int32)
+            logits[f"logits{i}"] = np.stack(steps)  # [T+1, V]
+            logits[f"greedy{i}"] = np.asarray(
+                toks[len(p):], np.int32
+            )
+    np.savez(os.path.join(out_dir, "golden_logits.npz"), **logits)
+    print("golden_llama:", out_dir)
+
+
+def make_llava() -> None:
+    from transformers import (
+        CLIPVisionConfig,
+        LlamaConfig,
+        LlavaConfig,
+        LlavaForConditionalGeneration,
+    )
+
+    torch.manual_seed(0x61)
+    image_token = 255
+    vision = CLIPVisionConfig(
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=2,
+        image_size=16,
+        patch_size=8,
+        projection_dim=32,
+    )
+    text = LlamaConfig(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=512,
+        rms_norm_eps=1e-6,
+        tie_word_embeddings=False,
+    )
+    cfg = LlavaConfig(
+        vision_config=vision,
+        text_config=text,
+        image_token_index=image_token,
+        vision_feature_layer=-2,
+        vision_feature_select_strategy="default",
+        projector_hidden_act="gelu",
+    )
+    model = LlavaForConditionalGeneration(cfg).eval().float()
+    out_dir = os.path.join(FIXDIR, "golden_llava")
+    model.save_pretrained(out_dir, safe_serialization=True)
+
+    num_patches = (16 // 8) ** 2  # 4
+    rng = np.random.default_rng(0x62)
+    pixels = rng.uniform(-1.0, 1.0, (1, 3, 16, 16)).astype(np.float32)
+    prompt = [5, 9] + [image_token] * num_patches + [17, 23, 4, 11]
+    with torch.no_grad():
+        lg = model(
+            input_ids=torch.tensor([prompt]),
+            pixel_values=torch.tensor(pixels),
+        ).logits[0, -1].numpy().astype(np.float32)
+    np.savez(
+        os.path.join(out_dir, "golden_logits.npz"),
+        prompt=np.asarray(prompt, np.int32),
+        pixels=pixels,
+        image_offset=np.int32(2),
+        last_logits=lg,
+    )
+    print("golden_llava:", out_dir)
+
+
+if __name__ == "__main__":
+    os.makedirs(FIXDIR, exist_ok=True)
+    make_llama()
+    make_llava()

@@ -1,0 +1,105 @@
+"""Golden-logit accuracy fixtures (VERDICT r3 item 7).
+
+Round-trip tests catch serialization bugs but not WEIGHT-MAPPING bugs:
+a transposed projection or mis-scaled norm survives a round trip and
+silently degrades every model loaded through the mapper.  These tests
+load committed transformers-generated checkpoints (tiny-but-real
+configs, scripts/make_golden_fixtures.py) through the SAME loader path
+real checkpoints use and pin our JAX forward to the HF reference logits
+— prefill, decode steps, and the LLaVA vision→projector→LM splice.
+Reference analog: /root/reference/tests/lmcache/ accuracy harness.
+"""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+FIXDIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "fixtures")
+LLAMA_DIR = os.path.join(FIXDIR, "golden_llama")
+LLAVA_DIR = os.path.join(FIXDIR, "golden_llava")
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(LLAMA_DIR), reason="golden fixtures not generated"
+)
+
+ATOL, RTOL = 2e-4, 2e-4
+
+
+def _run_steps(cfg, params, prompt, feed):
+    """Last-position logits for the prefill, then one decode step per
+    `feed` token — the exact paged path the engine serves."""
+    from dynamo_tpu.models import KVCache, forward_decode, forward_prefill
+
+    page_size = 8
+    n_pages = (len(prompt) + len(feed)) // page_size + 2
+    kv = KVCache.create(cfg, 1 + n_pages, page_size, jnp.float32)
+    table = jnp.arange(1, 1 + n_pages, dtype=jnp.int32)[None]
+    S = len(prompt)
+    logits, kv = forward_prefill(
+        params, cfg, kv, jnp.asarray([prompt], jnp.int32), table,
+        jnp.zeros((1,), jnp.int32), jnp.asarray([S], jnp.int32),
+    )
+    outs = [np.asarray(logits)[0]]
+    pos = S
+    for tok in feed:
+        logits, kv = forward_decode(
+            params, cfg, kv, jnp.asarray([tok], jnp.int32),
+            jnp.asarray([pos], jnp.int32), table,
+        )
+        outs.append(np.asarray(logits)[0])
+        pos += 1
+    return np.stack(outs)
+
+
+def test_golden_llama_matches_transformers():
+    from dynamo_tpu.models import ModelConfig
+    from dynamo_tpu.models.loader import load_params
+
+    cfg = ModelConfig.from_pretrained(LLAMA_DIR)
+    params = load_params(LLAMA_DIR, cfg, dtype=jnp.float32)
+    data = np.load(os.path.join(LLAMA_DIR, "golden_logits.npz"))
+    for i in range(2):
+        prompt = data[f"prompt{i}"].tolist()
+        golden = data[f"logits{i}"]  # [T+1, V]
+        greedy = data[f"greedy{i}"].tolist()
+        got = _run_steps(cfg, params, prompt, greedy[:-1])
+        assert got.shape == golden.shape
+        np.testing.assert_allclose(got, golden, atol=ATOL, rtol=RTOL)
+        # greedy continuation is bit-identical
+        assert got.argmax(-1).tolist() == golden.argmax(-1).tolist()
+
+
+def test_golden_llava_matches_transformers():
+    from dynamo_tpu.models import KVCache, forward_prefill
+    from dynamo_tpu.models.vision import encode_images
+    from dynamo_tpu.models.vlm import load_vlm
+
+    llm_params, cfg, vparams, vcfg = load_vlm(LLAVA_DIR, dtype=jnp.float32)
+    data = np.load(os.path.join(LLAVA_DIR, "golden_logits.npz"))
+    prompt = data["prompt"].tolist()
+    off = int(data["image_offset"])
+    # HF pixel_values are [N, 3, H, W]; the tower takes [N, H, W, 3]
+    pixels = jnp.asarray(data["pixels"].transpose(0, 2, 3, 1))
+    embeds = np.asarray(encode_images(vparams, vcfg, pixels))  # [1, P, h]
+    P = embeds.shape[1]
+    S = len(prompt)
+    extra = np.zeros((1, S, cfg.hidden_size), np.float32)
+    mask = np.zeros((1, S), bool)
+    extra[0, off:off + P] = embeds[0]
+    mask[0, off:off + P] = True
+
+    page_size = 8
+    n_pages = S // page_size + 2
+    kv = KVCache.create(cfg, 1 + n_pages, page_size, jnp.float32)
+    table = jnp.arange(1, 1 + n_pages, dtype=jnp.int32)[None]
+    logits, _ = forward_prefill(
+        llm_params, cfg, kv, jnp.asarray([prompt], jnp.int32), table,
+        jnp.zeros((1,), jnp.int32), jnp.asarray([S], jnp.int32),
+        extra_embeds=jnp.asarray(extra), extra_mask=jnp.asarray(mask),
+    )
+    got = np.asarray(logits)[0]
+    want = data["last_logits"]
+    np.testing.assert_allclose(got, want, atol=ATOL, rtol=RTOL)
+    assert int(got.argmax()) == int(want.argmax())

@@ -64,10 +64,11 @@ def test_k8s_render_shapes():
     assert ("Namespace", "testns") in kinds
     assert ("Deployment", "control-plane") in kinds
     assert ("Service", "control-plane") in kinds
-    assert ("Deployment", "frontend") in kinds
-    assert ("Service", "frontend") in kinds  # frontend exposes its port
+    # component objects carry the dynamo- prefix K8sActuator patches
+    assert ("Deployment", "dynamo-frontend") in kinds
+    assert ("Service", "dynamo-frontend") in kinds  # frontend exposes its port
     decode = next(d for d in docs if d["kind"] == "Deployment"
-                  and d["metadata"]["name"] == "decode")
+                  and d["metadata"]["name"] == "dynamo-decode")
     assert decode["spec"]["replicas"] == 2
     container = decode["spec"]["template"]["spec"]["containers"][0]
     assert container["resources"]["limits"]["google.com/tpu"] == "1"

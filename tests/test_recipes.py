@@ -41,6 +41,11 @@ def test_recipe_parses_and_flags_are_accepted(path):
     for comp in spec.components:
         try:
             args = _parse_component(comp)
+            if comp.multinode is not None:
+                # every rank's fanned-out argv must parse too
+                for argv in comp.group_commands("127.0.0.1:1", "c:9",
+                                                namespace="test"):
+                    _parser_for(comp.kind).parse_args(argv[3:])
         except SystemExit as e:
             raise AssertionError(
                 f"{os.path.basename(path)}: component {comp.name!r} "
@@ -79,6 +84,9 @@ def test_70b_recipe_north_star_flags():
     spec = GraphSpec.load(os.path.join(ROOT, "recipes",
                                        "llama-3-70b-v5e-64.yaml"))
     by_name = {c.name: c for c in spec.components}
+    # the worker groups fan out from the spec, not hand-run commands
+    assert by_name["decode"].multinode.num_hosts == 12
+    assert by_name["prefill"].multinode.num_hosts == 4
     decode = _parse_component(by_name["decode"])
     assert decode.kv_partition and decode.dp == 6 and decode.tp == 8
     from dynamo_tpu.worker.__main__ import engine_config_from_args
