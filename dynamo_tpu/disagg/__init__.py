@@ -18,7 +18,14 @@ runtime):
   in behind the same interface later.
 """
 
+from .encode import EncodeOffload, serve_encode_worker
 from .handler import DisaggDecodeHandler, serve_prefill_worker
 from .router import DisaggRouter
 
-__all__ = ["DisaggDecodeHandler", "DisaggRouter", "serve_prefill_worker"]
+__all__ = [
+    "DisaggDecodeHandler",
+    "DisaggRouter",
+    "EncodeOffload",
+    "serve_encode_worker",
+    "serve_prefill_worker",
+]

@@ -1,0 +1,129 @@
+"""EPD (encode/prefill/decode) split: a dedicated encode worker role.
+
+The reference runs multimodal encoders as their own workers — trtllm's
+`encode_helper` and sglang's `encode_worker_handler` receive the image,
+run the vision tower, and hand embeddings to the LLM workers (SURVEY
+§2.4).  Here:
+
+- `serve_encode_worker` serves a vision-equipped engine at
+  `{ns}.encoder.generate`: requests carry `mm_pixels`, responses carry
+  the projected patch embeddings + the image-content cache salt;
+- `EncodeOffload` wraps a SERVING engine (which needs no vision tower):
+  requests with pixels detour to the encode component and continue with
+  `mm_embeds` substituted — transparent to the frontend pipeline.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Optional
+
+from ..runtime import Context, DistributedRuntime
+
+logger = logging.getLogger(__name__)
+
+ENCODE_COMPONENT = "encoder"
+
+
+async def serve_encode_worker(
+    runtime: DistributedRuntime,
+    engine,
+    mdc,
+    namespace: str = "dynamo",
+):
+    """Serve the engine's vision tower as a standalone encode worker at
+    {ns}.encoder.generate (disagg_role=encode: frontends skip it)."""
+    from ..worker import serve_engine
+
+    class EncodeFacade:
+        """AsyncEngine facade: every request is an encode request."""
+
+        def __init__(self, engine):
+            self.engine = engine
+
+        async def generate(self, request, context):
+            yield await self.engine.encode_mm(request, context)
+
+        async def shutdown(self):
+            pass
+
+        def metrics(self):
+            return self.engine.metrics()
+
+        def clear_kv_blocks(self):
+            return self.engine.clear_kv_blocks()
+
+        def add_event_sink(self, sink):
+            self.engine.add_event_sink(sink)
+
+    mdc.disagg_role = "encode"
+    return await serve_engine(
+        runtime, EncodeFacade(engine), mdc,
+        namespace=namespace, component=ENCODE_COMPONENT,
+    )
+
+
+class EncodeOffload:
+    """Wraps a serving engine: image requests detour to the encode
+    component for their embeddings, so THIS worker carries no vision
+    tower.  Everything else delegates."""
+
+    def __init__(self, engine, runtime: DistributedRuntime,
+                 namespace: str = "dynamo",
+                 component: str = ENCODE_COMPONENT):
+        self.engine = engine
+        ep = (runtime.namespace(namespace).component(component)
+              .endpoint("generate"))
+        self.client = ep.client()
+        self._started = False
+
+    async def _encode(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        if not self._started:
+            await self.client.start()
+            self._started = True
+        resp: Optional[Dict[str, Any]] = None
+        async for out in self.client.round_robin(
+            {"mm_pixels": request["mm_pixels"],
+             "mm_offsets": request.get("mm_offsets") or []},
+            Context(),
+        ):
+            resp = out
+            break
+        if resp is None:
+            return {"error": "encode worker returned nothing"}
+        return resp
+
+    async def generate(self, request: Dict[str, Any],
+                       context: Optional[Context] = None):
+        if request.get("mm_pixels"):
+            resp = await self._encode(request)
+            if resp.get("error"):
+                yield {"token_ids": [], "finish_reason": "error",
+                       "error": f"encode worker: {resp['error']}"}
+                return
+            request = dict(request)
+            request.pop("mm_pixels")
+            request["mm_embeds"] = resp["mm_embeds"]
+            if not request.get("cache_salt"):
+                request["cache_salt"] = resp.get("cache_salt", "")
+        async for out in self.engine.generate(request, context):
+            yield out
+
+    # -- delegation ---------------------------------------------------------- #
+
+    def metrics(self):
+        return self.engine.metrics()
+
+    def clear_kv_blocks(self):
+        return self.engine.clear_kv_blocks()
+
+    def add_event_sink(self, sink):
+        self.engine.add_event_sink(sink)
+
+    async def embed(self, request, context=None):
+        return await self.engine.embed(request, context)
+
+    async def shutdown(self):
+        if self._started:
+            await self.client.stop()
+        await self.engine.shutdown()

@@ -802,17 +802,10 @@ class JaxEngine:
                         "sp×tp MoE requires moe_impl='ragged'|'a2a' and "
                         "num_experts divisible by tp"
                     )
-                if (model_cfg.is_moe and model_cfg.moe_impl == "a2a"
-                        and parallel.tp > 1
-                        and self.cfg.enable_prefix_caching):
-                    # tp == 1 never engages the all-to-all (the ragged
-                    # fallback is dropless), so caching stays legal there
-                    raise ValueError(
-                        "moe_impl='a2a' requires enable_prefix_caching="
-                        "False: its capacity drops depend on batch "
-                        "composition, so cached KV would not be "
-                        "reproducible across batches"
-                    )
+                # moe_impl='a2a' composes with prefix caching: capacity
+                # drops are per-token-per-peer (a pure function of the
+                # token's own routing — parallel/wide_ep.py), so cached
+                # KV is reproducible across batch compositions
                 # the sp shard_map's param specs shard heads, the vocab,
                 # and (dense models) the ffn dim over tp — catch uneven
                 # splits here with a clear message instead of an opaque
@@ -889,10 +882,12 @@ class JaxEngine:
         self.vision = vision
         self._encode_fn = None
         self._embed_fn = None
-        if vision is not None and (self._multihost or self._sp > 1):
+        # vision composes with multihost: the tower runs leader-local and
+        # the resulting embeds ride the lockstep prefill plan (small
+        # [N, patches, h] arrays); sp ring prefill remains excluded
+        if vision is not None and self._sp > 1:
             raise ValueError(
-                "the vision tower is not supported under multihost "
-                "lockstep or sp prefill yet"
+                "the vision tower is not supported under sp prefill yet"
             )
         self.params = self._shard_params(params)
         self.kv = self._make_kv()
@@ -1245,7 +1240,7 @@ class JaxEngine:
         seq = Sequence(context.id, prompt, opts)
         seq.seed = opts.seed if opts.seed is not None else self._py_rng.getrandbits(31)
         seq.hold_pages = bool(request.get("_hold_pages"))
-        if request.get("mm_pixels"):
+        if request.get("mm_pixels") or request.get("mm_embeds"):
             err = self._attach_mm(seq, request)
             if err:
                 yield {"token_ids": [], "finish_reason": "error", "error": err}
@@ -1601,6 +1596,9 @@ class JaxEngine:
                 "arrays": [tokens, table, prefix, chunk,
                            *[np.asarray(a) for a in samp], seeds, counters],
                 "owner": owner,
+                # vision embeds (leader-computed) ride the plan so every
+                # rank issues the identical with-embeds prefill variant
+                "mm": [np.asarray(m) for m in mm] if mm else None,
             })
         packed_d, tok_d = self._dispatch_prefill(
             tokens, table, prefix, chunk, samp, seeds, counters, with_top,
@@ -1824,13 +1822,44 @@ class JaxEngine:
         return p_packed, d_packed
 
     def _attach_mm(self, seq, request) -> Optional[str]:
-        """Validate + attach multimodal pixels to a sequence; returns an
-        error string instead of raising (engine errors are streamed)."""
+        """Validate + attach multimodal pixels OR precomputed patch
+        embeddings to a sequence; returns an error string instead of
+        raising (engine errors are streamed).  The embeds path is the
+        EPD split: a dedicated encode worker ran the tower
+        (disagg/encode.py), so THIS worker needs no vision tower."""
+        import hashlib
+
+        if request.get("mm_embeds"):
+            e = request["mm_embeds"]
+            try:
+                arr = np.frombuffer(
+                    e["data"], np.float32
+                ).reshape(e["shape"]).copy()
+            except (KeyError, TypeError, ValueError):
+                return "malformed mm_embeds payload"
+            offsets = list(request.get("mm_offsets") or [])
+            if arr.ndim != 3 or arr.shape[0] != len(offsets):
+                return "mm_embeds/mm_offsets mismatch"
+            if arr.shape[2] != self.model_cfg.hidden_size:
+                return (
+                    f"mm_embeds width {arr.shape[2]} != model hidden "
+                    f"size {self.model_cfg.hidden_size}"
+                )
+            P = arr.shape[1]
+            for off in offsets:
+                if (not isinstance(off, int) or isinstance(off, bool)
+                        or not 0 <= off <= len(seq.prompt) - P):
+                    return "mm_offsets must be integer offsets inside the prompt"
+            seq.mm_embeds = arr
+            seq.mm_offsets = offsets
+            salt = request.get("cache_salt")
+            seq.cache_salt = salt if isinstance(salt, str) and salt else (
+                hashlib.blake2b(arr.tobytes(), digest_size=8).hexdigest()
+            )
+            return None
         if self.vision is None:
             return "this worker has no vision tower attached"
         from ..llm.multimodal import unpack_pixels
-
-        import hashlib
 
         _, vcfg = self.vision
         try:
@@ -2101,11 +2130,12 @@ class JaxEngine:
             try:
                 if kind == "prefill":
                     a = desc["arrays"]
+                    mm = tuple(desc["mm"]) if desc.get("mm") else ()
                     self._dispatch_prefill(
                         a[0], a[1], a[2], a[3],
                         SamplingParams(*a[4:4 + samp_n]),
                         a[4 + samp_n], a[5 + samp_n], desc["with_top"],
-                        owner=desc.get("owner"),
+                        mm=mm, owner=desc.get("owner"),
                     )
                 elif kind == "decode":
                     a = desc["arrays"]
@@ -2185,6 +2215,54 @@ class JaxEngine:
         return {
             "embeddings": [vecs[i].tolist() for i in range(B)],
             "prompt_tokens": int(lens.sum()),
+        }
+
+    async def encode_mm(self, request: Dict[str, Any],
+                        context: Optional[Context] = None) -> Dict[str, Any]:
+        """EPD encode-worker surface: {"mm_pixels": {...}} → patch
+        embeddings {"mm_embeds": {shape, data}, "cache_salt": ...}.
+        A dedicated encode worker runs the vision tower so serving
+        workers don't carry it (reference: trtllm encode_helper /
+        sglang encode_worker_handler — SURVEY §2.4)."""
+        del context
+        if self.vision is None:
+            return {"error": "this worker has no vision tower attached"}
+        from ..llm.multimodal import unpack_pixels
+
+        import hashlib
+
+        _, vcfg = self.vision
+        try:
+            pixels = unpack_pixels(request["mm_pixels"])
+        except Exception:  # noqa: BLE001 — wire payloads are untrusted
+            return {"error": "malformed mm_pixels payload"}
+        if (pixels.ndim != 4
+                or pixels.shape[1:] != (vcfg.image_size, vcfg.image_size, 3)):
+            return {
+                "error": f"image shape {pixels.shape[1:]} != tower input "
+                         f"({vcfg.image_size}, {vcfg.image_size}, 3)"
+            }
+        vparams = self.vision[0]
+
+        def op():
+            if self._encode_fn is None:
+                from ..models.vision import encode_images
+
+                self._encode_fn = jax.jit(
+                    lambda p, px: encode_images(p, vcfg, px)
+                )
+            return np.asarray(jax.device_get(
+                self._encode_fn(vparams, jnp.asarray(pixels))
+            )).astype(np.float32)
+
+        emb = await self._device_op(op)
+        return {
+            "mm_embeds": {"shape": list(emb.shape), "data": emb.tobytes()},
+            # same image bytes → same salt: cache isolation keys match
+            # whether the tower ran here or on the serving worker
+            "cache_salt": hashlib.blake2b(
+                pixels.tobytes(), digest_size=8
+            ).hexdigest(),
         }
 
     def _embed_replay(self, tokens: np.ndarray, lens: np.ndarray) -> np.ndarray:

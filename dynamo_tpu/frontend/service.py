@@ -177,8 +177,10 @@ class ModelWatcher:
         except (ValueError, TypeError, KeyError) as e:
             logger.error("bad model card at %s: %s", key, e)
             return
-        if mdc.disagg_role == "prefill":
-            return  # prefill-only workers are not client-facing models
+        if mdc.disagg_role in ("prefill", "encode"):
+            return  # prefill-only / encode-only workers are not
+            # client-facing models (their generate surface speaks the
+            # internal disagg protocol, not completions)
         entry = self.manager.get(mdc.name)
         if entry is None:
             tokenizer = self._load_tokenizer(mdc)

@@ -12,15 +12,24 @@ TPU-native equivalent is the GShard/DeepEP pattern over an ep mesh axis:
   its expert (this is the expert all-to-all that rides ICI);
 - the owner computes its local experts via sort + `ragged_dot`
   (dropless within capacity) and a second all-to-all returns results;
-- tokens past a peer's capacity are dropped (standard GShard behavior —
-  their residual stream passes through unchanged); `expert_load` exposes
-  the per-expert routed-token histogram so imbalance is observable.
+- capacity is PER TOKEN PER PEER: a token may send at most
+  `ceil(k * capacity_factor / n)` of its k assignments to any one peer
+  — a drop happens only when a token's OWN top-k concentrates on one
+  shard, never because of other tokens' load.  This makes every drop a
+  pure function of the token's content: outputs are identical across
+  batch compositions, chunkings, and cached-prefix reuse, so the a2a
+  path composes with prefix caching (GShard-style batch-positional
+  drops would make cached KV depend on what happened to be co-batched
+  — VERDICT r3 item 9).  `expert_load` exposes the per-expert
+  routed-token histogram so imbalance stays observable.
 
 Use inside a shard_map where tokens are data-sharded (sp/dp) and the
 expert weight stacks are sharded on their leading E axis over `axis`.
 """
 
 from __future__ import annotations
+
+import math
 
 import jax
 import jax.numpy as jnp
@@ -46,9 +55,16 @@ def moe_all_to_all_ep(lp, x: jax.Array, cfg, axis: str = "tp",
     e_local = lp["w_gate"].shape[0]
     T = B * S
     A = T * k
-    # per-peer send capacity: fair share of this shard's assignments,
-    # padded by the capacity factor for imbalance
-    C = max(1, int(-(-A * capacity_factor // n)))
+    # PER-TOKEN per-peer send capacity (see module docstring): how many
+    # of ONE token's k assignments may target the same peer.  Each
+    # peer's buffer region is [T, C] — token t's sends to that peer
+    # always land in rows t*C..t*C+C-1 regardless of other tokens.
+    # Cost note: the fixed per-token regions carry zero rows for peers a
+    # token skips, so the a2a moves n*T*C rows vs the batch-packed
+    # T*k*cf — the price of content-pure drops; a purity-preserving
+    # compaction (variable per-peer counts need ragged collectives) is
+    # future work.
+    C = max(1, math.ceil(k * float(capacity_factor) / int(n)))
 
     xf = x.reshape(T, h)
     logits = jnp.einsum("th,he->te", xf, lp["router"],
@@ -62,31 +78,34 @@ def moe_all_to_all_ep(lp, x: jax.Array, cfg, axis: str = "tp",
     peer = sel // e_local  # shard owning the expert
     local_e = sel % e_local
 
-    # slot of each assignment within its peer's capacity buffer
-    onehot = jax.nn.one_hot(peer, n, dtype=jnp.int32)  # [A, n]
-    slot = (jnp.cumsum(onehot, axis=0) - onehot)  # prior sends per peer
-    slot = (slot * onehot).sum(-1)  # [A]
+    # slot of each assignment within ITS TOKEN's per-peer quota: count
+    # prior same-peer assignments among the token's own k (cumsum along
+    # the k axis only) — a pure function of the token's routing
+    onehot = jax.nn.one_hot(peer, n, dtype=jnp.int32).reshape(T, k, n)
+    prior = jnp.cumsum(onehot, axis=1) - onehot  # [T, k, n]
+    slot = (prior * onehot).sum(-1).reshape(A)  # [A]
     keep = slot < C
 
     # scatter into send buffers: tokens + (local expert, weight, source
     # assignment) sidecars; dropped/padding slots carry expert id
     # E_LOCAL (a sentinel group the owner computes nothing for)
-    flat = peer * C + jnp.where(keep, slot, 0)
-    send_x = jnp.zeros((n * C, h), x.dtype)
-    send_e = jnp.full((n * C,), e_local, jnp.int32)
+    R = T * C  # rows per peer region
+    flat = peer * R + tok * C + jnp.where(keep, slot, 0)
+    send_x = jnp.zeros((n * R, h), x.dtype)
+    send_e = jnp.full((n * R,), e_local, jnp.int32)
     upd = jnp.where(keep[:, None], xf[tok], 0)
-    send_x = send_x.at[jnp.where(keep, flat, n * C)].set(
+    send_x = send_x.at[jnp.where(keep, flat, n * R)].set(
         upd, mode="drop"
     )
-    send_e = send_e.at[jnp.where(keep, flat, n * C)].set(
+    send_e = send_e.at[jnp.where(keep, flat, n * R)].set(
         local_e, mode="drop"
     )
 
     def a2a(v):
         return jax.lax.all_to_all(
-            v.reshape(n, C, *v.shape[1:]), axis, split_axis=0,
+            v.reshape(n, R, *v.shape[1:]), axis, split_axis=0,
             concat_axis=0, tiled=True,
-        ).reshape(n * C, *v.shape[1:])
+        ).reshape(n * R, *v.shape[1:])
 
     recv_x = a2a(send_x)  # [n*C, h] tokens for MY experts
     recv_e = a2a(send_e)  # [n*C] local expert ids (e_local = hole)
@@ -109,7 +128,7 @@ def moe_all_to_all_ep(lp, x: jax.Array, cfg, axis: str = "tp",
     # zero them before unsorting (NaN would poison the return combine)
     valid_sorted = recv_e[order] < e_local
     ys = jnp.where(valid_sorted[:, None], ys, 0.0)
-    out_rows = jnp.zeros((n * C, h), jnp.float32).at[order].set(ys)
+    out_rows = jnp.zeros((n * R, h), jnp.float32).at[order].set(ys)
 
     # the tiled all_to_all is an involution (block i<->j swap), so the
     # second hop lands each assignment's result back at its send slot

@@ -63,7 +63,13 @@ def build_parser() -> argparse.ArgumentParser:
                          "shards (num_pages becomes per-shard; aggregate "
                          "capacity scales with the mesh)")
     ap.add_argument("--disagg-role", default="both",
-                    choices=["both", "prefill", "decode"])
+                    choices=["both", "prefill", "decode", "encode"],
+                    help="'encode' serves a dedicated vision-encode "
+                         "worker (EPD split; requires --vision)")
+    ap.add_argument("--encode-component", default="", metavar="COMPONENT",
+                    help="offload image encoding to the encode worker "
+                         "registered at this component (this worker "
+                         "then needs no vision tower)")
     # distributed KVBM: shared host/disk/object-store KV tiers
     ap.add_argument("--kvbm", action="store_true",
                     help="attach shared KV tiers via the kvbm bootstrap")
@@ -126,10 +132,22 @@ def check_args(ap: argparse.ArgumentParser, args) -> None:
         ap.error(str(e))
     if args.kvbm and getattr(args, "mock", False):
         ap.error("--kvbm requires a real JAX engine (incompatible with --mock)")
+    if args.disagg_role == "encode" and not args.vision:
+        ap.error("--disagg-role encode requires --vision (the encode "
+                 "worker IS the vision tower)")
+    if args.encode_component and args.vision:
+        ap.error("--encode-component offloads encoding — drop --vision "
+                 "on this worker")
+    if args.encode_component and args.disagg_role in ("prefill", "encode"):
+        ap.error("--encode-component composes with --disagg-role "
+                 "both|decode (prefill workers receive pre-encoded "
+                 "requests from their decode side; encode workers ARE "
+                 "the encoder)")
     if args.mock and (args.quantization != "none"
                       or args.attention_impl != "auto"
                       or args.decode_steps != 1 or args.decode_chain != 1
-                      or args.no_prefix_caching or args.vision):
+                      or args.no_prefix_caching or args.vision
+                      or args.encode_component):
         ap.error("engine-tuning/vision flags require a real JAX engine "
                  "(incompatible with --mock)")
     if args.dp_ranks > 1:
@@ -186,6 +204,11 @@ def main() -> None:
         # real group lifecycle (spawn / any-rank-death / respawn)
         if (args.host_id or 0) > 0:
             print("READY mock-follower", flush=True)
+            # block first or sigwait never consumes them (SIGTERM would
+            # take the kernel default and exit 143; SIGINT would hang)
+            signal.pthread_sigmask(
+                signal.SIG_BLOCK, {signal.SIGTERM, signal.SIGINT}
+            )
             signal.sigwait({signal.SIGTERM, signal.SIGINT})
             return
     else:
@@ -231,7 +254,25 @@ async def _run(args) -> None:
         await KvbmWorker(runtime, engine, namespace=args.namespace).start()
         if leader_task is not None:
             await leader_task
-    if args.disagg_role == "prefill":
+    def wrap_encode(inner):
+        """Outermost wrapper: image requests swap pixels for encoder
+        embeds BEFORE the disagg handler routes them, so remote
+        prefills already carry mm_embeds."""
+        if not args.encode_component:
+            return inner
+        from ..disagg import EncodeOffload
+
+        return EncodeOffload(
+            inner, runtime, namespace=args.namespace,
+            component=args.encode_component,
+        )
+
+    if args.disagg_role == "encode":
+        from ..disagg import serve_encode_worker
+
+        await serve_encode_worker(runtime, engine, mdc,
+                                  namespace=args.namespace)
+    elif args.disagg_role == "prefill":
         from ..disagg import serve_prefill_worker
 
         await serve_prefill_worker(runtime, engine, mdc, namespace=args.namespace)
@@ -243,16 +284,17 @@ async def _run(args) -> None:
             RemoteRouterClient(runtime, args.namespace, args.prefill_router)
             if args.prefill_router else None
         )
-        engine = DisaggDecodeHandler(
+        engine = wrap_encode(DisaggDecodeHandler(
             engine, runtime, namespace=args.namespace,
             prefill_router=prefill_router,
-        )
+        ))
         await serve_engine(
             runtime, engine, mdc,
             namespace=args.namespace, component=args.component,
             endpoint=args.endpoint,
         )
     else:
+        engine = wrap_encode(engine)
         await serve_engine(
             runtime, engine, mdc,
             namespace=args.namespace, component=args.component,
@@ -409,14 +451,20 @@ def _build_engine(args):
                                   pp=args.pp)
     vision = None
     mm_fields = {}
-    if args.vision:
+    if args.vision or args.encode_component:
         import jax
 
         from ..models.vision import init_vision_params, tiny_vision_config
 
         vcfg = tiny_vision_config(out_hidden_size=cfg.hidden_size)
-        vision = (init_vision_params(vcfg, jax.random.PRNGKey(7), dtype=dtype),
-                  vcfg)
+        if args.vision:
+            vision = (
+                init_vision_params(vcfg, jax.random.PRNGKey(7), dtype=dtype),
+                vcfg,
+            )
+        # --encode-component: no local tower, but the model card still
+        # advertises the image surface (preprocessor geometry must match
+        # the encode worker's tower)
         image_ids = tok.encode("<image>")
         if len(image_ids) != 1:
             raise SystemExit("tokenizer has no single-token <image> marker")

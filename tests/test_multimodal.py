@@ -278,3 +278,89 @@ async def test_e2e_http_multimodal_chat():
         await front_rt.shutdown(graceful=False)
         await worker_rt.shutdown(graceful=False)
         await control.stop()
+
+
+# -- EPD split: dedicated encode worker -------------------------------------- #
+
+
+async def test_engine_epd_embeds_path_matches_local_tower():
+    """encode_mm on a vision engine + generate with mm_embeds on a
+    TOWERLESS engine == the single-engine pixels path (the EPD split,
+    VERDICT r3 item 10; reference: trtllm encode_helper)."""
+    tok, cfg, params, vcfg, vparams, mdc = _mm_setup()
+    pre = OpenAIPreprocessor(mdc, tok)
+    out = pre.preprocess_chat({
+        "messages": [{"role": "user", "content": [
+            {"type": "text", "text": "what is this? "},
+            {"type": "image_url", "image_url": {"url": _data_uri((9, 90, 200))}},
+        ]}],
+    })
+
+    local = _engine(cfg, params, vcfg, vparams)
+    want = await _gen(local, out)
+
+    enc = await local.encode_mm({"mm_pixels": out["mm_pixels"]})
+    assert "mm_embeds" in enc and enc.get("cache_salt")
+    await local.shutdown()
+
+    towerless = JaxEngine(
+        cfg, params,
+        EngineConfig(page_size=8, num_pages=128, max_num_seqs=4,
+                     max_prefill_tokens=32, max_model_len=256),
+        kv_dtype=jnp.float32,  # NO vision=
+    )
+    req2 = dict(out)
+    req2.pop("mm_pixels")
+    req2["mm_embeds"] = enc["mm_embeds"]
+    req2["cache_salt"] = enc["cache_salt"]
+    got = await _gen(towerless, req2)
+    await towerless.shutdown()
+    assert got == want
+
+
+async def test_e2e_encode_worker_offload():
+    """Full EPD e2e through the runtime: a dedicated encode worker runs
+    the tower; the chat worker (no tower) offloads via EncodeOffload —
+    outputs equal the single-worker vision path."""
+    from dynamo_tpu.disagg import EncodeOffload, serve_encode_worker
+    from dynamo_tpu.runtime import ControlPlaneServer, DistributedRuntime
+
+    tok, cfg, params, vcfg, vparams, mdc = _mm_setup()
+    pre = OpenAIPreprocessor(mdc, tok)
+    out = pre.preprocess_chat({
+        "messages": [{"role": "user", "content": [
+            {"type": "text", "text": "describe "},
+            {"type": "image_url", "image_url": {"url": _data_uri((120, 4, 66))}},
+        ]}],
+    })
+
+    ref = _engine(cfg, params, vcfg, vparams)
+    want = await _gen(ref, out)
+    await ref.shutdown()
+
+    control = await ControlPlaneServer().start()
+    enc_rt = await DistributedRuntime.connect(control.address)
+    encoder = _engine(cfg, params, vcfg, vparams)
+    await serve_encode_worker(enc_rt, encoder, _mm_setup()[5])
+
+    chat_rt = await DistributedRuntime.connect(control.address)
+    towerless = JaxEngine(
+        cfg, params,
+        EngineConfig(page_size=8, num_pages=128, max_num_seqs=4,
+                     max_prefill_tokens=32, max_model_len=256),
+        kv_dtype=jnp.float32,
+    )
+    chat = EncodeOffload(towerless, chat_rt)
+    try:
+        got = await _gen(chat, out)  # pixels detour to the encoder
+        assert got == want
+        # repeated image reuses the prefix cache consistently (salts
+        # from the encoder match across requests)
+        again = await _gen(chat, out)
+        assert again == want
+    finally:
+        await chat.shutdown()
+        await encoder.shutdown()
+        await chat_rt.shutdown(graceful=False)
+        await enc_rt.shutdown(graceful=False)
+        await control.stop()
