@@ -74,9 +74,12 @@ async def run_round(engine, seed_base, *, batch=BATCH, prompt_len=PROMPT_LEN,
     return total, dt, ttfts[len(ttfts) // 2], itls[len(itls) // 2]
 
 
-async def median_of(engine, rounds=3, gen_tokens=GEN_TOKENS):
+async def median_of(engine, rounds=3, gen_tokens=GEN_TOKENS,
+                    with_samples=False):
     """The tunnel occasionally has whole slow phases (±20%); the MEDIAN
-    of several rounds is robust without inflating like a best-of."""
+    of several rounds is robust without inflating like a best-of.
+    `with_samples` additionally returns the per-round tok/s (for spread
+    reporting)."""
     await run_round(engine, seed_base=0, gen_tokens=gen_tokens)  # compile
     results = [
         await run_round(engine, seed_base=5000 + 999 * r,
@@ -84,7 +87,77 @@ async def median_of(engine, rounds=3, gen_tokens=GEN_TOKENS):
         for r in range(rounds)
     ]
     results.sort(key=lambda res: res[0] / res[1])
-    return results[len(results) // 2]
+    median = results[len(results) // 2]
+    if with_samples:
+        return median, sorted(r[0] / r[1] for r in results)
+    return median
+
+
+async def interleaved_ab(engines, rounds=3, gen_tokens=SUSTAINED_GEN):
+    """A/B-interleave measurement rounds across engines within ONE run:
+    a multi-hour tunnel phase shifts every engine's rounds together, so
+    per-engine medians stay comparable and the reported SPREAD separates
+    environment noise from real regressions (a sequential design lets a
+    phase land on one engine only and silently move the ratio).
+    Returns per-engine (median_tok_s, all_round_tok_s, median_round)."""
+    for e in engines:  # compile everything off the clock
+        await run_round(e, seed_base=0, gen_tokens=gen_tokens)
+    samples = {id(e): [] for e in engines}
+    for r in range(rounds):
+        for e in engines:  # one round each, alternating
+            res = await run_round(e, seed_base=5000 + 999 * r,
+                                  gen_tokens=gen_tokens)
+            samples[id(e)].append(res)
+    out = []
+    for e in engines:
+        rs = samples[id(e)]
+        rates = sorted(r[0] / r[1] for r in rs)
+        rs_sorted = sorted(rs, key=lambda res: res[0] / res[1])
+        out.append((rates[len(rates) // 2], rates,
+                    rs_sorted[len(rs_sorted) // 2]))
+    return out
+
+
+async def goodput_knee(engine, *, rates, n_req, prompt_len, gen, slo,
+                       min_fraction=0.9):
+    """Sweep Poisson offered rates up a ladder until the SLO breaks:
+    reports the max goodput observed under the SLO-met threshold and the
+    knee rate (the reference harness's concurrency sweeps,
+    benchmarking.md:70-75 — one point where attained ≈ offered measures
+    light-load SLO compliance, not capacity)."""
+    sweep = []
+    best_goodput, knee = 0.0, None
+    broken = False
+    for i, rate in enumerate(rates):
+        g = await poisson_goodput(
+            engine, n_req=n_req, rate_rps=rate, prompt_len=prompt_len,
+            gen=gen, slo=slo, seed=17 + i,
+        )
+        point = {
+            "rate_rps": rate,
+            "goodput_tok_s": round(g[0], 2),
+            "attained_tok_s": round(g[1], 2),
+            "ttft_p50_ms": round(g[2], 1),
+            "itl_p99_ms": round(g[3], 2),
+            "slo_met_fraction": round(g[4], 3),
+        }
+        sweep.append(point)
+        if g[4] >= min_fraction and not broken:
+            # knee = top of the CONTIGUOUS passing prefix: a higher rate
+            # passing after a failure is a burst artifact (all arrivals
+            # batch together), not restored capacity
+            knee = rate
+            best_goodput = max(best_goodput, g[0])
+        else:
+            broken = True
+            if g[4] < 0.5:
+                break  # far past the knee — stop burning chip time
+    return {
+        "sweep": sweep,
+        "knee_rate_rps": knee,
+        "max_goodput_at_slo_tok_s": round(best_goodput, 2),
+        "slo": slo,
+    }
 
 
 async def poisson_goodput(engine, *, n_req, rate_rps, prompt_len, gen,
@@ -132,6 +205,49 @@ async def poisson_goodput(engine, *, n_req, rate_rps, prompt_len, gen,
         itls[min(len(itls) - 1, int(len(itls) * 0.99))],
         len(ok) / max(len(results), 1),
     )
+
+
+async def warm_mixed(engine, prompt_len=PROMPT_LEN) -> bool:
+    """Warm prefill/decode/MIXED programs off the clock: solo request
+    first, then overlap a prefill with a LIVE decode until the mixed
+    program has actually compiled (engine._mixed_steps non-empty) — a
+    racy warmup leaks a ~30s tunnel compile into measured TTFTs."""
+    await run_round(engine, 0, batch=1, prompt_len=prompt_len,
+                    gen_tokens=40)
+
+    async def _mixed_warm(seed):
+        first = asyncio.Event()
+
+        async def bg():
+            req = {"token_ids": [(seed + j) % 997 + 1
+                                 for j in range(prompt_len)],
+                   "sampling_options": {"temperature": 0.0},
+                   "stop_conditions": {"max_tokens": 160,
+                                       "ignore_eos": True}}
+            async for out in engine.generate(req):
+                if out["token_ids"]:
+                    first.set()
+            first.set()  # errored/empty streams must not hang the bench
+
+        task = asyncio.get_running_loop().create_task(bg())
+        try:
+            await asyncio.wait_for(first.wait(), timeout=120)
+            # decode is live; the next prefill mixes
+            await run_round(engine, seed + 7, batch=1,
+                            prompt_len=prompt_len, gen_tokens=8)
+        finally:
+            await task
+
+    for attempt in range(4):
+        if engine._mixed_steps:  # noqa: SLF001 — compiled-variant cache
+            return True
+        await _mixed_warm(300 + 40 * attempt)
+    ok = bool(engine._mixed_steps)  # noqa: SLF001
+    if not ok:
+        print("WARNING: mixed-step warmup never compiled; goodput "
+              "TTFTs include an on-clock XLA compile",
+              file=sys.stderr, flush=True)
+    return ok
 
 
 def init_params_int8(cfg, key):
@@ -211,33 +327,64 @@ async def main_async():
             decode_batch_buckets=[BATCH, 2 * BATCH],
             chunk_buckets=[PROMPT_LEN],
             # measured sweeps on the tunneled chip: r2 64x2=1129;
-            # r3 int8 sweep: 96x4=1724 > 96x6=1709 > 64x4=1593 (gen 192)
+            # r3 int8 sweep: 96x4=1724 > 96x6=1709 > 64x4=1593 (gen 192);
+            # r4: fuse_projections +1-3%, dispatch measured ~0 (the 1B
+            # ceiling is device-side small-kernel efficiency, not host)
             decode_steps=steps,
             decode_chain=chain,
             mixed_prefill_tokens=mixed,
             enable_prefix_caching=False,  # raw compute, not cache hits
             quantization=quant,
+            fuse_projections=True,
         )
 
-    # headline (round-1/2 protocol for vs_baseline comparability)
+    # headline (round-1/2 protocol for vs_baseline comparability) — the
+    # per-round samples ride the JSON so a tunnel-phase dip is visible
+    # as spread rather than a silent regression
     engine = JaxEngine(cfg, params, ecfg("none", 64, 4, gen=GEN_TOKENS),
                        eos_token_ids=[])
-    total, dt, ttft_p50, itl_p50 = await median_of(engine)
+    (total, dt, ttft_p50, itl_p50), head_rates = await median_of(
+        engine, with_samples=True
+    )
     await engine.shutdown()
     out["value"] = round(total / dt, 2)
     out["ttft_p50_ms"] = round(ttft_p50 * 1000, 1)
     out["itl_p50_ms"] = round(itl_p50 * 1000, 2)
+    out["headline_samples_tok_s"] = [round(r, 1) for r in head_rates]
+    out["headline_spread"] = round(
+        max(head_rates) / max(min(head_rates), 1e-9), 3
+    )
+    out["measurement_notes"] = (
+        "in-run spreads are tight (<2-8%); cross-RUN deltas (r2 1072 / "
+        "r3 942 on identical protocol) come from multi-hour tunnel "
+        "phases that shift whole runs together — the interleaved A/B "
+        "phases + per-round samples here bound what environment can "
+        "hide. int8-1B profiling: host dispatch ~0s per plan; the 1B "
+        "ceiling is device-side small-kernel efficiency (~250 GB/s "
+        "effective vs ~500 on 8B shapes); fuse_projections buys 1-3%."
+    )
 
-    # sustained (192-token generations, tuned dispatch)
-    engine = JaxEngine(cfg, params, ecfg("none", 64, 4), eos_token_ids=[])
-    t_b, dt_b, _, itl_idle = await median_of(engine,
-                                             gen_tokens=SUSTAINED_GEN)
-    await engine.shutdown()
-    engine = JaxEngine(cfg, params, ecfg("int8", 96, 4), eos_token_ids=[])
-    t_q, dt_q, _, _ = await median_of(engine, gen_tokens=SUSTAINED_GEN)
-    await engine.shutdown()
-    bf16_sus, int8_sus = t_b / dt_b, t_q / dt_q
+    # sustained (192-token generations, tuned dispatch): bf16 and int8
+    # rounds INTERLEAVE within one run so a tunnel phase moves both —
+    # per-phase samples + spread ride the JSON (a headline that can
+    # silently lose 12% to environment is not a measurement)
+    e_bf = JaxEngine(cfg, params, ecfg("none", 64, 4), eos_token_ids=[])
+    e_q = JaxEngine(cfg, params, ecfg("int8", 96, 4), eos_token_ids=[])
+    (bf16_sus, bf_rates, bf_med), (int8_sus, q_rates, _) = (
+        await interleaved_ab([e_bf, e_q], rounds=3)
+    )
+    itl_idle = bf_med[3]
+    await e_bf.shutdown()
+    await e_q.shutdown()
+    del e_bf, e_q  # drop the fused weight copies before the 8B phases
     out["int8_tok_s"] = round(int8_sus, 2)
+    out["phase_samples_tok_s"] = {
+        "bf16": [round(r, 1) for r in bf_rates],
+        "int8": [round(r, 1) for r in q_rates],
+        "spread_bf16": round(max(bf_rates) / max(min(bf_rates), 1e-9), 3),
+        "spread_int8": round(max(q_rates) / max(min(q_rates), 1e-9), 3),
+        "int8_vs_bf16_sustained": round(int8_sus / max(bf16_sus, 1e-9), 3),
+    }
 
     # goodput under SLO, 1B: Poisson arrivals over the mixed scheduler
     # (prefills ride decode dispatches — ITL stays flat under load).
@@ -252,52 +399,30 @@ async def main_async():
         decode_batch_buckets=[16], chunk_buckets=[PROMPT_LEN],
         table_width_buckets=[16], decode_steps=16, decode_chain=2,
         mixed_prefill_tokens=PROMPT_LEN, enable_prefix_caching=False,
-        quantization="int8",
+        quantization="int8", fuse_projections=True,
     ), eos_token_ids=[])
     # warmup: solo request (prefill + decode programs), then overlap a
     # prefill with a LIVE decode until the mixed program has actually
     # compiled (engine._mixed_steps non-empty) — a racy warmup here
     # leaks a ~30s tunnel compile into the measured TTFTs
-    await run_round(engine, 0, batch=1, gen_tokens=40)
-
-    async def _mixed_warm(seed):
-        first = asyncio.Event()
-
-        async def bg():
-            req = {"token_ids": [(seed + j) % 997 + 1
-                                 for j in range(PROMPT_LEN)],
-                   "sampling_options": {"temperature": 0.0},
-                   "stop_conditions": {"max_tokens": 160,
-                                       "ignore_eos": True}}
-            async for out in engine.generate(req):
-                if out["token_ids"]:
-                    first.set()
-            first.set()  # errored/empty streams must not hang the bench
-
-        task = asyncio.get_running_loop().create_task(bg())
-        try:
-            await asyncio.wait_for(first.wait(), timeout=120)
-            # decode is live; the next prefill mixes
-            await run_round(engine, seed + 7, batch=1, gen_tokens=8)
-        finally:
-            await task
-
-    mixed_warm_ok = True
-    for attempt in range(4):
-        if engine._mixed_steps:  # noqa: SLF001 — compiled-variant cache
-            break
-        await _mixed_warm(300 + 40 * attempt)
-    else:
-        mixed_warm_ok = bool(engine._mixed_steps)  # noqa: SLF001
-        if not mixed_warm_ok:
-            print("WARNING: mixed-step warmup never compiled; goodput "
-                  "TTFTs include an on-clock XLA compile",
-                  file=sys.stderr, flush=True)
-    g1 = await poisson_goodput(
-        engine, n_req=20, rate_rps=4.0, prompt_len=PROMPT_LEN, gen=96,
-        slo=SLO_1B,
+    mixed_warm_ok = await warm_mixed(engine)
+    # rate LADDER up to the knee: one light-load point where attained ≈
+    # offered measures SLO compliance, not capacity (VERDICT r3 item 3)
+    k1 = await goodput_knee(
+        engine, rates=[2.0, 4.0, 8.0, 16.0], n_req=20,
+        prompt_len=PROMPT_LEN, gen=96, slo=SLO_1B,
     )
+    # the rate-4 point keeps round-3 field compatibility
+    g1 = next((
+        (p["goodput_tok_s"], p["attained_tok_s"], p["ttft_p50_ms"],
+         p["itl_p99_ms"], p["slo_met_fraction"])
+        for p in k1["sweep"] if p["rate_rps"] == 4.0
+    ), None) or (0.0, 0.0, 0.0, 0.0, 0.0)
     await engine.shutdown()
+    del engine  # fused 1B copy — free before the 8B weights arrive
+    import gc
+
+    gc.collect()
 
     # 8B int8 on the chip (~8 GB of weights initialized on device)
     cfg8 = LLAMA_3_1_8B
@@ -311,15 +436,40 @@ async def main_async():
         prefill_batch_size=BATCH, max_model_len=PROMPT_LEN + SUSTAINED_GEN + 16,
         decode_batch_buckets=[BATCH], chunk_buckets=[PROMPT_LEN],
         decode_steps=64, decode_chain=4, enable_prefix_caching=False,
+        # no fusion at 8B: concatenating ~8GB of resident weights doubles
+        # peak HBM (OOM), and the 4096-wide kernels are already large
+        # enough to run bandwidth-bound
     )
     engine8 = JaxEngine(cfg8, params8, e8, eos_token_ids=[])
     t8, dt8, ttft8, itl8 = await median_of(engine8,
                                            gen_tokens=SUSTAINED_GEN)
-    # batch-round goodput proxy (one shared arrival burst)
-    ok8 = 1.0 if (ttft8 * 1e3 <= SLO_8B["ttft_ms"]
-                  and itl8 * 1e3 <= SLO_8B["itl_ms"]) else 0.0
     await engine8.shutdown()
     tps8 = t8 / dt8
+
+    # 8B goodput: REAL Poisson arrivals over the mixed scheduler (the
+    # round-3 batch-burst proxy is gone), swept up a rate ladder to the
+    # knee.  Shapes pinned to one prefill/decode/chunk bucket each so
+    # the programs all warm off the clock
+    engine8g = JaxEngine(cfg8, params8, EngineConfig(
+        page_size=16, num_pages=1 + 12 * 16 + 32, max_num_seqs=8,
+        max_prefill_tokens=PROMPT_LEN, prefill_batch_size=1,
+        max_model_len=PROMPT_LEN + 96 + 16,
+        decode_batch_buckets=[8], chunk_buckets=[PROMPT_LEN],
+        table_width_buckets=[16], decode_steps=16, decode_chain=2,
+        mixed_prefill_tokens=PROMPT_LEN, enable_prefix_caching=False,
+    ), eos_token_ids=[])
+    mixed_warm_ok8 = await warm_mixed(engine8g)
+    k8 = await goodput_knee(
+        engine8g, rates=[0.5, 1.0, 2.0, 4.0], n_req=12,
+        prompt_len=PROMPT_LEN, gen=64, slo=SLO_8B,
+    )
+    await engine8g.shutdown()
+    # release the ~8GB of 8B weights before the remaining 1B phases —
+    # holding them through the ISL-2000 + prefix-cache engines OOMs HBM
+    del engine8, engine8g, params8
+    import gc
+
+    gc.collect()
 
     gb_1b_bf16 = cfg.num_params() * 2 / 1e9
     gb_1b_int8 = quantized_param_bytes(cfg) / 1e9
@@ -342,15 +492,44 @@ async def main_async():
             "ttft_p50_under_load_ms": round(g1[2], 1),
             "itl_p99_under_prefill_ms": round(g1[3], 2),
             "itl_p50_idle_ms": round(itl_idle * 1e3, 2),
+            "max_goodput_at_slo_tok_s": k1["max_goodput_at_slo_tok_s"],
+            "knee_rate_rps": k1["knee_rate_rps"],
+            "goodput_sweep": k1["sweep"],
         },
         "llama-3.1-8b-int8": {
+            **({} if mixed_warm_ok8 else {"goodput_warmup_failed": True}),
             "tok_s": round(tps8, 2),
             "ttft_p50_ms": round(ttft8 * 1e3, 1),
             "itl_p50_ms": round(itl8 * 1e3, 2),
             "weight_read_gbps": round(tps8 / BATCH * gb_8b_int8, 1),
-            "goodput_at_slo_tok_s": round(tps8 * ok8, 2),
+            "max_goodput_at_slo_tok_s": k8["max_goodput_at_slo_tok_s"],
+            "knee_rate_rps": k8["knee_rate_rps"],
+            "goodput_sweep": k8["sweep"],
             "slo": SLO_8B,
         },
+    }
+
+    # reference-protocol operating point: ISL 2000 / OSL 256
+    # (benchmarking.md:70-75) on the 1B bf16 engine
+    PI, GI, BI = 2000, 256, 4
+    pages_i = (PI + GI) // 16 + 2
+    engine_i = JaxEngine(cfg, params, EngineConfig(
+        page_size=16, num_pages=1 + BI * pages_i + 16, max_num_seqs=BI,
+        max_prefill_tokens=2048, prefill_batch_size=1,
+        max_model_len=PI + GI + 16, decode_batch_buckets=[BI],
+        chunk_buckets=[2048], decode_steps=64, decode_chain=4,
+        enable_prefix_caching=False, fuse_projections=True,
+    ), eos_token_ids=[])
+    await run_round(engine_i, 0, batch=BI, prompt_len=PI, gen_tokens=8)
+    ti, dti, ttft_i, itl_i = await run_round(
+        engine_i, 9000, batch=BI, prompt_len=PI, gen_tokens=GI,
+    )
+    await engine_i.shutdown()
+    out["isl2000_osl256"] = {
+        "tok_s": round(ti / dti, 2),
+        "ttft_p50_ms": round(ttft_i * 1e3, 1),
+        "itl_p50_ms": round(itl_i * 1e3, 2),
+        "batch": BI,
     }
 
     # prefix-cache TTFT win (the reference headlines a 40% TTFT

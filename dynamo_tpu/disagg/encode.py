@@ -30,9 +30,11 @@ async def serve_encode_worker(
     engine,
     mdc,
     namespace: str = "dynamo",
+    component: str = ENCODE_COMPONENT,
 ):
     """Serve the engine's vision tower as a standalone encode worker at
-    {ns}.encoder.generate (disagg_role=encode: frontends skip it)."""
+    {ns}.{component}.generate (disagg_role=encode: frontends skip it).
+    Serving workers' `--encode-component` must name the same component."""
     from ..worker import serve_engine
 
     class EncodeFacade:
@@ -59,7 +61,7 @@ async def serve_encode_worker(
     mdc.disagg_role = "encode"
     return await serve_engine(
         runtime, EncodeFacade(engine), mdc,
-        namespace=namespace, component=ENCODE_COMPONENT,
+        namespace=namespace, component=component,
     )
 
 

@@ -58,6 +58,14 @@ class EngineConfig:
     # symmetric; halves weight HBM traffic on the decode hot path)
     quantization: str = "none"
 
+    # fuse q/k/v (and dense gate/up) weights into single larger matmuls
+    # (models.llama.fuse_projections — numerically identical).  At small
+    # hidden sizes / batch, seven small per-layer weight reads leave HBM
+    # bandwidth idle behind per-kernel overheads; four larger reads keep
+    # the decode loop bandwidth-bound.  Single-device engines only (the
+    # fused output axis doesn't carry the megatron tp specs yet)
+    fuse_projections: bool = False
+
     # attention implementation: "auto" resolves to the Pallas streaming
     # kernels (ops/pallas_attention.py) on single-device TPU and the XLA
     # einsum path otherwise; "pallas"/"xla" force one

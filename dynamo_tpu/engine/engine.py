@@ -877,6 +877,15 @@ class JaxEngine:
             from ..models.quantization import quantize_params
 
             params = quantize_params(params)
+        if self.cfg.fuse_projections:
+            if self.mesh is not None:
+                raise ValueError(
+                    "fuse_projections is single-device only (the fused "
+                    "output axis does not carry the megatron tp specs)"
+                )
+            from ..models.llama import fuse_projections
+
+            params = fuse_projections(params)
         # vision tower (multimodal): embeds computed engine-side at first
         # prefill of the sequence, injected in place of placeholder tokens
         self.vision = vision
@@ -1711,16 +1720,38 @@ class JaxEngine:
 
     def _consume_decode(self, dispatches, rows, Bb, with_top) -> None:
         """Fetch + account a decode chain's outputs over a row layout
-        (callers manage deferred frees around in-flight dispatches)."""
+        (callers manage deferred frees around in-flight dispatches).
+
+        Rows that provably cannot stop inside the block take a BATCH
+        path: one extend + one page commit + one delivery for the whole
+        T-token block instead of T Python iterations — at decode_steps
+        64-96 × chain 4 a single plan carries thousands of tokens, and
+        the per-token loop (check_stop + queue item each) was a
+        measurable share of serving throughput on real chips."""
         for packed_d in dispatches:
             out, logp, tids, tlps = self._unpack_rows(
                 np.asarray(jax.device_get(packed_d)), Bb, with_top,
                 blocks=self._decode_blocks,
             )  # [T, B] each
+            T = out.shape[0]
             for i, s in enumerate(rows):
                 if s is None or s.status != "running":
                     continue
-                for t in range(out.shape[0]):
+                if (
+                    s.opts.ignore_eos
+                    and not s.opts.stop_token_ids
+                    and not s.opts.stop_sequences
+                    and len(s.output_tokens) + T < s.opts.max_tokens
+                    and s.total_len + T < self.cfg.max_model_len
+                    and s.num_computed + T <= self.cfg.hard_cap
+                ):
+                    s.num_computed += T
+                    s.output_tokens.extend(int(x) for x in out[:, i])
+                    self.scheduler.commit_full_pages(s)
+                    self._deliver_block(s, out[:, i], logp[:, i],
+                                        tids, tlps, i, with_top)
+                    continue
+                for t in range(T):
                     s.num_computed += 1
                     self.scheduler.commit_full_pages(s)
                     self._append_token(
@@ -1729,6 +1760,27 @@ class JaxEngine:
                     )
                     if s.status != "running":
                         break  # stop hit mid-block; rest discarded
+
+    def _deliver_block(self, seq: Sequence, toks, logps, tids, tlps,
+                       col: int, with_top: bool) -> None:
+        """One queue item for a whole decode block (fast path: the block
+        was appended without stop checks — none can hit)."""
+        queue = self._queues.get(seq.request_id)
+        if queue is None:
+            return
+        out = {
+            "token_ids": [int(x) for x in toks],
+            "finish_reason": None,
+        }
+        if seq.opts.logprobs:
+            out["log_probs"] = [float(x) for x in logps]
+        k = seq.opts.top_logprobs
+        if with_top and k and tids is not None:
+            out["top_logprobs"] = [
+                _tops_for(seq, tids, tlps, (t, col))
+                for t in range(len(out["token_ids"]))
+            ]
+        self._loop.call_soon_threadsafe(queue.put_nowait, out)
 
     def _run_mixed(self, plan: StepPlan) -> None:
         """One dispatch: bounded prefill chunk + decode block (the mixed

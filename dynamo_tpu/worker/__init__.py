@@ -182,6 +182,11 @@ async def serve_engine(
         served.metrics_publisher = metrics_pub
     ranks = engine.dp_ranks if isinstance(engine, DpRankEngine) else 1
     inner = engine.engines[0] if isinstance(engine, DpRankEngine) else engine
+    # unwrap handler/offload wrappers (DisaggDecodeHandler, EncodeOffload
+    # — each delegates to `.engine`) so the model card still advertises
+    # the real engine's page size / context / runtime config
+    while not isinstance(inner, JaxEngine) and hasattr(inner, "engine"):
+        inner = inner.engine
     if isinstance(inner, JaxEngine):
         if "embedding" not in mdc.types:
             mdc.model_type = mdc.model_type + ",embedding"

@@ -58,6 +58,10 @@ def build_parser() -> argparse.ArgumentParser:
                          "max_prefill_tokens, 0 disables mixing "
                          "(prefill-first scheduling)")
     ap.add_argument("--no-prefix-caching", action="store_true")
+    ap.add_argument("--fuse-projections", action="store_true",
+                    help="fuse qkv + gate/up weight reads (single-device "
+                         "engines; numerically identical, faster decode "
+                         "at small hidden sizes)")
     ap.add_argument("--kv-partition", action="store_true",
                     help="partition the KV pool across the mesh's dp*sp "
                          "shards (num_pages becomes per-shard; aggregate "
@@ -159,6 +163,7 @@ def check_args(ap: argparse.ArgumentParser, args) -> None:
             (args.kvbm, "--kvbm"),
             (args.mock, "--mock"),
             (bool(args.coordinator), "--coordinator (multihost)"),
+            (bool(args.encode_component), "--encode-component"),
         ]:
             if bad:
                 ap.error(f"--dp-ranks > 1 is incompatible with {flag}")
@@ -182,6 +187,7 @@ def engine_config_from_args(args):
         mixed_prefill_tokens=args.mixed_prefill_tokens,
         kv_partition=args.kv_partition,
         enable_prefix_caching=not args.no_prefix_caching,
+        fuse_projections=args.fuse_projections,
     )
 
 
@@ -269,9 +275,15 @@ async def _run(args) -> None:
 
     if args.disagg_role == "encode":
         from ..disagg import serve_encode_worker
+        from ..disagg.encode import ENCODE_COMPONENT
 
-        await serve_encode_worker(runtime, engine, mdc,
-                                  namespace=args.namespace)
+        # registers at --component ("encoder" when left at the worker
+        # default) — serving workers point --encode-component at it
+        await serve_encode_worker(
+            runtime, engine, mdc, namespace=args.namespace,
+            component=(args.component if args.component != "backend"
+                       else ENCODE_COMPONENT),
+        )
     elif args.disagg_role == "prefill":
         from ..disagg import serve_prefill_worker
 

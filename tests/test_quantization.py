@@ -117,3 +117,53 @@ async def test_quantized_engine_on_tp_mesh():
     )
     got = await run(par)
     assert got == want
+
+
+async def test_fused_projections_match_unfused():
+    """fuse_projections (qkv + gate/up concat) is numerically identical:
+    greedy, sampled, and penalized outputs equal the unfused engine —
+    bf16-path and int8-path both (the bench's decode hot-loop
+    optimization must not change a single token)."""
+    import asyncio
+
+    from dynamo_tpu.engine import EngineConfig, JaxEngine
+    from dynamo_tpu.models import init_params, tiny_config
+
+    cfg = tiny_config(attention_bias=True)  # qwen-style bias: bqkv path
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+
+    def make(quant, fused):
+        return JaxEngine(
+            cfg, params,
+            EngineConfig(page_size=8, num_pages=96, max_num_seqs=4,
+                         max_prefill_tokens=32, max_model_len=128,
+                         quantization=quant, fuse_projections=fused),
+            eos_token_ids=[], kv_dtype=jnp.float32,
+        )
+
+    def req(p, i):
+        so = {"temperature": 0.0}
+        if i == 1:
+            so = {"temperature": 0.9, "seed": 7}
+        if i == 2:
+            so = {"temperature": 0.0, "frequency_penalty": 0.6}
+        return {"token_ids": p, "sampling_options": so,
+                "stop_conditions": {"max_tokens": 8, "ignore_eos": True}}
+
+    async def run(engine):
+        async def one(i):
+            p = [(11 * i + j) % cfg.vocab_size for j in range(6 + 5 * i)]
+            toks = []
+            async for d in engine.generate(req(p, i)):
+                assert d.get("finish_reason") != "error", d
+                toks += d["token_ids"]
+            return toks
+
+        outs = await asyncio.gather(*[one(i) for i in range(3)])
+        await engine.shutdown()
+        return outs
+
+    for quant in ("none", "int8"):
+        plain = await run(make(quant, False))
+        fused = await run(make(quant, True))
+        assert fused == plain, quant
