@@ -1,0 +1,42 @@
+{{/* Image reference */}}
+{{- define "dynamo-tpu.image" -}}
+{{ .Values.image.repository }}:{{ .Values.image.tag }}
+{{- end -}}
+
+{{/* Control-plane address as seen from pods in the release namespace */}}
+{{- define "dynamo-tpu.controlAddress" -}}
+control-plane.{{ .Release.Namespace }}.svc:{{ .Values.controlPlane.port }}
+{{- end -}}
+
+{{/* Common labels */}}
+{{- define "dynamo-tpu.labels" -}}
+app.kubernetes.io/part-of: dynamo-tpu
+app.kubernetes.io/managed-by: {{ .Release.Service }}
+app.kubernetes.io/instance: {{ .Release.Name }}
+{{- end -}}
+
+{{/*
+Render a component's args map as CLI flags, matching
+dynamo_tpu/deploy/graph.py ComponentSpec.command: true -> bare flag,
+false/null -> omitted, else --key value (underscores become dashes).
+Scope: a dict {"args": map}.
+*/}}
+{{- define "dynamo-tpu.argFlags" -}}
+{{- range $k, $v := .args }}
+{{- if eq (toString $v) "true" }} --{{ $k | replace "_" "-" }}
+{{- else if eq (toString $v) "false" }}
+{{- else if kindIs "invalid" $v }}
+{{- else }} --{{ $k | replace "_" "-" }} {{ $v }}
+{{- end }}
+{{- end }}
+{{- end -}}
+
+{{/* Module for a component kind (graph.py _KIND_MODULE) */}}
+{{- define "dynamo-tpu.module" -}}
+{{- if eq . "frontend" }}dynamo_tpu.frontend
+{{- else if eq . "worker" }}dynamo_tpu.worker
+{{- else if eq . "router" }}dynamo_tpu.router
+{{- else if eq . "planner" }}dynamo_tpu.planner
+{{- else }}{{ fail (printf "unknown component kind %q" .) }}
+{{- end }}
+{{- end -}}

@@ -1,18 +1,28 @@
 """Deployment: declarative component graphs rendered to local processes or
-Kubernetes manifests (the reference's operator/CRD layer, redesigned as a
-renderer + launcher)."""
+Kubernetes manifests, reconciled by a flag-driven controller or a
+watch-based operator over the control-plane deployment store, fronted by
+a model-aware inference gateway (the reference's operator/CRD +
+inference-gateway layer, redesigned TPU-side)."""
 
 from .controller import GraphController, K8sActuator, LocalActuator
+from .gateway import InferenceGateway, register_frontend
 from .graph import ComponentSpec, GraphSpec, LocalLauncher, format_commands
 from .k8s import render_manifests
+from .operator import Operator, apply, delete_deployment, get_status
 
 __all__ = [
     "ComponentSpec",
     "GraphController",
     "GraphSpec",
+    "InferenceGateway",
     "K8sActuator",
     "LocalActuator",
     "LocalLauncher",
+    "Operator",
+    "apply",
+    "delete_deployment",
     "format_commands",
+    "get_status",
+    "register_frontend",
     "render_manifests",
 ]

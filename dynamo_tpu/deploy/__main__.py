@@ -14,7 +14,94 @@ from .graph import GraphSpec, LocalLauncher, format_commands
 logger = logging.getLogger(__name__)
 
 
+_VERBS = {"apply", "delete", "status", "operator", "gateway"}
+
+
+def _verb_main(argv) -> None:
+    """kubectl-style verbs against the deployment store
+    (`/deployments/{name}/spec` documents reconciled by `operator`)."""
+    import asyncio
+
+    verb, rest = argv[0], argv[1:]
+    if verb == "gateway":
+        from . import gateway as gw
+
+        args = gw.build_parser().parse_args(rest)
+        logging.basicConfig(level=args.log_level.upper())
+        asyncio.run(gw._amain(args))
+        return
+
+    ap = argparse.ArgumentParser(f"dynamo_tpu.deploy {verb}")
+    ap.add_argument("--control", required=True,
+                    help="control plane host:port")
+    if verb == "apply":
+        ap.add_argument("--config", required=True, help="graph YAML path")
+        ap.add_argument("--name", default="",
+                        help="deployment name (default: namespace from "
+                             "the spec)")
+    elif verb in ("delete", "status"):
+        ap.add_argument("--name", required=True)
+    else:  # operator
+        ap.add_argument("--interval", type=float, default=1.0)
+        ap.add_argument("--k8s-actuate", action="store_true")
+        ap.add_argument("--log-level", default="info")
+    args = ap.parse_args(rest)
+
+    async def run() -> None:
+        from ..runtime.transport.control_plane import ControlPlaneClient
+        from . import operator as op
+
+        if verb == "operator":
+            from ..runtime import DistributedRuntime
+
+            logging.basicConfig(level=args.log_level.upper())
+            rt = await DistributedRuntime.connect(args.control)
+            operator = await op.Operator(
+                rt, args.control, interval=args.interval,
+                k8s=args.k8s_actuate,
+            ).start()
+            print(f"READY operator control={args.control}", flush=True)
+            stop = asyncio.Event()
+            loop = asyncio.get_running_loop()
+            for sig in (signal.SIGINT, signal.SIGTERM):
+                loop.add_signal_handler(sig, stop.set)
+            await stop.wait()
+            # signal-driven shutdown is an operator RESTART, not a
+            # teardown: on k8s the objects must keep serving (the next
+            # operator re-adopts them); local child processes would be
+            # orphaned with no handle, so those do stop.  Teardown is
+            # only ever the explicit `delete` verb.
+            await operator.stop(stop_replicas=not args.k8s_actuate)
+            await rt.shutdown(graceful=False)
+            return
+
+        client = await ControlPlaneClient(args.control).connect()
+        try:
+            if verb == "apply":
+                with open(args.config) as f:
+                    text = f.read()
+                name = args.name or GraphSpec.parse(text).namespace
+                gen = await op.apply(client, name, text)
+                print(f"deployment {name} applied (generation {gen})")
+            elif verb == "delete":
+                await op.delete_deployment(client, args.name)
+                print(f"deployment {args.name} deleted")
+            else:  # status
+                import json
+
+                st = await op.get_status(client, args.name)
+                print(json.dumps(st, indent=2, sort_keys=True)
+                      if st else f"deployment {args.name}: no status")
+        finally:
+            await client.close()
+
+    asyncio.run(run())
+
+
 def main() -> None:
+    if len(sys.argv) > 1 and sys.argv[1] in _VERBS:
+        _verb_main(sys.argv[1:])
+        return
     ap = argparse.ArgumentParser("dynamo_tpu.deploy")
     ap.add_argument("--config", required=True, help="graph YAML path")
     ap.add_argument("--control", default="",

@@ -220,7 +220,8 @@ class GraphController:
 
     def __init__(self, spec: GraphSpec, control: str,
                  runtime: Optional[DistributedRuntime] = None,
-                 actuator=None, interval: float = 1.0, stdout=None):
+                 actuator=None, interval: float = 1.0, stdout=None,
+                 status_cb=None):
         self.spec = spec
         self.control = control
         self.runtime = runtime
@@ -234,9 +235,73 @@ class GraphController:
         self._comp: Dict[str, ComponentSpec] = {
             c.name: c for c in spec.components
         }
+        # components dropped from the spec but whose replicas are still
+        # draining: reconciled to 0 until observed 0, then forgotten
+        self._retired: Dict[str, ComponentSpec] = {}
+        # components whose definition changed: bounce to 0 this pass so
+        # the next pass brings them up with the new argv
+        self._restart: set = set()
         self._task: Optional[asyncio.Task] = None
         self._wake = asyncio.Event()
         self.reconciles = 0
+        # async callback invoked with the post-pass status dict — the
+        # operator uses it to publish /deployments/{name}/status
+        self.status_cb = status_cb
+
+    def update_spec(self, spec: GraphSpec) -> None:
+        """Adopt a new desired spec (the operator's CRD-update path,
+        reference: DynamoGraphDeployment reconcile on resource change).
+        Removed components drain to 0; changed components bounce so
+        replicas restart with the new argv; spec replica counts reset
+        any planner override (the planner re-merges on the next tick,
+        exactly like a re-applied k8s resource).  The namespace is
+        immutable (like most CRD identity fields): the actuator and the
+        planner targets key are namespace-scoped at construction, so a
+        rename would silently split state — delete and re-apply
+        instead."""
+        if spec.namespace != self.spec.namespace:
+            raise ValueError(
+                f"namespace is immutable ({self.spec.namespace!r} -> "
+                f"{spec.namespace!r}); delete the deployment and apply "
+                f"it under the new namespace"
+            )
+        new_names = {c.name for c in spec.components}
+        for name, comp in list(self._comp.items()):
+            if name not in new_names:
+                self._retired[name] = comp
+                self._comp.pop(name)
+                self.desired.pop(name, None)
+        for comp in spec.components:
+            old = self._comp.get(comp.name)
+            if (self._retired.pop(comp.name, None) is not None
+                    and not isinstance(self.actuator, K8sActuator)):
+                # re-added while its old replicas may still be draining:
+                # bounce so survivors can't keep running the old argv
+                # (on k8s the template never changed — no point killing
+                # healthy pods; the replica patch alone converges)
+                self._restart.add(comp.name)
+            if old is not None and (
+                old.kind != comp.kind or old.args != comp.args
+                or old.multinode != comp.multinode
+            ):
+                if isinstance(self.actuator, K8sActuator):
+                    # a replica bounce cannot deliver a new argv there:
+                    # the pod template lives in the rendered manifests,
+                    # and patching spec.replicas 0->N would disrupt for
+                    # zero effect — the template must be re-applied
+                    # (helm upgrade / kubectl apply of --render k8s)
+                    logger.warning(
+                        "%s: definition changed but the k8s actuator "
+                        "only scales replicas — re-apply the rendered "
+                        "manifests for the new args to take effect",
+                        comp.name,
+                    )
+                else:
+                    self._restart.add(comp.name)
+            self._comp[comp.name] = comp
+            self.desired[comp.name] = comp.replicas
+        self.spec = spec
+        self._wake.set()
 
     @property
     def targets_key(self) -> str:
@@ -284,8 +349,20 @@ class GraphController:
         await self._merge_planner_targets()
         loop = asyncio.get_running_loop()
         status = {}
-        for name, comp in self._comp.items():
-            want = self.desired[name]
+        for name, comp in list(self._comp.items()):
+            want = self.desired.get(name)
+            if want is None:
+                continue  # removed by a concurrent update_spec mid-pass
+            if name in self._restart:
+                # definition changed: drain now, rebuild next pass
+                await loop.run_in_executor(
+                    None, self.actuator.scale_to, comp, 0
+                )
+                self._restart.discard(name)
+                self._wake.set()  # converge back up promptly
+                status[name] = {"desired": want, "observed": 0,
+                                "restarting": True}
+                continue
             have = await loop.run_in_executor(
                 None, self.actuator.observed, comp
             )
@@ -294,7 +371,27 @@ class GraphController:
                     None, self.actuator.scale_to, comp, want
                 )
             status[name] = {"desired": want, "observed": have}
+        for name, comp in list(self._retired.items()):
+            have = await loop.run_in_executor(
+                None, self.actuator.observed, comp
+            )
+            if have is None:
+                # actuator error (e.g. kubectl timeout) — NOT drained;
+                # keep the component retired and retry next pass
+                status[name] = {"desired": 0, "observed": None}
+            elif have:
+                await loop.run_in_executor(
+                    None, self.actuator.scale_to, comp, 0
+                )
+                status[name] = {"desired": 0, "observed": have}
+            else:
+                self._retired.pop(name)
         self.reconciles += 1
+        if self.status_cb is not None:
+            try:
+                await self.status_cb(status)
+            except Exception:  # noqa: BLE001 — status is best-effort
+                logger.exception("status callback failed")
         return status
 
     async def scale(self, name: str, replicas: int) -> None:
@@ -309,13 +406,16 @@ class GraphController:
 
     async def _loop(self) -> None:
         while True:
+            # clear BEFORE reconciling: a wake set during the pass
+            # (update_spec/scale from another task, the restart bounce)
+            # must shorten the next sleep, not be discarded
+            self._wake.clear()
             try:
                 await self.reconcile()
             except asyncio.CancelledError:
                 raise
             except Exception:  # noqa: BLE001 — the loop must survive
                 logger.exception("reconcile pass failed")
-            self._wake.clear()
             try:
                 await asyncio.wait_for(self._wake.wait(), self.interval)
             except asyncio.TimeoutError:
@@ -326,6 +426,19 @@ class GraphController:
             self._task.cancel()
             await asyncio.gather(self._task, return_exceptions=True)
         if stop_replicas:
-            await asyncio.get_running_loop().run_in_executor(
-                None, self.actuator.stop_all
-            )
+            loop = asyncio.get_running_loop()
+            # scale everything to 0 THROUGH the actuator first: for k8s
+            # this is the only teardown there is (stop_all is a no-op —
+            # the objects outlive the controller), for local it starts
+            # the graceful SIGTERM drain that stop_all then reaps
+            for comp in list(self._comp.values()) + list(
+                self._retired.values()
+            ):
+                try:
+                    await loop.run_in_executor(
+                        None, self.actuator.scale_to, comp, 0
+                    )
+                except Exception:  # noqa: BLE001 — teardown continues
+                    logger.exception("scale-to-0 of %s failed during "
+                                     "stop", comp.name)
+            await loop.run_in_executor(None, self.actuator.stop_all)

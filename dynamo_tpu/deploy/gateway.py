@@ -1,0 +1,420 @@
+"""Inference gateway: a model-aware L7 proxy in front of one or more
+deployment graphs — the TPU-stack equivalent of the reference's
+inference-gateway integration (/root/reference/deploy/inference-gateway/,
+the k8s Gateway API "endpoint picker" (EPP) that selects a backend pod
+per request from an InferencePool).
+
+Where the reference plugs an EPP into Envoy, here the gateway is a
+first-party aiohttp proxy with the same job split:
+
+- **endpoint discovery**: frontends self-register in the control plane
+  under their primary lease (`register_frontend`, key
+  `/http/frontends/{lease}`), so the live backend set tracks lease
+  expiry exactly like worker instance discovery does.
+- **model index**: the gateway watches the `/models` card prefix on each
+  control plane, so it knows which *deployment* (control plane) can
+  serve a request's `model` before picking an endpoint within it.
+- **endpoint picking**: least-outstanding-requests among healthy
+  frontends of the deployments that serve the model, with a short
+  cooldown after connect failures and one retry on a fresh backend if
+  the first connect fails before any response bytes were streamed.
+
+Multiple `--control` addresses federate several deployment graphs (e.g.
+one per model family) behind a single OpenAI-compatible address.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from aiohttp import ClientSession, ClientTimeout, client_exceptions, web
+
+from ..llm.model_card import MODEL_ROOT
+from ..runtime.transport.control_plane import ControlPlaneClient
+from ..runtime.transport.wire import pack, unpack
+
+logger = logging.getLogger(__name__)
+
+FRONTEND_ROOT = "/http/frontends"
+
+# headers that must not be forwarded verbatim by a proxy
+_HOP_HEADERS = {
+    "host", "connection", "keep-alive", "transfer-encoding", "upgrade",
+    "proxy-authorization", "proxy-connection", "te", "trailer",
+    "content-length",
+}
+
+
+async def register_frontend(runtime, port: int, scheme: str = "http") -> str:
+    """Publish this frontend's HTTP address under the runtime's primary
+    lease so gateways discover it (and lose it when the lease expires).
+    Returns the registration key."""
+    key = f"{FRONTEND_ROOT}/{runtime.primary_lease}"
+    addr = f"{scheme}://{runtime._advertise_host}:{port}"  # noqa: SLF001
+    await runtime.control.put(
+        key, pack({"url": addr}), lease=runtime.primary_lease
+    )
+    return key
+
+
+@dataclass
+class _Backend:
+    url: str
+    key: str
+    cp: int  # index into the gateway's control-plane list
+    inflight: int = 0
+    cooldown_until: float = 0.0
+
+    def healthy(self) -> bool:
+        return time.monotonic() >= self.cooldown_until
+
+
+@dataclass
+class _Deployment:
+    """Gateway-side view of one control plane: its frontends and the
+    model names currently carded there."""
+
+    address: str
+    client: Optional[ControlPlaneClient] = None
+    backends: Dict[str, _Backend] = field(default_factory=dict)
+    # card key → model name (cards are per-instance; a model is served
+    # while at least one card names it)
+    cards: Dict[str, str] = field(default_factory=dict)
+
+    def models(self) -> Set[str]:
+        return set(self.cards.values())
+
+
+class InferenceGateway:
+    def __init__(self, controls: List[str], host: str = "0.0.0.0",
+                 port: int = 8080, cooldown: float = 2.0,
+                 connect_timeout: float = 5.0, ca_path: str = "",
+                 insecure: bool = False):
+        if not controls:
+            raise ValueError("gateway needs at least one --control address")
+        self.host = host
+        self.port = port
+        self.cooldown = cooldown
+        self.connect_timeout = connect_timeout
+        # TLS trust for https backends: default system store; ca_path
+        # trusts a private CA (the repo's own self-signed TLS path);
+        # insecure disables verification outright
+        self._backend_ssl: Any = None
+        if insecure:
+            self._backend_ssl = False
+        elif ca_path:
+            import ssl
+
+            self._backend_ssl = ssl.create_default_context(cafile=ca_path)
+        self.deployments = [_Deployment(address=a) for a in controls]
+        self._rr = 0
+        self._tasks: List[asyncio.Task] = []
+        self._runner: Optional[web.AppRunner] = None
+        self._session: Optional[ClientSession] = None
+        self.app = web.Application()
+        self.app.router.add_get("/health", self._health)
+        self.app.router.add_get("/live", self._health)
+        self.app.router.add_get("/v1/models", self._models)
+        self.app.router.add_route("*", "/{tail:.*}", self._proxy)
+
+    # -- lifecycle ----------------------------------------------------------- #
+
+    async def start(self) -> "InferenceGateway":
+        # no total timeout: streamed completions run for minutes
+        import aiohttp
+
+        self._session = ClientSession(
+            timeout=ClientTimeout(total=None, connect=self.connect_timeout),
+            connector=aiohttp.TCPConnector(ssl=self._backend_ssl)
+            if self._backend_ssl is not None else None,
+        )
+        for i, dep in enumerate(self.deployments):
+            dep.client = await ControlPlaneClient(dep.address).connect()
+            self._tasks.append(asyncio.create_task(
+                self._watch(i, FRONTEND_ROOT, self._on_frontend_event)
+            ))
+            self._tasks.append(asyncio.create_task(
+                self._watch(i, MODEL_ROOT, self._on_card_event)
+            ))
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        for s in site._server.sockets:  # noqa: SLF001
+            self.port = s.getsockname()[1]
+            break
+        logger.info("inference gateway on %s:%d over %d deployment(s)",
+                    self.host, self.port, len(self.deployments))
+        return self
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        self._tasks.clear()
+        if self._runner:
+            await self._runner.cleanup()
+        if self._session:
+            await self._session.close()
+        for dep in self.deployments:
+            if dep.client is not None:
+                await dep.client.close()
+
+    # -- discovery ----------------------------------------------------------- #
+
+    async def _watch(self, cp: int, prefix: str, on_event) -> None:
+        """One watch loop per (control plane, prefix); reconnects with
+        backoff so a restarted control plane re-syncs the snapshot."""
+        dep = self.deployments[cp]
+        while True:
+            try:
+                stream = await dep.client.watch_prefix(prefix)
+                async for ev in stream:
+                    if ev.type in ("put", "delete"):
+                        on_event(cp, ev)
+                # a dropped control-plane connection ends the stream
+                # NORMALLY (WatchStream yields None) — same flush as the
+                # exception path: stale state must not route, the
+                # re-watch snapshot rebuilds it
+                logger.warning("gateway watch %s on %s ended; rewatching",
+                               prefix, dep.address)
+                on_event(cp, None)
+                await asyncio.sleep(1.0)
+            except asyncio.CancelledError:
+                raise
+            except (ConnectionError, RuntimeError, OSError) as e:
+                logger.warning("gateway watch %s on %s lost (%s); retrying",
+                               prefix, dep.address, e)
+                on_event(cp, None)  # flush: stale state must not route
+                await asyncio.sleep(1.0)
+
+    def _on_frontend_event(self, cp: int, ev) -> None:
+        dep = self.deployments[cp]
+        if ev is None:
+            dep.backends.clear()
+            return
+        if ev.type == "delete":
+            dep.backends.pop(ev.key, None)
+            return
+        try:
+            url = str(unpack(ev.value)["url"]).rstrip("/")
+        except Exception:  # noqa: BLE001 — a bad registration is skipped
+            logger.warning("unparseable frontend registration at %s", ev.key)
+            return
+        old = dep.backends.get(ev.key)
+        if old is not None and old.url == url:
+            return
+        dep.backends[ev.key] = _Backend(url=url, key=ev.key, cp=cp)
+        logger.info("gateway: frontend %s at %s", ev.key, url)
+
+    def _on_card_event(self, cp: int, ev) -> None:
+        dep = self.deployments[cp]
+        if ev is None:
+            dep.cards.clear()
+            return
+        if ev.type == "delete":
+            dep.cards.pop(ev.key, None)
+            return
+        try:
+            dep.cards[ev.key] = str(unpack(ev.value)["name"])
+        except Exception:  # noqa: BLE001
+            logger.warning("unparseable model card at %s", ev.key)
+
+    # -- endpoint picking ---------------------------------------------------- #
+
+    def pick(self, model: Optional[str],
+             exclude: Tuple[Tuple[int, str], ...] = ()) -> Optional[_Backend]:
+        """EPP decision: among deployments that serve `model` (all of
+        them when no model field is present — e.g. GET endpoints), the
+        healthy backend with the fewest outstanding requests; round-robin
+        breaks ties so equal-load backends share work.  `exclude`
+        entries are (cp, key) pairs — lease-derived keys alone collide
+        across federated control planes (each numbers leases from the
+        same counter)."""
+        candidates: List[_Backend] = []
+        for dep in self.deployments:
+            if model is not None and model not in dep.models():
+                continue
+            candidates.extend(
+                b for b in dep.backends.values()
+                if b.healthy() and (b.cp, b.key) not in exclude
+            )
+        if not candidates:
+            return None
+        low = min(b.inflight for b in candidates)
+        tied = [b for b in candidates if b.inflight == low]
+        self._rr += 1
+        return tied[self._rr % len(tied)]
+
+    def serves(self, model: str) -> bool:
+        return any(model in dep.models() for dep in self.deployments)
+
+    # -- handlers ------------------------------------------------------------ #
+
+    async def _health(self, request: web.Request) -> web.Response:
+        return web.json_response({
+            "status": "healthy",
+            "deployments": [
+                {
+                    "control": dep.address,
+                    "frontends": [b.url for b in dep.backends.values()],
+                    "models": sorted(dep.models()),
+                }
+                for dep in self.deployments
+            ],
+        })
+
+    async def _models(self, request: web.Request) -> web.Response:
+        """Aggregated /v1/models across every federated deployment —
+        built from the gateway's own card index (the same source the
+        frontends' own listings come from)."""
+        seen: Dict[str, Dict[str, Any]] = {}
+        for dep in self.deployments:
+            for name in sorted(dep.models()):
+                seen.setdefault(name, {
+                    "id": name, "object": "model",
+                    "created": int(time.time()), "owned_by": "dynamo-tpu",
+                })
+        return web.json_response(
+            {"object": "list", "data": list(seen.values())}
+        )
+
+    async def _proxy(self, request: web.Request) -> web.StreamResponse:
+        body = await request.read()
+        model: Optional[str] = None
+        if body:
+            try:
+                parsed = json.loads(body)
+                if isinstance(parsed, dict):
+                    model = parsed.get("model")
+            except (ValueError, UnicodeDecodeError):
+                pass
+        if model is not None and not self.serves(model):
+            return web.json_response(
+                {"error": {"message": f"model {model!r} is not served by "
+                                      f"any federated deployment",
+                           "type": "model_not_found"}},
+                status=404,
+            )
+        tried: List[Tuple[int, str]] = []
+        # one retry on a different backend — only safe while no response
+        # bytes have been committed, i.e. on connect-phase failures
+        for _ in range(2):
+            backend = self.pick(model, exclude=tuple(tried))
+            if backend is None:
+                break
+            tried.append((backend.cp, backend.key))
+            try:
+                return await self._forward(request, body, backend)
+            except (client_exceptions.ClientConnectionError,
+                    asyncio.TimeoutError):
+                backend.cooldown_until = time.monotonic() + self.cooldown
+                logger.warning("gateway: backend %s unreachable; cooling "
+                               "down %.1fs", backend.url, self.cooldown)
+        return web.json_response(
+            {"error": {"message": "no live frontend can take this request",
+                       "type": "service_unavailable"}},
+            status=503,
+        )
+
+    async def _forward(self, request: web.Request, body: bytes,
+                       backend: _Backend) -> web.StreamResponse:
+        """Relay one request.  Failures BEFORE `resp.prepare()` propagate
+        as connect errors (retryable — nothing was sent to the client);
+        once the response is committed, a backend death mid-stream must
+        NOT retry (the POST is non-idempotent and the client already has
+        a status line + partial body) — the stream just ends truncated,
+        which SSE clients see as an aborted generation."""
+        headers = {k: v for k, v in request.headers.items()
+                   if k.lower() not in _HOP_HEADERS}
+        headers["X-Forwarded-For"] = request.remote or ""
+        url = backend.url + request.rel_url.raw_path
+        if request.rel_url.raw_query_string:
+            url += "?" + request.rel_url.raw_query_string
+        backend.inflight += 1
+        try:
+            async with self._session.request(
+                request.method, url, data=body if body else None,
+                headers=headers,
+            ) as upstream:
+                out_headers = {
+                    k: v for k, v in upstream.headers.items()
+                    if k.lower() not in _HOP_HEADERS
+                }
+                resp = web.StreamResponse(status=upstream.status,
+                                          headers=out_headers)
+                await resp.prepare(request)
+                try:
+                    # chunk-for-chunk relay: SSE deltas flush as they
+                    # arrive
+                    async for chunk in upstream.content.iter_any():
+                        await resp.write(chunk)
+                    await resp.write_eof()
+                except (client_exceptions.ClientConnectionError,
+                        client_exceptions.ClientPayloadError,
+                        asyncio.TimeoutError):
+                    backend.cooldown_until = (
+                        time.monotonic() + self.cooldown
+                    )
+                    logger.warning(
+                        "gateway: backend %s dropped mid-stream; "
+                        "truncating the relayed response", backend.url,
+                    )
+                return resp
+        finally:
+            backend.inflight -= 1
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        "dynamo_tpu.deploy.gateway",
+        description="model-aware inference gateway over deployment graphs",
+    )
+    ap.add_argument("--control", action="append", required=True,
+                    help="control-plane host:port (repeat to federate "
+                         "several deployments)")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=8080)
+    ap.add_argument("--cooldown", type=float, default=2.0,
+                    help="seconds a backend sits out after a connect "
+                         "failure")
+    ap.add_argument("--ca", default="",
+                    help="PEM CA bundle to trust for https backends "
+                         "(self-signed frontend certs)")
+    ap.add_argument("--insecure", action="store_true",
+                    help="skip TLS verification of https backends")
+    ap.add_argument("--log-level", default="info")
+    return ap
+
+
+async def _amain(args) -> None:
+    import signal
+
+    gw = await InferenceGateway(
+        args.control, host=args.host, port=args.port,
+        cooldown=args.cooldown, ca_path=args.ca, insecure=args.insecure,
+    ).start()
+    print(f"READY gateway http://{args.host}:{gw.port} "
+          f"deployments={len(gw.deployments)}", flush=True)
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    await gw.stop()
+
+
+def main() -> None:
+    args = build_parser().parse_args()
+    logging.basicConfig(level=args.log_level.upper())
+    asyncio.run(_amain(args))
+
+
+if __name__ == "__main__":
+    main()

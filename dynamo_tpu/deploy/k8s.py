@@ -67,6 +67,14 @@ def _control_manifests(ns: str, image: str) -> List[Dict[str, Any]]:
     ]
 
 
+def _podip_env() -> Dict[str, Any]:
+    """Endpoints and frontend registrations must advertise a
+    cross-pod-dialable address, not loopback (runtime.py reads
+    DYN_ADVERTISE_HOST)."""
+    return {"name": "DYN_ADVERTISE_HOST",
+            "valueFrom": {"fieldRef": {"fieldPath": "status.podIP"}}}
+
+
 def _add_tpu_resources(container: Dict[str, Any], comp: ComponentSpec) -> None:
     """One chip per WORKER replica by default (GKE TPU scheduling);
     `tpu_resources` in args overrides; non-worker kinds get none."""
@@ -104,6 +112,7 @@ def _multinode_manifest(comp: ComponentSpec, ns: str, image: str,
         "image": image,
         "command": ["sh", "-c", shell],
         "ports": [{"containerPort": mn.coordinator_port}],
+        "env": [_podip_env()],
     }
     _add_tpu_resources(container, comp)
     return [
@@ -155,6 +164,7 @@ def _component_manifest(comp: ComponentSpec, ns: str, image: str,
         "name": comp.name,
         "image": image,
         "command": argv,
+        "env": [_podip_env()],
     }
     out: List[Dict[str, Any]] = []
     _add_tpu_resources(container, comp)

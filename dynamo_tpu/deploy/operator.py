@@ -1,0 +1,275 @@
+"""Watch-based deployment operator: graph specs live IN the control
+plane and a long-running operator reconciles every one of them.
+
+This is the TPU stack's analog of the reference's Kubernetes operator
+(/root/reference/deploy/cloud/operator/ — `DynamoGraphDeployment` CRD +
+controller): the custom resource becomes a document under
+`/deployments/{name}/spec` in the control-plane KV, `apply`/`delete`
+are the kubectl verbs, and the operator is the controller-manager —
+it watches the prefix, runs one `GraphController` per deployment, and
+publishes `/deployments/{name}/status` (per-component desired/observed
+counts + observedGeneration) after every reconcile pass, mirroring the
+CRD's status subresource.
+
+Differences from the flag-driven `--controller` mode in `__main__`:
+that mode loads ONE spec from a file at startup; this mode is
+level-triggered on the *spec store* — `apply` a changed document and
+the running operator converges on it (replica changes scale in place,
+arg changes bounce the component, removed components drain), `delete`
+tears the deployment down.  Several deployments reconcile side by side.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Dict, Optional
+
+from ..runtime import DistributedRuntime
+from ..runtime.transport.control_plane import ControlPlaneClient
+from ..runtime.transport.wire import pack, unpack
+from .controller import GraphController, K8sActuator, LocalActuator
+from .graph import GraphSpec
+
+logger = logging.getLogger(__name__)
+
+DEPLOYMENTS_ROOT = "/deployments"
+
+
+def spec_key(name: str) -> str:
+    return f"{DEPLOYMENTS_ROOT}/{name}/spec"
+
+
+def status_key(name: str) -> str:
+    return f"{DEPLOYMENTS_ROOT}/{name}/status"
+
+
+def _name_of(key: str) -> Optional[str]:
+    parts = key.split("/")
+    # /deployments/{name}/spec
+    if len(parts) == 4 and parts[1] == "deployments" and parts[3] == "spec":
+        return parts[2]
+    return None
+
+
+async def apply(control: ControlPlaneClient, name: str,
+                yaml_text: str) -> int:
+    """`kubectl apply` analog: validate + store the spec document,
+    bumping its generation.  Returns the new generation."""
+    GraphSpec.parse(yaml_text)  # reject malformed specs at apply time
+    generation = 1
+    existing = await control.get(spec_key(name))
+    if existing:
+        doc = unpack(existing)
+        generation = int(doc.get("generation", 0)) + 1
+        if doc.get("yaml") == yaml_text:
+            return int(doc.get("generation", generation))  # unchanged
+    await control.put(
+        spec_key(name), pack({"yaml": yaml_text, "generation": generation})
+    )
+    return generation
+
+
+async def delete_deployment(control: ControlPlaneClient, name: str) -> None:
+    await control.delete(spec_key(name))
+
+
+async def get_status(control: ControlPlaneClient,
+                     name: str) -> Optional[dict]:
+    data = await control.get(status_key(name))
+    return unpack(data) if data else None
+
+
+class _Managed:
+    def __init__(self, controller: GraphController, generation: int,
+                 yaml_text: str):
+        self.controller = controller
+        self.generation = generation
+        # the yaml actually APPLIED: dedupe compares content, not just
+        # generation, so a lost-update race between two `apply`s (both
+        # read gen N, both write N+1) still converges on the stored doc
+        self.yaml = yaml_text
+
+
+class Operator:
+    """One process reconciling every deployment document it can see."""
+
+    def __init__(self, runtime: DistributedRuntime, control_address: str,
+                 interval: float = 1.0, k8s: bool = False, stdout=None):
+        self.runtime = runtime
+        self.control_address = control_address
+        self.interval = interval
+        self.k8s = k8s
+        self.stdout = stdout
+        self._managed: Dict[str, _Managed] = {}
+        # last status payload written per deployment (minus updated_at):
+        # converged deployments must not churn the KV/watch fan-out
+        # every interval
+        self._last_status: Dict[str, tuple] = {}
+        self._task: Optional[asyncio.Task] = None
+        self.synced = asyncio.Event()  # set once the snapshot replayed
+
+    async def start(self) -> "Operator":
+        self._task = asyncio.create_task(self._watch_loop())
+        return self
+
+    async def stop(self, stop_replicas: bool = True) -> None:
+        if self._task:
+            self._task.cancel()
+            await asyncio.gather(self._task, return_exceptions=True)
+        for name in list(self._managed):
+            await self._drop(name, stop_replicas=stop_replicas,
+                             clear_status=False)
+
+    async def _watch_loop(self) -> None:
+        while True:
+            try:
+                stream = await self.runtime.control.watch_prefix(
+                    DEPLOYMENTS_ROOT
+                )
+                # spec names seen in this connection's snapshot: on
+                # "sync", any managed deployment NOT in it was deleted
+                # while the watch was down and must be dropped —
+                # otherwise an orphaned controller keeps respawning
+                # replicas (and republishing status) forever
+                snapshot: set = set()
+                pre_sync = True
+                async for ev in stream:
+                    if ev.type == "sync":
+                        pre_sync = False
+                        for gone in [n for n in self._managed
+                                     if n not in snapshot]:
+                            logger.warning(
+                                "deployment %s: vanished while watch "
+                                "was down — tearing down", gone,
+                            )
+                            await self._drop(gone)
+                        self.synced.set()
+                        continue
+                    name = _name_of(ev.key)
+                    if name is None:
+                        continue  # status keys etc.
+                    if pre_sync and ev.type == "put":
+                        snapshot.add(name)
+                    try:
+                        if ev.type == "put":
+                            await self._apply_doc(name, unpack(ev.value))
+                        elif ev.type == "delete":
+                            await self._drop(name)
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception:  # noqa: BLE001 — one bad document
+                        # (unparseable msgpack, non-dict payload) must
+                        # not kill reconciliation for every deployment
+                        logger.exception(
+                            "deployment %s: event handling failed", name
+                        )
+                # connection loss ends the stream NORMALLY (WatchStream
+                # yields None) — pause, then re-watch; the fresh
+                # snapshot + the sync pruning above resolve anything
+                # missed during the gap
+                logger.warning("operator watch ended; rewatching")
+                await asyncio.sleep(1.0)
+            except asyncio.CancelledError:
+                raise
+            except (ConnectionError, RuntimeError, OSError) as e:
+                logger.warning("operator watch lost (%s); retrying", e)
+                await asyncio.sleep(1.0)
+
+    async def _apply_doc(self, name: str, doc: dict) -> None:
+        try:
+            spec = GraphSpec.parse(doc["yaml"])
+        except (KeyError, ValueError, TypeError, AttributeError) as e:
+            logger.error("deployment %s: bad spec document: %s", name, e)
+            if name not in self._managed:
+                # never clobber a RUNNING deployment's status with
+                # generation-0/{}: its reconcile keeps reporting the
+                # spec that actually runs
+                await self._write_status(name, 0, {}, error=str(e))
+            return
+        generation = int(doc.get("generation", 0))
+        managed = self._managed.get(name)
+        if managed is not None:
+            if (generation == managed.generation
+                    and doc.get("yaml") == managed.yaml):
+                return  # replayed snapshot of what we already run
+            logger.info("deployment %s: generation %d -> %d", name,
+                        managed.generation, generation)
+            try:
+                managed.controller.update_spec(spec)
+            except ValueError as e:  # e.g. immutable-field change
+                # generation is NOT advanced: observed_generation keeps
+                # naming the spec that actually runs
+                logger.error("deployment %s: rejected update: %s", name, e)
+                await self._write_status(
+                    name, managed.generation, {}, error=str(e)
+                )
+                return
+            managed.generation = generation
+            managed.yaml = doc.get("yaml", "")
+            return
+        # namespace is the actuation scope (planner targets key, spawned
+        # --namespace, k8s object names): two deployments sharing one
+        # would fight over the same objects every interval
+        for other_name, other in self._managed.items():
+            if other.controller.spec.namespace == spec.namespace:
+                msg = (f"namespace {spec.namespace!r} is already owned "
+                       f"by deployment {other_name!r}")
+                logger.error("deployment %s: rejected: %s", name, msg)
+                await self._write_status(name, generation, {}, error=msg)
+                return
+        logger.info("deployment %s: adopting (generation %d, %d "
+                    "components)", name, generation, len(spec.components))
+
+        async def _status_cb(status, _name=name):
+            m = self._managed.get(_name)
+            await self._write_status(
+                _name, m.generation if m else generation, status
+            )
+
+        actuator = (K8sActuator(spec.namespace) if self.k8s
+                    else LocalActuator(self.control_address,
+                                       stdout=self.stdout,
+                                       namespace=spec.namespace))
+        controller = GraphController(
+            spec, self.control_address, runtime=self.runtime,
+            actuator=actuator, interval=self.interval,
+            status_cb=_status_cb,
+        )
+        self._managed[name] = _Managed(controller, generation,
+                                       doc.get("yaml", ""))
+        await controller.start()
+
+    async def _drop(self, name: str, stop_replicas: bool = True,
+                    clear_status: bool = True) -> None:
+        managed = self._managed.pop(name, None)
+        self._last_status.pop(name, None)
+        if managed is None:
+            return
+        logger.info("deployment %s: deleting (stop_replicas=%s)", name,
+                    stop_replicas)
+        await managed.controller.stop(stop_replicas=stop_replicas)
+        if clear_status:
+            try:
+                await self.runtime.control.delete(status_key(name))
+            except (ConnectionError, RuntimeError):
+                pass
+
+    async def _write_status(self, name: str, generation: int,
+                            components: dict, error: str = "") -> None:
+        fingerprint = (repr(sorted(components.items())), generation, error)
+        if self._last_status.get(name) == fingerprint:
+            return  # converged: no KV churn, no watch fan-out
+        doc = {
+            "components": components,
+            "observed_generation": generation,
+            "updated_at": time.time(),
+        }
+        if error:
+            doc["error"] = error
+        try:
+            await self.runtime.control.put(status_key(name), pack(doc))
+            self._last_status[name] = fingerprint
+        except (ConnectionError, RuntimeError):
+            pass  # status is best-effort; the next pass retries

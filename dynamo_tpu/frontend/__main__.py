@@ -18,6 +18,10 @@ def build_parser() -> argparse.ArgumentParser:
     _env_control = RuntimeConfig.from_env().control
     ap.add_argument("--control", required=not _env_control, default=_env_control, help="control plane host:port")
     ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--advertise-host", default="",
+                    help="address gateways should dial to reach this "
+                         "frontend (default: DYN_ADVERTISE_HOST, else "
+                         "127.0.0.1)")
     ap.add_argument("--namespace", default="dynamo",
                     help="accepted for graph-launcher symmetry; model cards "
                          "carry their own namespace and the watcher follows "
@@ -61,7 +65,9 @@ async def _run(args) -> None:
     from ..runtime import DistributedRuntime
     from . import HttpService, ModelManager, ModelWatcher
 
-    runtime = await DistributedRuntime.connect(args.control)
+    runtime = await DistributedRuntime.connect(
+        args.control, advertise_host=args.advertise_host or None
+    )
     manager = ModelManager()
     kv_factory = None
     if args.router_mode == "kv":
@@ -83,6 +89,13 @@ async def _run(args) -> None:
         tls_cert=args.tls_cert, tls_key=args.tls_key,
         enabled_routes=enabled,
     ).start()
+    # self-register for inference gateways (lease-scoped, like worker
+    # instance discovery): deploy/gateway.py watches this key space
+    from ..deploy.gateway import register_frontend
+
+    await register_frontend(
+        runtime, http.port, scheme="https" if args.tls_cert else "http"
+    )
     kserve = None
     if args.grpc_port >= 0:
         from ..grpc import KserveGrpcService

@@ -15,6 +15,9 @@ deployments configure processes without rewriting commands:
   DYN_COMPUTE_THREADS  compute-pool size (tokenization etc.)
   DYN_AUDIT_SINK       audit sink spec ("file:/path/audit.jsonl")
   DYN_MODEL_CACHE      local model cache directory (hub)
+  DYN_ADVERTISE_HOST   address other processes should dial to reach
+                       this one (k8s: the pod IP via fieldRef) — used
+                       for endpoint serving and frontend registration
 """
 
 from __future__ import annotations
@@ -67,6 +70,7 @@ class RuntimeConfig:
     compute_threads: int = 0  # 0 → auto
     audit_sink: str = ""
     model_cache: str = ""
+    advertise_host: str = ""
 
     @classmethod
     def from_env(cls) -> "RuntimeConfig":
@@ -83,6 +87,7 @@ class RuntimeConfig:
             compute_threads=env_int("DYN_COMPUTE_THREADS", 0),
             audit_sink=env_str("DYN_AUDIT_SINK"),
             model_cache=env_str("DYN_MODEL_CACHE"),
+            advertise_host=env_str("DYN_ADVERTISE_HOST"),
         )
 
 

@@ -41,7 +41,13 @@ class DistributedRuntime:
         self.service_client = ServiceClient()
         self.service_server: ServiceServer | None = None
         self.primary_lease: int = 0
-        self._advertise_host = advertise_host or "127.0.0.1"
+        # dialable-from-other-hosts address: explicit arg, else
+        # DYN_ADVERTISE_HOST (k8s: pod IP via fieldRef), else loopback
+        from .config import RuntimeConfig as _RC
+
+        self._advertise_host = (
+            advertise_host or _RC.from_env().advertise_host or "127.0.0.1"
+        )
         self._lease_ttl = lease_ttl
         self._keepalive_task: asyncio.Task | None = None
         self._embedded_server: ControlPlaneServer | None = None

@@ -1,0 +1,360 @@
+"""Watch-based operator (deploy/operator.py) and inference gateway
+(deploy/gateway.py): the CRD-analog deployment store + reconciler and the
+endpoint-picker proxy (reference: deploy/cloud/operator/ CRD controller,
+deploy/inference-gateway/ EPP)."""
+
+import asyncio
+import os
+
+import aiohttp
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.deploy import (
+    InferenceGateway,
+    Operator,
+    apply,
+    delete_deployment,
+    get_status,
+    register_frontend,
+)
+from dynamo_tpu.deploy.gateway import _Backend
+from dynamo_tpu.engine import EngineConfig, JaxEngine
+from dynamo_tpu.frontend import HttpService, ModelManager, ModelWatcher
+from dynamo_tpu.llm import ModelDeploymentCard
+from dynamo_tpu.models import init_params, tiny_config
+from dynamo_tpu.runtime import ControlPlaneServer, DistributedRuntime
+from dynamo_tpu.testing import tiny_tokenizer
+from dynamo_tpu.worker import serve_engine
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+GRAPH_V1 = """
+namespace: opns
+components:
+  decode:
+    kind: worker
+    replicas: 1
+    args: {model: tiny, mock: true, component: backend, platform: cpu}
+"""
+
+GRAPH_V2 = """
+namespace: opns
+components:
+  decode:
+    kind: worker
+    replicas: 2
+    args: {model: tiny, mock: true, component: backend, platform: cpu}
+  prefill:
+    kind: worker
+    replicas: 1
+    args: {model: tiny, mock: true, component: prefill, platform: cpu}
+"""
+
+GRAPH_V3 = """
+namespace: opns
+components:
+  decode:
+    kind: worker
+    replicas: 1
+    args: {model: tiny, mock: true, component: backend, platform: cpu}
+"""
+
+
+async def _instances(rt, ns, comp, n, timeout=90.0):
+    ep = rt.namespace(ns).component(comp).endpoint("generate")
+    client = ep.client()
+    await client.start()
+    deadline = asyncio.get_running_loop().time() + timeout
+    ids = []
+    while asyncio.get_running_loop().time() < deadline:
+        ids = client.instance_ids()
+        if len(ids) == n:
+            await client.stop()
+            return ids
+        await asyncio.sleep(0.25)
+    await client.stop()
+    raise AssertionError(f"expected {n} instances for {comp}, have {ids}")
+
+
+async def test_operator_apply_update_delete():
+    """The full CRD lifecycle: apply brings a deployment up, a changed
+    document reshapes it in place, delete drains it — all through the
+    control-plane spec store, no operator restarts."""
+    os.environ.setdefault("PYTHONPATH", ROOT)
+    control = await ControlPlaneServer().start()
+    rt = await DistributedRuntime.connect(control.address)
+    op = await Operator(rt, control.address, interval=0.3).start()
+    try:
+        gen = await apply(rt.control, "graph-a", GRAPH_V1)
+        assert gen == 1
+        await _instances(rt, "opns", "backend", 1)
+
+        # re-applying the identical document is a no-op (same generation)
+        assert await apply(rt.control, "graph-a", GRAPH_V1) == 1
+
+        # v2: decode scales to 2, a prefill component appears
+        assert await apply(rt.control, "graph-a", GRAPH_V2) == 2
+        await _instances(rt, "opns", "backend", 2)
+        await _instances(rt, "opns", "prefill", 1)
+
+        # status subresource reflects the converged state + generation
+        deadline = asyncio.get_running_loop().time() + 30
+        st = None
+        while asyncio.get_running_loop().time() < deadline:
+            st = await get_status(rt.control, "graph-a")
+            if (st and st.get("observed_generation") == 2
+                    and st["components"].get("decode", {}).get("observed") == 2
+                    and st["components"].get("prefill", {}).get("observed") == 1):
+                break
+            await asyncio.sleep(0.25)
+        assert st and st["observed_generation"] == 2, st
+        assert st["components"]["decode"] == {"desired": 2, "observed": 2}
+
+        # v3: prefill removed → drains; decode shrinks to 1
+        assert await apply(rt.control, "graph-a", GRAPH_V3) == 3
+        await _instances(rt, "opns", "backend", 1)
+        await _instances(rt, "opns", "prefill", 0)
+
+        # delete: everything goes away, status key cleared
+        await delete_deployment(rt.control, "graph-a")
+        await _instances(rt, "opns", "backend", 0)
+        deadline = asyncio.get_running_loop().time() + 15
+        while asyncio.get_running_loop().time() < deadline:
+            if await get_status(rt.control, "graph-a") is None:
+                break
+            await asyncio.sleep(0.25)
+        assert await get_status(rt.control, "graph-a") is None
+    finally:
+        await op.stop()
+        await rt.shutdown(graceful=False)
+        await control.stop()
+
+
+async def test_operator_rejects_namespace_change():
+    """The namespace is deployment identity: a re-applied doc renaming
+    it is rejected and observed_generation keeps naming the spec that
+    actually runs (the actuator/targets key are namespace-scoped)."""
+    os.environ.setdefault("PYTHONPATH", ROOT)
+    control = await ControlPlaneServer().start()
+    rt = await DistributedRuntime.connect(control.address)
+    op = await Operator(rt, control.address, interval=0.3).start()
+    try:
+        await apply(rt.control, "graph-ns", GRAPH_V1)
+        await _instances(rt, "opns", "backend", 1)
+        await apply(rt.control, "graph-ns",
+                    GRAPH_V1.replace("namespace: opns", "namespace: other"))
+        # the deployment keeps running the gen-1 spec; status never
+        # claims the rejected generation landed
+        await asyncio.sleep(1.5)
+        st = await get_status(rt.control, "graph-ns")
+        assert st["observed_generation"] == 1, st
+        await _instances(rt, "opns", "backend", 1)
+    finally:
+        await op.stop()
+        await rt.shutdown(graceful=False)
+        await control.stop()
+
+
+async def test_operator_prunes_deployments_deleted_during_outage():
+    """A control-plane restart with an empty store must not leave an
+    orphaned controller running: the re-watch snapshot prunes managed
+    deployments whose spec document vanished."""
+    os.environ.setdefault("PYTHONPATH", ROOT)
+    control = await ControlPlaneServer().start()
+    host, port = control.address.rsplit(":", 1)
+    rt = await DistributedRuntime.connect(control.address)
+    op = await Operator(rt, control.address, interval=0.3).start()
+    try:
+        await apply(rt.control, "graph-gone", GRAPH_V1)
+        await _instances(rt, "opns", "backend", 1)
+        # the control plane dies and comes back EMPTY on the same port
+        # (the deployment store did not survive)
+        await control.stop()
+        control = await ControlPlaneServer(host=host,
+                                           port=int(port)).start()
+        # operator re-watches, sees no spec for graph-gone, tears the
+        # replicas down
+        deadline = asyncio.get_running_loop().time() + 60
+        while asyncio.get_running_loop().time() < deadline:
+            if "graph-gone" not in op._managed:  # noqa: SLF001
+                break
+            await asyncio.sleep(0.25)
+        assert "graph-gone" not in op._managed  # noqa: SLF001
+    finally:
+        await op.stop()
+        await rt.shutdown(graceful=False)
+        await control.stop()
+
+
+async def test_operator_rejects_bad_spec():
+    control = await ControlPlaneServer().start()
+    rt = await DistributedRuntime.connect(control.address)
+    try:
+        try:
+            await apply(rt.control, "bad", "components: {}")
+            raise AssertionError("apply accepted an empty graph")
+        except ValueError:
+            pass
+    finally:
+        await rt.shutdown(graceful=False)
+        await control.stop()
+
+
+# -- gateway ---------------------------------------------------------------- #
+
+
+async def _serving_stack(model_name: str):
+    """One deployment: control plane + tiny-model worker + registered
+    frontend, all in-proc (same shape as test_e2e_http.start_stack)."""
+    tok = tiny_tokenizer()
+    cfg = tiny_config(vocab_size=tok.vocab_size)
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    control = await ControlPlaneServer().start()
+    worker_rt = await DistributedRuntime.connect(control.address)
+    engine = JaxEngine(
+        cfg, params,
+        EngineConfig(page_size=8, num_pages=64, max_num_seqs=2,
+                     max_prefill_tokens=64, max_model_len=128),
+        eos_token_ids=list(tok.eos_token_ids), kv_dtype=jnp.float32,
+    )
+    mdc = ModelDeploymentCard(
+        name=model_name, tokenizer_json=tok.to_json_str(),
+        eos_token_ids=list(tok.eos_token_ids),
+    )
+    await serve_engine(worker_rt, engine, mdc)
+    front_rt = await DistributedRuntime.connect(control.address)
+    manager = ModelManager()
+    watcher = await ModelWatcher(front_rt, manager).start()
+    await watcher.wait_for_model(model_name)
+    http = await HttpService(manager, host="127.0.0.1", port=0).start()
+    await register_frontend(front_rt, http.port)
+    return control, worker_rt, front_rt, engine, watcher, http
+
+
+async def _stop_stack(control, worker_rt, front_rt, engine, watcher, http):
+    await http.stop()
+    await watcher.stop()
+    await engine.shutdown()
+    await front_rt.shutdown(graceful=False)
+    await worker_rt.shutdown(graceful=False)
+    await control.stop()
+
+
+async def test_gateway_federates_and_routes_by_model():
+    """Two separate deployments (own control planes, different models)
+    behind one gateway: /v1/models aggregates, chat requests land on the
+    deployment that serves the named model, unknown models 404."""
+    stack_a = await _serving_stack("tiny-alpha")
+    stack_b = await _serving_stack("tiny-beta")
+    gw = await InferenceGateway(
+        [stack_a[0].address, stack_b[0].address], host="127.0.0.1", port=0,
+    ).start()
+    try:
+        base = f"http://127.0.0.1:{gw.port}"
+        # wait for both model indexes + frontend registrations to sync
+        deadline = asyncio.get_running_loop().time() + 15
+        while asyncio.get_running_loop().time() < deadline:
+            if (gw.serves("tiny-alpha") and gw.serves("tiny-beta")
+                    and all(d.backends for d in gw.deployments)):
+                break
+            await asyncio.sleep(0.1)
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{base}/v1/models") as r:
+                ids = sorted(m["id"] for m in (await r.json())["data"])
+            assert ids == ["tiny-alpha", "tiny-beta"]
+
+            for name in ("tiny-alpha", "tiny-beta"):
+                req = {
+                    "model": name,
+                    "messages": [{"role": "user", "content": "hi"}],
+                    "max_tokens": 4, "temperature": 0,
+                    "nvext": {"ignore_eos": True},
+                }
+                async with s.post(f"{base}/v1/chat/completions",
+                                  json=req) as r:
+                    assert r.status == 200, await r.text()
+                    out = await r.json()
+                assert out["model"] == name
+                assert out["choices"][0]["message"]["content"]
+
+            # streaming SSE relays through the proxy
+            req = {
+                "model": "tiny-alpha", "stream": True,
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 4, "temperature": 0,
+                "nvext": {"ignore_eos": True},
+            }
+            async with s.post(f"{base}/v1/chat/completions", json=req) as r:
+                assert r.status == 200
+                text = (await r.read()).decode()
+            assert "data: " in text and "[DONE]" in text
+
+            async with s.post(f"{base}/v1/chat/completions",
+                              json={"model": "nope", "messages": []}) as r:
+                assert r.status == 404
+
+            async with s.get(f"{base}/health") as r:
+                health = await r.json()
+            assert len(health["deployments"]) == 2
+    finally:
+        await gw.stop()
+        await _stop_stack(*stack_a)
+        await _stop_stack(*stack_b)
+
+
+async def test_gateway_retries_dead_backend():
+    """A stale registration (frontend gone, lease not yet expired) must
+    not fail requests: the gateway cools the dead endpoint down and
+    retries on a live one."""
+    stack = await _serving_stack("tiny-retry")
+    control, worker_rt, front_rt = stack[0], stack[1], stack[2]
+    # a second, dead frontend registration on the same deployment
+    from dynamo_tpu.runtime.transport.wire import pack
+
+    await front_rt.control.put(
+        "/http/frontends/999999", pack({"url": "http://127.0.0.1:9"}),
+    )
+    gw = await InferenceGateway([control.address], host="127.0.0.1",
+                                port=0).start()
+    try:
+        deadline = asyncio.get_running_loop().time() + 15
+        while asyncio.get_running_loop().time() < deadline:
+            if gw.serves("tiny-retry") and len(gw.deployments[0].backends) == 2:
+                break
+            await asyncio.sleep(0.1)
+        req = {
+            "model": "tiny-retry",
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 4, "temperature": 0, "nvext": {"ignore_eos": True},
+        }
+        async with aiohttp.ClientSession() as s:
+            # several requests: whichever order the picker tries, every
+            # request must succeed (dead backend → cooldown + retry)
+            for _ in range(4):
+                async with s.post(
+                    f"http://127.0.0.1:{gw.port}/v1/chat/completions",
+                    json=req,
+                ) as r:
+                    assert r.status == 200, await r.text()
+    finally:
+        await gw.stop()
+        await _stop_stack(*stack)
+
+
+def test_gateway_picks_least_inflight():
+    gw = InferenceGateway(["x:1"], port=0)
+    dep = gw.deployments[0]
+    dep.cards["/models/ns/m/1"] = "m"
+    dep.backends["a"] = _Backend(url="http://a", key="a", cp=0, inflight=3)
+    dep.backends["b"] = _Backend(url="http://b", key="b", cp=0, inflight=1)
+    dep.backends["c"] = _Backend(url="http://c", key="c", cp=0, inflight=1)
+    picked = {gw.pick("m").key for _ in range(8)}
+    assert picked == {"b", "c"}  # least-loaded set, round-robin within it
+    assert gw.pick("unknown") is None
+    # cooldown removes a backend from eligibility
+    import time as _t
+
+    dep.backends["b"].cooldown_until = _t.monotonic() + 60
+    dep.backends["c"].cooldown_until = _t.monotonic() + 60
+    assert gw.pick("m").key == "a"
