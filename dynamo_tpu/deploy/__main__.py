@@ -170,7 +170,10 @@ def main() -> None:
                 signal.SIGINT, stop.set
             )
             await stop.wait()
-            await ctl.stop()
+            # like the operator verb: a signal is a controller RESTART —
+            # k8s objects must keep serving (the next controller
+            # re-adopts); local children would be orphaned, so they stop
+            await ctl.stop(stop_replicas=not args.k8s_actuate)
             await rt.shutdown(graceful=False)
             if launcher is not None:
                 launcher.stop()

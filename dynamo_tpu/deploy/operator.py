@@ -255,6 +255,25 @@ class Operator:
                 await self.runtime.control.delete(status_key(name))
             except (ConnectionError, RuntimeError):
                 pass
+            # the freed namespace may unblock a spec that was rejected
+            # for conflicting with this deployment — re-scan the store
+            # so the operator stays level-triggered on it
+            await self._rescan_unmanaged()
+
+    async def _rescan_unmanaged(self) -> None:
+        try:
+            entries = await self.runtime.control.get_prefix(DEPLOYMENTS_ROOT)
+        except (ConnectionError, RuntimeError):
+            return
+        for key, value in entries:
+            name = _name_of(key)
+            if name is None or name in self._managed:
+                continue
+            try:
+                await self._apply_doc(name, unpack(value))
+            except Exception:  # noqa: BLE001 — same tolerance as the loop
+                logger.exception("deployment %s: rescan adoption failed",
+                                 name)
 
     async def _write_status(self, name: str, generation: int,
                             components: dict, error: str = "") -> None:

@@ -187,14 +187,28 @@ def _build_prefill_step_sp(cfg: ModelConfig, mesh, with_top: bool = False,
     return step
 
 
+def _pp_lockstep_kw(mesh, n_replicated: int):
+    """jit out_shardings for a pp step under multihost lockstep: the
+    packed/chained outputs come back REPLICATED (cross-process shards
+    are not addressable, so the leader could not read them otherwise)
+    and the KV keeps its pp-staged layout."""
+    from ..parallel.pp_engine import kv_pspec_pp
+
+    rep = NamedSharding(mesh, P())
+    kvsh = jax.tree.map(lambda s: NamedSharding(mesh, s), kv_pspec_pp())
+    return {"out_shardings": (*([rep] * n_replicated), kvsh)}
+
+
 def _build_prefill_step_pp(cfg: ModelConfig, mesh, with_top: bool = False,
-                           attn_impl: str = "xla"):
+                           attn_impl: str = "xla", lockstep: bool = False):
     """Prefill through the GPipe-staged pipeline (parallel/pp_engine.py);
     sampling happens at the jit level on the replicated last-position
     logits."""
     from ..parallel.pp_engine import forward_prefill_pp
 
-    @partial(jax.jit, donate_argnums=(1,))
+    kw = _pp_lockstep_kw(mesh, 2) if lockstep else {}
+
+    @partial(jax.jit, donate_argnums=(1,), **kw)
     def step(params, kv, tokens, page_table, prefix_lens, chunk_lens, samp,
              seeds, counters):
         logits, kv = forward_prefill_pp(
@@ -210,7 +224,8 @@ def _build_prefill_step_pp(cfg: ModelConfig, mesh, with_top: bool = False,
 
 def _build_decode_step_pp(cfg: ModelConfig, mesh, n_steps: int,
                           max_valid_pos: int, penalized: bool = False,
-                          with_top: bool = False, attn_impl: str = "xla"):
+                          with_top: bool = False, attn_impl: str = "xla",
+                          lockstep: bool = False):
     """Multi-token decode with the pipeline kept full (the ring schedule
     of parallel/pp_engine.py); packs per-step rows in the `_unpack_out`
     layout ([T, 2B], or [T, B*(2+2*TOPLP)] with top-logprobs).  Penalty
@@ -229,7 +244,9 @@ def _build_decode_step_pp(cfg: ModelConfig, mesh, n_steps: int,
 
     top_k = TOPLP if with_top else 0
     if penalized:
-        @partial(jax.jit, donate_argnums=(1, 5))
+        kw = _pp_lockstep_kw(mesh, 5) if lockstep else {}
+
+        @partial(jax.jit, donate_argnums=(1, 5), **kw)
         def step(params, kv, tokens, positions, counters, counts,
                  page_table, samp, seeds):
             toks, logp, tops, counts, kv = forward_decode_pp(
@@ -240,7 +257,9 @@ def _build_decode_step_pp(cfg: ModelConfig, mesh, n_steps: int,
             return (pack(toks, logp, tops), toks[-1], positions + n_steps,
                     counters + n_steps, counts, kv)
     else:
-        @partial(jax.jit, donate_argnums=(1,))
+        kw = _pp_lockstep_kw(mesh, 4) if lockstep else {}
+
+        @partial(jax.jit, donate_argnums=(1,), **kw)
         def step(params, kv, tokens, positions, counters, page_table,
                  samp, seeds):
             toks, logp, tops, _, kv = forward_decode_pp(
@@ -747,13 +766,22 @@ class JaxEngine:
                         "pp does not compose with kv_partition yet (the "
                         "KV layer axis is already sharded over pp)"
                     )
-                if self._multihost:
-                    raise ValueError("pp is single-host for now")
                 if vision is not None or tiered is not None:
                     raise ValueError(
                         "pp does not support the vision tower or KVBM "
                         "tiering yet"
                     )
+                if parallel.tp > 1:
+                    bad = [k for k, v in {
+                        "q heads": model_cfg.num_attention_heads,
+                        "kv heads": model_cfg.num_key_value_heads,
+                        "vocab_size": model_cfg.vocab_size,
+                    }.items() if v % parallel.tp]
+                    if bad:
+                        raise ValueError(
+                            f"tp={parallel.tp} must evenly divide "
+                            f"{', '.join(bad)} for pp×tp serving"
+                        )
                 # decode microbatches the batch into pp groups, and the
                 # fused/mixed fast paths assume the flat dispatch shape
                 self.cfg = dataclasses.replace(
@@ -1111,7 +1139,7 @@ class JaxEngine:
             elif self._pp > 1:
                 self._prefill_steps[key] = _build_prefill_step_pp(
                     self.model_cfg, self.mesh, with_top=with_top,
-                    attn_impl=self._attn_impl,
+                    attn_impl=self._attn_impl, lockstep=self._multihost,
                 )
             elif self._pooled:
                 self._prefill_steps[key] = _build_prefill_step_pooled(
@@ -1135,6 +1163,7 @@ class JaxEngine:
                     self.model_cfg, self.mesh, self.cfg.decode_steps,
                     self.cfg.hard_cap, penalized=penalized,
                     with_top=with_top, attn_impl=self._attn_impl,
+                    lockstep=self._multihost,
                 )
             elif self._pooled:
                 self._decode_steps[key] = _build_decode_step_pooled(

@@ -25,8 +25,10 @@ keeps its replicas consistent exactly like the non-pp engine, and each
 stage's params/KV shard over tp with their usual megatron specs (XLA
 inserts the within-stage collectives).  A 70B int8 stack (~70GB) on
 16GB/chip v5e needs tp×pp ≥ 8 in some combination — this is the
-composition that makes pp serve the model it exists for.  sp within a
-stage remains future work.
+composition that makes pp serve the model it exists for.  Composes
+with multihost lockstep too (the mesh spans processes; step outputs
+replicate so every host reads them locally), so those tp×pp chips
+need not share a host.  sp within a stage remains future work.
 """
 
 from __future__ import annotations

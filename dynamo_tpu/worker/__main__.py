@@ -85,6 +85,11 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--platform", default="default",
                     choices=["default", "cpu"],
                     help="force the JAX backend (cpu for tests/CI)")
+    ap.add_argument("--local-devices", type=int, default=0,
+                    help="with --platform cpu: virtual CPU devices per "
+                         "process (0 = backend default) — lets a "
+                         "multihost group form a real global mesh "
+                         "without TPU chips")
     ap.add_argument("--status-port", type=int, default=0,
                     help="system status server port (0 = ephemeral, "
                          "-1 = disabled); serves /health /live /metrics")
@@ -203,6 +208,8 @@ def main() -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+        if args.local_devices:
+            jax.config.update("jax_num_cpu_devices", args.local_devices)
     if args.mock and args.coordinator:
         # a MOCK multinode group never joins a jax world (there are no
         # device dispatches to replay): rank 0 serves the simulator,

@@ -743,3 +743,260 @@ def test_vision_composes_with_multihost():
     )
     assert ref.returncode == 0, ref.stdout + ref.stderr
     assert _tokens_from(outs[0]) == _tokens_from(ref.stdout)
+
+
+# -- pipeline parallelism composed with multihost lockstep ------------------ #
+# The GPipe-staged serving engine spans 2 processes: a dp=1 x pp=2 x tp=2
+# mesh over 4 global devices, rank 0 serving and rank 1 replaying plans
+# (round 4: the 70B recipe needs tp*pp >= 8 ACROSS hosts — 16GB/chip
+# v5e holds no 70B stack on one host's chips).  Greedy + penalized +
+# top-logprobs outputs must equal a plain single-device engine.
+
+PP_MH_WORKER = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)  # 2 local x 2 hosts = 4 global
+
+from dynamo_tpu.parallel.multihost import initialize_multihost
+
+rank = int(sys.argv[1])
+assert initialize_multihost(sys.argv[2], num_hosts=2, host_id=rank)
+assert jax.device_count() == 4
+
+import asyncio
+import jax.numpy as jnp
+from dynamo_tpu.engine import EngineConfig, JaxEngine
+from dynamo_tpu.models import init_params, tiny_config
+from dynamo_tpu.parallel import ParallelConfig
+
+cfg = tiny_config()
+params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+ecfg = EngineConfig(page_size=8, num_pages=64, max_num_seqs=4,
+                    max_prefill_tokens=32, max_model_len=64)
+engine = JaxEngine(cfg, params, ecfg, kv_dtype=jnp.float32,
+                   parallel=ParallelConfig(tp=2, pp=2))
+
+if rank == 0:
+    async def run():
+        outs = []
+        for i in range(3):
+            p = [(i * 13 + j) % cfg.vocab_size for j in range(5 + 3 * i)]
+            so = {"temperature": 0.0}
+            sc = {"max_tokens": 6, "ignore_eos": True}
+            if i == 1:  # penalized: last-stage histogram + sparse plan
+                so["frequency_penalty"] = 0.7
+            if i == 2:  # top-logprobs ride the ring's last stage
+                so["top_logprobs"] = 3
+            req = {"token_ids": p, "sampling_options": so,
+                   "stop_conditions": sc}
+            toks = []
+            async for out in engine.generate(req):
+                assert out.get("finish_reason") != "error", out
+                toks += out["token_ids"]
+            outs.append(toks)
+        await engine.shutdown()
+        return outs
+
+    print("TOKENS", repr(asyncio.run(run())), flush=True)
+else:
+    engine.follower_loop()
+    print("FOLLOWER DONE", flush=True)
+"""
+
+PP_MH_REFERENCE = r"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import asyncio
+import jax.numpy as jnp
+from dynamo_tpu.engine import EngineConfig, JaxEngine
+from dynamo_tpu.models import init_params, tiny_config
+
+cfg = tiny_config()
+params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+ecfg = EngineConfig(page_size=8, num_pages=64, max_num_seqs=4,
+                    max_prefill_tokens=32, max_model_len=64)
+engine = JaxEngine(cfg, params, ecfg, kv_dtype=jnp.float32)
+
+async def run():
+    outs = []
+    for i in range(3):
+        p = [(i * 13 + j) % cfg.vocab_size for j in range(5 + 3 * i)]
+        so = {"temperature": 0.0}
+        sc = {"max_tokens": 6, "ignore_eos": True}
+        if i == 1:
+            so["frequency_penalty"] = 0.7
+        if i == 2:
+            so["top_logprobs"] = 3
+        req = {"token_ids": p, "sampling_options": so,
+               "stop_conditions": sc}
+        toks = []
+        async for out in engine.generate(req):
+            assert out.get("finish_reason") != "error", out
+            toks += out["token_ids"]
+        outs.append(toks)
+    await engine.shutdown()
+    return outs
+
+print("TOKENS", repr(asyncio.run(run())), flush=True)
+"""
+
+
+@pytest.mark.timeout(300)
+def test_pp_engine_composes_with_multihost():
+    env = {**os.environ, "PYTHONPATH": ROOT}
+    env.pop("XLA_FLAGS", None)
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    coordinator = f"127.0.0.1:{s.getsockname()[1]}"
+    s.close()
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", PP_MH_WORKER, str(rank), coordinator],
+            env=env, cwd=ROOT, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+        )
+        for rank in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=240)
+        assert p.returncode == 0, out
+        outs.append(out)
+    assert "FOLLOWER DONE" in outs[1]
+
+    ref = subprocess.run(
+        [sys.executable, "-c", PP_MH_REFERENCE], env=env, cwd=ROOT,
+        capture_output=True, text=True, timeout=240,
+    )
+    assert ref.returncode == 0, ref.stdout + ref.stderr
+    assert _tokens_from(outs[0]) == _tokens_from(ref.stdout)
+
+
+# -- wide-EP all-to-all composed with multihost lockstep -------------------- #
+# The 64-expert a2a MoE dispatch runs on a 2-process sp=2 x tp=2 mesh:
+# expert all-to-alls cross the host boundary (the reference's wide-EP
+# story is multi-node 16-way — recipes/deepseek-r1/sglang-wideep).
+# Greedy output must equal a plain single-process engine.
+
+WIDEEP_MH_WORKER = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)  # 2 local x 2 hosts = 4 global
+
+from dynamo_tpu.parallel.multihost import initialize_multihost
+
+rank = int(sys.argv[1])
+assert initialize_multihost(sys.argv[2], num_hosts=2, host_id=rank)
+assert jax.device_count() == 4
+
+import asyncio
+import jax.numpy as jnp
+from dynamo_tpu.engine import EngineConfig, JaxEngine
+from dynamo_tpu.models import init_params, tiny_moe_config
+from dynamo_tpu.parallel import ParallelConfig
+
+cfg = tiny_moe_config(num_experts=64, num_experts_per_tok=4,
+                      moe_impl="a2a", moe_capacity_factor=8.0)
+params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+ecfg = EngineConfig(page_size=8, num_pages=96, max_num_seqs=4,
+                    max_prefill_tokens=4 * 128, prefill_batch_size=1,
+                    max_model_len=128, enable_prefix_caching=False)
+engine = JaxEngine(cfg, params, ecfg, kv_dtype=jnp.float32,
+                   parallel=ParallelConfig(sp=2, tp=2))
+
+if rank == 0:
+    async def run():
+        outs = []
+        for i in range(3):
+            p = [(7 * j + i) % cfg.vocab_size for j in range(20 + 4 * i)]
+            req = {"token_ids": p,
+                   "sampling_options": {"temperature": 0.0},
+                   "stop_conditions": {"max_tokens": 5, "ignore_eos": True}}
+            toks = []
+            async for out in engine.generate(req):
+                assert out.get("finish_reason") != "error", out
+                toks += out["token_ids"]
+            outs.append(toks)
+        await engine.shutdown()
+        return outs
+
+    print("TOKENS", repr(asyncio.run(run())), flush=True)
+else:
+    engine.follower_loop()
+    print("FOLLOWER DONE", flush=True)
+"""
+
+WIDEEP_MH_REFERENCE = r"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import asyncio
+import jax.numpy as jnp
+from dynamo_tpu.engine import EngineConfig, JaxEngine
+from dynamo_tpu.models import init_params, tiny_moe_config
+
+cfg = tiny_moe_config(num_experts=64, num_experts_per_tok=4,
+                      moe_impl="a2a", moe_capacity_factor=8.0)
+params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+ecfg = EngineConfig(page_size=8, num_pages=96, max_num_seqs=4,
+                    max_prefill_tokens=4 * 128, prefill_batch_size=1,
+                    max_model_len=128, enable_prefix_caching=False)
+engine = JaxEngine(cfg, params, ecfg, kv_dtype=jnp.float32)
+
+async def run():
+    outs = []
+    for i in range(3):
+        p = [(7 * j + i) % cfg.vocab_size for j in range(20 + 4 * i)]
+        req = {"token_ids": p,
+               "sampling_options": {"temperature": 0.0},
+               "stop_conditions": {"max_tokens": 5, "ignore_eos": True}}
+        toks = []
+        async for out in engine.generate(req):
+            assert out.get("finish_reason") != "error", out
+            toks += out["token_ids"]
+        outs.append(toks)
+    await engine.shutdown()
+    return outs
+
+print("TOKENS", repr(asyncio.run(run())), flush=True)
+"""
+
+
+@pytest.mark.timeout(300)
+def test_wide_ep_a2a_composes_with_multihost():
+    env = {**os.environ, "PYTHONPATH": ROOT}
+    env.pop("XLA_FLAGS", None)
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    coordinator = f"127.0.0.1:{s.getsockname()[1]}"
+    s.close()
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", WIDEEP_MH_WORKER, str(rank), coordinator],
+            env=env, cwd=ROOT, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+        )
+        for rank in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=240)
+        assert p.returncode == 0, out
+        outs.append(out)
+    assert "FOLLOWER DONE" in outs[1]
+
+    ref = subprocess.run(
+        [sys.executable, "-c", WIDEEP_MH_REFERENCE], env=env, cwd=ROOT,
+        capture_output=True, text=True, timeout=240,
+    )
+    assert ref.returncode == 0, ref.stdout + ref.stderr
+    assert _tokens_from(outs[0]) == _tokens_from(ref.stdout)

@@ -187,6 +187,43 @@ async def test_operator_prunes_deployments_deleted_during_outage():
         await control.stop()
 
 
+async def test_operator_adopts_conflicting_spec_after_owner_deleted():
+    """Two documents claiming one namespace: the second is rejected, but
+    deleting the owner frees the namespace and the operator re-scans
+    the store and adopts it — level-triggered on the spec store, not
+    just on watch events."""
+    os.environ.setdefault("PYTHONPATH", ROOT)
+    control = await ControlPlaneServer().start()
+    rt = await DistributedRuntime.connect(control.address)
+    op = await Operator(rt, control.address, interval=0.3).start()
+    try:
+        await apply(rt.control, "owner", GRAPH_V1)
+        await _instances(rt, "opns", "backend", 1)
+        await apply(rt.control, "rival", GRAPH_V1)
+        deadline = asyncio.get_running_loop().time() + 15
+        st = None
+        while asyncio.get_running_loop().time() < deadline:
+            st = await get_status(rt.control, "rival")
+            if st and "error" in st:
+                break
+            await asyncio.sleep(0.25)
+        assert st and "already owned" in st["error"], st
+
+        await delete_deployment(rt.control, "owner")
+        # the rescan adopts rival without any new apply
+        await _instances(rt, "opns", "backend", 1)
+        deadline = asyncio.get_running_loop().time() + 30
+        while asyncio.get_running_loop().time() < deadline:
+            if "rival" in op._managed:  # noqa: SLF001
+                break
+            await asyncio.sleep(0.25)
+        assert "rival" in op._managed  # noqa: SLF001
+    finally:
+        await op.stop()
+        await rt.shutdown(graceful=False)
+        await control.stop()
+
+
 async def test_operator_rejects_bad_spec():
     control = await ControlPlaneServer().start()
     rt = await DistributedRuntime.connect(control.address)
