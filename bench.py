@@ -251,45 +251,12 @@ async def warm_mixed(engine, prompt_len=PROMPT_LEN) -> bool:
 
 
 def init_params_int8(cfg, key):
-    """Random ALREADY-QUANTIZED params built on device (bench-only: the
-    values are random but the pytree layout is exactly what
-    models.quantization.quantize_params produces, so the engine's int8
-    serving path is the one measured — no 2x-size host transfer)."""
-    import jax
-    import jax.numpy as jnp
+    """Random already-quantized params on device (layout =
+    models.quantization.quantize_params; see random_int8_params there —
+    shared with the planner profiler's llama-8b mode)."""
+    from dynamo_tpu.models.quantization import random_int8_params
 
-    h, hd = cfg.hidden_size, cfg.head_dim_
-    nh, nkv, L = (cfg.num_attention_heads, cfg.num_key_value_heads,
-                  cfg.num_hidden_layers)
-    f = cfg.intermediate_size
-    V = cfg.vocab_size
-    ks = iter(jax.random.split(key, 16))
-
-    def qw(k, *shape):
-        q = jax.random.randint(k, shape, -127, 128, jnp.int8)
-        s_shape = (shape[0], shape[-1]) if len(shape) == 3 else (shape[-1],)
-        s = jnp.full(s_shape, 1.0 / (127 * (shape[-2] ** 0.5)), jnp.float32)
-        return {"q": q, "s": s}
-
-    layers = {
-        "wq": qw(next(ks), L, h, nh * hd),
-        "wk": qw(next(ks), L, h, nkv * hd),
-        "wv": qw(next(ks), L, h, nkv * hd),
-        "wo": qw(next(ks), L, nh * hd, h),
-        "w_gate": qw(next(ks), L, h, f),
-        "w_up": qw(next(ks), L, h, f),
-        "w_down": qw(next(ks), L, f, h),
-        "attn_norm": jnp.ones((L, h), jnp.bfloat16),
-        "mlp_norm": jnp.ones((L, h), jnp.bfloat16),
-    }
-    embed = (jax.random.normal(next(ks), (V, h), jnp.float32) * 0.02
-             ).astype(jnp.bfloat16)
-    return {
-        "embed": embed,
-        "final_norm": jnp.ones((h,), jnp.bfloat16),
-        "lm_head": qw(next(ks), h, V),
-        "layers": layers,
-    }
+    return random_int8_params(cfg, key)
 
 
 def quantized_param_bytes(cfg):

@@ -129,6 +129,8 @@ def _build_prefill_step(cfg: ModelConfig, with_top: bool = False,
             attn_impl=attn_impl,
             extra_embeds=mm[0] if with_embeds else None,
             extra_mask=mm[1] if with_embeds else None,
+            # mrope models ship the (t, h, w) streams as a third array
+            mm_positions=mm[2] if with_embeds and len(mm) > 2 else None,
         )
         out = sample_tokens(logits, samp, seeds, counters)
         logp = compute_logprobs(logits, out)
@@ -305,13 +307,15 @@ def _make_decode_scan(cfg: ModelConfig, n_steps: int, max_valid_pos: int,
     """The traced decode-block body shared by the pure decode step and the
     mixed (prefill+decode) step: scans `n_steps` forward+sample steps,
     returning per-step packed outputs plus the carries."""
-    def body_common(kv, tok, pos, ctr, counts, page_table, samp, seeds, params):
+    def body_common(kv, tok, pos, ctr, counts, page_table, samp, seeds,
+                    params, rope_off=None):
         ok = pos < max_valid_pos
         safe_pos = jnp.where(ok, pos, 0)
         # out-of-window rows use an all-trash table row
         table = jnp.where(ok[:, None], page_table, 0)
         logits, kv = forward_decode(
-            params, cfg, kv, tok, safe_pos, table, attn_impl=attn_impl
+            params, cfg, kv, tok, safe_pos, table, attn_impl=attn_impl,
+            rope_offset=rope_off,
         )
         if penalized:
             logits = apply_penalties(
@@ -326,11 +330,12 @@ def _make_decode_scan(cfg: ModelConfig, n_steps: int, max_valid_pos: int,
 
     if penalized:
         def scan(params, kv, tokens, positions, counters, counts,
-                 page_table, samp, seeds):
+                 page_table, samp, seeds, rope_off=None):
             def body(carry, _):
                 kv, tok, pos, ctr, cts = carry
                 kv, out, cts, packed = body_common(
-                    kv, tok, pos, ctr, cts, page_table, samp, seeds, params
+                    kv, tok, pos, ctr, cts, page_table, samp, seeds,
+                    params, rope_off,
                 )
                 return (kv, out, pos + 1, ctr + 1, cts), packed
 
@@ -341,12 +346,13 @@ def _make_decode_scan(cfg: ModelConfig, n_steps: int, max_valid_pos: int,
             return packed, tok, pos, ctr, cts, kv
     else:
         def scan(params, kv, tokens, positions, counters, counts,
-                 page_table, samp, seeds):
+                 page_table, samp, seeds, rope_off=None):
             del counts
             def body(carry, _):
                 kv, tok, pos, ctr = carry
                 kv, out, _, packed = body_common(
-                    kv, tok, pos, ctr, None, page_table, samp, seeds, params
+                    kv, tok, pos, ctr, None, page_table, samp, seeds,
+                    params, rope_off,
                 )
                 return (kv, out, pos + 1, ctr + 1), packed
 
@@ -381,25 +387,41 @@ def _build_decode_step(cfg: ModelConfig, n_steps: int, max_valid_pos: int,
     run = _make_decode_scan(cfg, n_steps, max_valid_pos, penalized,
                             with_top, attn_impl)
     dp = P("dp")
+    mrope = bool(cfg.mrope_section)  # +rope_off operand (qwen2_vl)
     if penalized:
         kw = ({"out_shardings": _lockstep_out_shardings(
             lockstep_mesh, dp, dp, dp, P("dp", None))}
             if lockstep_mesh is not None else {})
 
-        @partial(jax.jit, donate_argnums=(1, 5), **kw)
-        def step(params, kv, tokens, positions, counters, counts,
-                 page_table, samp, seeds):
-            return run(params, kv, tokens, positions, counters, counts,
-                       page_table, samp, seeds)
+        if mrope:
+            @partial(jax.jit, donate_argnums=(1, 5), **kw)
+            def step(params, kv, tokens, positions, counters, counts,
+                     page_table, samp, seeds, rope_off):
+                return run(params, kv, tokens, positions, counters, counts,
+                           page_table, samp, seeds, rope_off)
+        else:
+            @partial(jax.jit, donate_argnums=(1, 5), **kw)
+            def step(params, kv, tokens, positions, counters, counts,
+                     page_table, samp, seeds):
+                return run(params, kv, tokens, positions, counters, counts,
+                           page_table, samp, seeds)
     else:
         kw = ({"out_shardings": _lockstep_out_shardings(
             lockstep_mesh, dp, dp, dp)}
             if lockstep_mesh is not None else {})
 
-        @partial(jax.jit, donate_argnums=(1,), **kw)
-        def step(params, kv, tokens, positions, counters, page_table, samp, seeds):
-            return run(params, kv, tokens, positions, counters, None,
-                       page_table, samp, seeds)
+        if mrope:
+            @partial(jax.jit, donate_argnums=(1,), **kw)
+            def step(params, kv, tokens, positions, counters, page_table,
+                     samp, seeds, rope_off):
+                return run(params, kv, tokens, positions, counters, None,
+                           page_table, samp, seeds, rope_off)
+        else:
+            @partial(jax.jit, donate_argnums=(1,), **kw)
+            def step(params, kv, tokens, positions, counters, page_table,
+                     samp, seeds):
+                return run(params, kv, tokens, positions, counters, None,
+                           page_table, samp, seeds)
 
     return step
 
@@ -926,6 +948,19 @@ class JaxEngine:
             raise ValueError(
                 "the vision tower is not supported under sp prefill yet"
             )
+        if model_cfg.mrope_section:
+            # M-RoPE (qwen2_vl): decode ropes at slot + per-seq delta.
+            # The fused/mixed fast paths don't thread the offset operand
+            # yet, and the meshed step variants don't either — keep the
+            # mrope serving path the flat engine
+            if self._pooled or self._sp > 1 or self._pp > 1:
+                raise ValueError(
+                    "mrope models serve on the flat engine (no "
+                    "kv_partition/sp/pp yet)"
+                )
+            self.cfg = dataclasses.replace(
+                self.cfg, fuse_prefill_decode=False, mixed_prefill_tokens=0
+            )
         self.params = self._shard_params(params)
         self.kv = self._make_kv()
         self._extra_event_sinks: List[Callable[[KvEvent], None]] = []
@@ -1278,7 +1313,8 @@ class JaxEngine:
         seq = Sequence(context.id, prompt, opts)
         seq.seed = opts.seed if opts.seed is not None else self._py_rng.getrandbits(31)
         seq.hold_pages = bool(request.get("_hold_pages"))
-        if request.get("mm_pixels") or request.get("mm_embeds"):
+        if (request.get("mm_pixels") or request.get("mm_embeds")
+                or request.get("mm_patches")):
             err = self._attach_mm(seq, request)
             if err:
                 yield {"token_ids": [], "finish_reason": "error", "error": err}
@@ -1617,7 +1653,7 @@ class JaxEngine:
         seeds, counters = self._seed_arrays(seq_rows)
         samp = self._samp_arrays(seq_rows)
         for s in seqs:  # encode pending vision inputs (step thread)
-            if s.mm_pixels is not None:
+            if s.mm_pixels is not None or s.mm_patches is not None:
                 self._encode_mm(s)
         mm = ()
         if any(s.mm_embeds is not None for s in seqs):
@@ -1938,6 +1974,8 @@ class JaxEngine:
                 hashlib.blake2b(arr.tobytes(), digest_size=8).hexdigest()
             )
             return None
+        if request.get("mm_patches"):
+            return self._attach_mm_qwen(seq, request)
         if self.vision is None:
             return "this worker has no vision tower attached"
         from ..llm.multimodal import unpack_pixels
@@ -1972,10 +2010,112 @@ class JaxEngine:
         )
         return None
 
+    def _attach_mm_qwen(self, seq, request) -> Optional[str]:
+        """Dynamic-resolution (qwen2_vl) media: per-medium patch blobs +
+        grids; M-RoPE positions/delta derive from the placeholder runs."""
+        import hashlib
+
+        from ..llm.multimodal import unpack_patches
+        from ..models.qwen_vl import (
+            Qwen2VLVisionConfig, merged_tokens, mrope_positions_from_runs,
+        )
+
+        if self.vision is None:
+            return "this worker has no vision tower attached"
+        _, vcfg = self.vision
+        if not isinstance(vcfg, Qwen2VLVisionConfig):
+            return "mm_patches requires a qwen2_vl vision tower"
+        if not self.model_cfg.mrope_section:
+            return "mm_patches requires an mrope language model"
+        offsets = list(request.get("mm_offsets") or [])
+        blobs = request["mm_patches"]
+        if len(blobs) != len(offsets):
+            return "mm_patches/mm_offsets mismatch"
+        patches, grids, runs = [], [], []
+        h = hashlib.blake2b(digest_size=8)
+        try:
+            for blob, off in zip(blobs, offsets):
+                arr, grid = unpack_patches(blob)
+                t, gh, gw = grid
+                if arr.ndim != 2 or arr.shape[1] != vcfg.patch_dim:
+                    return "patch width != tower patch_dim"
+                if (arr.shape[0] != t * gh * gw
+                        or gh % vcfg.spatial_merge_size
+                        or gw % vcfg.spatial_merge_size):
+                    return "patch count does not match the grid"
+                n = merged_tokens(grid, vcfg)
+                if (not isinstance(off, int) or isinstance(off, bool)
+                        or not 0 <= off <= len(seq.prompt) - n):
+                    return ("mm_offsets must be integer offsets inside "
+                            "the prompt")
+                patches.append(arr)
+                grids.append(grid)
+                runs.append((off, grid))
+                h.update(np.ascontiguousarray(arr).tobytes())
+        except (KeyError, TypeError, ValueError):
+            return "malformed mm_patches payload"
+        # runs must tile disjoint spans — an overlap would silently put
+        # the position streams and the embeds at different indices
+        spans = sorted(
+            (off, off + merged_tokens(g, vcfg)) for off, g in runs
+        )
+        for (_, end), (nxt, _) in zip(spans, spans[1:]):
+            if nxt < end:
+                return "mm_offsets overlap"
+        try:
+            pos, delta = mrope_positions_from_runs(
+                len(seq.prompt), runs, vcfg
+            )
+        except ValueError as e:
+            return str(e)
+        seq.mm_patches = patches
+        seq.mm_grids = grids
+        seq.mm_offsets = offsets
+        seq.mm_positions = pos
+        seq.rope_delta = delta
+        salt = request.get("cache_salt")
+        seq.cache_salt = salt if isinstance(salt, str) and salt else (
+            h.hexdigest()
+        )
+        return None
+
     def _encode_mm(self, seq) -> None:
         """Run the vision tower for a sequence (step thread, between
         dispatches)."""
+        from ..models.qwen_vl import Qwen2VLVisionConfig
+
         vparams, vcfg = self.vision
+        if isinstance(vcfg, Qwen2VLVisionConfig):
+            from ..models.qwen_vl import encode_patches
+
+            if self._encode_fn is None:
+                # one compiled program per grid shape (dynamic resolution
+                # buckets naturally by smart-resized grid).  LRU-bounded:
+                # real traffic produces a near-continuous grid space and
+                # each novel grid costs a trace+compile on the step
+                # thread — the cap keeps a long-lived worker's executable
+                # set (and that stall frequency, via reuse) bounded
+                from collections import OrderedDict
+
+                self._encode_fn = OrderedDict()
+            embeds = []
+            for arr, grid in zip(seq.mm_patches, seq.mm_grids):
+                fn = self._encode_fn.get(grid)
+                if fn is None:
+                    fn = jax.jit(
+                        lambda p, px, g=grid: encode_patches(p, vcfg, px, g)
+                    )
+                    self._encode_fn[grid] = fn
+                    if len(self._encode_fn) > 64:
+                        self._encode_fn.popitem(last=False)
+                else:
+                    self._encode_fn.move_to_end(grid)
+                embeds.append(np.asarray(
+                    jax.device_get(fn(vparams, jnp.asarray(arr)))
+                ))
+            seq.mm_embeds = embeds
+            seq.mm_patches = None
+            return
         if self._encode_fn is None:
             from ..models.vision import encode_images
 
@@ -1988,27 +2128,50 @@ class JaxEngine:
         seq.mm_pixels = None
 
     def _mm_arrays(self, item_rows, B, chunk_bucket):
-        """Build (extra_embeds [B,S,h], mask [B,S]) covering every image
+        """Build (extra_embeds [B,S,h], mask [B,S]) covering every media
         patch run intersecting this chunk (chunked prefill may slice
-        through a run)."""
+        through a run).  mm_embeds is [N, P, h] for fixed-resolution
+        (clip) towers or a LIST of [P_i, h] for dynamic resolution.  For
+        mrope models a third array carries the per-token (t, h, w) rope
+        streams [B, 3, S] — text rows get their sequential positions so
+        one with-mm program serves mixed batches exactly."""
         h = self.model_cfg.hidden_size
+        mrope = bool(self.model_cfg.mrope_section)
         extra = np.zeros((B, chunk_bucket, h), np.float32)
         mask = np.zeros((B, chunk_bucket), bool)
+        pos = np.zeros((B, 3, chunk_bucket), np.int32) if mrope else None
         for i, it in enumerate(item_rows):
             if it is None:
                 continue
             s = it.seq
+            if mrope:
+                lo, hi = it.chunk_start, it.chunk_start + it.chunk_len
+                if s.mm_positions is not None:
+                    w = min(hi, s.mm_positions.shape[1]) - lo
+                    if w > 0:
+                        pos[i, :, :w] = s.mm_positions[:, lo:lo + w]
+                    # rows may extend past the precomputed prompt span
+                    # only via bucket padding; pad positions are inert
+                else:
+                    pos[i, :, :] = lo + np.arange(chunk_bucket)
             if s.mm_embeds is None:
                 continue
-            P = s.mm_embeds.shape[1]
-            for img, off in enumerate(s.mm_offsets):
+            per_img = (
+                [e for e in s.mm_embeds]
+                if isinstance(s.mm_embeds, list)
+                else [s.mm_embeds[n] for n in range(s.mm_embeds.shape[0])]
+            )
+            for emb, off in zip(per_img, s.mm_offsets):
+                P = emb.shape[0]
                 lo = max(off, it.chunk_start)
                 hi = min(off + P, it.chunk_start + it.chunk_len)
                 if hi > lo:
                     extra[i, lo - it.chunk_start : hi - it.chunk_start] = (
-                        s.mm_embeds[img, lo - off : hi - off]
+                        emb[lo - off : hi - off]
                     )
                     mask[i, lo - it.chunk_start : hi - it.chunk_start] = True
+        if mrope:
+            return extra, mask, pos
         return extra, mask
 
     def _dispatch_prefill(self, tokens, table, prefix, chunk, samp, seeds,
@@ -2097,6 +2260,12 @@ class JaxEngine:
         samp = self._samp_arrays(rows)
         # histograms updated on-device within and across chained blocks
         counts = self._counts_array(rows) if penalized else None
+        rope_off = None
+        if self.model_cfg.mrope_section:
+            rope_off = np.zeros((Bb,), np.int32)
+            for i, s in enumerate(rows):
+                if s is not None:
+                    rope_off[i] = s.rope_delta
         if self._multihost:
             # penalized plans carry the output tokens SPARSELY (flat list +
             # row offsets) — broadcasting the dense [B, vocab] histogram
@@ -2109,10 +2278,11 @@ class JaxEngine:
                 "arrays": [tokens, positions, counters, table,
                            *[np.asarray(a) for a in samp], seeds],
                 "counts_sparse": sparse,
+                "rope_off": rope_off,
             })
         dispatches = self._dispatch_decode(
             tokens, positions, counters, counts, table, samp, seeds,
-            penalized, with_top, chain_len,
+            penalized, with_top, chain_len, rope_off=rope_off,
         )
         # page frees deferred until the whole chain drains: an in-flight
         # dispatch must never see its table's pages reallocated (unchained
@@ -2128,7 +2298,8 @@ class JaxEngine:
                 self.pool.free(deferred)
 
     def _dispatch_decode(self, tokens, positions, counters, counts, table,
-                         samp, seeds, penalized, with_top, chain_len):
+                         samp, seeds, penalized, with_top, chain_len,
+                         rope_off=None):
         """Issue the chained decode dispatches (identical on leader and
         followers); returns the per-block packed outputs."""
         step = self._get_decode_step(penalized, with_top)
@@ -2138,6 +2309,12 @@ class JaxEngine:
         table_d = self._put(table, self._bax, None)
         samp_d = self._put_samp(samp)
         seeds_d = self._put(seeds, self._bax)
+        mrope = bool(self.model_cfg.mrope_section)
+        rope = ()
+        if mrope:
+            if rope_off is None:
+                rope_off = np.zeros_like(positions)
+            rope = (self._put(rope_off, self._bax),)
         if penalized:
             cts_d = self._put(counts, self._bax, None)
         dispatches = []
@@ -2145,12 +2322,12 @@ class JaxEngine:
             if penalized:
                 packed_d, tok_d, pos_d, ctr_d, cts_d, self.kv = step(
                     self.params, self.kv, tok_d, pos_d, ctr_d, cts_d,
-                    table_d, samp_d, seeds_d,
+                    table_d, samp_d, seeds_d, *rope,
                 )
             else:
                 packed_d, tok_d, pos_d, ctr_d, self.kv = step(
                     self.params, self.kv, tok_d, pos_d, ctr_d,
-                    table_d, samp_d, seeds_d,
+                    table_d, samp_d, seeds_d, *rope,
                 )
             try:  # start the host copy early; overlaps later blocks' compute
                 packed_d.copy_to_host_async()
@@ -2227,7 +2404,7 @@ class JaxEngine:
                         a[0], a[1], a[2], counts, a[3],
                         SamplingParams(*a[4:4 + samp_n]), a[4 + samp_n],
                         desc["penalized"], desc["with_top"],
-                        desc["chain_len"],
+                        desc["chain_len"], rope_off=desc.get("rope_off"),
                     )
                 elif kind == "mixed":
                     a = desc["arrays"]

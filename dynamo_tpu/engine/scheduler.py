@@ -65,9 +65,17 @@ class Sequence:
         # encodes them at first prefill.  cache_salt isolates the prefix
         # cache per image content — image placeholder tokens are identical
         # across different images, so token-only hashes would alias
-        self.mm_pixels = None  # np [N, H, W, 3] float32
+        self.mm_pixels = None  # np [N, H, W, 3] float32 (clip towers)
         self.mm_offsets: List[int] = []
-        self.mm_embeds = None  # np [N, patches, h] (engine fills)
+        self.mm_embeds = None  # np [N, patches, h] — or, for dynamic-
+        # resolution (qwen2_vl) media, a LIST of [P_i, h] arrays
+        # qwen2_vl: per-medium (patches [L_i, patch_dim], grid (t, h, w))
+        self.mm_patches = None
+        self.mm_grids: List[tuple] = []
+        # M-RoPE: per-token (temporal, height, width) streams for the
+        # prompt, and the delta every later rope position shifts by
+        self.mm_positions = None  # np [3, prompt_len] int32
+        self.rope_delta = 0
         self.cache_salt = ""
         self.pages: List[int] = []
         self.kv_rank = 0  # pool partition this sequence's pages live on

@@ -57,6 +57,14 @@ class ModelDeploymentCard:
     image_token_id: Optional[int] = None
     image_patches: int = 0
     image_size: int = 0
+    # multimodal architecture: "clip" (fixed-resolution tower,
+    # image_patches per image) or "qwen2_vl" (dynamic resolution +
+    # M-RoPE; per-image token counts come from smart-resized grids, and
+    # video_url parts are accepted).  mm_config carries the vision
+    # geometry the preprocessor needs (patch/merge/temporal sizes,
+    # pixel budget) without shipping tower weights
+    mm_arch: str = "clip"
+    mm_config: Dict[str, Any] = field(default_factory=dict)
     user_data: Dict[str, Any] = field(default_factory=dict)
 
     @property

@@ -113,23 +113,11 @@ def expand_image_tokens(
 ) -> Tuple[List[int], List[int]]:
     """Replace each single image placeholder token with `patches_per_image`
     copies (reference encode_worker_handler.py:144-156); returns
-    (expanded token_ids, start offset of each image's patch run)."""
-    found = [i for i, t in enumerate(token_ids) if t == image_token_id]
-    if len(found) != n_images:
-        raise RequestError(
-            f"prompt contains {len(found)} image placeholder(s) for "
-            f"{n_images} image(s)"
-        )
-    out: List[int] = []
-    offsets: List[int] = []
-    prev = 0
-    for idx in found:
-        out.extend(token_ids[prev:idx])
-        offsets.append(len(out))
-        out.extend([image_token_id] * patches_per_image)
-        prev = idx + 1
-    out.extend(token_ids[prev:])
-    return out, offsets
+    (expanded token_ids, start offset of each image's patch run).  The
+    fixed-count form of `expand_media_tokens`."""
+    return expand_media_tokens(
+        token_ids, image_token_id, [patches_per_image] * n_images
+    )
 
 
 def pack_pixels(pixels: np.ndarray) -> Dict[str, Any]:
@@ -139,3 +127,92 @@ def pack_pixels(pixels: np.ndarray) -> Dict[str, Any]:
 
 def unpack_pixels(blob: Dict[str, Any]) -> np.ndarray:
     return np.frombuffer(blob["data"], np.float32).reshape(blob["shape"])
+
+
+def extract_media(messages: List[Dict[str, Any]]) -> List[Dict[str, str]]:
+    """Collect image_url AND video_url parts in reading order.  Returns
+    [{"kind": "image"|"video", "url": ...}].  video_url is the common
+    OpenAI-compatible extension the reference's engines accept (sglang
+    multimodal handlers); only data: URIs / DYN_IMAGE_FILE_ROOT paths
+    load, like images."""
+    media = []
+    for m in messages:
+        content = m.get("content")
+        if not isinstance(content, list):
+            continue
+        for part in content:
+            if not isinstance(part, dict):
+                continue
+            kind = part.get("type")
+            if kind in ("image_url", "video_url"):
+                url = (part.get(kind) or {}).get("url")
+                if not url:
+                    raise RequestError(f"{kind} part missing 'url'")
+                media.append(
+                    {"kind": kind.split("_")[0], "url": url}
+                )
+    return media
+
+
+MAX_VIDEO_FRAMES = 16
+
+
+def process_frames(raw: bytes, height: int, width: int,
+                   max_frames: int = MAX_VIDEO_FRAMES) -> np.ndarray:
+    """Encoded image OR animated image (GIF/WebP/APNG) bytes →
+    [T, H, W, 3] float32 in [0, 1].  Frames are sampled uniformly down
+    to `max_frames` BEFORE decoding — a thousand-frame GIF must not
+    cost a thousand RGB conversions in the request path."""
+    from PIL import Image
+
+    try:
+        img = Image.open(io.BytesIO(raw))
+        n = getattr(img, "n_frames", 1)
+        idx = (range(n) if n <= max_frames else
+               np.linspace(0, n - 1, max_frames).round().astype(int))
+        frames = []
+        for i in idx:
+            if n > 1:
+                img.seek(int(i))
+            frames.append(
+                img.convert("RGB").resize((width, height), Image.BILINEAR)
+            )
+    except Exception as e:  # noqa: BLE001 — PIL raises many types
+        raise RequestError(f"cannot decode video/image: {e}") from None
+    if not frames:
+        raise RequestError("media contains no frames")
+    return np.stack([np.asarray(f, np.float32) for f in frames]) / 255.0
+
+
+def expand_media_tokens(
+    token_ids: List[int], media_token_id: int, counts: List[int],
+) -> Tuple[List[int], List[int]]:
+    """`expand_image_tokens` for PER-MEDIA token counts (dynamic
+    resolution): the i-th placeholder expands to counts[i] copies."""
+    found = [i for i, t in enumerate(token_ids) if t == media_token_id]
+    if len(found) != len(counts):
+        raise RequestError(
+            f"prompt contains {len(found)} media placeholder(s) for "
+            f"{len(counts)} media item(s)"
+        )
+    out: List[int] = []
+    offsets: List[int] = []
+    prev = 0
+    for idx, n in zip(found, counts):
+        out.extend(token_ids[prev:idx])
+        offsets.append(len(out))
+        out.extend([media_token_id] * n)
+        prev = idx + 1
+    out.extend(token_ids[prev:])
+    return out, offsets
+
+
+def pack_patches(patches: np.ndarray, grid) -> Dict[str, Any]:
+    patches = np.ascontiguousarray(patches, np.float32)
+    return {"shape": list(patches.shape), "data": patches.tobytes(),
+            "grid": [int(g) for g in grid]}
+
+
+def unpack_patches(blob: Dict[str, Any]) -> Tuple[np.ndarray, tuple]:
+    arr = np.frombuffer(blob["data"], np.float32).reshape(blob["shape"])
+    return arr, tuple(int(g) for g in blob["grid"])

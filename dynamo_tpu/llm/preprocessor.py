@@ -69,12 +69,18 @@ class OpenAIPreprocessor:
         messages = request.get("messages")
         if not messages:
             raise RequestError("'messages' must be a non-empty list")
-        from .multimodal import extract_image_urls
+        from .multimodal import extract_media
 
-        image_urls = extract_image_urls(messages)
-        if image_urls and not self.mdc.image_token:
+        media = extract_media(messages)
+        if media and not self.mdc.image_token:
             raise RequestError(
                 f"model {self.mdc.name!r} does not accept image input"
+            )
+        if any(m["kind"] == "video" for m in media) and (
+            self.mdc.mm_arch != "qwen2_vl"
+        ):
+            raise RequestError(
+                f"model {self.mdc.name!r} does not accept video input"
             )
         prompt = self.apply_template(
             messages, tools=request.get("tools"),
@@ -86,8 +92,13 @@ class OpenAIPreprocessor:
         ):
             token_ids = [self.tokenizer.bos_token_id] + token_ids
         mm = None
-        if image_urls:
-            token_ids, mm = self._process_images(token_ids, image_urls)
+        if media:
+            if self.mdc.mm_arch == "qwen2_vl":
+                token_ids, mm = self._process_media_qwen(token_ids, media)
+            else:
+                token_ids, mm = self._process_images(
+                    token_ids, [m["url"] for m in media]
+                )
         out = self._finish(request, token_ids, prompt)
         if mm:
             out.update(mm)
@@ -133,6 +144,68 @@ class OpenAIPreprocessor:
                 np.ascontiguousarray(pixels, np.float32).tobytes(),
                 digest_size=8,
             ).hexdigest(),
+        }
+
+    def _process_media_qwen(self, token_ids, media):
+        """Qwen2-VL media path: smart-resize each image/video to its own
+        grid (dynamic resolution), patchify host-side, and expand each
+        placeholder to that medium's MERGED token count.  Ships
+        per-medium patch blobs + grids; the worker's tower encodes and
+        the engine computes M-RoPE positions from the runs."""
+        import hashlib
+
+        import numpy as np
+
+        from ..models.qwen_vl import (
+            Qwen2VLVisionConfig,
+            frames_to_patches,
+            merged_tokens,
+            smart_resize,
+        )
+        from .multimodal import (
+            expand_media_tokens,
+            load_image_bytes,
+            pack_patches,
+            process_frames,
+        )
+
+        vcfg = Qwen2VLVisionConfig.from_hf_config(self.mdc.mm_config or {})
+        tok_id = self.mdc.image_token_id
+        if tok_id is None:
+            ids = self.tokenizer.encode(self.mdc.image_token)
+            if len(ids) != 1:
+                raise RequestError(
+                    "model's image_token does not map to a single token"
+                )
+            tok_id = ids[0]
+        blobs, counts = [], []
+        salts = hashlib.blake2b(digest_size=8)
+        for m in media:
+            raw = load_image_bytes(m["url"])
+            from PIL import Image
+            import io as _io
+
+            try:
+                with Image.open(_io.BytesIO(raw)) as probe:
+                    w0, h0 = probe.size
+            except Exception as e:  # noqa: BLE001
+                raise RequestError(f"cannot decode media: {e}") from None
+            h1, w1 = smart_resize(h0, w0, vcfg)
+            frames = process_frames(
+                raw, h1, w1,
+                max_frames=(1 if m["kind"] == "image" else 16),
+            )
+            patches, grid = frames_to_patches(frames, vcfg)
+            blobs.append(pack_patches(patches, grid))
+            counts.append(merged_tokens(grid, vcfg))
+            salts.update(np.ascontiguousarray(patches).tobytes())
+        token_ids, offsets = expand_media_tokens(token_ids, tok_id, counts)
+        return token_ids, {
+            "mm_patches": blobs,
+            "mm_offsets": offsets,
+            # same contract as the clip path: content-derived salt keeps
+            # prefix-cache namespaces per-media (must equal the engine's)
+            "cache_salt": salts.hexdigest(),
         }
 
     # -- completions --------------------------------------------------------- #
@@ -286,7 +359,11 @@ def _normalize_messages(messages: List[Dict[str, Any]],
                 if isinstance(part, dict) and part.get("type") == "text":
                     texts.append(part.get("text", ""))
                 elif (isinstance(part, dict)
-                        and part.get("type") == "image_url" and image_token):
+                        and part.get("type") in ("image_url", "video_url")
+                        and image_token):
+                    # video parts share the image placeholder: media
+                    # order matches placeholder order, and per-media
+                    # token counts disambiguate at expansion time
                     texts.append(image_token)
                 else:
                     raise RequestError(

@@ -34,6 +34,10 @@ class ModelConfig:
     rms_norm_eps: float = 1e-5
     rope_theta: float = 10000.0
     rope_scaling: Optional[dict] = None
+    # Qwen2-VL multimodal rope: head_dim//2 rotary frequencies split
+    # into (temporal, height, width) sections; text tokens carry equal
+    # ids on all three streams (ops.apply_mrope).  None = standard rope.
+    mrope_section: Optional[tuple] = None
     tie_word_embeddings: bool = False
     attention_bias: bool = False
     # sliding-window attention (Mistral/GPT-OSS family): tokens attend to
@@ -128,11 +132,23 @@ class ModelConfig:
             rms_norm_eps=d.get("rms_norm_eps", 1e-5),
             rope_theta=d.get("rope_theta", 10000.0),
             rope_scaling=d.get("rope_scaling"),
+            # Qwen2-VL: rope_scaling {"type"|"rope_type": "mrope",
+            # "mrope_section": [t, h, w]} (HF Qwen2VLConfig)
+            mrope_section=(
+                tuple(d["rope_scaling"]["mrope_section"])
+                if (d.get("rope_scaling") or {}).get(
+                    "rope_type", (d.get("rope_scaling") or {}).get("type")
+                ) in ("mrope", "default") and
+                (d.get("rope_scaling") or {}).get("mrope_section")
+                else None
+            ),
             tie_word_embeddings=d.get("tie_word_embeddings", False),
             # HF Qwen2Config has no attention_bias field — its attention
             # hardcodes qkv bias on (o_proj off); mirror that default
             attention_bias=d.get(
-                "attention_bias", d.get("model_type") == "qwen2"
+                "attention_bias",
+                d.get("model_type") in ("qwen2", "qwen2_vl",
+                                        "qwen2_vl_text"),
             ),
             num_experts=num_experts,
             num_experts_per_tok=d.get("num_experts_per_tok", 2),

@@ -429,6 +429,7 @@ def _layer_prefill(
     inv_freq: jax.Array,
     attn_impl: str = "xla",
     window=None,  # per-layer sliding window (scalar; <= 0 → full)
+    rope_pos=None,  # [B, 3, S] mrope streams (Qwen2-VL); None = standard
 ):
     B, S, h = x.shape
     nh, nkv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim_
@@ -440,8 +441,14 @@ def _layer_prefill(
     q = q.astype(dt).reshape(B, S, nh, hd)
     k = k.astype(dt).reshape(B, S, nkv, hd)
     v = v.astype(dt).reshape(B, S, nkv, hd)
-    q = apply_rope(q, positions, inv_freq)
-    k = apply_rope(k, positions, inv_freq)
+    if rope_pos is not None:
+        from ..ops import apply_mrope
+
+        q = apply_mrope(q, rope_pos, inv_freq, cfg.mrope_section)
+        k = apply_mrope(k, rope_pos, inv_freq, cfg.mrope_section)
+    else:
+        q = apply_rope(q, positions, inv_freq)
+        k = apply_rope(k, positions, inv_freq)
 
     attn = prefill_attention(
         q, k, v, k_pages, v_pages, page_table, prefix_lens, chunk_lens,
@@ -471,6 +478,8 @@ def _layer_decode(
     inv_freq: jax.Array,
     attn_impl: str = "xla",
     window=None,  # per-layer sliding window (scalar; <= 0 → full)
+    rope_pos=None,  # [B] rope positions when they differ from the KV
+    # slot index (mrope decode: slot + per-seq delta)
 ):
     B, h = x.shape
     nh, nkv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim_
@@ -482,8 +491,9 @@ def _layer_decode(
     q = q.astype(dt).reshape(B, 1, nh, hd)
     k = k.astype(dt).reshape(B, 1, nkv, hd)
     v = v.astype(dt).reshape(B, 1, nkv, hd)
-    q = apply_rope(q, positions[:, None], inv_freq)[:, 0]
-    k = apply_rope(k, positions[:, None], inv_freq)
+    rp = positions if rope_pos is None else rope_pos
+    q = apply_rope(q, rp[:, None], inv_freq)[:, 0]
+    k = apply_rope(k, rp[:, None], inv_freq)
 
     # write first, then attend over the full table (new token included)
     k_pages, v_pages = write_kv_pages(
@@ -541,6 +551,7 @@ def prefill_layers(
     chunk_lens: jax.Array,
     attn_impl: str = "xla",
     wins: Optional[Tuple[jax.Array, ...]] = None,  # per-layer windows xs
+    rope_pos=None,  # [B, 3, S] mrope streams (Qwen2-VL multimodal)
 ) -> Tuple[jax.Array, KVCache]:
     """Scan a STACK of decoder layers over an embedded chunk (the body of
     `forward_prefill`, exposed so pipeline stages can run their local
@@ -555,7 +566,7 @@ def prefill_layers(
         h, (k_pages, v_pages) = _layer_prefill(
             lp, (k_pages, v_pages), h, positions, page_table,
             prefix_lens, chunk_lens, cfg, inv_freq, attn_impl,
-            window=xs[3] if wins else None,
+            window=xs[3] if wins else None, rope_pos=rope_pos,
         )
         return h, (k_pages, v_pages)
 
@@ -572,6 +583,8 @@ def decode_layers(
     page_table: jax.Array,
     attn_impl: str = "xla",
     wins: Optional[Tuple[jax.Array, ...]] = None,
+    rope_offset=None,  # [B] added to positions for ROPE only (mrope
+    # delta — the KV slot index stays the raw token index)
 ) -> Tuple[jax.Array, KVCache]:
     """Scan a STACK of decoder layers for one decode step (the body of
     `forward_decode`, exposed for pipeline stages)."""
@@ -579,6 +592,7 @@ def decode_layers(
     seq_lens = positions + 1
     if wins is None:
         wins = _window_xs(cfg)
+    rope_pos = None if rope_offset is None else positions + rope_offset
 
     def body(carry, xs):
         h = carry
@@ -586,6 +600,7 @@ def decode_layers(
         h, (k_pages, v_pages) = _layer_decode(
             lp, (k_pages, v_pages), h, positions, page_table, seq_lens, cfg,
             inv_freq, attn_impl, window=xs[3] if wins else None,
+            rope_pos=rope_pos,
         )
         return h, (k_pages, v_pages)
 
@@ -604,6 +619,7 @@ def forward_prefill(
     attn_impl: str = "xla",
     extra_embeds: Optional[jax.Array] = None,  # [B, S, h]
     extra_mask: Optional[jax.Array] = None,  # [B, S] bool
+    mm_positions: Optional[jax.Array] = None,  # [B, 3, S] mrope streams
 ) -> Tuple[jax.Array, KVCache]:
     """Run a prefill chunk; returns logits at the last valid position [B, V].
 
@@ -611,7 +627,10 @@ def forward_prefill(
     tower patches) in place of the token embedding at masked positions —
     the multimodal prompt path (the reference forwards precomputed
     embeddings to its engines, sglang/request_handlers/multimodal/
-    encode_worker_handler.py)."""
+    encode_worker_handler.py).  `mm_positions` supplies the per-token
+    (temporal, height, width) rope streams for mrope models (Qwen2-VL);
+    without it an mrope model ropes text-style (all streams equal),
+    which is exact for text-only prompts."""
     B, S = tokens.shape
     positions = prefix_lens[:, None] + jnp.arange(S)[None, :]
     x = params["embed"][tokens]  # [B, S, h]
@@ -620,6 +639,7 @@ def forward_prefill(
     x, kv = prefill_layers(
         params["layers"], cfg, kv, x, positions, page_table, prefix_lens,
         chunk_lens, attn_impl,
+        rope_pos=mm_positions if cfg.mrope_section else None,
     )
     last = jnp.maximum(chunk_lens - 1, 0)
     x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]  # [B, h]
@@ -677,10 +697,13 @@ def forward_decode(
     positions: jax.Array,  # [B] — position of this token
     page_table: jax.Array,  # [B, max_pages]
     attn_impl: str = "xla",
+    rope_offset: Optional[jax.Array] = None,  # [B] mrope delta (rope
+    # position = slot + delta; KV slots stay raw token indices)
 ) -> Tuple[jax.Array, KVCache]:
     """One decode step for the whole batch; returns logits [B, V]."""
     x = params["embed"][tokens]  # [B, h]
     x, kv = decode_layers(
-        params["layers"], cfg, kv, x, positions, page_table, attn_impl
+        params["layers"], cfg, kv, x, positions, page_table, attn_impl,
+        rope_offset=rope_offset,
     )
     return _lm_logits(params, cfg, x), kv

@@ -122,3 +122,45 @@ def quantize_pspecs(params, specs, tp_axis: str = "tp"):
         return s
 
     return walk(params, specs)
+
+
+def random_int8_params(cfg, key):
+    """Random ALREADY-QUANTIZED llama-layout params built on device: the
+    values are random but the pytree layout is exactly what
+    `quantize_params` produces, so the int8 serving path measured by the
+    bench/profiler is the real one — and no 2x-size bf16 tree is ever
+    materialized (an 8B stack would not survive that on a 16GB chip).
+    Jit the call so init happens on-device: `jax.jit(lambda k:
+    random_int8_params(cfg, k))(key)`."""
+    h, hd = cfg.hidden_size, cfg.head_dim_
+    nh, nkv, L = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                  cfg.num_hidden_layers)
+    f = cfg.intermediate_size
+    V = cfg.vocab_size
+    ks = iter(jax.random.split(key, 16))
+
+    def qw(k, *shape):
+        q = jax.random.randint(k, shape, -127, 128, jnp.int8)
+        s_shape = (shape[0], shape[-1]) if len(shape) == 3 else (shape[-1],)
+        s = jnp.full(s_shape, 1.0 / (127 * (shape[-2] ** 0.5)), jnp.float32)
+        return {"q": q, "s": s}
+
+    layers = {
+        "wq": qw(next(ks), L, h, nh * hd),
+        "wk": qw(next(ks), L, h, nkv * hd),
+        "wv": qw(next(ks), L, h, nkv * hd),
+        "wo": qw(next(ks), L, nh * hd, h),
+        "w_gate": qw(next(ks), L, h, f),
+        "w_up": qw(next(ks), L, h, f),
+        "w_down": qw(next(ks), L, f, h),
+        "attn_norm": jnp.ones((L, h), jnp.bfloat16),
+        "mlp_norm": jnp.ones((L, h), jnp.bfloat16),
+    }
+    embed = (jax.random.normal(next(ks), (V, h), jnp.float32) * 0.02
+             ).astype(jnp.bfloat16)
+    return {
+        "embed": embed,
+        "final_norm": jnp.ones((h,), jnp.bfloat16),
+        "lm_head": qw(next(ks), h, V),
+        "layers": layers,
+    }

@@ -1,0 +1,358 @@
+"""Qwen2-VL vision tower: dynamic-resolution ViT with 2D rotary
+position embedding and 2x2 spatial patch merging, plus the M-RoPE
+position computation for the language model.
+
+The reference serves qwen-vl-class models through its engines' own
+multimodal handlers (SURVEY §2.4 — sglang multimodal handlers, trtllm
+encode_helper); here the tower is first-party JAX, numerically pinned
+to HF `Qwen2VLForConditionalGeneration.visual`
+(transformers modeling_qwen2_vl.py):
+
+- **dynamic resolution**: images are smart-resized to multiples of
+  patch_size*merge (28px), so the patch grid — and the token count —
+  varies per image instead of being squashed to a fixed square;
+- **patch embed**: a Conv3d over (temporal_patch_size, patch, patch)
+  voxels, expressed as a flatten+matmul (MXU-friendly); images
+  duplicate their single frame to fill the temporal patch, video
+  supplies real frame pairs;
+- **2D rope**: each patch's (row, col) indexes two halves of the
+  rotary spectrum (no learned positions, no CLS token), with patches
+  laid out in merge-group-major order exactly like the HF processor;
+- **attention**: full within each temporal slice (HF cu_seqlens
+  semantics), expressed as a block mask so one jitted program serves
+  any grid;
+- **merger**: LayerNorm → concat each 2x2 spatial group → 2-layer GELU
+  MLP into the LLM's hidden size.
+
+`mrope_positions` mirrors HF `get_rope_index`: text tokens advance all
+three (temporal, height, width) streams together; a vision run spreads
+them over the grid; the sequence's `delta` (max position + 1 - length)
+shifts every later scalar position, including decode steps.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Params = Dict[str, Any]
+
+# HF Qwen2VLImageProcessor normalization (OPENAI_CLIP_MEAN/STD)
+CLIP_MEAN = np.array([0.48145466, 0.4578275, 0.40821073], np.float32)
+CLIP_STD = np.array([0.26862954, 0.26130258, 0.27577711], np.float32)
+
+
+@dataclass(frozen=True)
+class Qwen2VLVisionConfig:
+    embed_dim: int = 1280
+    depth: int = 32
+    num_heads: int = 16
+    mlp_ratio: float = 4.0
+    in_channels: int = 3
+    patch_size: int = 14
+    temporal_patch_size: int = 2
+    spatial_merge_size: int = 2
+    out_hidden_size: int = 1536  # LLM hidden (HF vision_config.hidden_size)
+    # smart-resize pixel budget (HF min_pixels/max_pixels)
+    min_pixels: int = 56 * 56
+    max_pixels: int = 14 * 14 * 4 * 1280
+
+    @property
+    def head_dim(self) -> int:
+        return self.embed_dim // self.num_heads
+
+    @property
+    def patch_dim(self) -> int:
+        return (self.in_channels * self.temporal_patch_size
+                * self.patch_size * self.patch_size)
+
+    @property
+    def merge_unit(self) -> int:
+        return self.spatial_merge_size ** 2
+
+    @staticmethod
+    def from_hf_config(d: dict) -> "Qwen2VLVisionConfig":
+        return Qwen2VLVisionConfig(
+            embed_dim=d.get("embed_dim", 1280),
+            depth=d.get("depth", 32),
+            num_heads=d.get("num_heads", 16),
+            mlp_ratio=d.get("mlp_ratio", 4.0),
+            in_channels=d.get("in_channels", d.get("in_chans", 3)),
+            patch_size=d.get("patch_size", 14),
+            temporal_patch_size=d.get("temporal_patch_size", 2),
+            spatial_merge_size=d.get("spatial_merge_size", 2),
+            out_hidden_size=d.get("hidden_size", 1536),
+            # pixel budget lives in the HF *processor* config; accept it
+            # here so model cards can ship one geometry dict
+            min_pixels=d.get("min_pixels", 56 * 56),
+            max_pixels=d.get("max_pixels", 14 * 14 * 4 * 1280),
+        )
+
+
+def tiny_qwen_vl_vision_config(**over) -> Qwen2VLVisionConfig:
+    """Tiny tower for tests (pairs with models.tiny_config: out 64)."""
+    base = dict(embed_dim=32, depth=2, num_heads=2, mlp_ratio=2.0,
+                patch_size=4, temporal_patch_size=2, spatial_merge_size=2,
+                out_hidden_size=64, min_pixels=8 * 8, max_pixels=64 * 64)
+    base.update(over)
+    return Qwen2VLVisionConfig(**base)
+
+
+def init_qwen_vl_vision_params(cfg: Qwen2VLVisionConfig, key,
+                               dtype=jnp.float32) -> Params:
+    e, L = cfg.embed_dim, cfg.depth
+    f = int(cfg.embed_dim * cfg.mlp_ratio)
+    mu = cfg.merge_unit
+    ks = iter(jax.random.split(key, 8))
+
+    def w(k, *shape):
+        return (jax.random.normal(k, shape, jnp.float32)
+                * (shape[-2] ** -0.5)).astype(dtype)
+
+    layers = {
+        "ln1_scale": jnp.ones((L, e), dtype),
+        "ln1_bias": jnp.zeros((L, e), dtype),
+        # HF qkv is ONE [e, 3e] projection with bias
+        "wqkv": w(next(ks), L, e, 3 * e),
+        "bqkv": jnp.zeros((L, 3 * e), dtype),
+        "wo": w(next(ks), L, e, e),
+        "bo": jnp.zeros((L, e), dtype),
+        "ln2_scale": jnp.ones((L, e), dtype),
+        "ln2_bias": jnp.zeros((L, e), dtype),
+        "w1": w(next(ks), L, e, f),
+        "b1": jnp.zeros((L, f), dtype),
+        "w2": w(next(ks), L, f, e),
+        "b2": jnp.zeros((L, e), dtype),
+    }
+    return {
+        "patch_proj": w(next(ks), cfg.patch_dim, e),
+        "layers": layers,
+        "merge_ln_scale": jnp.ones((e,), dtype),
+        "merge_ln_bias": jnp.zeros((e,), dtype),
+        "merge_w1": w(next(ks), mu * e, mu * e),
+        "merge_b1": jnp.zeros((mu * e,), dtype),
+        "merge_w2": w(next(ks), mu * e, cfg.out_hidden_size),
+        "merge_b2": jnp.zeros((cfg.out_hidden_size,), dtype),
+    }
+
+
+def _ln(x, scale, bias, eps=1e-6):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = ((x32 - mu) ** 2).mean(-1, keepdims=True)
+    return ((x32 - mu) * jax.lax.rsqrt(var + eps) * scale + bias).astype(x.dtype)
+
+
+def _rot_half(x):
+    d2 = x.shape[-1] // 2
+    return jnp.concatenate([-x[..., d2:], x[..., :d2]], axis=-1)
+
+
+def _vision_rope(grid: Tuple[int, int, int], cfg: Qwen2VLVisionConfig):
+    """Per-patch rope angles [L, head_dim//2] from (row, col), patches in
+    merge-group-major order (HF Qwen2VisionTransformer.rot_pos_emb)."""
+    t, h, w = grid
+    m = cfg.spatial_merge_size
+    # inv freqs over head_dim//4 (half the spectrum for rows, half cols)
+    d4 = cfg.head_dim // 4
+    inv = 1.0 / (10000.0 ** (np.arange(d4, dtype=np.float32) / d4))
+    hpos = np.arange(h)[:, None].repeat(w, 1)
+    wpos = np.arange(w)[None, :].repeat(h, 0)
+
+    def merge_order(a):
+        return (a.reshape(h // m, m, w // m, m)
+                 .transpose(0, 2, 1, 3).reshape(-1))
+
+    hp, wp = merge_order(hpos), merge_order(wpos)  # [h*w]
+    angles = np.concatenate(
+        [hp[:, None] * inv[None, :], wp[:, None] * inv[None, :]], axis=1
+    )  # [h*w, head_dim//2]
+    return jnp.asarray(np.tile(angles, (t, 1)), jnp.float32)
+
+
+def _frame_ids(grid: Tuple[int, int, int]) -> np.ndarray:
+    t, h, w = grid
+    return np.arange(t, dtype=np.int32).repeat(h * w)
+
+
+def encode_patches(params: Params, cfg: Qwen2VLVisionConfig,
+                   patches: jax.Array,  # [L, patch_dim]
+                   grid: Tuple[int, int, int]) -> jax.Array:
+    """Flattened voxel patches of ONE image/video → merged embeddings
+    [L // merge_unit, out_hidden] in the LLM's embedding space."""
+    L = patches.shape[0]
+    e, nh, hd = cfg.embed_dim, cfg.num_heads, cfg.head_dim
+    x = patches.astype(params["patch_proj"].dtype) @ params["patch_proj"]
+
+    angles = _vision_rope(grid, cfg)  # [L, hd//2]
+    cos = jnp.cos(jnp.concatenate([angles, angles], -1))  # [L, hd]
+    sin = jnp.sin(jnp.concatenate([angles, angles], -1))
+    # attention is full WITHIN each temporal slice (HF cu_seqlens)
+    fid = jnp.asarray(_frame_ids(grid))
+    mask = jnp.where(fid[:, None] == fid[None, :], 0.0, -1e9)[None]
+
+    def block(x, lp):
+        a = _ln(x, lp["ln1_scale"], lp["ln1_bias"])
+        qkv = a @ lp["wqkv"] + lp["bqkv"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(L, nh, hd)
+        k = k.reshape(L, nh, hd)
+        v = v.reshape(L, nh, hd)
+        q = q * cos[:, None, :] + _rot_half(q) * sin[:, None, :]
+        k = k * cos[:, None, :] + _rot_half(k) * sin[:, None, :]
+        s = jnp.einsum("qhd,khd->hqk", q, k,
+                       preferred_element_type=jnp.float32) * (hd ** -0.5)
+        p = jax.nn.softmax(s + mask, axis=-1)
+        o = jnp.einsum("hqk,khd->qhd", p, v.astype(jnp.float32))
+        x = x + (o.reshape(L, e).astype(x.dtype) @ lp["wo"] + lp["bo"])
+        m_in = _ln(x, lp["ln2_scale"], lp["ln2_bias"])
+        m = m_in @ lp["w1"] + lp["b1"]
+        m = m * jax.nn.sigmoid(1.702 * m)  # quick_gelu
+        x = x + (m @ lp["w2"] + lp["b2"]).astype(x.dtype)
+        return x, None
+
+    x, _ = jax.lax.scan(block, x, params["layers"])
+    # merger: LN, concat each 2x2 spatial group, 2-layer GELU MLP
+    x = _ln(x, params["merge_ln_scale"], params["merge_ln_bias"])
+    x = x.reshape(L // cfg.merge_unit, cfg.merge_unit * e)
+    x = jax.nn.gelu(x @ params["merge_w1"] + params["merge_b1"],
+                    approximate=False)
+    return x @ params["merge_w2"] + params["merge_b2"]
+
+
+# -- host-side preprocessing ------------------------------------------------- #
+
+
+def smart_resize(height: int, width: int, cfg: Qwen2VLVisionConfig,
+                 ) -> Tuple[int, int]:
+    """HF qwen-vl smart_resize: round to multiples of patch*merge while
+    keeping the pixel count inside [min_pixels, max_pixels] and the
+    aspect ratio (nearly) intact."""
+    factor = cfg.patch_size * cfg.spatial_merge_size
+    if max(height, width) / min(height, width) > 200:
+        raise ValueError("absurd aspect ratio")
+    h_bar = max(factor, round(height / factor) * factor)
+    w_bar = max(factor, round(width / factor) * factor)
+    if h_bar * w_bar > cfg.max_pixels:
+        beta = math.sqrt((height * width) / cfg.max_pixels)
+        h_bar = math.floor(height / beta / factor) * factor
+        w_bar = math.floor(width / beta / factor) * factor
+    elif h_bar * w_bar < cfg.min_pixels:
+        beta = math.sqrt(cfg.min_pixels / (height * width))
+        h_bar = math.ceil(height * beta / factor) * factor
+        w_bar = math.ceil(width * beta / factor) * factor
+    return max(factor, h_bar), max(factor, w_bar)
+
+
+def frames_to_patches(frames: np.ndarray, cfg: Qwen2VLVisionConfig,
+                      ) -> Tuple[np.ndarray, Tuple[int, int, int]]:
+    """[T, H, W, 3] floats in [0,1] (H, W already smart-resized) →
+    (patches [L, patch_dim] float32 in HF processor order, grid
+    (t, h, w)).  A single image passes T=1 and gets its frame
+    duplicated across the temporal patch; video frame counts round up
+    to a temporal_patch_size multiple the same way."""
+    T, H, W, C = frames.shape
+    p, m, tp = cfg.patch_size, cfg.spatial_merge_size, cfg.temporal_patch_size
+    if H % (p * m) or W % (p * m):
+        raise ValueError(f"frame {H}x{W} not smart-resized (factor {p * m})")
+    x = (frames.astype(np.float32) - CLIP_MEAN) / CLIP_STD
+    if T % tp:
+        pad = tp - T % tp
+        x = np.concatenate([x, np.repeat(x[-1:], pad, 0)], 0)
+        T += pad
+    gt, gh, gw = T // tp, H // p, W // p
+    # [gt, tp, gh/m, m, p, gw/m, m, p, C] in merge-group-major order,
+    # channel-first voxels (HF: C, tp, p, p flattened per patch)
+    x = x.reshape(gt, tp, gh // m, m, p, gw // m, m, p, C)
+    x = x.transpose(0, 2, 5, 3, 6, 8, 1, 4, 7)
+    patches = x.reshape(gt * gh * gw, C * tp * p * p)
+    return np.ascontiguousarray(patches), (gt, gh, gw)
+
+
+def merged_tokens(grid: Tuple[int, int, int],
+                  cfg: Qwen2VLVisionConfig) -> int:
+    t, h, w = grid
+    return t * h * w // cfg.merge_unit
+
+
+def mrope_positions(
+    token_ids: Sequence[int],
+    image_token_id: int,
+    grids: List[Tuple[int, int, int]],
+    cfg: Qwen2VLVisionConfig,
+) -> Tuple[np.ndarray, int]:
+    """(positions [3, S] int32, delta) for a prompt whose image/video
+    placeholder runs are already expanded to `merged_tokens(grid)`
+    copies each (HF `Qwen2VLModel.get_rope_index` semantics).  `delta` =
+    (max position + 1) - len(tokens): every position after the prompt —
+    including decode steps — ropes at token_index + delta."""
+    m = cfg.spatial_merge_size
+    S = len(token_ids)
+    pos = np.zeros((3, S), np.int32)
+    i = 0
+    nxt = 0  # next scalar position
+    g = iter(grids)
+    while i < S:
+        if token_ids[i] == image_token_id:
+            t, h, w = next(g)
+            lh, lw = h // m, w // m
+            n = t * lh * lw
+            tt = np.arange(t, dtype=np.int32).repeat(lh * lw)
+            hh = np.tile(np.arange(lh, dtype=np.int32).repeat(lw), t)
+            ww = np.tile(np.tile(np.arange(lw, dtype=np.int32), lh), t)
+            pos[0, i:i + n] = nxt + tt
+            pos[1, i:i + n] = nxt + hh
+            pos[2, i:i + n] = nxt + ww
+            nxt = nxt + max(t, lh, lw)
+            i += n
+        else:
+            pos[:, i] = nxt
+            nxt += 1
+            i += 1
+    try:
+        next(g)
+        raise ValueError("more grids than image runs in the prompt")
+    except StopIteration:
+        pass
+    return pos, int(nxt - S)
+
+
+def mrope_positions_from_runs(
+    total_len: int,
+    runs: List[Tuple[int, Tuple[int, int, int]]],  # (offset, grid) sorted
+    cfg: Qwen2VLVisionConfig,
+) -> Tuple[np.ndarray, int]:
+    """`mrope_positions` without token ids: the engine knows each vision
+    run's start offset and grid (the preprocessor expanded placeholders
+    already), which fully determines the three streams."""
+    m = cfg.spatial_merge_size
+    pos = np.zeros((3, total_len), np.int32)
+    i = 0
+    nxt = 0
+    runs = sorted(runs)
+    for off, (t, h, w) in runs:
+        while i < off:
+            pos[:, i] = nxt
+            nxt += 1
+            i += 1
+        lh, lw = h // m, w // m
+        n = t * lh * lw
+        if off + n > total_len:
+            raise ValueError("vision run exceeds the prompt")
+        pos[0, i:i + n] = nxt + np.arange(t, dtype=np.int32).repeat(lh * lw)
+        pos[1, i:i + n] = nxt + np.tile(
+            np.arange(lh, dtype=np.int32).repeat(lw), t)
+        pos[2, i:i + n] = nxt + np.tile(
+            np.tile(np.arange(lw, dtype=np.int32), lh), t)
+        nxt += max(t, lh, lw)
+        i += n
+    while i < total_len:
+        pos[:, i] = nxt
+        nxt += 1
+        i += 1
+    return pos, int(nxt - total_len)

@@ -148,7 +148,7 @@ def load_vlm(path: str, dtype=jnp.bfloat16) -> Tuple:
         hf = json.load(f)
     text_cfg = hf.get("text_config") or hf
     llm_cfg = ModelConfig.from_hf_config(
-        text_cfg, name=hf.get("_name_or_path", os.path.basename(path))
+        text_cfg, name=hf.get("_name_or_path") or os.path.basename(path)
     )
     # ONE reader for the probe + both loads (a sharded checkpoint's
     # index parses once; shard handles are shared)
@@ -169,4 +169,84 @@ def load_vlm(path: str, dtype=jnp.bfloat16) -> Tuple:
     vparams = load_vision_params(path, vcfg, dtype=jnp.float32, reader=r)
     llm_params = load_params(path, llm_cfg, dtype=dtype,
                              prefix="language_model.", reader=r)
+    return llm_params, llm_cfg, vparams, vcfg
+
+
+# -- Qwen2-VL layout --------------------------------------------------------- #
+
+
+def load_qwen_vl_vision_params(path: str, vcfg, dtype=jnp.float32,
+                               reader=None, prefix: str = "visual."):
+    """Qwen2-VL tower weights (`visual.*`) → models.qwen_vl params."""
+    r = reader or _ShardReader(path)
+    L = vcfg.depth
+    B = prefix + "blocks.{i}."
+
+    def stack(fmt: str, transpose: bool = True):
+        return stack_layers(r, L, fmt, transpose=transpose, dtype=dtype)
+
+    conv = r.get(prefix + "patch_embed.proj.weight")  # [e, C, tp, p, p]
+    return {
+        # voxel flatten order is (C, tp, p, p) — matches frames_to_patches
+        "patch_proj": jnp.asarray(
+            np.ascontiguousarray(conv.reshape(conv.shape[0], -1).T), dtype
+        ),
+        "layers": {
+            "ln1_scale": stack(B + "norm1.weight", False),
+            "ln1_bias": stack(B + "norm1.bias", False),
+            "wqkv": stack(B + "attn.qkv.weight"),
+            "bqkv": stack(B + "attn.qkv.bias", False),
+            "wo": stack(B + "attn.proj.weight"),
+            "bo": stack(B + "attn.proj.bias", False),
+            "ln2_scale": stack(B + "norm2.weight", False),
+            "ln2_bias": stack(B + "norm2.bias", False),
+            "w1": stack(B + "mlp.fc1.weight"),
+            "b1": stack(B + "mlp.fc1.bias", False),
+            "w2": stack(B + "mlp.fc2.weight"),
+            "b2": stack(B + "mlp.fc2.bias", False),
+        },
+        "merge_ln_scale": jnp.asarray(r.get(prefix + "merger.ln_q.weight"), dtype),
+        "merge_ln_bias": jnp.asarray(r.get(prefix + "merger.ln_q.bias"), dtype),
+        "merge_w1": jnp.asarray(r.get(prefix + "merger.mlp.0.weight").T, dtype),
+        "merge_b1": jnp.asarray(r.get(prefix + "merger.mlp.0.bias"), dtype),
+        "merge_w2": jnp.asarray(r.get(prefix + "merger.mlp.2.weight").T, dtype),
+        "merge_b2": jnp.asarray(r.get(prefix + "merger.mlp.2.bias"), dtype),
+    }
+
+
+def load_qwen_vl(path: str, dtype=jnp.bfloat16) -> Tuple:
+    """Load a Qwen2-VL-layout checkpoint directory: returns
+    (llm_params, llm_cfg, vision_params, vision_cfg).  Handles both the
+    published layout (`visual.*` + `model.*`) and the re-nested one
+    (`model.visual.*` + `model.language_model.*`)."""
+    from .qwen_vl import Qwen2VLVisionConfig
+
+    with open(os.path.join(path, "config.json")) as f:
+        hf = json.load(f)
+    # re-saved checkpoints nest the LLM fields under text_config (same
+    # fallback as load_vlm)
+    text = hf.get("text_config") or hf
+    llm_cfg = ModelConfig.from_hf_config(
+        text, name=hf.get("_name_or_path") or os.path.basename(path)
+    )
+    if not llm_cfg.mrope_section:
+        raise ValueError("qwen2_vl config has no mrope_section")
+    vcfg = Qwen2VLVisionConfig.from_hf_config(hf.get("vision_config") or {})
+    if vcfg.out_hidden_size != llm_cfg.hidden_size:
+        raise ValueError(
+            f"tower output {vcfg.out_hidden_size} != LLM hidden "
+            f"{llm_cfg.hidden_size}"
+        )
+    r = _ShardReader(path)
+    if r.has("visual.patch_embed.proj.weight"):
+        vis_prefix, llm_prefix = "visual.", ""
+    elif r.has("model.visual.patch_embed.proj.weight"):
+        vis_prefix, llm_prefix = "model.visual.", "model.language_"
+    else:
+        raise ValueError("no qwen2-vl visual tower found in checkpoint")
+    vparams = load_qwen_vl_vision_params(
+        path, vcfg, dtype=jnp.float32, reader=r, prefix=vis_prefix
+    )
+    llm_params = load_params(path, llm_cfg, dtype=dtype,
+                             prefix=llm_prefix, reader=r)
     return llm_params, llm_cfg, vparams, vcfg

@@ -12,7 +12,7 @@ from .paged_attention import (
     prefill_attention,
     write_kv_pages,
 )
-from .rotary import apply_rope, rope_frequencies
+from .rotary import apply_mrope, apply_rope, rope_frequencies
 from .sampling import (
     SamplingParams,
     apply_penalties,
@@ -24,6 +24,7 @@ from .sampling import (
 __all__ = [
     "SamplingParams",
     "apply_penalties",
+    "apply_mrope",
     "apply_rope",
     "compute_logprobs",
     "decode_attention",

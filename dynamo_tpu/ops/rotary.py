@@ -50,3 +50,41 @@ def apply_rope(
     x1, x2 = x[..., :d2].astype(jnp.float32), x[..., d2:].astype(jnp.float32)
     out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
     return out.astype(x.dtype)
+
+
+def apply_mrope(
+    x: jax.Array,  # [B, seq, heads, head_dim]
+    positions: jax.Array,  # [B, 3, seq] — (temporal, height, width) ids
+    inv_freq: jax.Array,  # [head_dim//2]
+    section,  # 3 ints summing to head_dim//2 (HF mrope_section)
+) -> jax.Array:
+    """Multimodal rotary embedding (Qwen2-VL): the head_dim//2 rotary
+    frequencies split into three contiguous sections that read their
+    angle from the temporal / height / width position stream
+    respectively.  Text tokens carry identical (t, h, w) ids, for which
+    this reduces exactly to `apply_rope` — decode therefore never needs
+    the 3-stream form, only a scalar position shifted by the sequence's
+    mrope delta.  Reference semantics: HF Qwen2VL
+    `apply_multimodal_rotary_pos_emb` (modeling_qwen2_vl.py)."""
+    t, h, w = section
+    assert t + h + w == inv_freq.shape[0], (section, inv_freq.shape)
+    sec_of = jnp.concatenate([
+        jnp.zeros((t,), jnp.int32),
+        jnp.ones((h,), jnp.int32),
+        jnp.full((w,), 2, jnp.int32),
+    ])  # [d/2] → which stream each frequency reads
+    # angles[b, s, i] = positions[b, sec_of[i], s] * inv_freq[i]
+    pos = jnp.take_along_axis(
+        positions.astype(jnp.float32),
+        jnp.broadcast_to(sec_of[None, :, None],
+                         (positions.shape[0], inv_freq.shape[0],
+                          positions.shape[2])),
+        axis=1,
+    )  # [B, d/2, seq]
+    angles = pos.transpose(0, 2, 1) * inv_freq  # [B, seq, d/2]
+    cos = jnp.cos(angles)[..., None, :]  # [B, seq, 1, d/2]
+    sin = jnp.sin(angles)[..., None, :]
+    d2 = x.shape[-1] // 2
+    x1, x2 = x[..., :d2].astype(jnp.float32), x[..., d2:].astype(jnp.float32)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)

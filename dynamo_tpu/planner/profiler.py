@@ -161,6 +161,24 @@ def _build_engine(args):
         cfg = LLAMA_3_2_1B
         dtype = jnp.bfloat16
         params = init_params(cfg, jax.random.PRNGKey(0), dtype=dtype)
+    elif args.model == "llama-8b":
+        # 8B fits a 16GB chip only as int8 (~8GB weights); init the
+        # quantized tree directly on device — a bf16 intermediate would
+        # OOM (same path bench.py measures)
+        from ..models.config import LLAMA_3_1_8B
+        from ..models.quantization import random_int8_params
+
+        if getattr(args, "quantization", "none") != "int8":
+            raise SystemExit("--model llama-8b requires --quantization int8")
+        cfg = LLAMA_3_1_8B
+        dtype = jnp.bfloat16
+        params = jax.jit(lambda k: random_int8_params(cfg, k))(
+            jax.random.PRNGKey(1)
+        )
+        jax.block_until_ready(params)
+        args = __import__("argparse").Namespace(
+            **{**vars(args), "quantization": "none"}
+        )  # params are already quantized; the engine must not re-quantize
     else:
         from ..llm import HuggingFaceTokenizer  # noqa: F401 — config check
         from ..models import ModelConfig
@@ -188,7 +206,8 @@ def main(argv=None) -> None:
     ap = argparse.ArgumentParser("dynamo_tpu.planner.profiler")
     ap.add_argument("--out", required=True, help="output npz path")
     ap.add_argument("--model", default="tiny",
-                    help="tiny | llama-1b | checkpoint dir")
+                    help="tiny | llama-1b | llama-8b (int8 only) | "
+                         "checkpoint dir")
     ap.add_argument("--mock", action="store_true")
     ap.add_argument("--quantization", default="none",
                     choices=["none", "int8"],

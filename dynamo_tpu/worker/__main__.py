@@ -435,6 +435,7 @@ def _build_engine(args):
     from ..engine import JaxEngine
 
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    loaded_vision = None
     if args.model == "tiny":
         import jax
 
@@ -456,7 +457,14 @@ def _build_engine(args):
 
         model_dir = resolve_model(args.model)
         cfg = ModelConfig.from_pretrained(model_dir)
-        params = load_params(model_dir, cfg, dtype=dtype)
+        if cfg.model_type == "qwen2_vl":
+            # qwen-vl checkpoints carry their own tower + mrope config
+            from ..models.vlm import load_qwen_vl
+
+            params, cfg, vparams, vcfg = load_qwen_vl(model_dir, dtype=dtype)
+            loaded_vision = (vparams, vcfg)
+        else:
+            params = load_params(model_dir, cfg, dtype=dtype)
         tok = HuggingFaceTokenizer.from_pretrained(model_dir)
         name = args.model_name or cfg.name
         tokenizer_json = tok.to_json_str()
@@ -470,7 +478,39 @@ def _build_engine(args):
                                   pp=args.pp)
     vision = None
     mm_fields = {}
-    if args.vision or args.encode_component:
+    if loaded_vision is not None:
+        # qwen2-vl checkpoint: the tower + geometry came with the model
+        import json as _json
+        import os as _os
+
+        vision = loaded_vision
+        vcfg = loaded_vision[1]
+        with open(_os.path.join(model_dir, "config.json")) as f:
+            hf = _json.load(f)
+        img_id = hf.get("image_token_id", 151655)
+        # id -> literal token string: decode() skips special tokens (the
+        # placeholder IS one), so keep them for this lookup
+        img_tok = tok.decode([img_id], skip_special_tokens=False)
+        if not img_tok or tok.encode(img_tok)[-1:] != [img_id]:
+            raise SystemExit(
+                f"image_token_id {img_id} does not round-trip through "
+                f"the tokenizer (got {img_tok!r})"
+            )
+        mm_fields = dict(
+            image_token=img_tok,
+            image_token_id=img_id,
+            mm_arch="qwen2_vl",
+            mm_config=dict(
+                depth=vcfg.depth, embed_dim=vcfg.embed_dim,
+                num_heads=vcfg.num_heads, mlp_ratio=vcfg.mlp_ratio,
+                patch_size=vcfg.patch_size,
+                temporal_patch_size=vcfg.temporal_patch_size,
+                spatial_merge_size=vcfg.spatial_merge_size,
+                hidden_size=vcfg.out_hidden_size,
+                min_pixels=vcfg.min_pixels, max_pixels=vcfg.max_pixels,
+            ),
+        )
+    elif args.vision or args.encode_component:
         import jax
 
         from ..models.vision import init_vision_params, tiny_vision_config
