@@ -142,7 +142,8 @@ def _build_prefill_step(cfg: ModelConfig, with_top: bool = False,
 
 
 def _build_prefill_step_sp(cfg: ModelConfig, mesh, with_top: bool = False,
-                           lockstep: bool = False, pool_axes=None):
+                           lockstep: bool = False, pool_axes=None,
+                           with_embeds: bool = False):
     """Sequence-parallel whole-prompt prefill (parallel/sp_prefill.py):
     the prompt is sharded over the sp axis and attention runs as ring
     attention; sampling happens on the gathered last-position logits.
@@ -165,10 +166,13 @@ def _build_prefill_step_sp(cfg: ModelConfig, mesh, with_top: bool = False,
     if pool_axes is None:
         @partial(jax.jit, donate_argnums=(1,), **kw)
         def step(params, kv, tokens, page_table, prefix_lens, chunk_lens,
-                 samp, seeds, counters, prefix_table):
+                 samp, seeds, counters, *rest):
+            mm, (prefix_table,) = rest[:-1], rest[-1:]
             logits, kv = forward_prefill_sp(
                 params, cfg, kv, tokens, page_table, chunk_lens, mesh,
                 prefix_lens=prefix_lens, prefix_table=prefix_table,
+                extra_embeds=mm[0] if with_embeds else None,
+                extra_mask=mm[1] if with_embeds else None,
             )
             out = sample_tokens(logits, samp, seeds, counters)
             logp = compute_logprobs(logits, out)
@@ -176,11 +180,14 @@ def _build_prefill_step_sp(cfg: ModelConfig, mesh, with_top: bool = False,
     else:
         @partial(jax.jit, donate_argnums=(1,), **kw)
         def step(params, kv, tokens, page_table, prefix_lens, chunk_lens,
-                 samp, seeds, counters, owner):
+                 samp, seeds, counters, *rest):
             del prefix_lens
+            mm, (owner,) = rest[:-1], rest[-1:]
             logits, kv = forward_prefill_sp(
                 params, cfg, kv, tokens, page_table, chunk_lens, mesh,
                 owner=owner, pool_axes=pool_axes,
+                extra_embeds=mm[0] if with_embeds else None,
+                extra_mask=mm[1] if with_embeds else None,
             )
             out = sample_tokens(logits, samp, seeds, counters)
             logp = compute_logprobs(logits, out)
@@ -508,16 +515,21 @@ def _lockstep_pooled_kw(mesh, pool_axes, out_specs, n_replicated: int = 1):
 
 def _build_prefill_step_pooled(cfg: ModelConfig, mesh, pool_axes,
                                with_top: bool = False, attn_impl: str = "xla",
-                               lockstep: bool = False):
+                               lockstep: bool = False,
+                               with_embeds: bool = False):
     from ..parallel._compat import shard_map
 
     kvspec, bx, bx2 = _pooled_specs(pool_axes)
 
     def body(params, kv, tokens, page_table, prefix_lens, chunk_lens, samp,
-             seeds, counters):
+             seeds, counters, *mm):
         logits, kv = forward_prefill(
             params, cfg, kv, tokens, page_table, prefix_lens, chunk_lens,
             attn_impl=attn_impl,
+            # vision embeds shard over the same per-rank batch blocks as
+            # the tokens (vision × kv_partition)
+            extra_embeds=mm[0] if with_embeds else None,
+            extra_mask=mm[1] if with_embeds else None,
         )
         out = sample_tokens(logits, samp, seeds, counters)
         logp = compute_logprobs(logits, out)
@@ -527,9 +539,10 @@ def _build_prefill_step_pooled(cfg: ModelConfig, mesh, pool_axes,
     # so the global array is a concatenation of per-rank blocks — the
     # host unpacks with `_unpack_rows(..., blocks=R)`
     out_specs = (bx, bx, kvspec)
+    mm_specs = ((P(*pool_axes, None, None), bx2) if with_embeds else ())
     sm = shard_map(
         body, mesh=mesh,
-        in_specs=(P(), kvspec, bx2, bx2, bx, bx, bx, bx, bx),
+        in_specs=(P(), kvspec, bx2, bx2, bx, bx, bx, bx, bx, *mm_specs),
         out_specs=out_specs,
         axis_names=set(pool_axes),
     )
@@ -900,11 +913,6 @@ class JaxEngine:
                         f"={max(self.cfg.decode_batch_buckets)} >= "
                         f"max_num_seqs={self.cfg.max_num_seqs}"
                     )
-                if vision is not None:
-                    raise ValueError(
-                        "the vision tower is not supported with a "
-                        "partitioned (kv_partition) pool yet"
-                    )
             else:
                 # every batch shape must divide dp (rows beyond the real
                 # batch are trash-page padding)
@@ -941,13 +949,11 @@ class JaxEngine:
         self.vision = vision
         self._encode_fn = None
         self._embed_fn = None
-        # vision composes with multihost: the tower runs leader-local and
-        # the resulting embeds ride the lockstep prefill plan (small
-        # [N, patches, h] arrays); sp ring prefill remains excluded
-        if vision is not None and self._sp > 1:
-            raise ValueError(
-                "the vision tower is not supported under sp prefill yet"
-            )
+        # vision composes with multihost (the tower runs leader-local and
+        # the resulting embeds ride the lockstep prefill plan), with
+        # kv_partition (embeds shard with the per-rank batch blocks),
+        # and with sp (embeds/mask shard their sequence axis over the
+        # ring exactly like the tokens)
         if model_cfg.mrope_section:
             # M-RoPE (qwen2_vl): decode ropes at slot + per-seq delta.
             # The fused/mixed fast paths don't thread the offset operand
@@ -1170,6 +1176,7 @@ class JaxEngine:
                     self.model_cfg, self.mesh, with_top,
                     lockstep=self._multihost,
                     pool_axes=self._pool_axes if self._pooled else None,
+                    with_embeds=with_mm,
                 )
             elif self._pp > 1:
                 self._prefill_steps[key] = _build_prefill_step_pp(
@@ -1180,7 +1187,7 @@ class JaxEngine:
                 self._prefill_steps[key] = _build_prefill_step_pooled(
                     self.model_cfg, self.mesh, self._pool_axes,
                     with_top=with_top, attn_impl=self._attn_impl,
-                    lockstep=self._multihost,
+                    lockstep=self._multihost, with_embeds=with_mm,
                 )
             else:
                 self._prefill_steps[key] = _build_prefill_step(
@@ -1979,8 +1986,14 @@ class JaxEngine:
         if self.vision is None:
             return "this worker has no vision tower attached"
         from ..llm.multimodal import unpack_pixels
+        from ..models.vision import VisionConfig
 
         _, vcfg = self.vision
+        if not isinstance(vcfg, VisionConfig):
+            # e.g. mm_pixels sent to a qwen2_vl (dynamic-resolution)
+            # tower — the fixed-shape checks below would AttributeError
+            return ("this worker's vision tower takes mm_patches "
+                    "(dynamic resolution), not mm_pixels")
         try:
             pixels = unpack_pixels(request["mm_pixels"])
         except Exception:  # noqa: BLE001 — wire payloads are untrusted

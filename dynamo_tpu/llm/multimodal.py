@@ -162,7 +162,9 @@ def process_frames(raw: bytes, height: int, width: int,
     """Encoded image OR animated image (GIF/WebP/APNG) bytes →
     [T, H, W, 3] float32 in [0, 1].  Frames are sampled uniformly down
     to `max_frames` BEFORE decoding — a thousand-frame GIF must not
-    cost a thousand RGB conversions in the request path."""
+    cost a thousand RGB conversions in the request path.  Resampling is
+    BICUBIC: the qwen-vl towers this path feeds were trained behind
+    HF's Qwen2VLImageProcessor, whose default resample is bicubic."""
     from PIL import Image
 
     try:
@@ -175,7 +177,7 @@ def process_frames(raw: bytes, height: int, width: int,
             if n > 1:
                 img.seek(int(i))
             frames.append(
-                img.convert("RGB").resize((width, height), Image.BILINEAR)
+                img.convert("RGB").resize((width, height), Image.BICUBIC)
             )
     except Exception as e:  # noqa: BLE001 — PIL raises many types
         raise RequestError(f"cannot decode video/image: {e}") from None

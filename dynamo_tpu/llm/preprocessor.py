@@ -163,6 +163,7 @@ class OpenAIPreprocessor:
             smart_resize,
         )
         from .multimodal import (
+            MAX_VIDEO_FRAMES,
             expand_media_tokens,
             load_image_bytes,
             pack_patches,
@@ -193,7 +194,8 @@ class OpenAIPreprocessor:
             h1, w1 = smart_resize(h0, w0, vcfg)
             frames = process_frames(
                 raw, h1, w1,
-                max_frames=(1 if m["kind"] == "image" else 16),
+                max_frames=(1 if m["kind"] == "image"
+                            else MAX_VIDEO_FRAMES),
             )
             patches, grid = frames_to_patches(frames, vcfg)
             blobs.append(pack_patches(patches, grid))

@@ -264,6 +264,9 @@ def forward_prefill_sp(
     prefix_table: jax.Array = None,  # [B, Wp] pages covering the batch's
     # longest prefix (width-bucketed host-side; Wp == 0 → no cached
     # prefixes this step, the prefix path compiles out)
+    extra_embeds: jax.Array = None,  # [B, S, h] vision-tower patches
+    extra_mask: jax.Array = None,  # [B, S] bool — both shard their S
+    # axis over sp exactly like the tokens (vision × sp)
 ) -> Tuple[jax.Array, KVCache]:
     """Whole-prompt prefill with the sequence sharded over `sp` and heads
     over `tp`.
@@ -294,9 +297,10 @@ def forward_prefill_sp(
     inv_freq = rope_frequencies(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
 
     pooled = owner is not None
+    with_embeds = extra_embeds is not None
 
     def body(params, kv_k, kv_v, tokens_l, table_l, chunk_l, owner_l,
-             prefix_l, prefix_table_l):
+             prefix_l, prefix_table_l, *mm):
         sp_i = jax.lax.axis_index("sp")
         Bl, Sl = tokens_l.shape
         # the ring starts at each row's prefix boundary (0 with no cache)
@@ -310,6 +314,9 @@ def forward_prefill_sp(
             prefix_full = jax.lax.all_gather(prefix_l, "dp", axis=0, tiled=True)
 
         x = _embed_sp(params["embed"], tokens_l)
+        if with_embeds:
+            # the local S slice of embeds/mask lines up with tokens_l
+            x = jnp.where(mm[1][..., None], mm[0].astype(x.dtype), x)
         from ..models.llama import _window_xs
 
         wins = _window_xs(cfg)
@@ -352,12 +359,17 @@ def forward_prefill_sp(
         prefix_lens = jnp.zeros(tokens.shape[:1], jnp.int32)
     if prefix_table is None:
         prefix_table = jnp.zeros((tokens.shape[0], 0), jnp.int32)
+    mm_args = ()
+    mm_specs = ()
+    if with_embeds:
+        mm_args = (extra_embeds, extra_mask)
+        mm_specs = (P("dp", "sp", None), P("dp", "sp"))
     logits, k_new, v_new = shard_map(
         body,
         mesh=mesh,
         in_specs=(pspec, kv_spec, kv_spec, P("dp", "sp"), P("dp", None),
-                  P("dp"), P("dp"), P("dp"), P("dp", None)),
+                  P("dp"), P("dp"), P("dp"), P("dp", None), *mm_specs),
         out_specs=(P("dp", "tp"), kv_spec, kv_spec),
     )(params, kv.k, kv.v, tokens, page_table, chunk_lens, owner,
-      prefix_lens, prefix_table)
+      prefix_lens, prefix_table, *mm_args)
     return logits, KVCache(k_new, v_new)

@@ -176,9 +176,8 @@ def _build_engine(args):
             jax.random.PRNGKey(1)
         )
         jax.block_until_ready(params)
-        args = __import__("argparse").Namespace(
-            **{**vars(args), "quantization": "none"}
-        )  # params are already quantized; the engine must not re-quantize
+        # params are already quantized; the engine must not re-quantize
+        args.quantization = "none"
     else:
         from ..llm import HuggingFaceTokenizer  # noqa: F401 — config check
         from ..models import ModelConfig

@@ -364,3 +364,105 @@ async def test_e2e_encode_worker_offload():
         await chat_rt.shutdown(graceful=False)
         await enc_rt.shutdown(graceful=False)
         await control.stop()
+
+
+async def test_vision_composes_with_kv_partition():
+    """Image chat on a partitioned-pool (kv_partition) engine: embeds
+    shard with the per-rank batch blocks; greedy output equals the flat
+    single-device engine (round 4: the vision x kv_partition exclusion
+    is lifted)."""
+    from dynamo_tpu.parallel import ParallelConfig
+
+    tok, cfg, params, vcfg, vparams, mdc = _mm_setup()
+    pre = OpenAIPreprocessor(mdc, tok)
+
+    def req(color):
+        return pre.preprocess_chat({
+            "messages": [{"role": "user", "content": [
+                {"type": "text", "text": "what is this? "},
+                {"type": "image_url", "image_url": {"url": _data_uri(color)}},
+            ]}],
+        })
+
+    def ecfg():
+        return EngineConfig(
+            page_size=8, num_pages=64, max_num_seqs=4,
+            max_prefill_tokens=64, max_model_len=128,
+            kv_partition=True,
+        )
+
+    flat = JaxEngine(cfg, params, EngineConfig(
+        page_size=8, num_pages=128, max_num_seqs=4,
+        max_prefill_tokens=64, max_model_len=128,
+    ), kv_dtype=jnp.float32, vision=(vparams, vcfg))
+    want = [await _gen(flat, req(c))
+            for c in [(0, 0, 0), (255, 255, 255), (30, 200, 40)]]
+    await flat.shutdown()
+
+    import jax as _jax
+
+    pooled = JaxEngine(
+        cfg, params, ecfg(), kv_dtype=jnp.float32,
+        vision=(vparams, vcfg), parallel=ParallelConfig(dp=2),
+        devices=_jax.devices()[:2],
+    )
+    got = [await _gen(pooled, req(c))
+           for c in [(0, 0, 0), (255, 255, 255), (30, 200, 40)]]
+    await pooled.shutdown()
+    assert got == want
+
+
+async def test_vision_composes_with_sp_ring_prefill():
+    """Image chat under sp ring prefill (and sp x kv_partition): the
+    tower's embeds shard their sequence axis over the ring like the
+    tokens; greedy output equals the flat single-device engine (round 4:
+    the vision x sp exclusion is lifted)."""
+    from dynamo_tpu.parallel import ParallelConfig
+
+    tok, cfg, params, vcfg, vparams, mdc = _mm_setup()
+    pre = OpenAIPreprocessor(mdc, tok)
+
+    def req(color):
+        return pre.preprocess_chat({
+            "messages": [{"role": "user", "content": [
+                {"type": "text", "text": "look: "},
+                {"type": "image_url", "image_url": {"url": _data_uri(color)}},
+            ]}],
+        })
+
+    flat = JaxEngine(cfg, params, EngineConfig(
+        page_size=8, num_pages=128, max_num_seqs=4,
+        max_prefill_tokens=256, max_model_len=128, prefill_batch_size=1,
+        enable_prefix_caching=False,
+    ), kv_dtype=jnp.float32, vision=(vparams, vcfg))
+    colors = [(0, 0, 0), (250, 250, 250)]
+    want = [await _gen(flat, req(c)) for c in colors]
+    await flat.shutdown()
+
+    def sp_cfg(**over):
+        kw = dict(page_size=8, num_pages=64, max_num_seqs=4,
+                  max_prefill_tokens=256, max_model_len=128,
+                  prefill_batch_size=1, enable_prefix_caching=False)
+        kw.update(over)
+        return EngineConfig(**kw)
+
+    import jax as _jax
+
+    # tp=1: the tiny tokenizer's vocab (261) does not divide tp
+    sp = JaxEngine(
+        cfg, params, sp_cfg(), kv_dtype=jnp.float32,
+        vision=(vparams, vcfg), parallel=ParallelConfig(dp=2, sp=2),
+        devices=_jax.devices()[:4],
+    )
+    got = [await _gen(sp, req(c)) for c in colors]
+    await sp.shutdown()
+    assert got == want
+
+    pooled_sp = JaxEngine(
+        cfg, params, sp_cfg(kv_partition=True), kv_dtype=jnp.float32,
+        vision=(vparams, vcfg), parallel=ParallelConfig(dp=2, sp=2),
+        devices=_jax.devices()[:4],
+    )
+    got2 = [await _gen(pooled_sp, req(c)) for c in colors]
+    await pooled_sp.shutdown()
+    assert got2 == want
