@@ -40,6 +40,8 @@ class ModelConfig:
     mrope_section: Optional[tuple] = None
     tie_word_embeddings: bool = False
     attention_bias: bool = False
+    # gpt-oss biases o_proj too (qwen2 biases only q/k/v)
+    attention_out_bias: bool = False
     # sliding-window attention (Mistral/GPT-OSS family): tokens attend to
     # at most the last `sliding_window` positions.  `layer_types` (HF
     # convention: "sliding_attention" / "full_attention" per layer) mixes
@@ -65,6 +67,12 @@ class ModelConfig:
     # dispatch group size: tokens are dispatched within groups of this many
     # so the one-hot dispatch tensor stays O(T*G), not O(T^2)
     moe_group_size: int = 256
+    # expert activation: "silu" (mixtral-style silu(gate)*up) or
+    # "gpt_oss_glu" (clamped gate*sigmoid(1.702*gate) * (up+1) — HF
+    # GptOssExperts with limit 7.0); moe_bias adds router + per-expert
+    # gate/up/down biases (gpt-oss carries all four)
+    moe_act: str = "silu"
+    moe_bias: bool = False
     # identity
     model_type: str = "llama"
     name: str = "llama"
@@ -145,14 +153,22 @@ class ModelConfig:
             tie_word_embeddings=d.get("tie_word_embeddings", False),
             # HF Qwen2Config has no attention_bias field — its attention
             # hardcodes qkv bias on (o_proj off); mirror that default
-            attention_bias=d.get(
+            attention_bias=(attn_bias := d.get(
                 "attention_bias",
                 d.get("model_type") in ("qwen2", "qwen2_vl",
-                                        "qwen2_vl_text"),
+                                        "qwen2_vl_text", "gpt_oss"),
+            )),
+            # gpt-oss biases o_proj too — ONE resolution of
+            # attention_bias drives both fields so they cannot split
+            attention_out_bias=(
+                d.get("model_type") == "gpt_oss" and attn_bias
             ),
             num_experts=num_experts,
             num_experts_per_tok=d.get("num_experts_per_tok", 2),
             moe_intermediate_size=d.get("moe_intermediate_size"),
+            moe_act=("gpt_oss_glu" if d.get("model_type") == "gpt_oss"
+                     else "silu"),
+            moe_bias=d.get("model_type") == "gpt_oss",
             # Qwen2.5 ships sliding_window=131072 with
             # use_sliding_window=false — HF only engages the window when
             # the flag is on (absent = on, the Mistral convention)
@@ -327,8 +343,42 @@ QWEN2_5_0_5B = ModelConfig(
     name="qwen2.5-0.5b",
 )
 
+GPT_OSS_20B = ModelConfig(
+    # openai/gpt-oss-20b (HF GptOssConfig): 24-layer MoE, 32 experts
+    # top-4, alternating sliding/full attention, learnable sinks,
+    # biased router + clamped-GLU experts, o_proj bias
+    vocab_size=201088,
+    hidden_size=2880,
+    intermediate_size=2880,
+    num_hidden_layers=24,
+    num_attention_heads=64,
+    num_key_value_heads=8,
+    head_dim=64,
+    max_position_embeddings=131072,
+    rms_norm_eps=1e-5,
+    rope_theta=150000.0,
+    rope_scaling={"rope_type": "yarn", "factor": 32.0,
+                  "beta_fast": 32.0, "beta_slow": 1.0,
+                  "original_max_position_embeddings": 4096,
+                  "truncate": False},
+    attention_bias=True,
+    attention_out_bias=True,
+    attention_sinks=True,
+    sliding_window=128,
+    layer_types=tuple(
+        "sliding_attention" if i % 2 == 0 else "full_attention"
+        for i in range(24)
+    ),
+    num_experts=32,
+    num_experts_per_tok=4,
+    moe_act="gpt_oss_glu",
+    moe_bias=True,
+    model_type="gpt_oss",
+    name="gpt-oss-20b",
+)
+
 CONFIGS = {
     c.name: c
     for c in [LLAMA_3_2_1B, LLAMA_3_1_8B, LLAMA_3_70B, MIXTRAL_8X7B,
-              MISTRAL_7B, QWEN2_5_7B, QWEN2_5_0_5B]
+              MISTRAL_7B, QWEN2_5_7B, QWEN2_5_0_5B, GPT_OSS_20B]
 }

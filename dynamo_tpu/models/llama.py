@@ -31,6 +31,7 @@ from ..ops import (
     decode_attention,
     prefill_attention,
     rms_norm,
+    rope_attention_scale,
     rope_frequencies,
     write_kv_pages,
 )
@@ -100,6 +101,8 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
                 "bv": w(next(ks), L, nkv * hd, scale=0.02),
             }
         )
+    if cfg.attention_out_bias:  # gpt-oss biases o_proj too
+        layers["bo"] = w(next(ks), L, h, scale=0.02)
     if cfg.attention_sinks:  # gpt-oss learnable per-head sink logits
         layers["sinks"] = w(next(ks), L, nh, scale=1.0)
     if cfg.is_moe:
@@ -113,6 +116,15 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
                 "w_down": w(next(ks), L, E, fm, h),
             }
         )
+        if cfg.moe_bias:  # gpt-oss: router + per-expert ffn biases
+            layers.update(
+                {
+                    "router_b": w(next(ks), L, E, scale=0.02),
+                    "b_gate": w(next(ks), L, E, fm, scale=0.02),
+                    "b_up": w(next(ks), L, E, fm, scale=0.02),
+                    "b_down": w(next(ks), L, E, h, scale=0.02),
+                }
+            )
     else:
         layers.update(
             {
@@ -154,6 +166,8 @@ def param_pspecs(cfg: ModelConfig, tp_axis: str = "tp", ep_axis: str = "tp") -> 
                 "bv": P(None, tp_axis),
             }
         )
+    if cfg.attention_out_bias:  # output-dim bias: replicated over tp
+        layers["bo"] = P(None, None)
     if cfg.attention_sinks:
         layers["sinks"] = P(None, tp_axis)
     if cfg.is_moe:
@@ -165,6 +179,15 @@ def param_pspecs(cfg: ModelConfig, tp_axis: str = "tp", ep_axis: str = "tp") -> 
                 "w_down": P(None, ep_axis, None, None),
             }
         )
+        if cfg.moe_bias:  # biases shard on the expert dim like weights
+            layers.update(
+                {
+                    "router_b": P(None, None),
+                    "b_gate": P(None, ep_axis, None),
+                    "b_up": P(None, ep_axis, None),
+                    "b_down": P(None, ep_axis, None),
+                }
+            )
     else:
         layers.update(
             {
@@ -267,23 +290,46 @@ def fuse_projections(params: Params) -> Params:
     return {**params, "layers": layers}
 
 
+def moe_act(cfg: ModelConfig, gate: jax.Array, up: jax.Array) -> jax.Array:
+    """Expert gating nonlinearity (float32 in/out).  "silu" is the
+    mixtral family; "gpt_oss_glu" is HF GptOssExperts: gate clamped to
+    <= 7, up to |7|, glu = gate*sigmoid(1.702*gate), out = (up+1)*glu."""
+    if cfg.moe_act == "gpt_oss_glu":
+        limit = 7.0
+        gate = jnp.minimum(gate, limit)
+        up = jnp.clip(up, -limit, limit)
+        return (up + 1.0) * (gate * jax.nn.sigmoid(1.702 * gate))
+    return jax.nn.silu(gate) * up
+
+
+def moe_router_logits(lp: Params, x: jax.Array, eq: str) -> jax.Array:
+    out = jnp.einsum(eq, x, lp["router"],
+                     preferred_element_type=jnp.float32)
+    if "router_b" in lp:
+        out = out + lp["router_b"]
+    return out
+
+
 def _moe_dense(lp: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
     """Reference MoE: every expert computes every token, one-hot combine.
     O(E) compute — kept as the equality oracle for the dispatched path and
     for tiny test models where dispatch overhead dominates."""
     B, S, h = x.shape
     E, k = cfg.num_experts, cfg.num_experts_per_tok
-    router_logits = jnp.einsum(
-        "bsh,he->bse", x, lp["router"], preferred_element_type=jnp.float32
-    )
+    router_logits = moe_router_logits(lp, x, "bsh,he->bse")
     weights, selected = jax.lax.top_k(router_logits, k)  # [B,S,k]
     weights = jax.nn.softmax(weights, axis=-1)
     onehot = jax.nn.one_hot(selected, E, dtype=x.dtype)  # [B,S,k,E]
     combine = jnp.einsum("bsk,bske->bse", weights.astype(x.dtype), onehot)  # [B,S,E]
     gate = jnp.einsum("bsh,ehf->ebsf", x, lp["w_gate"], preferred_element_type=jnp.float32)
     up = jnp.einsum("bsh,ehf->ebsf", x, lp["w_up"], preferred_element_type=jnp.float32)
-    act = (jax.nn.silu(gate) * up).astype(x.dtype)
+    if "b_gate" in lp:
+        gate = gate + lp["b_gate"][:, None, None, :]
+        up = up + lp["b_up"][:, None, None, :]
+    act = moe_act(cfg, gate, up).astype(x.dtype)
     out = jnp.einsum("ebsf,efh->ebsh", act, lp["w_down"], preferred_element_type=jnp.float32)
+    if "b_down" in lp:
+        out = out + lp["b_down"][:, None, None, :]
     return jnp.einsum("ebsh,bse->bsh", out.astype(x.dtype), combine)
 
 
@@ -302,9 +348,7 @@ def _moe_ragged(lp: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
     A = T * k
 
     xf = x.reshape(T, h)
-    router_logits = jnp.einsum(
-        "th,he->te", xf, lp["router"], preferred_element_type=jnp.float32
-    )
+    router_logits = moe_router_logits(lp, xf, "th,he->te")
     weights, selected = jax.lax.top_k(router_logits, k)  # [T, k]
     weights = jax.nn.softmax(weights, axis=-1)
 
@@ -313,6 +357,7 @@ def _moe_ragged(lp: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
     token_of = order // k  # assignment a (row-major [T, k]) is token a // k
     xs = xf[token_of]  # [A, h] rows sorted by expert
     group_sizes = jnp.bincount(expert_of, length=E)
+    expert_sorted = expert_of[order]  # bias rows per sorted assignment
 
     gate = jax.lax.ragged_dot(
         xs, lp["w_gate"], group_sizes,
@@ -322,11 +367,16 @@ def _moe_ragged(lp: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
         xs, lp["w_up"], group_sizes,
         preferred_element_type=jnp.float32,
     )
-    act = (jax.nn.silu(gate) * up).astype(x.dtype)
+    if "b_gate" in lp:
+        gate = gate + lp["b_gate"][expert_sorted]
+        up = up + lp["b_up"][expert_sorted]
+    act = moe_act(cfg, gate, up).astype(x.dtype)
     ys = jax.lax.ragged_dot(
         act, lp["w_down"], group_sizes,
         preferred_element_type=jnp.float32,
     )  # [A, h]
+    if "b_down" in lp:
+        ys = ys + lp["b_down"][expert_sorted]
 
     wf = weights.reshape(A)[order].astype(jnp.float32)
     out = jnp.zeros((T, h), jnp.float32).at[token_of].add(ys * wf[:, None])
@@ -379,9 +429,7 @@ def _moe_capacity(lp: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
     if Tp != T:
         xf = jnp.pad(xf, ((0, Tp - T), (0, 0)))
     xg = xf.reshape(n_g, G, h)
-    router_logits = jnp.einsum(
-        "gth,he->gte", xg, lp["router"], preferred_element_type=jnp.float32
-    )
+    router_logits = moe_router_logits(lp, xg, "gth,he->gte")
     weights, selected = jax.lax.top_k(router_logits, k)  # [n_g, G, k]
     weights = jax.nn.softmax(weights, axis=-1)
 
@@ -405,8 +453,13 @@ def _moe_capacity(lp: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
 
     gate = jnp.einsum("gech,ehf->gecf", xe, lp["w_gate"], preferred_element_type=jnp.float32)
     up = jnp.einsum("gech,ehf->gecf", xe, lp["w_up"], preferred_element_type=jnp.float32)
-    act = (jax.nn.silu(gate) * up).astype(x.dtype)
+    if "b_gate" in lp:
+        gate = gate + lp["b_gate"][None, :, None, :]
+        up = up + lp["b_up"][None, :, None, :]
+    act = moe_act(cfg, gate, up).astype(x.dtype)
     ye = jnp.einsum("gecf,efh->gech", act, lp["w_down"], preferred_element_type=jnp.float32)
+    if "b_down" in lp:
+        ye = ye + lp["b_down"][None, :, None, :]
 
     wf = weights.astype(x.dtype).reshape(n_g, G * k)
     out = jnp.einsum(
@@ -430,6 +483,7 @@ def _layer_prefill(
     attn_impl: str = "xla",
     window=None,  # per-layer sliding window (scalar; <= 0 → full)
     rope_pos=None,  # [B, 3, S] mrope streams (Qwen2-VL); None = standard
+    rope_scale: float = 1.0,  # yarn amplitude factor
 ):
     B, S, h = x.shape
     nh, nkv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim_
@@ -447,8 +501,8 @@ def _layer_prefill(
         q = apply_mrope(q, rope_pos, inv_freq, cfg.mrope_section)
         k = apply_mrope(k, rope_pos, inv_freq, cfg.mrope_section)
     else:
-        q = apply_rope(q, positions, inv_freq)
-        k = apply_rope(k, positions, inv_freq)
+        q = apply_rope(q, positions, inv_freq, scale=rope_scale)
+        k = apply_rope(k, positions, inv_freq, scale=rope_scale)
 
     attn = prefill_attention(
         q, k, v, k_pages, v_pages, page_table, prefix_lens, chunk_lens,
@@ -460,6 +514,8 @@ def _layer_prefill(
     attn_out = matmul_any(
         attn.reshape(B, S, nh * hd), lp["wo"], "bsd,dh->bsh"
     ).astype(x.dtype)
+    if "bo" in lp:  # gpt-oss carries an o_proj bias
+        attn_out = attn_out + lp["bo"].astype(x.dtype)
     x = x + attn_out
 
     mlp_in = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
@@ -480,6 +536,7 @@ def _layer_decode(
     window=None,  # per-layer sliding window (scalar; <= 0 → full)
     rope_pos=None,  # [B] rope positions when they differ from the KV
     # slot index (mrope decode: slot + per-seq delta)
+    rope_scale: float = 1.0,  # yarn amplitude factor
 ):
     B, h = x.shape
     nh, nkv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim_
@@ -492,8 +549,8 @@ def _layer_decode(
     k = k.astype(dt).reshape(B, 1, nkv, hd)
     v = v.astype(dt).reshape(B, 1, nkv, hd)
     rp = positions if rope_pos is None else rope_pos
-    q = apply_rope(q, rp[:, None], inv_freq)[:, 0]
-    k = apply_rope(k, rp[:, None], inv_freq)
+    q = apply_rope(q, rp[:, None], inv_freq, scale=rope_scale)[:, 0]
+    k = apply_rope(k, rp[:, None], inv_freq, scale=rope_scale)
 
     # write first, then attend over the full table (new token included)
     k_pages, v_pages = write_kv_pages(
@@ -506,6 +563,8 @@ def _layer_decode(
     attn_out = matmul_any(
         attn.reshape(B, nh * hd), lp["wo"], "bd,dh->bh"
     ).astype(x.dtype)
+    if "bo" in lp:  # gpt-oss carries an o_proj bias
+        attn_out = attn_out + lp["bo"].astype(x.dtype)
     x = x + attn_out
 
     mlp_in = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
@@ -557,6 +616,7 @@ def prefill_layers(
     `forward_prefill`, exposed so pipeline stages can run their local
     layer slice — parallel/pp_engine.py)."""
     inv_freq = rope_frequencies(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
+    rs = rope_attention_scale(cfg.rope_scaling)
     if wins is None:
         wins = _window_xs(cfg)
 
@@ -567,6 +627,7 @@ def prefill_layers(
             lp, (k_pages, v_pages), h, positions, page_table,
             prefix_lens, chunk_lens, cfg, inv_freq, attn_impl,
             window=xs[3] if wins else None, rope_pos=rope_pos,
+            rope_scale=rs,
         )
         return h, (k_pages, v_pages)
 
@@ -589,6 +650,7 @@ def decode_layers(
     """Scan a STACK of decoder layers for one decode step (the body of
     `forward_decode`, exposed for pipeline stages)."""
     inv_freq = rope_frequencies(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
+    rs = rope_attention_scale(cfg.rope_scaling)
     seq_lens = positions + 1
     if wins is None:
         wins = _window_xs(cfg)
@@ -600,7 +662,7 @@ def decode_layers(
         h, (k_pages, v_pages) = _layer_decode(
             lp, (k_pages, v_pages), h, positions, page_table, seq_lens, cfg,
             inv_freq, attn_impl, window=xs[3] if wins else None,
-            rope_pos=rope_pos,
+            rope_pos=rope_pos, rope_scale=rs,
         )
         return h, (k_pages, v_pages)
 

@@ -109,6 +109,8 @@ def load_params(path: str, cfg: ModelConfig, dtype=jnp.bfloat16,
             "does not declare attention_bias — refusing to silently drop "
             "them"
         )
+    if cfg.attention_out_bias:  # gpt-oss biases o_proj too
+        layers["bo"] = stack(p + "self_attn.o_proj.bias", transpose=False)
     if cfg.attention_sinks:  # gpt-oss sink logits — gate on the CONFIG
         # (like every other consumer) so params and cfg cannot disagree
         if not r.has(prefix + "model.layers.0.self_attn.sinks"):
@@ -117,7 +119,37 @@ def load_params(path: str, cfg: ModelConfig, dtype=jnp.bfloat16,
                 "no self_attn.sinks tensors"
             )
         layers["sinks"] = stack(p + "self_attn.sinks", transpose=False)
-    if cfg.is_moe:
+    if cfg.moe_bias and r.has(
+        prefix + "model.layers.0.mlp.experts.gate_up_proj"
+    ):
+        # gpt-oss layout: stacked expert tensors with INTERLEAVED
+        # gate/up columns (HF GptOssExperts: gate = [..., ::2]),
+        # per-expert biases, and a biased router
+        def estack(name):
+            return np.stack([
+                r.get(prefix + f"model.layers.{i}.mlp.{name}")
+                for i in range(L)
+            ])
+
+        gu = estack("experts.gate_up_proj")  # [L, E, h, 2f]
+        gub = estack("experts.gate_up_proj_bias")  # [L, E, 2f]
+        layers.update(
+            {
+                "router": jnp.asarray(
+                    estack("router.weight").transpose(0, 2, 1), dtype
+                ),  # [L, E, h] → [L, h, E]
+                "router_b": jnp.asarray(estack("router.bias"), dtype),
+                "w_gate": jnp.asarray(gu[..., ::2], dtype),
+                "w_up": jnp.asarray(gu[..., 1::2], dtype),
+                "b_gate": jnp.asarray(gub[..., ::2], dtype),
+                "b_up": jnp.asarray(gub[..., 1::2], dtype),
+                "w_down": jnp.asarray(estack("experts.down_proj"), dtype),
+                "b_down": jnp.asarray(
+                    estack("experts.down_proj_bias"), dtype
+                ),
+            }
+        )
+    elif cfg.is_moe:
         E = cfg.num_experts
 
         def stack_experts(sub: str) -> jnp.ndarray:

@@ -12,7 +12,8 @@ from .paged_attention import (
     prefill_attention,
     write_kv_pages,
 )
-from .rotary import apply_mrope, apply_rope, rope_frequencies
+from .rotary import (apply_mrope, apply_rope,
+                     rope_attention_scale, rope_frequencies)
 from .sampling import (
     SamplingParams,
     apply_penalties,
@@ -31,6 +32,7 @@ __all__ = [
     "gather_kv",
     "prefill_attention",
     "rms_norm",
+    "rope_attention_scale",
     "rope_frequencies",
     "sample_tokens",
     "top_logprobs",

@@ -22,6 +22,30 @@ def rope_frequencies(
     inv_freq = 1.0 / (
         theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
     )
+    if scaling and scaling.get("rope_type", scaling.get("type")) == "yarn":
+        # YaRN (gpt-oss ships factor=32 over 4096 original): interpolate
+        # the long-wavelength frequencies by 1/factor, keep the short
+        # ones, linear-ramp between — HF _compute_yarn_parameters.  The
+        # companion amplitude factor is `rope_attention_scale`.
+        factor = float(scaling["factor"])
+        orig = float(scaling.get("original_max_position_embeddings", 4096))
+        beta_fast = float(scaling.get("beta_fast", 32.0))
+        beta_slow = float(scaling.get("beta_slow", 1.0))
+
+        def dim_for(rotations: float) -> float:
+            return (head_dim * math.log(orig / (rotations * 2 * math.pi))
+                    ) / (2 * math.log(theta))
+
+        low = math.floor(dim_for(beta_fast))
+        high = math.ceil(dim_for(beta_slow))
+        ramp = jnp.clip(
+            (jnp.arange(head_dim // 2, dtype=jnp.float32) - low)
+            / max(high - low, 1e-3),
+            0.0, 1.0,
+        )
+        extrapolation_mask = 1.0 - ramp  # 1 → keep original frequency
+        return (inv_freq / factor) * (1.0 - extrapolation_mask) \
+            + inv_freq * extrapolation_mask
     if scaling and scaling.get("rope_type", scaling.get("type")) == "llama3":
         factor = scaling["factor"]
         low = scaling["low_freq_factor"]
@@ -37,10 +61,25 @@ def rope_frequencies(
     return inv_freq
 
 
+def rope_attention_scale(scaling: Optional[dict]) -> float:
+    """YaRN's amplitude factor: HF multiplies cos AND sin by it, which
+    equals scaling the roped q and k by the factor (score scale f²).
+    1.0 for every other rope flavor."""
+    if scaling and scaling.get("rope_type", scaling.get("type")) == "yarn":
+        explicit = scaling.get("attention_factor")
+        if explicit is not None:
+            return float(explicit)
+        factor = float(scaling["factor"])
+        mscale = float(scaling.get("mscale", 1.0))
+        return 0.1 * mscale * math.log(factor) + 1.0
+    return 1.0
+
+
 def apply_rope(
     x: jax.Array,  # [..., seq, heads, head_dim]
     positions: jax.Array,  # [..., seq]
     inv_freq: jax.Array,  # [head_dim//2]
+    scale: float = 1.0,  # yarn attention factor (rope_attention_scale)
 ) -> jax.Array:
     """Rotate pairs (x[..., :d/2], x[..., d/2:]) — HF llama convention."""
     angles = positions[..., None].astype(jnp.float32) * inv_freq  # [..., seq, d/2]
@@ -49,6 +88,8 @@ def apply_rope(
     d2 = x.shape[-1] // 2
     x1, x2 = x[..., :d2].astype(jnp.float32), x[..., d2:].astype(jnp.float32)
     out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    if scale != 1.0:
+        out = out * scale
     return out.astype(x.dtype)
 
 

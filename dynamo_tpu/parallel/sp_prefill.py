@@ -42,7 +42,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..models import KVCache, ModelConfig, kv_cache_pspec, param_pspecs
 from ..models.llama import _lm_logits, _moe, _proj
 from ..models.quantization import matmul_any, quantize_pspecs
-from ..ops import apply_rope, rms_norm, rope_frequencies, write_kv_pages
+from ..ops import apply_rope, rms_norm, rope_attention_scale, rope_frequencies, write_kv_pages
 from ._compat import shard_map
 from .ring_attention import ring_attention_local
 
@@ -89,8 +89,9 @@ def _layer_sp(lp, kv_layer, x, positions, table_full, chunk_full, cfg, inv_freq,
     q = _proj(attn_in, lp, "wq", "bq").astype(dt).reshape(Bl, Sl, nh, hd)
     k = _proj(attn_in, lp, "wk", "bk").astype(dt).reshape(Bl, Sl, nkv, hd)
     v = _proj(attn_in, lp, "wv", "bv").astype(dt).reshape(Bl, Sl, nkv, hd)
-    q = apply_rope(q, positions, inv_freq)
-    k = apply_rope(k, positions, inv_freq)
+    rs = rope_attention_scale(cfg.rope_scaling)
+    q = apply_rope(q, positions, inv_freq, scale=rs)
+    k = apply_rope(k, positions, inv_freq, scale=rs)
 
     pk = pv = None
     use_prefix = prefix_table_l is not None and prefix_table_l.shape[1] > 0
@@ -138,6 +139,9 @@ def _layer_sp(lp, kv_layer, x, positions, table_full, chunk_full, cfg, inv_freq,
         attn.reshape(Bl, Sl, nh * hd), lp["wo"], "bsd,dh->bsh"
     )
     attn_out = jax.lax.psum(attn_out, "tp").astype(dt)
+    if "bo" in lp:  # gpt-oss o_proj bias — AFTER the tp psum (the bias
+        # is replicated; adding pre-psum would scale it by tp)
+        attn_out = attn_out + lp["bo"].astype(dt)
     x = x + attn_out
     mlp_in = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
     if cfg.is_moe:
@@ -210,9 +214,9 @@ def _moe_ragged_ep(lp, x, cfg):
     A = T * k
 
     xf = x.reshape(T, h)
-    router_logits = jnp.einsum(
-        "th,he->te", xf, lp["router"], preferred_element_type=jnp.float32
-    )
+    from ..models.llama import moe_act, moe_router_logits
+
+    router_logits = moe_router_logits(lp, xf, "th,he->te")
     weights, selected = jax.lax.top_k(router_logits, k)  # [T, k]
     weights = jax.nn.softmax(weights, axis=-1)
 
@@ -233,12 +237,19 @@ def _moe_ragged_ep(lp, x, cfg):
     up = jax.lax.ragged_dot(
         xs, lp["w_up"], gs_local, preferred_element_type=jnp.float32
     )
-    act = (jax.nn.silu(gate) * up).astype(x.dtype)
+    exp_rolled = expert_of[rolled]
+    if "b_gate" in lp:  # gpt-oss: per-LOCAL-expert ffn biases (rows of
+        # other shards' experts get a clipped bias, masked out below)
+        safe_e = jnp.clip(exp_rolled - e0, 0, El - 1)
+        gate = gate + lp["b_gate"][safe_e]
+        up = up + lp["b_up"][safe_e]
+    act = moe_act(cfg, gate, up).astype(x.dtype)
     ys = jax.lax.ragged_dot(
         act, lp["w_down"], gs_local, preferred_element_type=jnp.float32
     )  # [A, h] — rows past the local assignment count are garbage
+    if "b_down" in lp:
+        ys = ys + lp["b_down"][safe_e]
 
-    exp_rolled = expert_of[rolled]
     local = (exp_rolled >= e0) & (exp_rolled < e0 + El)
     wf = weights.reshape(A)[rolled].astype(jnp.float32)
     # where(), not multiply-by-zero: rows past sum(gs_local) are

@@ -67,8 +67,9 @@ def moe_all_to_all_ep(lp, x: jax.Array, cfg, axis: str = "tp",
     C = max(1, math.ceil(k * float(capacity_factor) / int(n)))
 
     xf = x.reshape(T, h)
-    logits = jnp.einsum("th,he->te", xf, lp["router"],
-                        preferred_element_type=jnp.float32)
+    from ..models.llama import moe_router_logits
+
+    logits = moe_router_logits(lp, xf, "th,he->te")
     weights, selected = jax.lax.top_k(logits, k)  # [T, k]
     weights = jax.nn.softmax(weights, axis=-1)
 
@@ -116,17 +117,27 @@ def moe_all_to_all_ep(lp, x: jax.Array, cfg, axis: str = "tp",
     xs = recv_x[order]
     gs = jnp.bincount(recv_e, length=e_local + 1)[:e_local]
 
+    from ..models.llama import moe_act
+
+    recv_sorted = recv_e[order]  # local expert per sorted row
+    safe_e = jnp.clip(recv_sorted, 0, e_local - 1)  # hole rows: any bias
     gate = jax.lax.ragged_dot(xs, lp["w_gate"], gs,
                               preferred_element_type=jnp.float32)
     up = jax.lax.ragged_dot(xs, lp["w_up"], gs,
                             preferred_element_type=jnp.float32)
-    act = (jax.nn.silu(gate) * up).astype(x.dtype)
+    if "b_gate" in lp:  # gpt-oss: per-LOCAL-expert ffn biases
+        gate = gate + lp["b_gate"][safe_e]
+        up = up + lp["b_up"][safe_e]
+    act = moe_act(cfg, gate, up).astype(x.dtype)
     ys = jax.lax.ragged_dot(act, lp["w_down"], gs,
                             preferred_element_type=jnp.float32)
+    if "b_down" in lp:
+        ys = ys + lp["b_down"][safe_e]
 
     # rows past the real assignments are UNSPECIFIED ragged output —
-    # zero them before unsorting (NaN would poison the return combine)
-    valid_sorted = recv_e[order] < e_local
+    # zero them before unsorting (NaN would poison the return combine);
+    # hole-row biases above are discarded by the same mask
+    valid_sorted = recv_sorted < e_local
     ys = jnp.where(valid_sorted[:, None], ys, 0.0)
     out_rows = jnp.zeros((n * R, h), jnp.float32).at[order].set(ys)
 

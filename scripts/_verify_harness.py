@@ -1,0 +1,62 @@
+"""Shared process harness for the scripts/verify_*.py drivers: spawn
+long-lived processes with log files, poll logs for readiness, and tear
+everything down (SIGTERM, then kill past the deadline).  One copy so a
+harness fix doesn't have to land in every driver."""
+
+import os
+import socket
+import subprocess
+import sys
+import time
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def wait_ready(proc, logpath, needle="READY", timeout=300):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            with open(logpath) as f:
+                sys.exit(
+                    f"process died rc={proc.returncode}:\n{f.read()[-3000:]}"
+                )
+        with open(logpath) as f:
+            if needle in f.read():
+                return
+        time.sleep(0.5)
+    with open(logpath) as f:
+        sys.exit(f"timeout waiting for {needle!r}:\n{f.read()[-3000:]}")
+
+
+class ProcSet:
+    """Spawner + teardown for one driver run."""
+
+    def __init__(self, tmp: str, env: dict):
+        self.tmp = tmp
+        self.env = env
+        self.procs = []
+
+    def spawn(self, argv, name):
+        log = os.path.join(self.tmp, f"{name}.log")
+        with open(log, "w") as f:
+            p = subprocess.Popen(argv, env=self.env, stdout=f,
+                                 stderr=subprocess.STDOUT)
+        self.procs.append((p, log))
+        return p, log
+
+    def stop(self, timeout: float = 10.0):
+        for p, _ in self.procs[::-1]:
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.time() + timeout
+        for p, _ in self.procs:
+            while p.poll() is None and time.time() < deadline:
+                time.sleep(0.1)
+            if p.poll() is None:
+                p.kill()

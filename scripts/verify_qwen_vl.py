@@ -16,12 +16,13 @@ import base64
 import io
 import json
 import os
-import socket
-import subprocess
 import sys
 import tempfile
 import time
 import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _verify_harness import ProcSet, free_port, wait_ready  # noqa: E402
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ENV = dict(os.environ, PYTHONPATH=ROOT, JAX_PLATFORMS="cpu")
@@ -79,26 +80,6 @@ def make_checkpoint(out_dir: str) -> None:
     print(f"[checkpoint] {out_dir} (image token id {img_id[0]})")
 
 
-def free_port():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
-
-
-def wait_ready(proc, logpath, needle="READY", timeout=240):
-    deadline = time.time() + timeout
-    while time.time() < deadline:
-        if proc.poll() is not None:
-            with open(logpath) as f:
-                sys.exit(f"process died rc={proc.returncode}:\n{f.read()[-3000:]}")
-        with open(logpath) as f:
-            if needle in f.read():
-                return
-        time.sleep(0.5)
-    with open(logpath) as f:
-        sys.exit(f"timeout waiting for {needle!r}:\n{f.read()[-3000:]}")
 
 
 def png_uri(color, size=(40, 32)):
@@ -142,14 +123,8 @@ def main():
     tmp = tempfile.mkdtemp(prefix="vfy_qwenvl_")
     ckpt = os.path.join(tmp, "tiny-qwen2-vl")
     make_checkpoint(ckpt)
-    procs = []
-
-    def spawn(argv, name):
-        log = os.path.join(tmp, f"{name}.log")
-        p = subprocess.Popen(argv, env=ENV, stdout=open(log, "w"),
-                             stderr=subprocess.STDOUT)
-        procs.append((p, log))
-        return p, log
+    ps = ProcSet(tmp, ENV)
+    spawn = ps.spawn
 
     control_port = free_port()
     control = f"127.0.0.1:{control_port}"
@@ -222,15 +197,7 @@ def main():
         print("[ok] text-only chat on the same model")
         print("VERIFY PASS")
     finally:
-        for p, _ in procs[::-1]:
-            if p.poll() is None:
-                p.terminate()
-        deadline = time.time() + 10
-        for p, _ in procs:
-            while p.poll() is None and time.time() < deadline:
-                time.sleep(0.1)
-            if p.poll() is None:
-                p.kill()
+        ps.stop()
 
 
 if __name__ == "__main__":

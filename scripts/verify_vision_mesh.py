@@ -16,38 +16,19 @@ import base64
 import io
 import json
 import os
-import socket
-import subprocess
 import sys
 import tempfile
 import time
 import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _verify_harness import ProcSet, free_port, wait_ready  # noqa: E402
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ENV = dict(os.environ, PYTHONPATH=ROOT, JAX_PLATFORMS="cpu")
 ENV.pop("XLA_FLAGS", None)
 
 
-def free_port():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
-
-
-def wait_ready(proc, logpath, needle="READY", timeout=300):
-    deadline = time.time() + timeout
-    while time.time() < deadline:
-        if proc.poll() is not None:
-            with open(logpath) as f:
-                sys.exit(f"process died rc={proc.returncode}:\n{f.read()[-3000:]}")
-        with open(logpath) as f:
-            if needle in f.read():
-                return
-        time.sleep(0.5)
-    with open(logpath) as f:
-        sys.exit(f"timeout waiting for {needle!r}:\n{f.read()[-3000:]}")
 
 
 def png_uri(color, size=(32, 32)):
@@ -78,14 +59,8 @@ def chat(port, model, color):
 
 def main():
     tmp = tempfile.mkdtemp(prefix="vfy_vmesh_")
-    procs = []
-
-    def spawn(argv, name):
-        log = os.path.join(tmp, f"{name}.log")
-        p = subprocess.Popen(argv, env=ENV, stdout=open(log, "w"),
-                             stderr=subprocess.STDOUT)
-        procs.append((p, log))
-        return p, log
+    ps = ProcSet(tmp, ENV)
+    spawn = ps.spawn
 
     control_port = free_port()
     control = f"127.0.0.1:{control_port}"
@@ -141,15 +116,7 @@ def main():
               "the flat engine through HTTP")
         print("VERIFY PASS")
     finally:
-        for p, _ in procs[::-1]:
-            if p.poll() is None:
-                p.terminate()
-        deadline = time.time() + 10
-        for p, _ in procs:
-            while p.poll() is None and time.time() < deadline:
-                time.sleep(0.1)
-            if p.poll() is None:
-                p.kill()
+        ps.stop()
 
 
 if __name__ == "__main__":
