@@ -3,9 +3,19 @@
 {{ .Values.image.repository }}:{{ .Values.image.tag }}
 {{- end -}}
 
-{{/* Control-plane address as seen from pods in the release namespace */}}
+{{/* Control-plane address as seen from pods in the release namespace.
+An explicit controlPlane.address wins — it is how components join an
+EXTERNAL control plane when controlPlane.enabled=false (ADVICE r4: the
+in-namespace Service doesn't exist in that mode). */}}
 {{- define "dynamo-tpu.controlAddress" -}}
+{{- if .Values.controlPlane.address -}}
+{{ .Values.controlPlane.address }}
+{{- else -}}
+{{- if not .Values.controlPlane.enabled -}}
+{{ fail "controlPlane.address is required when controlPlane.enabled=false" }}
+{{- end -}}
 control-plane.{{ .Release.Namespace }}.svc:{{ .Values.controlPlane.port }}
+{{- end -}}
 {{- end -}}
 
 {{/* Common labels */}}

@@ -263,6 +263,7 @@ class Scheduler:
             s.prefill_done for s in self.running
         ) and not any(
             s.mm_embeds is not None or s.mm_pixels is not None
+            or s.mm_patches is not None
             for s in self.running if not s.prefill_done
         ):
             decodable = self._plan_decode()

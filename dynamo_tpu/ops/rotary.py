@@ -36,11 +36,19 @@ def rope_frequencies(
             return (head_dim * math.log(orig / (rotations * 2 * math.pi))
                     ) / (2 * math.log(theta))
 
-        low = math.floor(dim_for(beta_fast))
-        high = math.ceil(dim_for(beta_slow))
+        # HF only floor/ceils the correction range when truncate (default
+        # true) — gpt-oss ships truncate:false and expects the fractional
+        # band (ADVICE r4: floored bounds drift inv_freq ~3% in the ramp
+        # band at head_dim=64/theta=150000, growing with position).
+        low, high = dim_for(beta_fast), dim_for(beta_slow)
+        if scaling.get("truncate", True):
+            low, high = math.floor(low), math.ceil(high)
+        low, high = max(low, 0.0), min(high, float(head_dim - 1))
+        if low == high:
+            high += 0.001  # HF linear_ramp_factor degenerate-band guard
         ramp = jnp.clip(
             (jnp.arange(head_dim // 2, dtype=jnp.float32) - low)
-            / max(high - low, 1e-3),
+            / (high - low),
             0.0, 1.0,
         )
         extrapolation_mask = 1.0 - ramp  # 1 → keep original frequency
@@ -70,8 +78,21 @@ def rope_attention_scale(scaling: Optional[dict]) -> float:
         if explicit is not None:
             return float(explicit)
         factor = float(scaling["factor"])
-        mscale = float(scaling.get("mscale", 1.0))
-        return 0.1 * mscale * math.log(factor) + 1.0
+
+        def get_mscale(scale: float, mscale: float = 1.0) -> float:
+            if scale <= 1.0:
+                return 1.0
+            return 0.1 * mscale * math.log(scale) + 1.0
+
+        # deepseek-style yarn configs set BOTH mscale and mscale_all_dim;
+        # HF then uses the ratio of the two mscales (ADVICE r4).  A lone
+        # mscale is IGNORED by HF — the fallback is get_mscale(factor).
+        mscale = scaling.get("mscale")
+        mscale_all_dim = scaling.get("mscale_all_dim")
+        if mscale and mscale_all_dim:
+            return get_mscale(factor, float(mscale)) / get_mscale(
+                factor, float(mscale_all_dim))
+        return get_mscale(factor)
     return 1.0
 
 

@@ -1,0 +1,100 @@
+"""RoPE frequency parity with HF transformers on REAL geometries.
+
+The 14-token golden tests can't see ramp-band drift (it grows with
+position — ADVICE r4), so the yarn inv_freq/attention-factor formulas are
+pinned directly against HF `_compute_yarn_parameters` on the published
+gpt-oss geometry (head_dim=64, theta=150000, factor=32, truncate:false)
+and a deepseek-style mscale/mscale_all_dim config.
+"""
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.ops.rotary import (
+    apply_rope,
+    rope_attention_scale,
+    rope_frequencies,
+)
+
+torch = pytest.importorskip("torch")
+
+
+def _hf_yarn(head_dim, theta, scaling, max_pos):
+    from transformers import PretrainedConfig
+    from transformers.modeling_rope_utils import _compute_yarn_parameters
+
+    cfg = PretrainedConfig()
+    cfg.rope_theta = theta
+    cfg.head_dim = head_dim
+    cfg.hidden_size = head_dim * 8
+    cfg.num_attention_heads = 8
+    cfg.max_position_embeddings = max_pos
+    cfg.rope_scaling = dict(scaling)
+    inv_freq, att = _compute_yarn_parameters(cfg, device="cpu")
+    return np.asarray(inv_freq, np.float32), float(att)
+
+
+GPT_OSS_YARN = {
+    "rope_type": "yarn", "factor": 32.0, "beta_fast": 32.0,
+    "beta_slow": 1.0, "original_max_position_embeddings": 4096,
+    "truncate": False,
+}
+
+
+def test_yarn_gpt_oss_geometry_matches_hf():
+    """Published gpt-oss rope (truncate:false, fractional correction
+    band): inv_freq AND the amplitude factor match HF exactly."""
+    inv_hf, att_hf = _hf_yarn(64, 150000.0, GPT_OSS_YARN, 131072)
+    inv = np.asarray(rope_frequencies(64, 150000.0, GPT_OSS_YARN))
+    np.testing.assert_allclose(inv, inv_hf, rtol=1e-6)
+    assert abs(rope_attention_scale(GPT_OSS_YARN) - att_hf) < 1e-9
+
+
+def test_yarn_truncate_default_matches_hf():
+    """Without an explicit truncate key HF floors/ceils the band — so do
+    we (and the clamp keeps the band inside [0, head_dim-1])."""
+    scaling = {k: v for k, v in GPT_OSS_YARN.items() if k != "truncate"}
+    inv_hf, att_hf = _hf_yarn(64, 150000.0, scaling, 131072)
+    inv = np.asarray(rope_frequencies(64, 150000.0, scaling))
+    np.testing.assert_allclose(inv, inv_hf, rtol=1e-6)
+    assert abs(rope_attention_scale(scaling) - att_hf) < 1e-9
+
+
+def test_yarn_deepseek_mscale_ratio_matches_hf():
+    """deepseek-style configs set mscale AND mscale_all_dim; the
+    attention factor is the ratio of the two mscales (ADVICE r4)."""
+    scaling = {
+        "rope_type": "yarn", "factor": 40.0, "beta_fast": 32.0,
+        "beta_slow": 1.0, "original_max_position_embeddings": 4096,
+        "mscale": 1.0, "mscale_all_dim": 0.707,
+    }
+    inv_hf, att_hf = _hf_yarn(64, 10000.0, scaling, 163840)
+    inv = np.asarray(rope_frequencies(64, 10000.0, scaling))
+    np.testing.assert_allclose(inv, inv_hf, rtol=1e-6)
+    assert abs(rope_attention_scale(scaling) - att_hf) < 1e-6
+
+
+def test_yarn_lone_mscale_ignored_like_hf():
+    """A lone mscale (no mscale_all_dim) is IGNORED by HF — the factor
+    falls back to get_mscale(factor)."""
+    scaling = {
+        "rope_type": "yarn", "factor": 40.0, "beta_fast": 32.0,
+        "beta_slow": 1.0, "original_max_position_embeddings": 4096,
+        "mscale": 0.707,
+    }
+    _, att_hf = _hf_yarn(64, 10000.0, scaling, 163840)
+    assert abs(rope_attention_scale(scaling) - att_hf) < 1e-9
+
+
+def test_yarn_long_position_rotation_drift():
+    """Angle-drift guard at position 120000: our frequencies stay within
+    float32 noise of HF's (≤0.05 rad accumulated), while the pre-fix
+    floored band is off by radians there — the drift a short-prompt
+    tolerance test can't see (ADVICE r4)."""
+    inv_hf, _ = _hf_yarn(64, 150000.0, GPT_OSS_YARN, 131072)
+    ours = np.asarray(rope_frequencies(64, 150000.0, GPT_OSS_YARN))
+    floored = np.asarray(rope_frequencies(
+        64, 150000.0, {**GPT_OSS_YARN, "truncate": True}))
+    pos = 120000.0
+    assert np.abs((ours - inv_hf) * pos).max() < 0.05
+    assert np.abs((floored - inv_hf) * pos).max() > 1.0
