@@ -1,0 +1,36 @@
+#!/usr/bin/env python
+"""Regenerate the helm golden renders (tests/fixtures/helm_golden/).
+
+Run after an INTENTIONAL chart change; the goldens make any template
+regression fail CI (tests/test_helm_chart.py::test_render_matches_golden).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dynamo_tpu.deploy.helm_render import render_chart, validate_manifests
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHART = os.path.join(ROOT, "deploy", "helm", "dynamo-tpu")
+GOLDEN = os.path.join(ROOT, "tests", "fixtures", "helm_golden")
+
+
+def main():
+    sys.path.insert(0, os.path.join(ROOT, "tests"))
+    from test_helm_chart import MULTINODE_VALUES
+
+    os.makedirs(GOLDEN, exist_ok=True)
+    for name, values in (("default", None),
+                         ("multinode_gateway", MULTINODE_VALUES)):
+        stream = render_chart(CHART, values=values, namespace="prod")
+        validate_manifests(stream)  # never golden an invalid render
+        path = os.path.join(GOLDEN, f"{name}.yaml")
+        with open(path, "w") as f:
+            f.write(stream)
+        print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    main()
