@@ -119,19 +119,45 @@ def load_params(path: str, cfg: ModelConfig, dtype=jnp.bfloat16,
                 "no self_attn.sinks tensors"
             )
         layers["sinks"] = stack(p + "self_attn.sinks", transpose=False)
-    if cfg.moe_bias and r.has(
+    mxfp4 = r.has(
+        prefix + "model.layers.0.mlp.experts.gate_up_proj_blocks"
+    )
+    if cfg.moe_bias and (mxfp4 or r.has(
         prefix + "model.layers.0.mlp.experts.gate_up_proj"
-    ):
+    )):
         # gpt-oss layout: stacked expert tensors with INTERLEAVED
         # gate/up columns (HF GptOssExperts: gate = [..., ::2]),
-        # per-expert biases, and a biased router
+        # per-expert biases, and a biased router.  The published 120b/20b
+        # checkpoints ship the expert mats as MXFP4 blocks+scales —
+        # dequantize-on-load to `dtype` (models/mxfp4.py, bit-equal to
+        # HF convert_moe_packed_tensors)
         def estack(name):
             return np.stack([
                 r.get(prefix + f"model.layers.{i}.mlp.{name}")
                 for i in range(L)
             ])
 
-        gu = estack("experts.gate_up_proj")  # [L, E, h, 2f]
+        def estack_proj(proj):
+            """[L, E, Z, X] expert mats in the bf16-export layout,
+            dequantizing per layer when the checkpoint is MXFP4 (a
+            full-checkpoint f32 intermediate would be ~10x the 120b's
+            bf16 footprint)."""
+            if not mxfp4:
+                return estack(f"experts.{proj}")
+            from .mxfp4 import dequant_mxfp4
+
+            np_dtype = jnp.dtype(dtype).type
+            return np.stack([
+                dequant_mxfp4(
+                    r.get(prefix + f"model.layers.{i}.mlp.experts."
+                                   f"{proj}_blocks"),
+                    r.get(prefix + f"model.layers.{i}.mlp.experts."
+                                   f"{proj}_scales"),
+                ).astype(np_dtype)
+                for i in range(L)
+            ])
+
+        gu = estack_proj("gate_up_proj")  # [L, E, h, 2f]
         gub = estack("experts.gate_up_proj_bias")  # [L, E, 2f]
         layers.update(
             {
@@ -143,7 +169,7 @@ def load_params(path: str, cfg: ModelConfig, dtype=jnp.bfloat16,
                 "w_up": jnp.asarray(gu[..., 1::2], dtype),
                 "b_gate": jnp.asarray(gub[..., ::2], dtype),
                 "b_up": jnp.asarray(gub[..., 1::2], dtype),
-                "w_down": jnp.asarray(estack("experts.down_proj"), dtype),
+                "w_down": jnp.asarray(estack_proj("down_proj"), dtype),
                 "b_down": jnp.asarray(
                     estack("experts.down_proj_bias"), dtype
                 ),

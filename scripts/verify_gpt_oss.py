@@ -2,12 +2,15 @@
 
     python scripts/verify_gpt_oss.py
 
-Generates a tiny gpt-oss-layout checkpoint (HF GptOss key naming:
-stacked interleaved gate_up expert tensors, biased router, o_proj bias,
-sinks, alternating sliding windows), serves it with
-`python -m dynamo_tpu.worker --model <dir> --reasoning-parser gpt_oss`,
-and chats through the HTTP frontend: deterministic per prompt,
-sensitive to the prompt, SSE == unary.  Prints VERIFY PASS.
+Generates TWIN tiny gpt-oss-layout checkpoints carrying identical
+snapped weights — dense bf16 export and the published MXFP4
+blocks/scales layout (HF GptOss key naming: stacked interleaved gate_up
+expert tensors, biased router, o_proj bias, sinks, alternating sliding
+windows) — serves BOTH with `python -m dynamo_tpu.worker --model <dir>
+--reasoning-parser gpt_oss`, and chats through the HTTP frontend:
+deterministic per prompt, sensitive to the prompt, SSE == unary, and
+the mxfp4 serve token-identical to the dense serve.  Prints VERIFY
+PASS.
 """
 
 import json
@@ -46,15 +49,32 @@ def make_checkpoint(out_dir: str) -> None:
         tie_word_embeddings=False, attention_bias=True,
     )
     model = GptOssForCausalLM(cfg).eval().float()
+    from dynamo_tpu.models.mxfp4 import dequant_mxfp4, quant_mxfp4
+
     tensors = {k: np.asarray(v.detach().to(torch.float32).numpy(), np.float32)
                for k, v in model.state_dict().items()}
-    os.makedirs(out_dir, exist_ok=True)
-    save_file(tensors, os.path.join(out_dir, "model.safetensors"))
-    with open(os.path.join(out_dir, "config.json"), "w") as f:
-        json.dump(cfg.to_dict(), f)
-    with open(os.path.join(out_dir, "tokenizer.json"), "w") as f:
-        f.write(tok.to_json_str())
-    print(f"[checkpoint] {out_dir}")
+    # twin checkpoints with IDENTICAL weights: expert mats snapped to
+    # MXFP4-representable values — the bf16 dir stores them dense, the
+    # -mxfp4 dir stores the published blocks/scales layout.  Serving
+    # either must produce the same tokens (fidelity of the format path).
+    mx_tensors = {}
+    for k in list(tensors):
+        if k.endswith("mlp.experts.gate_up_proj") or k.endswith(
+                "mlp.experts.down_proj"):
+            blocks, scales = quant_mxfp4(tensors[k])
+            tensors[k] = dequant_mxfp4(blocks, scales)
+            mx_tensors[k + "_blocks"] = blocks
+            mx_tensors[k + "_scales"] = scales
+        else:
+            mx_tensors[k] = tensors[k]
+    for d, t in ((out_dir, tensors), (out_dir + "-mxfp4", mx_tensors)):
+        os.makedirs(d, exist_ok=True)
+        save_file(t, os.path.join(d, "model.safetensors"))
+        with open(os.path.join(d, "config.json"), "w") as f:
+            json.dump(cfg.to_dict(), f)
+        with open(os.path.join(d, "tokenizer.json"), "w") as f:
+            f.write(tok.to_json_str())
+        print(f"[checkpoint] {d}")
 
 
 
@@ -103,28 +123,35 @@ def main():
                          "--dtype", "float32", "--platform", "cpu",
                          "--reasoning-parser", "gpt_oss"], "worker")
         wait_ready(w, wlog, needle="READY worker")
+        wm, wmlog = spawn([sys.executable, "-m", "dynamo_tpu.worker",
+                           "--control", control, "--model", ckpt + "-mxfp4",
+                           "--dtype", "float32", "--platform", "cpu",
+                           "--reasoning-parser", "gpt_oss"], "worker-mxfp4")
+        wait_ready(wm, wmlog, needle="READY worker")
         http_port = free_port()
         fe, felog = spawn([sys.executable, "-m", "dynamo_tpu.frontend",
                            "--control", control, "--host", "127.0.0.1",
                            "--port", str(http_port)], "frontend")
         wait_ready(fe, felog)
         deadline = time.time() + 120
-        model = None
+        model = model_mx = None
         while time.time() < deadline:
             try:
                 with urllib.request.urlopen(
                     f"http://127.0.0.1:{http_port}/v1/models", timeout=5
                 ) as r:
                     data = json.loads(r.read())["data"]
-                if data:
-                    model = data[0]["id"]
+                ids = [d["id"] for d in data]
+                model = next((i for i in ids if "mxfp4" not in i), None)
+                model_mx = next((i for i in ids if "mxfp4" in i), None)
+                if model and model_mx:
                     break
             except Exception:
                 pass
             time.sleep(0.5)
-        if not model:
-            sys.exit("model never appeared")
-        print(f"[model] {model}")
+        if not (model and model_mx):
+            sys.exit(f"models never appeared ({model}, {model_mx})")
+        print(f"[model] {model} + {model_mx}")
 
         a = chat(http_port, model, "hello world")
         a2 = chat(http_port, model, "hello world")
@@ -134,6 +161,13 @@ def main():
         assert a != b, "prompt must reach the model"
         assert s == a, "SSE stream must equal the unary response"
         print(f"[ok] deterministic + prompt-sensitive + SSE==unary: {a[:14]!r}")
+        # the MXFP4 checkpoint carries the SAME (snapped) weights — the
+        # served tokens must match the dense bf16 serve exactly
+        am = chat(http_port, model_mx, "hello world")
+        bm = chat(http_port, model_mx, "different prompt")
+        assert am == a and bm == b, (
+            f"mxfp4 serve diverged from dense: {am!r} vs {a!r}")
+        print("[ok] mxfp4 checkpoint serves token-identical to dense")
         print("VERIFY PASS")
     finally:
         ps.stop()

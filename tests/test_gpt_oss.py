@@ -144,6 +144,106 @@ def test_gpt_oss_checkpoint_loads(tmp_path):
         )
 
 
+def test_mxfp4_dequant_matches_hf_bitwise():
+    """Our numpy dequant == HF transformers convert_moe_packed_tensors
+    (integrations/mxfp4.py) on random blocks/scales, bit for bit in
+    float32 — the layout contract of the published 120b checkpoints."""
+    from transformers.integrations.mxfp4 import convert_moe_packed_tensors
+
+    from dynamo_tpu.models.mxfp4 import dequant_mxfp4
+
+    rng = np.random.default_rng(3)
+    blocks = rng.integers(0, 256, size=(4, 6, 2, 16), dtype=np.uint8)
+    scales = rng.integers(110, 140, size=(4, 6, 2), dtype=np.uint8)
+    ours = dequant_mxfp4(blocks, scales)
+    hf = convert_moe_packed_tensors(
+        torch.from_numpy(blocks), torch.from_numpy(scales),
+        dtype=torch.float32,
+    ).numpy()
+    np.testing.assert_array_equal(ours, hf)
+
+
+def test_mxfp4_quant_roundtrip():
+    """quant→dequant is identity on already-representable values and
+    bounded-error on arbitrary ones (fixture-quantizer sanity)."""
+    from dynamo_tpu.models.mxfp4 import FP4_VALUES, dequant_mxfp4, quant_mxfp4
+
+    rng = np.random.default_rng(5)
+    # exactly-representable: lut values times per-group powers of two
+    idx = rng.integers(0, 16, size=(2, 64, 64))
+    exp = np.repeat(rng.integers(-3, 4, size=(2, 64, 2)), 32, axis=-1)
+    w_t = FP4_VALUES[idx] * np.exp2(exp)  # [E, X=64, Z=64] grouped along Z
+    w = np.swapaxes(w_t, 1, 2)  # bf16-export layout [E, Z, X]
+    blocks, scales = quant_mxfp4(w)
+    np.testing.assert_array_equal(dequant_mxfp4(blocks, scales), w)
+    # arbitrary values: absolute error bounded per 32-group by half the
+    # widest E2M1 gap at the group's scale (amax/2^e ∈ (3, 6] by the
+    # exponent choice, widest gap 2 → err ≤ 2^e ≤ amax/3)
+    w2 = rng.normal(size=(2, 32, 16)).astype(np.float32)
+    b2, s2 = quant_mxfp4(w2)
+    err = np.abs(dequant_mxfp4(b2, s2) - w2)  # [E, Z, X]
+    amax = np.abs(w2).reshape(2, 1, 32, 16).max(axis=2)  # per (E, grp, X)
+    bound = np.repeat(amax / 3, 32, axis=1).reshape(w2.shape)
+    assert (err <= bound + 1e-7).all()
+    # quantizer outputs must be C-contiguous (safetensors serializes the
+    # raw buffer; a strided view scrambles on save)
+    assert b2.flags["C_CONTIGUOUS"] and s2.flags["C_CONTIGUOUS"]
+
+
+def test_gpt_oss_mxfp4_checkpoint_matches_golden_logits(tmp_path):
+    """A synthetic MXFP4-format checkpoint (blocks/scales tensors named
+    and laid out like the published gpt-oss-120b) loads through
+    load_params and reproduces HF logits on the SAME snapped weights —
+    the VERDICT r5 item 7 round-trip."""
+    safetensors_np = pytest.importorskip("safetensors.numpy")
+    import json
+    import os
+
+    from dynamo_tpu.models.loader import load_params
+    from dynamo_tpu.models.mxfp4 import dequant_mxfp4, quant_mxfp4
+
+    model, hf_cfg = _hf_model()
+    # snap every expert mat to MXFP4-representable values so the fidelity
+    # bar is exactness of the FORMAT path, not quantization error
+    sd = model.state_dict()
+    tensors = {}
+    for k, v in sd.items():
+        a = _t2n(v)
+        if k.endswith("mlp.experts.gate_up_proj") or k.endswith(
+                "mlp.experts.down_proj"):
+            blocks, scales = quant_mxfp4(a)
+            snapped = dequant_mxfp4(blocks, scales)
+            with torch.no_grad():
+                sd[k].copy_(torch.from_numpy(snapped))
+            tensors[k + "_blocks"] = blocks
+            tensors[k + "_scales"] = scales
+        else:
+            tensors[k] = a
+    safetensors_np.save_file(
+        tensors, os.path.join(tmp_path, "model.safetensors"))
+    with open(os.path.join(tmp_path, "config.json"), "w") as f:
+        json.dump({**hf_cfg.to_dict(),
+                   "quantization_config": {"quant_method": "mxfp4"}}, f)
+
+    cfg = ModelConfig.from_pretrained(str(tmp_path))
+    params = load_params(str(tmp_path), cfg, dtype=jnp.float32)
+
+    rng = np.random.default_rng(1)
+    prompt = rng.integers(7, 120, size=14).tolist()
+    S = len(prompt)
+    with torch.no_grad():
+        hf_logits = _t2n(model(input_ids=torch.tensor([prompt])).logits)[0]
+    n_pages = S // 8 + 2
+    kv = KVCache.create(cfg, 1 + n_pages, 8, jnp.float32)
+    table = jnp.arange(1, n_pages + 1, dtype=jnp.int32)[None]
+    logits, kv = forward_prefill(
+        params, cfg, kv, jnp.asarray([prompt], jnp.int32), table,
+        jnp.zeros((1,), jnp.int32), jnp.asarray([S], jnp.int32),
+    )
+    d = np.abs(np.asarray(logits)[0] - hf_logits[-1]).max()
+    assert d < 3e-3, f"mxfp4-loaded prefill diff {d}"
+
+
 async def test_gpt_oss_engine_serves():
     """The serving engine decodes a gpt-oss-class model (sinks + windows
     + biased MoE through the ragged dispatch) deterministically."""
