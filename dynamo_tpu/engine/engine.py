@@ -173,6 +173,7 @@ def _build_prefill_step_sp(cfg: ModelConfig, mesh, with_top: bool = False,
                 prefix_lens=prefix_lens, prefix_table=prefix_table,
                 extra_embeds=mm[0] if with_embeds else None,
                 extra_mask=mm[1] if with_embeds else None,
+                mm_positions=mm[2] if with_embeds and len(mm) > 2 else None,
             )
             out = sample_tokens(logits, samp, seeds, counters)
             logp = compute_logprobs(logits, out)
@@ -188,6 +189,7 @@ def _build_prefill_step_sp(cfg: ModelConfig, mesh, with_top: bool = False,
                 owner=owner, pool_axes=pool_axes,
                 extra_embeds=mm[0] if with_embeds else None,
                 extra_mask=mm[1] if with_embeds else None,
+                mm_positions=mm[2] if with_embeds and len(mm) > 2 else None,
             )
             out = sample_tokens(logits, samp, seeds, counters)
             logp = compute_logprobs(logits, out)
@@ -196,7 +198,7 @@ def _build_prefill_step_sp(cfg: ModelConfig, mesh, with_top: bool = False,
     return step
 
 
-def _pp_lockstep_kw(mesh, n_replicated: int):
+def _pp_lockstep_kw(mesh, n_replicated: int, pooled: bool = False):
     """jit out_shardings for a pp step under multihost lockstep: the
     packed/chained outputs come back REPLICATED (cross-process shards
     are not addressable, so the leader could not read them otherwise)
@@ -204,25 +206,27 @@ def _pp_lockstep_kw(mesh, n_replicated: int):
     from ..parallel.pp_engine import kv_pspec_pp
 
     rep = NamedSharding(mesh, P())
-    kvsh = jax.tree.map(lambda s: NamedSharding(mesh, s), kv_pspec_pp())
+    kvsh = jax.tree.map(lambda s: NamedSharding(mesh, s),
+                        kv_pspec_pp(pooled))
     return {"out_shardings": (*([rep] * n_replicated), kvsh)}
 
 
 def _build_prefill_step_pp(cfg: ModelConfig, mesh, with_top: bool = False,
-                           attn_impl: str = "xla", lockstep: bool = False):
+                           attn_impl: str = "xla", lockstep: bool = False,
+                           pooled: bool = False):
     """Prefill through the GPipe-staged pipeline (parallel/pp_engine.py);
     sampling happens at the jit level on the replicated last-position
-    logits."""
+    logits (dp-sharded when the pool is partitioned)."""
     from ..parallel.pp_engine import forward_prefill_pp
 
-    kw = _pp_lockstep_kw(mesh, 2) if lockstep else {}
+    kw = _pp_lockstep_kw(mesh, 2, pooled) if lockstep else {}
 
     @partial(jax.jit, donate_argnums=(1,), **kw)
     def step(params, kv, tokens, page_table, prefix_lens, chunk_lens, samp,
              seeds, counters):
         logits, kv = forward_prefill_pp(
             params, cfg, kv, tokens, page_table, prefix_lens, chunk_lens,
-            mesh, attn_impl,
+            mesh, attn_impl, pooled=pooled,
         )
         out = sample_tokens(logits, samp, seeds, counters)
         logp = compute_logprobs(logits, out)
@@ -234,7 +238,7 @@ def _build_prefill_step_pp(cfg: ModelConfig, mesh, with_top: bool = False,
 def _build_decode_step_pp(cfg: ModelConfig, mesh, n_steps: int,
                           max_valid_pos: int, penalized: bool = False,
                           with_top: bool = False, attn_impl: str = "xla",
-                          lockstep: bool = False):
+                          lockstep: bool = False, pooled: bool = False):
     """Multi-token decode with the pipeline kept full (the ring schedule
     of parallel/pp_engine.py); packs per-step rows in the `_unpack_out`
     layout ([T, 2B], or [T, B*(2+2*TOPLP)] with top-logprobs).  Penalty
@@ -253,7 +257,7 @@ def _build_decode_step_pp(cfg: ModelConfig, mesh, n_steps: int,
 
     top_k = TOPLP if with_top else 0
     if penalized:
-        kw = _pp_lockstep_kw(mesh, 5) if lockstep else {}
+        kw = _pp_lockstep_kw(mesh, 5, pooled) if lockstep else {}
 
         @partial(jax.jit, donate_argnums=(1, 5), **kw)
         def step(params, kv, tokens, positions, counters, counts,
@@ -261,12 +265,12 @@ def _build_decode_step_pp(cfg: ModelConfig, mesh, n_steps: int,
             toks, logp, tops, counts, kv = forward_decode_pp(
                 params, cfg, kv, tokens, positions, page_table, samp,
                 seeds, counters, n_steps, max_valid_pos, mesh, attn_impl,
-                counts=counts, top_k=top_k,
+                counts=counts, top_k=top_k, pooled=pooled,
             )
             return (pack(toks, logp, tops), toks[-1], positions + n_steps,
                     counters + n_steps, counts, kv)
     else:
-        kw = _pp_lockstep_kw(mesh, 4) if lockstep else {}
+        kw = _pp_lockstep_kw(mesh, 4, pooled) if lockstep else {}
 
         @partial(jax.jit, donate_argnums=(1,), **kw)
         def step(params, kv, tokens, positions, counters, page_table,
@@ -274,7 +278,7 @@ def _build_decode_step_pp(cfg: ModelConfig, mesh, n_steps: int,
             toks, logp, tops, _, kv = forward_decode_pp(
                 params, cfg, kv, tokens, positions, page_table, samp,
                 seeds, counters, n_steps, max_valid_pos, mesh, attn_impl,
-                top_k=top_k,
+                top_k=top_k, pooled=pooled,
             )
             return (pack(toks, logp, tops), toks[-1], positions + n_steps,
                     counters + n_steps, kv)
@@ -442,9 +446,12 @@ def _make_mixed_body(cfg: ModelConfig, n_steps: int, max_valid_pos: int,
     run = _make_decode_scan(cfg, n_steps, max_valid_pos, penalized,
                             with_top, attn_impl)
 
-    def body(params, kv,
-             p_tokens, p_table, p_prefix, p_chunk, p_samp, p_seeds, p_ctr,
-             d_tokens, d_pos, d_ctr, d_counts, d_table, d_samp, d_seeds):
+    def common(params, kv, p_tokens, p_table, p_prefix, p_chunk, p_samp,
+               p_seeds, p_ctr, d_tokens, d_pos, d_ctr, d_counts, d_table,
+               d_samp, d_seeds, d_rope=None):
+        # the scheduler excludes mm-carrying sequences from mixed plans,
+        # so the prefill side ropes text-style (mm_positions=None) even
+        # on mrope models; the decode side still needs each row's delta
         logits, kv = forward_prefill(
             params, cfg, kv, p_tokens, p_table, p_prefix, p_chunk,
             attn_impl=attn_impl,
@@ -454,9 +461,26 @@ def _make_mixed_body(cfg: ModelConfig, n_steps: int, max_valid_pos: int,
         p_packed = _pack_out(p_out, p_logp, logits if with_top else None)
         d_packed, *_, kv = run(
             params, kv, d_tokens, d_pos, d_ctr, d_counts, d_table,
-            d_samp, d_seeds,
+            d_samp, d_seeds, d_rope,
         )
         return p_packed, d_packed, kv
+
+    if cfg.mrope_section:
+        def body(params, kv,
+                 p_tokens, p_table, p_prefix, p_chunk, p_samp, p_seeds,
+                 p_ctr, d_tokens, d_pos, d_ctr, d_counts, d_table, d_samp,
+                 d_seeds, d_rope):
+            return common(params, kv, p_tokens, p_table, p_prefix, p_chunk,
+                          p_samp, p_seeds, p_ctr, d_tokens, d_pos, d_ctr,
+                          d_counts, d_table, d_samp, d_seeds, d_rope)
+    else:
+        def body(params, kv,
+                 p_tokens, p_table, p_prefix, p_chunk, p_samp, p_seeds,
+                 p_ctr, d_tokens, d_pos, d_ctr, d_counts, d_table, d_samp,
+                 d_seeds):
+            return common(params, kv, p_tokens, p_table, p_prefix, p_chunk,
+                          p_samp, p_seeds, p_ctr, d_tokens, d_pos, d_ctr,
+                          d_counts, d_table, d_samp, d_seeds)
 
     return body
 
@@ -530,6 +554,8 @@ def _build_prefill_step_pooled(cfg: ModelConfig, mesh, pool_axes,
             # the tokens (vision × kv_partition)
             extra_embeds=mm[0] if with_embeds else None,
             extra_mask=mm[1] if with_embeds else None,
+            # mrope models ship the (t, h, w) streams as a third array
+            mm_positions=mm[2] if with_embeds and len(mm) > 2 else None,
         )
         out = sample_tokens(logits, samp, seeds, counters)
         logp = compute_logprobs(logits, out)
@@ -539,7 +565,11 @@ def _build_prefill_step_pooled(cfg: ModelConfig, mesh, pool_axes,
     # so the global array is a concatenation of per-rank blocks — the
     # host unpacks with `_unpack_rows(..., blocks=R)`
     out_specs = (bx, bx, kvspec)
-    mm_specs = ((P(*pool_axes, None, None), bx2) if with_embeds else ())
+    mm_specs = ()
+    if with_embeds:
+        mm_specs = (P(pool_axes, None, None), bx2)
+        if cfg.mrope_section:  # [B, 3, chunk] rope streams ride as mm[2]
+            mm_specs += (P(pool_axes, None, None),)
     sm = shard_map(
         body, mesh=mesh,
         in_specs=(P(), kvspec, bx2, bx2, bx, bx, bx, bx, bx, *mm_specs),
@@ -561,12 +591,20 @@ def _build_decode_step_pooled(cfg: ModelConfig, mesh, pool_axes, n_steps: int,
     kvspec, bx, bx2 = _pooled_specs(pool_axes)
     # per-step packed results are 1-D per shard → [T, R * local] global
     packed_spec = P(None, pool_axes)
+    mrope = bool(cfg.mrope_section)  # +rope_off operand (qwen2_vl)
 
-    def body(params, kv, tokens, positions, counters, counts, table, samp,
-             seeds):
-        return run(params, kv, tokens, positions, counters, counts, table,
-                   samp, seeds)
+    if mrope:
+        def body(params, kv, tokens, positions, counters, counts, table,
+                 samp, seeds, rope_off):
+            return run(params, kv, tokens, positions, counters, counts,
+                       table, samp, seeds, rope_off)
+    else:
+        def body(params, kv, tokens, positions, counters, counts, table,
+                 samp, seeds):
+            return run(params, kv, tokens, positions, counters, counts,
+                       table, samp, seeds)
 
+    rope_specs = (bx,) if mrope else ()
     if penalized:
         out_specs = (packed_spec, bx, bx, bx, bx2, kvspec)
         donate = (1, 5)
@@ -576,7 +614,7 @@ def _build_decode_step_pooled(cfg: ModelConfig, mesh, pool_axes, n_steps: int,
     sm = shard_map(
         body, mesh=mesh,
         in_specs=(P(), kvspec, bx, bx, bx, bx2 if penalized else P(),
-                  bx2, bx, bx),
+                  bx2, bx, bx, *rope_specs),
         out_specs=out_specs,
         axis_names=set(pool_axes),
     )
@@ -586,8 +624,8 @@ def _build_decode_step_pooled(cfg: ModelConfig, mesh, pool_axes, n_steps: int,
         return step
     # present the same call shape as _build_decode_step's plain variant
     return lambda params, kv, tokens, positions, counters, table, samp, \
-        seeds: step(params, kv, tokens, positions, counters, None, table,
-                    samp, seeds)
+        seeds, *rope: step(params, kv, tokens, positions, counters, None,
+                           table, samp, seeds, *rope)
 
 
 def _build_mixed_step_pooled(cfg: ModelConfig, mesh, pool_axes, n_steps: int,
@@ -609,11 +647,13 @@ def _build_mixed_step_pooled(cfg: ModelConfig, mesh, pool_axes, n_steps: int,
     kvspec, bx, bx2 = _pooled_specs(pool_axes)
     d_packed_spec = P(None, pool_axes)  # [T, R*local]
     out_specs = (bx, d_packed_spec, kvspec)
+    rope_specs = (bx,) if cfg.mrope_section else ()
     sm = shard_map(
         body, mesh=mesh,
         in_specs=(P(), kvspec,
                   bx2, bx2, bx, bx, bx, bx, bx,
-                  bx, bx, bx, bx2 if penalized else P(), bx2, bx, bx),
+                  bx, bx, bx, bx2 if penalized else P(), bx2, bx, bx,
+                  *rope_specs),
         out_specs=out_specs,
         axis_names=set(pool_axes),
     )
@@ -651,6 +691,75 @@ def _build_export_fn_pooled(cfg: ModelConfig, mesh, pool_axes,
         rep = NamedSharding(mesh, P())
         kw["out_shardings"] = (rep, rep)
     return jax.jit(sm, **kw)
+
+
+def _build_export_fn_pp_pooled(cfg: ModelConfig, mesh,
+                               replicate_out: bool = False):
+    """Export LOCAL page ids from ONE dp rank of a pp×kv_partition pool:
+    the owner's page gathers are stage-local layer SLICES — a psum over
+    dp keeps the owner's values, then an all_gather over pp stitches the
+    stage slices back into full-layer blobs (the layout every consumer —
+    disagg transfer, KVBM host pool — expects)."""
+    from ..parallel._compat import shard_map
+    from ..parallel.pp_engine import _manual_only, kv_pspec_pp
+
+    kv_in = _manual_only(kv_pspec_pp(True).k, keep=("pp", "dp"))
+
+    def body(kv_k, kv_v, pages, rank):
+        m = (jax.lax.axis_index("dp") == rank)
+        k = jax.lax.psum(jnp.where(m, kv_k[:, pages], 0), "dp")
+        v = jax.lax.psum(jnp.where(m, kv_v[:, pages], 0), "dp")
+        return (jax.lax.all_gather(k, "pp", axis=0, tiled=True),
+                jax.lax.all_gather(v, "pp", axis=0, tiled=True))
+
+    sm = shard_map(
+        body, mesh=mesh, in_specs=(kv_in, kv_in, P(), P()),
+        out_specs=(P(), P()), axis_names={"pp", "dp"},
+    )
+    kw = {}
+    if replicate_out:
+        rep = NamedSharding(mesh, P())
+        kw["out_shardings"] = (rep, rep)
+    fn = jax.jit(lambda kv, pages, rank: sm(kv.k, kv.v, pages, rank), **kw)
+    return fn
+
+
+def _build_import_fn_pp_pooled(cfg: ModelConfig, mesh,
+                               sharded_blob: bool = False):
+    """Write a full-layer (k, v) blob into ONE dp rank's local pages of a
+    pp×kv_partition pool: each pp stage slices its layer range out of
+    the blob, and only the owning dp rank's pages change.  With
+    `sharded_blob` the blob's PAGE axis arrives dp-sharded (multihost
+    per-shard fetch layout — non-owner blocks are zeros)."""
+    from ..parallel._compat import shard_map
+    from ..parallel.pp_engine import _manual_only, kv_pspec_pp
+
+    kv_in = _manual_only(kv_pspec_pp(True).k, keep=("pp", "dp"))
+    blob_spec = P(None, "dp", None, None, None) if sharded_blob else P()
+
+    def body(kv_k, kv_v, k_blob, v_blob, pages, rank):
+        s = jax.lax.axis_index("pp")
+        l_local = kv_k.shape[0]
+        kb = jax.lax.dynamic_slice_in_dim(k_blob, s * l_local, l_local, 0)
+        vb = jax.lax.dynamic_slice_in_dim(v_blob, s * l_local, l_local, 0)
+        m = (jax.lax.axis_index("dp") == rank)
+        k_new = jnp.where(m, kb.astype(kv_k.dtype), kv_k[:, pages])
+        v_new = jnp.where(m, vb.astype(kv_v.dtype), kv_v[:, pages])
+        return (kv_k.at[:, pages].set(k_new),
+                kv_v.at[:, pages].set(v_new))
+
+    sm = shard_map(
+        body, mesh=mesh,
+        in_specs=(kv_in, kv_in, blob_spec, blob_spec, P(), P()),
+        out_specs=(kv_in, kv_in), axis_names={"pp", "dp"},
+    )
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def imp(kv, k_blob, v_blob, pages, rank):
+        k_new, v_new = sm(kv.k, kv.v, k_blob, v_blob, pages, rank)
+        return type(kv)(k_new, v_new)
+
+    return imp
 
 
 def _build_import_fn_pooled(cfg: ModelConfig, mesh, pool_axes,
@@ -796,15 +905,14 @@ class JaxEngine:
                         f"pp={self._pp} must divide num_hidden_layers="
                         f"{model_cfg.num_hidden_layers}"
                     )
-                if self.cfg.kv_partition:
+                if self.cfg.kv_partition and parallel.sp > 1:
                     raise ValueError(
-                        "pp does not compose with kv_partition yet (the "
-                        "KV layer axis is already sharded over pp)"
+                        "pp×kv_partition partitions pages over dp only "
+                        "(sp within a stage is future work)"
                     )
-                if vision is not None or tiered is not None:
+                if vision is not None:
                     raise ValueError(
-                        "pp does not support the vision tower or KVBM "
-                        "tiering yet"
+                        "pp does not support the vision tower yet"
                     )
                 if parallel.tp > 1:
                     bad = [k for k, v in {
@@ -818,13 +926,18 @@ class JaxEngine:
                             f"{', '.join(bad)} for pp×tp serving"
                         )
                 # decode microbatches the batch into pp groups, and the
-                # fused/mixed fast paths assume the flat dispatch shape
+                # fused/mixed fast paths assume the flat dispatch shape.
+                # kv_partition buckets are PER-RANK (rows arrive as dp
+                # blocks), so they round to pp only; global buckets round
+                # to dp*pp
+                round_to = (self._pp if self.cfg.kv_partition
+                            else self._dp * self._pp)
                 self.cfg = dataclasses.replace(
                     self.cfg,
                     fuse_prefill_decode=False,
                     mixed_prefill_tokens=0,
                     decode_batch_buckets=sorted({
-                        -(-b // (self._dp * self._pp)) * self._dp * self._pp
+                        -(-b // round_to) * round_to
                         for b in self.cfg.decode_batch_buckets
                     }),
                 )
@@ -956,17 +1069,12 @@ class JaxEngine:
         # ring exactly like the tokens)
         if model_cfg.mrope_section:
             # M-RoPE (qwen2_vl): decode ropes at slot + per-seq delta.
-            # The fused/mixed fast paths don't thread the offset operand
-            # yet, and the meshed step variants don't either — keep the
-            # mrope serving path the flat engine
-            if self._pooled or self._sp > 1 or self._pp > 1:
-                raise ValueError(
-                    "mrope models serve on the flat engine (no "
-                    "kv_partition/sp/pp yet)"
-                )
-            self.cfg = dataclasses.replace(
-                self.cfg, fuse_prefill_decode=False, mixed_prefill_tokens=0
-            )
+            # r5: the rope-offset operand threads through the fused,
+            # mixed, pooled (kv_partition) and sp-ring step variants, so
+            # qwen2-vl serves on meshed engines with mixed scheduling on
+            # (VERDICT r4 item 5).  pp stages don't carry it yet.
+            if self._pp > 1:
+                raise ValueError("mrope models do not serve under pp yet")
         self.params = self._shard_params(params)
         self.kv = self._make_kv()
         self._extra_event_sinks: List[Callable[[KvEvent], None]] = []
@@ -979,7 +1087,14 @@ class JaxEngine:
         self._prefill_steps: Dict[bool, Callable] = {}
         self._decode_steps: Dict[tuple, Callable] = {}
         self._mixed_steps: Dict[tuple, Callable] = {}
-        if self._pooled:
+        if self._pooled and self._pp > 1:
+            self._export_fn = _build_export_fn_pp_pooled(
+                self.model_cfg, self.mesh, replicate_out=self._multihost,
+            )
+            self._import_fn = _build_import_fn_pp_pooled(
+                self.model_cfg, self.mesh,
+            )
+        elif self._pooled:
             self._export_fn = _build_export_fn_pooled(
                 self.model_cfg, self.mesh, self._pool_axes,
                 replicate_out=self._multihost,
@@ -1133,7 +1248,7 @@ class JaxEngine:
 
             return jax.tree.map(
                 lambda x, s: host_array_to_global(self.mesh, s, x),
-                kv, kv_pspec_pp(),
+                kv, kv_pspec_pp(pooled=self._pooled),
             )
         from ..parallel import shard_kv_cache
 
@@ -1183,6 +1298,7 @@ class JaxEngine:
                 self._prefill_steps[key] = _build_prefill_step_pp(
                     self.model_cfg, self.mesh, with_top=with_top,
                     attn_impl=self._attn_impl, lockstep=self._multihost,
+                    pooled=self._pooled,
                 )
             elif self._pooled:
                 self._prefill_steps[key] = _build_prefill_step_pooled(
@@ -1206,7 +1322,7 @@ class JaxEngine:
                     self.model_cfg, self.mesh, self.cfg.decode_steps,
                     self.cfg.hard_cap, penalized=penalized,
                     with_top=with_top, attn_impl=self._attn_impl,
-                    lockstep=self._multihost,
+                    lockstep=self._multihost, pooled=self._pooled,
                 )
             elif self._pooled:
                 self._decode_steps[key] = _build_decode_step_pooled(
@@ -1520,13 +1636,17 @@ class JaxEngine:
 
     @property
     def _prefill_blocks(self) -> int:
-        """Packed-layout block count for prefill results (sp prefill
-        samples at the jit level, so its layout is flat)."""
-        return self._pool_ranks if (self._pooled and self._sp == 1) else 1
+        """Packed-layout block count for prefill results (sp and pp
+        variants sample at the jit level, so their layout is flat)."""
+        return (self._pool_ranks
+                if (self._pooled and self._sp == 1 and self._pp == 1)
+                else 1)
 
     @property
     def _decode_blocks(self) -> int:
-        return self._pool_ranks if self._pooled else 1
+        """pp packs [T, B] at the jit level (global row order), so its
+        layout is flat even on a partitioned pool."""
+        return self._pool_ranks if (self._pooled and self._pp == 1) else 1
 
     # Batch ROW LAYOUTS: every per-step array builder takes a `rows` list
     # (Sequence | None, None = padding row).  Unpartitioned engines use
@@ -1585,6 +1705,17 @@ class JaxEngine:
             np.asarray(seeds, np.uint32),
             np.asarray(counters, np.int32),
         )
+
+    def _rope_array(self, rows: List[Optional[Sequence]]):
+        """Per-row mrope rope-offset operand ([B] int32), or None for
+        non-mrope models."""
+        if not self.model_cfg.mrope_section:
+            return None
+        out = np.zeros((len(rows),), np.int32)
+        for i, s in enumerate(rows):
+            if s is not None:
+                out[i] = s.rope_delta
+        return out
 
     def _table_array(self, rows: List[Optional[Sequence]]) -> np.ndarray:
         """Page-table batch, width bucketed to the longest sequence present
@@ -1808,9 +1939,10 @@ class JaxEngine:
         table = self._table_array(
             seqs + [None] * (B - len(seqs))
         )  # includes extended pages
+        rope_off = self._rope_array(seqs + [None] * (B - len(seqs)))
         return self._dispatch_decode(
             tok_d, positions, decode_ctr, None, table, samp, seeds,
-            False, with_top, chain_len,
+            False, with_top, chain_len, rope_off=rope_off,
         )
 
     def _consume_decode(self, dispatches, rows, Bb, with_top) -> None:
@@ -1915,6 +2047,7 @@ class JaxEngine:
         )
         d_samp = self._samp_arrays(d_rows)
         counts = self._counts_array(d_rows) if penalized else None
+        d_rope = self._rope_array(d_rows)
         if self._multihost:
             sparse = (self._encode_counts_sparse(d_rows)
                       if penalized else None)
@@ -1926,11 +2059,12 @@ class JaxEngine:
                            d_tokens, d_pos, d_ctr, d_table,
                            *[np.asarray(a) for a in d_samp], d_seeds],
                 "counts_sparse": sparse,
+                "rope_off": d_rope,
             })
         p_packed_d, d_packed_d = self._dispatch_mixed(
             p_tokens, p_table, p_prefix, p_chunk, p_samp, p_seeds, p_ctr,
             d_tokens, d_pos, d_ctr, counts, d_table, d_samp, d_seeds,
-            penalized, with_top,
+            penalized, with_top, rope_off=d_rope,
         )
         # dispatch committed: account prefill chunks now (consume order
         # below matches the device program: prefill first, then decode)
@@ -1957,11 +2091,17 @@ class JaxEngine:
 
     def _dispatch_mixed(self, p_tokens, p_table, p_prefix, p_chunk, p_samp,
                         p_seeds, p_ctr, d_tokens, d_pos, d_ctr, d_counts,
-                        d_table, d_samp, d_seeds, penalized, with_top):
+                        d_table, d_samp, d_seeds, penalized, with_top,
+                        rope_off=None):
         """Issue the jitted mixed step (identical on leader and followers);
         returns the two packed device outputs."""
         step = self._get_mixed_step(penalized, with_top)
         cts_d = self._put(d_counts, self._bax, None) if penalized else None
+        rope = ()
+        if self.model_cfg.mrope_section:
+            if rope_off is None:
+                rope_off = np.zeros_like(d_pos)
+            rope = (self._put(rope_off, self._bax),)
         p_packed, d_packed, self.kv = step(
             self.params, self.kv,
             self._put(p_tokens, self._bax, None), self._put(p_table, self._bax, None),
@@ -1971,6 +2111,7 @@ class JaxEngine:
             self._put(d_tokens, self._bax), self._put(d_pos, self._bax),
             self._put(d_ctr, self._bax), cts_d, self._put(d_table, self._bax, None),
             self._put_samp(d_samp), self._put(d_seeds, self._bax),
+            *rope,
         )
         for a in (p_packed, d_packed):
             try:  # start both host copies; they ride back in fetch order
@@ -2307,12 +2448,7 @@ class JaxEngine:
         samp = self._samp_arrays(rows)
         # histograms updated on-device within and across chained blocks
         counts = self._counts_array(rows) if penalized else None
-        rope_off = None
-        if self.model_cfg.mrope_section:
-            rope_off = np.zeros((Bb,), np.int32)
-            for i, s in enumerate(rows):
-                if s is not None:
-                    rope_off[i] = s.rope_delta
+        rope_off = self._rope_array(rows)
         if self._multihost:
             # penalized plans carry the output tokens SPARSELY (flat list +
             # row offsets) — broadcasting the dense [B, vocab] histogram
@@ -2468,6 +2604,7 @@ class JaxEngine:
                         a[0], a[1], a[2], a[3], p_samp, p_seeds, p_ctr,
                         d_tokens, d_pos, d_ctr, counts, d_table, d_samp,
                         d_seeds, desc["penalized"], desc["with_top"],
+                        rope_off=desc.get("rope_off"),
                     )
                 elif kind == "kv_export":
                     self._export_replay(desc["padded"], desc["rank"])
@@ -2767,10 +2904,18 @@ class JaxEngine:
         pages_d = self._put(padded)
         if self._pooled:
             if self._import_fn_sharded is None:
-                self._import_fn_sharded = _build_import_fn_pooled(
-                    self.model_cfg, self.mesh, self._pool_axes,
-                    sharded_blob=True,
-                )
+                if self._pp > 1:
+                    # pp×kv_partition: the KV layer axis is pp-sharded —
+                    # the dp-only pooled import would reshard every
+                    # stage's cache to full layers (pp× HBM spike)
+                    self._import_fn_sharded = _build_import_fn_pp_pooled(
+                        self.model_cfg, self.mesh, sharded_blob=True,
+                    )
+                else:
+                    self._import_fn_sharded = _build_import_fn_pooled(
+                        self.model_cfg, self.mesh, self._pool_axes,
+                        sharded_blob=True,
+                    )
             self.kv = self._import_fn_sharded(
                 self.kv, k_blob, v_blob, pages_d,
                 self._put(np.int32(rank)),

@@ -79,10 +79,14 @@ def param_pspecs_pp(cfg: ModelConfig, pp_axis: str = "pp"):
     return out
 
 
-def kv_pspec_pp() -> KVCache:
+def kv_pspec_pp(pooled: bool = False) -> KVCache:
     """KV pages shard their LAYER axis over pp (stage-local cache) and
-    their kv-heads over tp, like the flat serving engine."""
-    s = P("pp", None, None, "tp", None)
+    their kv-heads over tp, like the flat serving engine.  With `pooled`
+    (engine kv_partition) the PAGE axis additionally shards over dp —
+    the layer axis (pp) and page axis (dp) are orthogonal, so aggregate
+    KV capacity scales with dp on top of pp's per-stage slicing
+    (VERDICT r4 item 8; reference: gpt-oss-120b + KVBM, SURVEY §2.2)."""
+    s = P("pp", "dp" if pooled else None, None, "tp", None)
     return KVCache(s, s)
 
 
@@ -112,18 +116,22 @@ def _local_wins(cfg: ModelConfig, l_local: int):
     return (jax.lax.dynamic_slice(full, (s * l_local,), (l_local,)),)
 
 
-def _pp_specs(cfg: ModelConfig):
+def _pp_specs(cfg: ModelConfig, pooled: bool = False):
     """(param-in_spec builder, kv in_spec) for the manual-over-pp
-    shard_map: placement specs with their auto (tp) names stripped."""
+    shard_map: placement specs with their auto (tp) names stripped.
+    `pooled` keeps dp manual too (partitioned page axis)."""
     from ..models.quantization import quantize_pspecs
+
+    keep = ("pp", "dp") if pooled else ("pp",)
 
     def pspec_of(params):
         full = quantize_pspecs(params, param_pspecs_pp(cfg))
         return jax.tree.map(
-            _manual_only, full, is_leaf=lambda x: isinstance(x, P)
+            lambda s: _manual_only(s, keep=keep), full,
+            is_leaf=lambda x: isinstance(x, P),
         )
 
-    kv_in = _manual_only(kv_pspec_pp().k)
+    kv_in = _manual_only(kv_pspec_pp(pooled).k, keep=keep)
     return pspec_of, KVCache(kv_in, kv_in)
 
 
@@ -137,19 +145,27 @@ def forward_prefill_pp(
     chunk_lens: jax.Array,  # [B]
     mesh: Mesh,
     attn_impl: str = "xla",
+    pooled: bool = False,
 ) -> Tuple[jax.Array, KVCache]:
     """GPipe prefill of a chunk batch: microbatch = one row.  Returns
     (last-position logits [B, V] — sampling happens at the jit level —
     and the updated stage-local KV)."""
     stages = mesh.shape["pp"]
-    pspec_of, kvspec = _pp_specs(cfg)
-    # manual over pp ONLY: dp stays auto (GSPMD), so the KV page axis —
-    # replicated across dp — keeps its replicas consistent exactly like
-    # the non-pp engine (a manual dp axis would let each dp shard write
-    # only its own rows and silently diverge the "replicated" cache)
-    bx, bx2 = P(), P()
+    pspec_of, kvspec = _pp_specs(cfg, pooled)
+    # Without kv_partition: manual over pp ONLY — dp stays auto (GSPMD),
+    # so the KV page axis — replicated across dp — keeps its replicas
+    # consistent exactly like the non-pp engine (a manual dp axis would
+    # let each dp shard write only its own rows and silently diverge the
+    # "replicated" cache).  WITH kv_partition (`pooled`): dp goes manual
+    # too — each dp shard owns its page range, batches arrive as per-rank
+    # row blocks with LOCAL tables, and every gather stays shard-local.
+    manual = {"pp", "dp"} if pooled else {"pp"}
+    bx = P("dp") if pooled else P()
+    bx2 = P("dp", None) if pooled else P()
 
-    D = mesh.shape.get("dp", 1)
+    # per-tick row grouping over the AUTO dp axis; manual dp sees only
+    # its local rows, so the grouping factor is 1
+    D = 1 if pooled else mesh.shape.get("dp", 1)
 
     def body(params, kv_k, kv_v, tokens_l, table_l, prefix_l, chunk_l):
         s = jax.lax.axis_index("pp")
@@ -215,7 +231,7 @@ def forward_prefill_pp(
         body, mesh=mesh,
         in_specs=(pspec_of(params), kvspec.k, kvspec.v, bx2, bx2, bx, bx),
         out_specs=(bx2, kvspec.k, kvspec.v),
-        axis_names={"pp"},
+        axis_names=manual,
     )(params, kv.k, kv.v, tokens, page_table, prefix_lens, chunk_lens)
     return logits, KVCache(k_new, v_new)
 
@@ -236,6 +252,7 @@ def forward_decode_pp(
     attn_impl: str = "xla",
     counts=None,  # [B, V] penalty histograms (None = unpenalized)
     top_k: int = 0,  # pack top-k (ids, logprobs) per step (0 = off)
+    pooled: bool = False,  # kv_partition: page axis sharded over dp
 ):
     """`n_steps` decode steps with the pipeline kept full: the batch
     splits into pp microbatches; the last stage samples and ships the
@@ -249,11 +266,15 @@ def forward_decode_pp(
     from ..ops import apply_penalties, top_logprobs
 
     stages = mesh.shape["pp"]
-    pspec_of, kvspec = _pp_specs(cfg)
-    bx, bx2 = P(), P()  # batch arrays: dp auto (see forward_prefill_pp)
+    pspec_of, kvspec = _pp_specs(cfg, pooled)
+    # batch arrays: dp auto, or manual per-rank blocks when pooled (see
+    # forward_prefill_pp)
+    manual = {"pp", "dp"} if pooled else {"pp"}
+    bx = P("dp") if pooled else P()
+    bx2 = P("dp", None) if pooled else P()
     penalized = counts is not None
 
-    D = mesh.shape.get("dp", 1)
+    D = 1 if pooled else mesh.shape.get("dp", 1)
 
     def body(params, kv_k, kv_v, tok, pos, table, samp, seeds, ctr, cts):
         s = jax.lax.axis_index("pp")
@@ -393,13 +414,18 @@ def forward_decode_pp(
 
     # tops/counts_out may be None (empty pytrees) — a P() prefix is
     # valid for any subtree, including an empty one
-    out_specs = (P(), P(), P(), P(), kvspec.k, kvspec.v)
+    if pooled:
+        tops_spec = ((P(None, "dp", None),) * 2 if top_k else P())
+        out_specs = (P(None, "dp"), P(None, "dp"), tops_spec,
+                     bx2 if penalized else P(), kvspec.k, kvspec.v)
+    else:
+        out_specs = (P(), P(), P(), P(), kvspec.k, kvspec.v)
     toks, logp, tops, counts_out, k_new, v_new = shard_map(
         body, mesh=mesh,
         in_specs=(pspec_of(params), kvspec.k, kvspec.v, bx, bx, bx2,
                   bx, bx, bx, bx2 if penalized else P()),
         out_specs=out_specs,
-        axis_names={"pp"},
+        axis_names=manual,
     )(params, kv.k, kv.v, tokens, positions, page_table, samp, seeds,
       counters, counts)
     return toks, logp, tops, counts_out, KVCache(k_new, v_new)

@@ -62,7 +62,7 @@ def _embed_sp(embed_local: jax.Array, tokens: jax.Array) -> jax.Array:
 def _layer_sp(lp, kv_layer, x, positions, table_full, chunk_full, cfg, inv_freq,
               tp: int, owner_l=None, table_l=None, chunk_l=None,
               prefix_l=None, prefix_full=None, window=None,
-              prefix_table_l=None):
+              prefix_table_l=None, rope_pos3=None):
     """One decoder layer on a [Bl, Sl] shard holding heads/tp: ring
     attention over sp on the local heads, KV head-slice written to the
     tp-sharded pool from the sp/dp-gathered chunk, tp psums after the
@@ -89,9 +89,17 @@ def _layer_sp(lp, kv_layer, x, positions, table_full, chunk_full, cfg, inv_freq,
     q = _proj(attn_in, lp, "wq", "bq").astype(dt).reshape(Bl, Sl, nh, hd)
     k = _proj(attn_in, lp, "wk", "bk").astype(dt).reshape(Bl, Sl, nkv, hd)
     v = _proj(attn_in, lp, "wv", "bv").astype(dt).reshape(Bl, Sl, nkv, hd)
-    rs = rope_attention_scale(cfg.rope_scaling)
-    q = apply_rope(q, positions, inv_freq, scale=rs)
-    k = apply_rope(k, positions, inv_freq, scale=rs)
+    if rope_pos3 is not None:
+        # mrope (qwen2_vl): the (t, h, w) streams' local S-slice rides in
+        # with the shard; text rows carry equal streams
+        from ..ops import apply_mrope
+
+        q = apply_mrope(q, rope_pos3, inv_freq, cfg.mrope_section)
+        k = apply_mrope(k, rope_pos3, inv_freq, cfg.mrope_section)
+    else:
+        rs = rope_attention_scale(cfg.rope_scaling)
+        q = apply_rope(q, positions, inv_freq, scale=rs)
+        k = apply_rope(k, positions, inv_freq, scale=rs)
 
     pk = pv = None
     use_prefix = prefix_table_l is not None and prefix_table_l.shape[1] > 0
@@ -278,6 +286,8 @@ def forward_prefill_sp(
     extra_embeds: jax.Array = None,  # [B, S, h] vision-tower patches
     extra_mask: jax.Array = None,  # [B, S] bool — both shard their S
     # axis over sp exactly like the tokens (vision × sp)
+    mm_positions: jax.Array = None,  # [B, 3, S] mrope (t, h, w) streams,
+    # S sharded over sp; None on an mrope model ropes text-style
 ) -> Tuple[jax.Array, KVCache]:
     """Whole-prompt prefill with the sequence sharded over `sp` and heads
     over `tp`.
@@ -310,6 +320,8 @@ def forward_prefill_sp(
     pooled = owner is not None
     with_embeds = extra_embeds is not None
 
+    mrope = bool(cfg.mrope_section)
+
     def body(params, kv_k, kv_v, tokens_l, table_l, chunk_l, owner_l,
              prefix_l, prefix_table_l, *mm):
         sp_i = jax.lax.axis_index("sp")
@@ -317,6 +329,13 @@ def forward_prefill_sp(
         # the ring starts at each row's prefix boundary (0 with no cache)
         positions = (prefix_l[:, None] + sp_i * Sl
                      + jnp.arange(Sl)[None, :] + jnp.zeros((Bl, 1), jnp.int32))
+        rope_pos3 = None
+        if mrope:
+            # mm rows carry precomputed streams; otherwise text-style
+            # (all three streams equal the scalar positions)
+            rope_pos3 = (mm[2] if with_embeds and len(mm) > 2
+                         else jnp.broadcast_to(positions[:, None, :],
+                                               (Bl, 3, Sl)))
         if pooled:
             table_full = chunk_full = prefix_full = None
         else:
@@ -343,6 +362,7 @@ def forward_prefill_sp(
                 prefix_l=prefix_l, prefix_full=prefix_full,
                 window=xs[3] if wins else None,
                 prefix_table_l=prefix_table_l,
+                rope_pos3=rope_pos3,
             )
             return h, (k_pages, v_pages)
 
@@ -375,6 +395,9 @@ def forward_prefill_sp(
     if with_embeds:
         mm_args = (extra_embeds, extra_mask)
         mm_specs = (P("dp", "sp", None), P("dp", "sp"))
+        if mrope and mm_positions is not None:
+            mm_args += (mm_positions,)
+            mm_specs += (P("dp", None, "sp"),)
     logits, k_new, v_new = shard_map(
         body,
         mesh=mesh,

@@ -195,6 +195,30 @@ def main():
         text = chat(http_port, model, [{"type": "text", "text": "hello"}])
         assert text, "text-only chat on the mrope model failed"
         print("[ok] text-only chat on the same model")
+
+        # meshed mrope (r5): the same checkpoint on a dp=2 PARTITIONED
+        # pool through the CLI — kill the flat worker so routing pins to
+        # the meshed one, then image chat must reproduce the flat outputs
+        w.kill()
+        wm, wmlog = spawn([sys.executable, "-m", "dynamo_tpu.worker",
+                           "--control", control, "--model", ckpt,
+                           "--dtype", "float32", "--platform", "cpu",
+                           "--local-devices", "2", "--dp", "2",
+                           "--kv-partition",
+                           "--max-prefill-tokens", "128"], "worker-mesh")
+        wait_ready(wm, wmlog, needle="READY worker")
+        time.sleep(6)  # old lease reaps; router converges to the mesh
+        red_m = chat(http_port, model, img_parts((200, 30, 30)))
+        vid_m = chat(http_port, model, [
+            {"type": "text", "text": "what happens? "},
+            {"type": "video_url", "video_url": {"url": gif_uri(
+                [(250, 0, 0), (0, 250, 0), (0, 0, 250), (250, 250, 0)]
+            )}},
+        ])
+        assert red_m == red, (
+            f"meshed mrope diverged from flat: {red_m!r} vs {red!r}")
+        assert vid_m == vid, "meshed mrope video diverged from flat"
+        print("[ok] dp=2 kv-partition worker serves mrope greedy-equal")
         print("VERIFY PASS")
     finally:
         ps.stop()

@@ -142,6 +142,105 @@ async def test_pp_tp_matches_single_device(setup):
     assert got_pen == want_pen
 
 
+async def test_pp_kv_partition_matches_and_scales(setup):
+    """pp × kv_partition (VERDICT r4 item 8): the KV layer axis (pp)
+    and page axis (dp) shard ORTHOGONALLY — pp=2×dp=2 with the pool
+    partitioned over dp is greedy-equal to single-device, aggregate
+    capacity scales with dp, and concurrent load overflowing one rank's
+    pool still serves."""
+    from jax.sharding import PartitionSpec as P
+
+    ref = make_engine(setup)
+    want = await _run_all(ref)
+    await ref.shutdown()
+
+    eng = make_engine(setup, parallel=ParallelConfig(pp=2, dp=2, tp=2),
+                      kv_partition=True)
+    assert eng._pp == 2 and eng._pooled and eng._pool_ranks == 2
+    assert eng.kv.k.sharding.spec == P("pp", "dp", None, "tp", None)
+    got = await _run_all(eng)
+    await eng.shutdown()
+    assert got == want
+
+    # capacity ∝ dp on top of pp's layer slicing: per-rank pool of 16
+    # pages (15 usable) must NOT bound the aggregate
+    eng2 = make_engine(setup, parallel=ParallelConfig(pp=2, dp=2, tp=2),
+                       kv_partition=True, num_pages=16, max_model_len=64,
+                       watermark=0.0)
+    assert eng2.metrics().kv_total_pages == 2 * 15
+    prompts = [[(5 * j + i) % 90 + 1 for j in range(40)] for i in range(4)]
+    outs = await asyncio.gather(
+        *[collect(eng2, req(p, max_tokens=8)) for p in prompts]
+    )
+    assert all(len(o) == 8 for o in outs)
+    assert 4 * (48 // 8) > 15, "load must overflow a single rank's pool"
+    await eng2.shutdown()
+
+
+async def test_pp_kvbm_tiering_offload_onboard(setup, tmp_path):
+    """KVBM tiering on a pp engine (plain AND kv_partition): offload
+    drains to the host pool, the device cache is cleared, and the next
+    run onboards from host with identical output (the gpt-oss-120b +
+    KVBM configuration, SURVEY §2.2/§6)."""
+    from dynamo_tpu.kvbm import DiskTier, HostBlockPool, TieredKvCache
+
+    cfg, params = setup
+
+    async def one(parallel, kv_partition, sub):
+        tiered = TieredKvCache(
+            HostBlockPool(capacity_bytes=64 << 20),
+            DiskTier(str(tmp_path / sub)),
+        )
+        eng = JaxEngine(
+            cfg, params, EngineConfig(
+                page_size=8, num_pages=96, max_num_seqs=8,
+                max_prefill_tokens=32, max_model_len=128, decode_steps=2,
+                kv_partition=kv_partition,
+            ), eos_token_ids=[], kv_dtype=jnp.float32,
+            parallel=parallel, tiered=tiered,
+        )
+        prompt = list(range(1, 41))  # 5 full pages
+        want = await collect(eng, req(prompt, max_tokens=4))
+        deadline = asyncio.get_running_loop().time() + 20
+        while tiered.pending_offloads or len(tiered.host) == 0:
+            assert asyncio.get_running_loop().time() < deadline, "no offload"
+            await asyncio.sleep(0.05)
+        assert len(tiered.host) >= 5
+        eng.clear_kv_blocks()
+        got = await collect(eng, req(prompt, max_tokens=4))
+        assert got == want, (sub, got, want)
+        assert tiered.onboarded_blocks >= 4
+        await eng.shutdown()
+
+    await one(ParallelConfig(pp=2, dp=4), False, "plain")
+    await one(ParallelConfig(pp=2, dp=2, tp=2), True, "pooled")
+
+
+async def test_pp_pooled_disagg_handoff(setup):
+    """Disagg prefill→decode between two pp×kv_partition engines: the
+    full-layer export blob stitches pp stage slices, the import slices
+    them back per stage — outputs equal a local run."""
+    ref = make_engine(setup)
+    p = [(7 * j) % 101 + 1 for j in range(20)]
+    want = await collect(ref, req(p, max_tokens=8))
+    await ref.shutdown()
+
+    pre = make_engine(setup, parallel=ParallelConfig(pp=2, dp=2, tp=2),
+                      kv_partition=True)
+    dec = make_engine(setup, parallel=ParallelConfig(pp=2, dp=2, tp=2),
+                      kv_partition=True)
+    out = await pre.prefill_remote(req(p, max_tokens=8))
+    assert "kv" in out, out
+    toks = []
+    async for d in dec.generate_with_kv(req(p, max_tokens=8),
+                                        out["token_ids"][0], out["kv"]):
+        assert d.get("finish_reason") != "error", d
+        toks.extend(d["token_ids"])
+    await pre.shutdown()
+    await dec.shutdown()
+    assert toks == want
+
+
 async def test_pp_kv_layer_axis_sharded(setup):
     """The cache genuinely shards its layer axis over pp (each stage
     holds L/pp layers' pages — weight+cache HBM scale with pp) and its
