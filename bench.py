@@ -373,6 +373,117 @@ async def disagg_phase(cfg, params, n=8, prompt_len=512, gen=8):
     return out
 
 
+def phase_breakdown(cfg, params, T=32, B=8, table_w=32):
+    """Per-phase decode-step shares measured ON DEVICE (VERDICT r5 item
+    4): full forward vs no-lm-head vs matmuls-only scans at the serving
+    shapes.  attention+norms = no_head - matmuls; head+sampling = full -
+    no_head; the matmuls time IS the weight-stream floor.  Interleaved
+    iterations + a trivial-program RTT baseline keep the tunnel out of
+    the numbers."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dynamo_tpu.models import KVCache
+    from dynamo_tpu.models.llama import forward_decode
+    from dynamo_tpu.models.quantization import matmul_any
+
+    kv = KVCache.create(cfg, 1 + B * table_w + 8, 16, jnp.bfloat16)
+    tokens = jnp.arange(B, dtype=jnp.int32) + 5
+    positions = jnp.full((B,), 130, jnp.int32)
+    table = jnp.tile(jnp.arange(1, table_w + 1, dtype=jnp.int32), (B, 1))
+    x0 = jnp.ones((B, cfg.hidden_size), jnp.bfloat16)
+
+    def scan_full(params, kv, tokens, positions, table):
+        def body(carry, _):
+            kv, tok, pos = carry
+            logits, kv = forward_decode(params, cfg, kv, tok, pos, table)
+            nxt = jnp.argmax(logits.astype(jnp.float32), -1).astype(
+                jnp.int32)
+            return (kv, nxt, pos + 1), ()
+        (kv, tok, _), _ = jax.lax.scan(
+            body, (kv, tokens, positions), None, length=T)
+        return tok
+
+    def scan_no_head(params, kv, tokens, positions, table):
+        from dynamo_tpu.models.llama import decode_layers
+
+        def body(carry, _):
+            kv, tok, pos = carry
+            x = params["embed"][tok] if not isinstance(
+                params["embed"], dict) else params["embed"]["q"][tok]
+            x, kv = decode_layers(params["layers"], cfg, kv,
+                                  x.astype(jnp.bfloat16), pos, table, "xla")
+            nxt = (tok + x[:, :8].sum(-1).astype(jnp.int32)) % 97
+            return (kv, nxt, pos + 1), ()
+        (kv, tok, _), _ = jax.lax.scan(
+            body, (kv, tokens, positions), None, length=T)
+        return tok
+
+    def scan_matmuls(params, x, tokens):
+        lp = params["layers"]
+
+        def body(carry, _):
+            x, tok = carry
+
+            def layer(h, w):
+                q = matmul_any(h, w["wq"], "bh,hd->bd")
+                k = matmul_any(h, w["wk"], "bh,hd->bd")
+                v = matmul_any(h, w["wv"], "bh,hd->bd")
+                o = (q + jnp.pad(k, ((0, 0), (0, q.shape[1] - k.shape[1])))
+                     + jnp.pad(v, ((0, 0), (0, q.shape[1] - v.shape[1]))))
+                h = (h + matmul_any(o.astype(h.dtype), w["wo"],
+                                    "bd,dh->bh")).astype(h.dtype)
+                g = matmul_any(h, w["w_gate"], "bh,hf->bf")
+                u = matmul_any(h, w["w_up"], "bh,hf->bf")
+                h = (h + matmul_any((g * u).astype(h.dtype), w["w_down"],
+                                    "bf,fh->bh")).astype(h.dtype)
+                return h, ()
+
+            x, _ = jax.lax.scan(layer, x, lp)
+            tok = tok + x[:, :8].sum(-1).astype(jnp.int32)
+            return (x, tok), ()
+        (x, tok), _ = jax.lax.scan(body, (x, tokens), None, length=T)
+        return tok
+
+    def sync(o):
+        np.asarray(jax.device_get(o))
+
+    triv = jax.jit(lambda t: t + 1)
+    fns = {
+        "full": (jax.jit(scan_full),
+                 (params, kv, tokens, positions, table)),
+        "no_head": (jax.jit(scan_no_head),
+                    (params, kv, tokens, positions, table)),
+        "matmuls": (jax.jit(scan_matmuls), (params, x0, tokens)),
+    }
+    for f, a in fns.values():
+        sync(f(*a))  # compile off the clock
+    sync(triv(tokens))
+    times = {k: [] for k in fns}
+    rtts = []
+    for _ in range(4):
+        for k, (f, a) in fns.items():
+            t0 = time.perf_counter()
+            sync(f(*a))
+            times[k].append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        sync(triv(tokens))
+        rtts.append(time.perf_counter() - t0)
+    rtt = min(rtts)
+    ms = {k: (min(v) - rtt) / T * 1e3 for k, v in times.items()}
+    return {
+        "matmul_weight_stream_ms": round(ms["matmuls"], 3),
+        "attention_norms_ms": round(max(ms["no_head"] - ms["matmuls"], 0.0),
+                                    3),
+        "head_sampling_ms": round(max(ms["full"] - ms["no_head"], 0.0), 3),
+        "full_step_ms": round(ms["full"], 3),
+        "fetch_rtt_ms": round(rtt * 1e3, 1),
+        "steps": T,
+        "batch": B,
+    }
+
+
 def init_params_int8(cfg, key):
     """Random already-quantized params on device (layout =
     models.quantization.quantize_params; see random_int8_params there —
@@ -445,13 +556,18 @@ async def main_async():
         max(head_rates) / max(min(head_rates), 1e-9), 3
     )
     out["measurement_notes"] = (
-        "in-run spreads are tight (<2-8%); cross-RUN deltas (r2 1072 / "
-        "r3 942 on identical protocol) come from multi-hour tunnel "
-        "phases that shift whole runs together — the interleaved A/B "
-        "phases + per-round samples here bound what environment can "
-        "hide. int8-1B profiling: host dispatch ~0s per plan; the 1B "
-        "ceiling is device-side small-kernel efficiency (~250 GB/s "
-        "effective vs ~500 on 8B shapes); fuse_projections buys 1-3%."
+        "in-run spreads are tight (<2-8%); cross-RUN deltas come from "
+        "multi-hour tunnel phases (fetch RTT drifts 50-105ms) that "
+        "shift whole runs together — interleaved A/B phases + per-round "
+        "samples bound what environment can hide. r5 profiling "
+        "(scripts/ablate_{decode,attention}.py): the decode ceiling was "
+        "a per-layer KV-scatter + pool-read interaction forcing XLA to "
+        "copy the page pool every layer-step (~1.8ms/step at 1B/b8) — "
+        "fixed by deferred writes (attend to old pool + self column, "
+        "one batched scatter per step); matmul weight streams run at "
+        "~720-760 GB/s of the 819 peak; a STATIC greedy sampling "
+        "variant replaces the runtime all-greedy cond (~0.1ms/step). "
+        "step_breakdown_* fields carry the on-device phase shares."
     )
 
     # sustained (192-token generations, tuned dispatch): bf16 and int8
@@ -467,6 +583,9 @@ async def main_async():
     await e_bf.shutdown()
     await e_q.shutdown()
     del e_bf, e_q  # drop the fused weight copies before the 8B phases
+    # on-device per-phase decode-step breakdown (1B bf16): where a step's
+    # time goes — the weight-stream floor vs attention vs head/sampling
+    out["step_breakdown_1b_bf16"] = phase_breakdown(cfg, params)
     out["int8_tok_s"] = round(int8_sus, 2)
     out["phase_samples_tok_s"] = {
         "bf16": [round(r, 1) for r in bf_rates],
@@ -484,11 +603,16 @@ async def main_async():
     # would swamp every TTFT.
     engine = JaxEngine(cfg, params, EngineConfig(
         page_size=16, num_pages=1 + 24 * 16 + 32, max_num_seqs=16,
-        max_prefill_tokens=PROMPT_LEN, prefill_batch_size=1,
+        # up to FOUR prompts ride one mixed dispatch: Poisson bursts
+        # clear in one pump iteration instead of queueing one prompt per
+        # ~200ms dispatch+fetch cycle (r5: burst-tail TTFTs broke the
+        # SLO while ITL had margin); 32-step decode blocks amortize the
+        # ~90ms tunnel fetch round trip
+        max_prefill_tokens=4 * PROMPT_LEN, prefill_batch_size=4,
         max_model_len=PROMPT_LEN + 96 + 16,
         decode_batch_buckets=[16], chunk_buckets=[PROMPT_LEN],
-        table_width_buckets=[16], decode_steps=16, decode_chain=2,
-        mixed_prefill_tokens=PROMPT_LEN, enable_prefix_caching=False,
+        table_width_buckets=[16], decode_steps=32, decode_chain=2,
+        mixed_prefill_tokens=4 * PROMPT_LEN, enable_prefix_caching=False,
         quantization="int8", fuse_projections=True,
     ), eos_token_ids=[])
     # warmup: solo request (prefill + decode programs), then overlap a
@@ -548,6 +672,7 @@ async def main_async():
                                            gen_tokens=SUSTAINED_GEN)
     await engine8.shutdown()
     tps8 = t8 / dt8
+    breakdown8 = phase_breakdown(cfg8, params8)
 
     # 8B goodput: REAL Poisson arrivals over the mixed scheduler (the
     # round-3 batch-burst proxy is gone), swept up a rate ladder to the
@@ -555,11 +680,13 @@ async def main_async():
     # the programs all warm off the clock
     engine8g = JaxEngine(cfg8, params8, EngineConfig(
         page_size=16, num_pages=1 + 12 * 16 + 32, max_num_seqs=8,
-        max_prefill_tokens=PROMPT_LEN, prefill_batch_size=1,
+        # two prompts per mixed dispatch (burst handling, see the 1B
+        # goodput engine); 32-step decode blocks amortize the tunnel RTT
+        max_prefill_tokens=2 * PROMPT_LEN, prefill_batch_size=2,
         max_model_len=PROMPT_LEN + 96 + 16,
         decode_batch_buckets=[8], chunk_buckets=[PROMPT_LEN],
-        table_width_buckets=[16], decode_steps=16, decode_chain=2,
-        mixed_prefill_tokens=PROMPT_LEN, enable_prefix_caching=False,
+        table_width_buckets=[16], decode_steps=32, decode_chain=2,
+        mixed_prefill_tokens=2 * PROMPT_LEN, enable_prefix_caching=False,
     ), eos_token_ids=[])
     mixed_warm_ok8 = await warm_mixed(engine8g)
     k8 = await goodput_knee(
@@ -605,6 +732,8 @@ async def main_async():
             "ttft_p50_ms": round(ttft8 * 1e3, 1),
             "itl_p50_ms": round(itl8 * 1e3, 2),
             "weight_read_gbps": round(tps8 / BATCH * gb_8b_int8, 1),
+            # which kernel eats the roofline gap (VERDICT r5 item 4)
+            "step_breakdown_ms": breakdown8,
             "max_goodput_at_slo_tok_s": k8["max_goodput_at_slo_tok_s"],
             "knee_rate_rps": k8["knee_rate_rps"],
             "goodput_sweep": k8["sweep"],
@@ -624,6 +753,17 @@ async def main_async():
         prefill_batch_size=1, max_model_len=PI + GI + 16,
         decode_batch_buckets=list(CONC), chunk_buckets=[2048],
         decode_steps=64, decode_chain=4,
+        # explicit prefill-first policy for the batch-throughput phase:
+        # at 2000-token prompts every mixed slice drags a 64-step decode
+        # block (TTFT balloons) and each (decode bucket x chunk) mixed
+        # shape is its own ~40s tunnel compile — the goodput phases
+        # already measure mixed ITL-flatness; prompts go first here, and
+        # r5's chain gating stops fused chains starving them
+        mixed_prefill_tokens=0,
+        # ONE table-width bucket: the default pow2 ladder crosses
+        # 128->142 pages mid-generation, compiling a fresh decode program
+        # ON THE CLOCK (~40s on the tunnel) — the r5 itl/tok_s collapse
+        table_width_buckets=[pages_i],
         enable_prefix_caching=False, fuse_projections=True,
     ), eos_token_ids=[])
     for b in CONC:  # warm every decode bucket off the clock

@@ -38,9 +38,9 @@ from ..ops import (
     SamplingParams,
     apply_penalties,
     compute_logprobs,
-    sample_tokens,
     top_logprobs,
 )
+from ..ops.sampling import sample_tokens_maybe_greedy
 from ..ops.paged_attention import resolve_attention_impl
 from ..runtime.engine import Context
 from ..tokens import compute_block_hash_for_seq
@@ -117,7 +117,7 @@ def _lockstep_out_shardings(mesh, *extra):
 
 def _build_prefill_step(cfg: ModelConfig, with_top: bool = False,
                         attn_impl: str = "xla", lockstep_mesh=None,
-                        with_embeds: bool = False):
+                        with_embeds: bool = False, greedy: bool = False):
     kw = ({"out_shardings": _lockstep_out_shardings(lockstep_mesh, P())}
           if lockstep_mesh is not None else {})
 
@@ -132,7 +132,8 @@ def _build_prefill_step(cfg: ModelConfig, with_top: bool = False,
             # mrope models ship the (t, h, w) streams as a third array
             mm_positions=mm[2] if with_embeds and len(mm) > 2 else None,
         )
-        out = sample_tokens(logits, samp, seeds, counters)
+        out = sample_tokens_maybe_greedy(logits, samp, seeds, counters,
+                                         greedy)
         logp = compute_logprobs(logits, out)
         # `out` rides back as a separate device int32 so a fused decode
         # chain can consume it without waiting for the packed host fetch
@@ -143,7 +144,7 @@ def _build_prefill_step(cfg: ModelConfig, with_top: bool = False,
 
 def _build_prefill_step_sp(cfg: ModelConfig, mesh, with_top: bool = False,
                            lockstep: bool = False, pool_axes=None,
-                           with_embeds: bool = False):
+                           with_embeds: bool = False, greedy: bool = False):
     """Sequence-parallel whole-prompt prefill (parallel/sp_prefill.py):
     the prompt is sharded over the sp axis and attention runs as ring
     attention; sampling happens on the gathered last-position logits.
@@ -175,7 +176,8 @@ def _build_prefill_step_sp(cfg: ModelConfig, mesh, with_top: bool = False,
                 extra_mask=mm[1] if with_embeds else None,
                 mm_positions=mm[2] if with_embeds and len(mm) > 2 else None,
             )
-            out = sample_tokens(logits, samp, seeds, counters)
+            out = sample_tokens_maybe_greedy(logits, samp, seeds, counters,
+                                         greedy)
             logp = compute_logprobs(logits, out)
             return _pack_out(out, logp, logits if with_top else None), out, kv
     else:
@@ -191,7 +193,8 @@ def _build_prefill_step_sp(cfg: ModelConfig, mesh, with_top: bool = False,
                 extra_mask=mm[1] if with_embeds else None,
                 mm_positions=mm[2] if with_embeds and len(mm) > 2 else None,
             )
-            out = sample_tokens(logits, samp, seeds, counters)
+            out = sample_tokens_maybe_greedy(logits, samp, seeds, counters,
+                                         greedy)
             logp = compute_logprobs(logits, out)
             return _pack_out(out, logp, logits if with_top else None), out, kv
 
@@ -213,7 +216,7 @@ def _pp_lockstep_kw(mesh, n_replicated: int, pooled: bool = False):
 
 def _build_prefill_step_pp(cfg: ModelConfig, mesh, with_top: bool = False,
                            attn_impl: str = "xla", lockstep: bool = False,
-                           pooled: bool = False):
+                           pooled: bool = False, greedy: bool = False):
     """Prefill through the GPipe-staged pipeline (parallel/pp_engine.py);
     sampling happens at the jit level on the replicated last-position
     logits (dp-sharded when the pool is partitioned)."""
@@ -228,7 +231,8 @@ def _build_prefill_step_pp(cfg: ModelConfig, mesh, with_top: bool = False,
             params, cfg, kv, tokens, page_table, prefix_lens, chunk_lens,
             mesh, attn_impl, pooled=pooled,
         )
-        out = sample_tokens(logits, samp, seeds, counters)
+        out = sample_tokens_maybe_greedy(logits, samp, seeds, counters,
+                                         greedy)
         logp = compute_logprobs(logits, out)
         return _pack_out(out, logp, logits if with_top else None), out, kv
 
@@ -238,7 +242,8 @@ def _build_prefill_step_pp(cfg: ModelConfig, mesh, with_top: bool = False,
 def _build_decode_step_pp(cfg: ModelConfig, mesh, n_steps: int,
                           max_valid_pos: int, penalized: bool = False,
                           with_top: bool = False, attn_impl: str = "xla",
-                          lockstep: bool = False, pooled: bool = False):
+                          lockstep: bool = False, pooled: bool = False,
+                          greedy: bool = False):
     """Multi-token decode with the pipeline kept full (the ring schedule
     of parallel/pp_engine.py); packs per-step rows in the `_unpack_out`
     layout ([T, 2B], or [T, B*(2+2*TOPLP)] with top-logprobs).  Penalty
@@ -265,7 +270,7 @@ def _build_decode_step_pp(cfg: ModelConfig, mesh, n_steps: int,
             toks, logp, tops, counts, kv = forward_decode_pp(
                 params, cfg, kv, tokens, positions, page_table, samp,
                 seeds, counters, n_steps, max_valid_pos, mesh, attn_impl,
-                counts=counts, top_k=top_k, pooled=pooled,
+                counts=counts, top_k=top_k, pooled=pooled, greedy=greedy,
             )
             return (pack(toks, logp, tops), toks[-1], positions + n_steps,
                     counters + n_steps, counts, kv)
@@ -278,7 +283,7 @@ def _build_decode_step_pp(cfg: ModelConfig, mesh, n_steps: int,
             toks, logp, tops, _, kv = forward_decode_pp(
                 params, cfg, kv, tokens, positions, page_table, samp,
                 seeds, counters, n_steps, max_valid_pos, mesh, attn_impl,
-                top_k=top_k, pooled=pooled,
+                top_k=top_k, pooled=pooled, greedy=greedy,
             )
             return (pack(toks, logp, tops), toks[-1], positions + n_steps,
                     counters + n_steps, kv)
@@ -314,7 +319,8 @@ def _build_import_fn():
 
 
 def _make_decode_scan(cfg: ModelConfig, n_steps: int, max_valid_pos: int,
-                      penalized: bool, with_top: bool, attn_impl: str):
+                      penalized: bool, with_top: bool, attn_impl: str,
+                      greedy: bool = False):
     """The traced decode-block body shared by the pure decode step and the
     mixed (prefill+decode) step: scans `n_steps` forward+sample steps,
     returning per-step packed outputs plus the carries."""
@@ -332,7 +338,7 @@ def _make_decode_scan(cfg: ModelConfig, n_steps: int, max_valid_pos: int,
             logits = apply_penalties(
                 logits, counts, samp.frequency_penalty, samp.presence_penalty
             )
-        out = sample_tokens(logits, samp, seeds, ctr)
+        out = sample_tokens_maybe_greedy(logits, samp, seeds, ctr, greedy)
         if penalized:
             counts = counts.at[jnp.arange(out.shape[0]), out].add(1.0)
         logp = compute_logprobs(logits, out)
@@ -376,6 +382,7 @@ def _make_decode_scan(cfg: ModelConfig, n_steps: int, max_valid_pos: int,
 
 
 def _build_decode_step(cfg: ModelConfig, n_steps: int, max_valid_pos: int,
+                       *, greedy: bool = False,
                        penalized: bool = False, with_top: bool = False,
                        attn_impl: str = "xla", lockstep_mesh=None):
     """Decode `n_steps` tokens per dispatch: lax.scan keeps the whole block
@@ -396,7 +403,7 @@ def _build_decode_step(cfg: ModelConfig, n_steps: int, max_valid_pos: int,
     presence penalties; `with_top` packs top-TOPLP logprobs per step.
     """
     run = _make_decode_scan(cfg, n_steps, max_valid_pos, penalized,
-                            with_top, attn_impl)
+                            with_top, attn_impl, greedy)
     dp = P("dp")
     mrope = bool(cfg.mrope_section)  # +rope_off operand (qwen2_vl)
     if penalized:
@@ -438,13 +445,14 @@ def _build_decode_step(cfg: ModelConfig, n_steps: int, max_valid_pos: int,
 
 
 def _make_mixed_body(cfg: ModelConfig, n_steps: int, max_valid_pos: int,
-                     penalized: bool, with_top: bool, attn_impl: str):
+                     penalized: bool, with_top: bool, attn_impl: str,
+                     greedy: bool = False):
     """The traced mixed-step body shared by the flat and pooled builders:
     the prefill side runs first (its page writes are disjoint from the
     decode rows'), then the decode scan; both packed outputs return in
     one fetch."""
     run = _make_decode_scan(cfg, n_steps, max_valid_pos, penalized,
-                            with_top, attn_impl)
+                            with_top, attn_impl, greedy)
 
     def common(params, kv, p_tokens, p_table, p_prefix, p_chunk, p_samp,
                p_seeds, p_ctr, d_tokens, d_pos, d_ctr, d_counts, d_table,
@@ -456,7 +464,8 @@ def _make_mixed_body(cfg: ModelConfig, n_steps: int, max_valid_pos: int,
             params, cfg, kv, p_tokens, p_table, p_prefix, p_chunk,
             attn_impl=attn_impl,
         )
-        p_out = sample_tokens(logits, p_samp, p_seeds, p_ctr)
+        p_out = sample_tokens_maybe_greedy(logits, p_samp, p_seeds, p_ctr,
+                                           greedy)
         p_logp = compute_logprobs(logits, p_out)
         p_packed = _pack_out(p_out, p_logp, logits if with_top else None)
         d_packed, *_, kv = run(
@@ -487,14 +496,15 @@ def _make_mixed_body(cfg: ModelConfig, n_steps: int, max_valid_pos: int,
 
 def _build_mixed_step(cfg: ModelConfig, n_steps: int, max_valid_pos: int,
                       penalized: bool = False, with_top: bool = False,
-                      attn_impl: str = "xla", lockstep_mesh=None):
+                      attn_impl: str = "xla", lockstep_mesh=None,
+                      greedy: bool = False):
     """One dispatch = one bounded prefill chunk + one decode block
     (chunked-prefill interleave, the TPU form: both forwards live in one
     XLA program, so running decodes pay zero extra host round-trips for
     a concurrent prompt's prefill — reference behavior: vLLM mixed
     batches / mocker watermark scheduler, scheduler.rs:240)."""
     body = _make_mixed_body(cfg, n_steps, max_valid_pos, penalized,
-                            with_top, attn_impl)
+                            with_top, attn_impl, greedy)
     kw = ({"out_shardings": _lockstep_out_shardings(lockstep_mesh, P())}
           if lockstep_mesh is not None else {})
     return partial(jax.jit, donate_argnums=(1,), **kw)(body)
@@ -540,7 +550,8 @@ def _lockstep_pooled_kw(mesh, pool_axes, out_specs, n_replicated: int = 1):
 def _build_prefill_step_pooled(cfg: ModelConfig, mesh, pool_axes,
                                with_top: bool = False, attn_impl: str = "xla",
                                lockstep: bool = False,
-                               with_embeds: bool = False):
+                               with_embeds: bool = False,
+                               greedy: bool = False):
     from ..parallel._compat import shard_map
 
     kvspec, bx, bx2 = _pooled_specs(pool_axes)
@@ -557,7 +568,8 @@ def _build_prefill_step_pooled(cfg: ModelConfig, mesh, pool_axes,
             # mrope models ship the (t, h, w) streams as a third array
             mm_positions=mm[2] if with_embeds and len(mm) > 2 else None,
         )
-        out = sample_tokens(logits, samp, seeds, counters)
+        out = sample_tokens_maybe_greedy(logits, samp, seeds, counters,
+                                         greedy)
         logp = compute_logprobs(logits, out)
         return _pack_out(out, logp, logits if with_top else None), out, kv
 
@@ -583,11 +595,11 @@ def _build_prefill_step_pooled(cfg: ModelConfig, mesh, pool_axes,
 def _build_decode_step_pooled(cfg: ModelConfig, mesh, pool_axes, n_steps: int,
                               max_valid_pos: int, penalized: bool = False,
                               with_top: bool = False, attn_impl: str = "xla",
-                              lockstep: bool = False):
+                              lockstep: bool = False, greedy: bool = False):
     from ..parallel._compat import shard_map
 
     run = _make_decode_scan(cfg, n_steps, max_valid_pos, penalized,
-                            with_top, attn_impl)
+                            with_top, attn_impl, greedy)
     kvspec, bx, bx2 = _pooled_specs(pool_axes)
     # per-step packed results are 1-D per shard → [T, R * local] global
     packed_spec = P(None, pool_axes)
@@ -631,7 +643,7 @@ def _build_decode_step_pooled(cfg: ModelConfig, mesh, pool_axes, n_steps: int,
 def _build_mixed_step_pooled(cfg: ModelConfig, mesh, pool_axes, n_steps: int,
                              max_valid_pos: int, penalized: bool = False,
                              with_top: bool = False, attn_impl: str = "xla",
-                             lockstep: bool = False):
+                             lockstep: bool = False, greedy: bool = False):
     """Mixed (prefill chunk + decode block) step over a PARTITIONED pool:
     the whole program runs manual-over-(dp, sp) — both sides' batches
     arrive as R uniform per-rank row blocks with LOCAL page tables, so
@@ -643,7 +655,7 @@ def _build_mixed_step_pooled(cfg: ModelConfig, mesh, pool_axes, n_steps: int,
     from ..parallel._compat import shard_map
 
     body = _make_mixed_body(cfg, n_steps, max_valid_pos, penalized,
-                            with_top, attn_impl)
+                            with_top, attn_impl, greedy)
     kvspec, bx, bx2 = _pooled_specs(pool_axes)
     d_packed_spec = P(None, pool_axes)  # [T, R*local]
     out_specs = (bx, d_packed_spec, kvspec)
@@ -1284,38 +1296,41 @@ class JaxEngine:
 
     # -- step variants -------------------------------------------------------- #
 
-    def _get_prefill_step(self, with_top: bool, with_mm: bool = False):
-        key = (with_top, with_mm)
+    def _get_prefill_step(self, with_top: bool, with_mm: bool = False,
+                          greedy: bool = False):
+        key = (with_top, with_mm, greedy)
         if key not in self._prefill_steps:
             if self._sp > 1:
                 self._prefill_steps[key] = _build_prefill_step_sp(
                     self.model_cfg, self.mesh, with_top,
                     lockstep=self._multihost,
                     pool_axes=self._pool_axes if self._pooled else None,
-                    with_embeds=with_mm,
+                    with_embeds=with_mm, greedy=greedy,
                 )
             elif self._pp > 1:
                 self._prefill_steps[key] = _build_prefill_step_pp(
                     self.model_cfg, self.mesh, with_top=with_top,
                     attn_impl=self._attn_impl, lockstep=self._multihost,
-                    pooled=self._pooled,
+                    pooled=self._pooled, greedy=greedy,
                 )
             elif self._pooled:
                 self._prefill_steps[key] = _build_prefill_step_pooled(
                     self.model_cfg, self.mesh, self._pool_axes,
                     with_top=with_top, attn_impl=self._attn_impl,
                     lockstep=self._multihost, with_embeds=with_mm,
+                    greedy=greedy,
                 )
             else:
                 self._prefill_steps[key] = _build_prefill_step(
                     self.model_cfg, with_top, attn_impl=self._attn_impl,
                     lockstep_mesh=self.mesh if self._multihost else None,
-                    with_embeds=with_mm,
+                    with_embeds=with_mm, greedy=greedy,
                 )
         return self._prefill_steps[key]
 
-    def _get_decode_step(self, penalized: bool, with_top: bool):
-        key = (penalized, with_top)
+    def _get_decode_step(self, penalized: bool, with_top: bool,
+                         greedy: bool = False):
+        key = (penalized, with_top, greedy)
         if key not in self._decode_steps:
             if self._pp > 1:
                 self._decode_steps[key] = _build_decode_step_pp(
@@ -1323,6 +1338,7 @@ class JaxEngine:
                     self.cfg.hard_cap, penalized=penalized,
                     with_top=with_top, attn_impl=self._attn_impl,
                     lockstep=self._multihost, pooled=self._pooled,
+                    greedy=greedy,
                 )
             elif self._pooled:
                 self._decode_steps[key] = _build_decode_step_pooled(
@@ -1330,6 +1346,7 @@ class JaxEngine:
                     self.cfg.decode_steps, self.cfg.hard_cap,
                     penalized=penalized, with_top=with_top,
                     attn_impl=self._attn_impl, lockstep=self._multihost,
+                    greedy=greedy,
                 )
             else:
                 self._decode_steps[key] = _build_decode_step(
@@ -1337,11 +1354,13 @@ class JaxEngine:
                     penalized=penalized, with_top=with_top,
                     attn_impl=self._attn_impl,
                     lockstep_mesh=self.mesh if self._multihost else None,
+                    greedy=greedy,
                 )
         return self._decode_steps[key]
 
-    def _get_mixed_step(self, penalized: bool, with_top: bool):
-        key = (penalized, with_top)
+    def _get_mixed_step(self, penalized: bool, with_top: bool,
+                        greedy: bool = False):
+        key = (penalized, with_top, greedy)
         if key not in self._mixed_steps:
             if self._pooled:
                 self._mixed_steps[key] = _build_mixed_step_pooled(
@@ -1349,6 +1368,7 @@ class JaxEngine:
                     self.cfg.decode_steps, self.cfg.hard_cap,
                     penalized=penalized, with_top=with_top,
                     attn_impl=self._attn_impl, lockstep=self._multihost,
+                    greedy=greedy,
                 )
             else:
                 self._mixed_steps[key] = _build_mixed_step(
@@ -1356,6 +1376,7 @@ class JaxEngine:
                     penalized=penalized, with_top=with_top,
                     attn_impl=self._attn_impl,
                     lockstep_mesh=self.mesh if self._multihost else None,
+                    greedy=greedy,
                 )
         return self._mixed_steps[key]
 
@@ -1679,7 +1700,12 @@ class JaxEngine:
 
     def _prefill_rows(self, items: List[PrefillItem]) -> List[Optional[PrefillItem]]:
         if not self._pooled:
-            B = self._pad_batch(len(items))
+            # pad to the CONSTANT prefill_batch_size: each distinct row
+            # count is otherwise its own prefill/mixed program (~40s per
+            # compile on a tunneled chip — r5's goodput sweeps kept
+            # hitting fresh row-count shapes mid-measurement); padding
+            # rows run a 1-token chunk into the trash page
+            B = self._pad_batch(max(len(items), self.cfg.prefill_batch_size))
             return list(items) + [None] * (B - len(items))
         if self._sp > 1:
             # sp ring prefill shards ROWS over dp only (the sequence axis
@@ -1705,6 +1731,13 @@ class JaxEngine:
             np.asarray(seeds, np.uint32),
             np.asarray(counters, np.int32),
         )
+
+    @staticmethod
+    def _is_greedy(samp: SamplingParams) -> bool:
+        """True when every row is temperature-0: the dispatch compiles
+        the STATIC greedy step variant (the runtime all-greedy cond
+        still costs ~0.9ms/step at a 128k vocab — ops/sampling.py)."""
+        return bool(np.all(np.asarray(samp.temperature) <= 0.0))
 
     def _rope_array(self, rows: List[Optional[Sequence]]):
         """Per-row mrope rope-offset operand ([B] int32), or None for
@@ -1825,6 +1858,7 @@ class JaxEngine:
             for i, it in enumerate(item_rows):
                 if it is not None:
                     owner[i] = it.seq.kv_rank % self._sp
+        greedy = self._is_greedy(samp)
         if self._multihost:
             self._lockstep_send({
                 "kind": "prefill", "with_top": with_top,
@@ -1834,10 +1868,11 @@ class JaxEngine:
                 # vision embeds (leader-computed) ride the plan so every
                 # rank issues the identical with-embeds prefill variant
                 "mm": [np.asarray(m) for m in mm] if mm else None,
+                "greedy": greedy,
             })
         packed_d, tok_d = self._dispatch_prefill(
             tokens, table, prefix, chunk, samp, seeds, counters, with_top,
-            mm=mm, owner=owner,
+            mm=mm, owner=owner, greedy=greedy,
         )
         # start the host copy of the prefill result BEFORE the fused
         # decode dispatches enqueue: on a FIFO-ish transfer path the copy
@@ -1912,9 +1947,16 @@ class JaxEngine:
         ):
             return []
         # same gating as _chain_ok block 0: nothing else needs the pump,
-        # and every sequence's pages extend without preemption
+        # and every sequence's pages extend without preemption.  Other
+        # running sequences with PENDING prefills also veto fusion — the
+        # scheduler should plan mixed dispatches so their TTFT doesn't
+        # sit behind a committed decode chain (bench r5: a 4×64-step
+        # fused chain cost concurrent ISL-2000 prompts seconds of TTFT)
         if (self._pending_aborts or self._pending_ops
                 or self.scheduler.waiting):
+            return []
+        if any(not s.prefill_done for s in self.scheduler.running
+               if s not in seqs):
             return []
         if self.tiered is not None and self.tiered.pending_offloads:
             return []
@@ -1943,6 +1985,7 @@ class JaxEngine:
         return self._dispatch_decode(
             tok_d, positions, decode_ctr, None, table, samp, seeds,
             False, with_top, chain_len, rope_off=rope_off,
+            greedy=self._is_greedy(samp),
         )
 
     def _consume_decode(self, dispatches, rows, Bb, with_top) -> None:
@@ -2048,6 +2091,7 @@ class JaxEngine:
         d_samp = self._samp_arrays(d_rows)
         counts = self._counts_array(d_rows) if penalized else None
         d_rope = self._rope_array(d_rows)
+        greedy_m = self._is_greedy(p_samp) and self._is_greedy(d_samp)
         if self._multihost:
             sparse = (self._encode_counts_sparse(d_rows)
                       if penalized else None)
@@ -2060,11 +2104,12 @@ class JaxEngine:
                            *[np.asarray(a) for a in d_samp], d_seeds],
                 "counts_sparse": sparse,
                 "rope_off": d_rope,
+                "greedy": greedy_m,
             })
         p_packed_d, d_packed_d = self._dispatch_mixed(
             p_tokens, p_table, p_prefix, p_chunk, p_samp, p_seeds, p_ctr,
             d_tokens, d_pos, d_ctr, counts, d_table, d_samp, d_seeds,
-            penalized, with_top, rope_off=d_rope,
+            penalized, with_top, rope_off=d_rope, greedy=greedy_m,
         )
         # dispatch committed: account prefill chunks now (consume order
         # below matches the device program: prefill first, then decode)
@@ -2092,10 +2137,10 @@ class JaxEngine:
     def _dispatch_mixed(self, p_tokens, p_table, p_prefix, p_chunk, p_samp,
                         p_seeds, p_ctr, d_tokens, d_pos, d_ctr, d_counts,
                         d_table, d_samp, d_seeds, penalized, with_top,
-                        rope_off=None):
+                        rope_off=None, greedy=False):
         """Issue the jitted mixed step (identical on leader and followers);
         returns the two packed device outputs."""
-        step = self._get_mixed_step(penalized, with_top)
+        step = self._get_mixed_step(penalized, with_top, greedy)
         cts_d = self._put(d_counts, self._bax, None) if penalized else None
         rope = ()
         if self.model_cfg.mrope_section:
@@ -2363,7 +2408,8 @@ class JaxEngine:
         return extra, mask
 
     def _dispatch_prefill(self, tokens, table, prefix, chunk, samp, seeds,
-                          counters, with_top, mm=(), owner=None):
+                          counters, with_top, mm=(), owner=None,
+                          greedy=False):
         """Issue the jitted prefill (identical on leader and followers).
         Returns (packed_d, tok_d): the packed host-fetchable result and
         the sampled tokens as a device int32 carry.  `owner` rides along
@@ -2387,7 +2433,8 @@ class JaxEngine:
             wp = min(wp, table.shape[1])
             extra = (self._put(np.ascontiguousarray(table[:, :wp]),
                                "dp", None),)
-        packed_d, tok_d, kv = self._get_prefill_step(with_top, bool(mm))(
+        packed_d, tok_d, kv = self._get_prefill_step(
+            with_top, bool(mm), greedy)(
             self.params,
             self.kv,
             self._put(tokens, bax, None),
@@ -2408,8 +2455,16 @@ class JaxEngine:
         """May decode block k be dispatched before block k-1's results are
         fetched?  Only when nothing else needs the pump, at least one
         sequence can still use the block, and every page can grow without
-        preemption (preempting would invalidate in-flight tables)."""
+        preemption (preempting would invalidate in-flight tables).
+
+        A RUNNING sequence with its prefill still pending blocks chaining
+        too: a committed multi-block chain would starve that prompt for
+        the whole chain (at ISL-2000 a 4×64-step chain held a concurrent
+        prompt's TTFT hostage for seconds — bench r5); breaking the chain
+        lets the scheduler plan a mixed dispatch instead."""
         if self._pending_aborts or self._pending_ops or self.scheduler.waiting:
+            return False
+        if any(not s.prefill_done for s in self.scheduler.running):
             return False
         if self.tiered is not None and self.tiered.pending_offloads:
             return False
@@ -2462,10 +2517,12 @@ class JaxEngine:
                            *[np.asarray(a) for a in samp], seeds],
                 "counts_sparse": sparse,
                 "rope_off": rope_off,
+                "greedy": self._is_greedy(samp),
             })
         dispatches = self._dispatch_decode(
             tokens, positions, counters, counts, table, samp, seeds,
             penalized, with_top, chain_len, rope_off=rope_off,
+            greedy=self._is_greedy(samp),
         )
         # page frees deferred until the whole chain drains: an in-flight
         # dispatch must never see its table's pages reallocated (unchained
@@ -2482,10 +2539,10 @@ class JaxEngine:
 
     def _dispatch_decode(self, tokens, positions, counters, counts, table,
                          samp, seeds, penalized, with_top, chain_len,
-                         rope_off=None):
+                         rope_off=None, greedy=False):
         """Issue the chained decode dispatches (identical on leader and
         followers); returns the per-block packed outputs."""
-        step = self._get_decode_step(penalized, with_top)
+        step = self._get_decode_step(penalized, with_top, greedy)
         tok_d = self._put(tokens, self._bax)
         pos_d = self._put(positions, self._bax)
         ctr_d = self._put(counters, self._bax)
@@ -2577,6 +2634,7 @@ class JaxEngine:
                         SamplingParams(*a[4:4 + samp_n]),
                         a[4 + samp_n], a[5 + samp_n], desc["with_top"],
                         mm=mm, owner=desc.get("owner"),
+                        greedy=desc.get("greedy", False),
                     )
                 elif kind == "decode":
                     a = desc["arrays"]
@@ -2588,6 +2646,7 @@ class JaxEngine:
                         SamplingParams(*a[4:4 + samp_n]), a[4 + samp_n],
                         desc["penalized"], desc["with_top"],
                         desc["chain_len"], rope_off=desc.get("rope_off"),
+                        greedy=desc.get("greedy", False),
                     )
                 elif kind == "mixed":
                     a = desc["arrays"]
@@ -2605,6 +2664,7 @@ class JaxEngine:
                         d_tokens, d_pos, d_ctr, counts, d_table, d_samp,
                         d_seeds, desc["penalized"], desc["with_top"],
                         rope_off=desc.get("rope_off"),
+                        greedy=desc.get("greedy", False),
                     )
                 elif kind == "kv_export":
                     self._export_replay(desc["padded"], desc["rank"])

@@ -537,6 +537,8 @@ def _layer_decode(
     rope_pos=None,  # [B] rope positions when they differ from the KV
     # slot index (mrope decode: slot + per-seq delta)
     rope_scale: float = 1.0,  # yarn amplitude factor
+    defer_write: bool = False,  # return the new token's (k, v) instead
+    # of writing the pool (the caller batch-scatters after the scan)
 ):
     B, h = x.shape
     nh, nkv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim_
@@ -552,14 +554,29 @@ def _layer_decode(
     q = apply_rope(q, rp[:, None], inv_freq, scale=rope_scale)[:, 0]
     k = apply_rope(k, rp[:, None], inv_freq, scale=rope_scale)
 
-    # write first, then attend over the full table (new token included)
-    k_pages, v_pages = write_kv_pages(
-        k_pages, v_pages, k, v, page_table, positions, jnp.ones_like(positions)
-    )
-    attn = decode_attention(
-        q, k_pages, v_pages, page_table, seq_lens, impl=attn_impl,
-        window=window, sink=lp.get("sinks"),
-    )
+    if defer_write:
+        # deferred-write path: attend to the OLD pool + an explicit self
+        # column; the caller lands every layer's (k, v) in ONE batched
+        # scatter after the layer scan (a per-layer scatter + pool read
+        # makes XLA copy the pool each layer-step — ~1.8ms/step at
+        # 1B/batch-8; see decode_attention self_kv + decode_layers)
+        attn = decode_attention(
+            q, k_pages, v_pages, page_table, seq_lens, impl=attn_impl,
+            window=window, sink=lp.get("sinks"),
+            self_kv=(k[:, 0], v[:, 0]),
+        )
+        kv_out = (k[:, 0], v[:, 0])
+    else:
+        # write first, then attend over the full table (new token incl.)
+        k_pages, v_pages = write_kv_pages(
+            k_pages, v_pages, k, v, page_table, positions,
+            jnp.ones_like(positions)
+        )
+        attn = decode_attention(
+            q, k_pages, v_pages, page_table, seq_lens, impl=attn_impl,
+            window=window, sink=lp.get("sinks"),
+        )
+        kv_out = (k_pages, v_pages)
     attn_out = matmul_any(
         attn.reshape(B, nh * hd), lp["wo"], "bd,dh->bh"
     ).astype(x.dtype)
@@ -572,7 +589,7 @@ def _layer_decode(
         mlp_out = _moe(lp, mlp_in[:, None], cfg)[:, 0]
     else:
         mlp_out = _mlp(lp, mlp_in[:, None])[:, 0]
-    return x + mlp_out, (k_pages, v_pages)
+    return x + mlp_out, kv_out
 
 
 def _window_xs(cfg: ModelConfig):
@@ -655,19 +672,39 @@ def decode_layers(
     if wins is None:
         wins = _window_xs(cfg)
     rope_pos = None if rope_offset is None else positions + rope_offset
+    # deferred KV write (see _layer_decode): xla decode path only — the
+    # Pallas kernel (long contexts under "adaptive") reads pages and has
+    # no self column, so it keeps the write-first layout.  The choice is
+    # static per trace (table width bucket).
+    from ..ops.paged_attention import _adapt
+
+    defer = _adapt(attn_impl, page_table, kv.k.shape[2]) != "pallas"
 
     def body(carry, xs):
         h = carry
         lp, k_pages, v_pages = xs[:3]
-        h, (k_pages, v_pages) = _layer_decode(
+        h, kv_out = _layer_decode(
             lp, (k_pages, v_pages), h, positions, page_table, seq_lens, cfg,
             inv_freq, attn_impl, window=xs[3] if wins else None,
-            rope_pos=rope_pos, rope_scale=rs,
+            rope_pos=rope_pos, rope_scale=rs, defer_write=defer,
         )
-        return h, (k_pages, v_pages)
+        return h, kv_out
 
     x, (k_new, v_new) = jax.lax.scan(body, x, (layers, kv.k, kv.v, *wins))
-    return x, KVCache(k_new, v_new)
+    if not defer:
+        return x, KVCache(k_new, v_new)
+    # ONE batched scatter lands every layer's new token ([L, B, kv, hd]);
+    # out-of-window rows carry an all-trash table row, so their slot is
+    # inside trash page 0 (duplicate trash slots may race — by design)
+    Lk, P, page = kv.k.shape[0], kv.k.shape[1], kv.k.shape[2]
+    page_idx = jnp.clip(positions // page, 0, page_table.shape[1] - 1)
+    slot = (jnp.take_along_axis(page_table, page_idx[:, None], axis=1)[:, 0]
+            * page + positions % page)  # [B]
+    kf = kv.k.reshape(Lk, P * page, *kv.k.shape[3:])
+    vf = kv.v.reshape(Lk, P * page, *kv.v.shape[3:])
+    kf = kf.at[:, slot].set(k_new.astype(kf.dtype), mode="drop")
+    vf = vf.at[:, slot].set(v_new.astype(vf.dtype), mode="drop")
+    return x, KVCache(kf.reshape(kv.k.shape), vf.reshape(kv.v.shape))
 
 
 def forward_prefill(

@@ -33,12 +33,17 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
-# contexts at least this wide use the Pallas kernels under "adaptive":
-# measured on v5e (ops microbench): gather/einsum wins below ~512 tokens
-# (kernel DMA-issue overhead dominates), the streaming kernel wins above
-# (3.2x at 4k, page 16); each table-width bucket is its own jit trace, so
-# the choice is static per compiled step
-PALLAS_MIN_CTX_TOKENS = 1024
+# contexts at least this wide use the Pallas kernels under "adaptive".
+# r5 re-measured the crossover AFTER the deferred-write decode fix (the
+# old per-layer scatter+gather pool copy had been taxing the xla path):
+# at ctx 2272/batch 4 on v5e the xla+deferred path runs 9.6ms/step vs
+# the kernel's 15.8 (the kernel still requires write-first), so the
+# decode crossover moved out past 4k; each table-width bucket is its own
+# jit trace, so the choice is static per compiled step.  PREFILL still
+# uses the write-first layout the old measurement covered (streaming
+# kernel 3.2x at 4k, winning from ~1k), so it keeps its own threshold.
+PALLAS_MIN_CTX_TOKENS = 4096
+PALLAS_MIN_CTX_TOKENS_PREFILL = 1024
 
 
 def resolve_attention_impl(impl: str = "auto", meshed: bool = False) -> str:
@@ -76,12 +81,13 @@ _PALLAS_PREFILL_VMEM_BUDGET = 12 * 1024 * 1024
 
 
 def _adapt(impl: str, page_table: jax.Array, page_size: int,
-           chunk_vmem_bytes: int = 0) -> str:
+           chunk_vmem_bytes: int = 0,
+           min_ctx: int = PALLAS_MIN_CTX_TOKENS) -> str:
     if impl == "adaptive":
         ctx = page_table.shape[1] * page_size
         if chunk_vmem_bytes > _PALLAS_PREFILL_VMEM_BUDGET:
             return "xla"
-        return "pallas" if ctx >= PALLAS_MIN_CTX_TOKENS else "xla"
+        return "pallas" if ctx >= min_ctx else "xla"
     return impl
 
 
@@ -198,7 +204,8 @@ def prefill_attention(
         + 2 * S * n_heads * 4               # m + l
         + 4 * max(1, 128 // page) * page * n_kv * hd * esize  # 2x2 KV bufs
     )
-    impl = _adapt(impl, page_table, page, chunk_vmem_bytes=vmem)
+    impl = _adapt(impl, page_table, page, chunk_vmem_bytes=vmem,
+                  min_ctx=PALLAS_MIN_CTX_TOKENS_PREFILL)
     if impl == "pallas":
         from .pallas_attention import prefill_attention_pallas
 
@@ -239,17 +246,26 @@ def prefill_attention(
 
 def decode_attention(
     q: jax.Array,  # [B, n_heads, hd] — one new token per sequence
-    k_pages: jax.Array,  # [P, page, n_kv, hd] (new token already written)
+    k_pages: jax.Array,  # [P, page, n_kv, hd] (new token already written,
+    # UNLESS self_kv is given — see below)
     v_pages: jax.Array,
     page_table: jax.Array,  # [B, max_pages]
     seq_lens: jax.Array,  # [B] — context length incl. the new token
     impl: str = "xla",
     window=None,  # scalar int (traced OK); <= 0 → full attention
     sink=None,  # [n_heads] learnable sink logits; None → plain softmax
+    self_kv=None,  # ([B, n_kv, hd], same): the NEW token's k/v, NOT yet
+    # in the pool — it joins the softmax as an explicit self column.
+    # This is the deferred-write decode path: a per-layer pool scatter
+    # followed by a pool read forces XLA to copy the pool every
+    # layer-step (~1.8ms/step at 1B/batch-8 on v5e); attending to the
+    # OLD pool + self lets the caller land ONE batched scatter per step
+    # (scripts/ablate_attention.py measured 2.98 → 1.16 ms/step)
 ) -> jax.Array:
     """Single-token attention over the page table. Returns [B, n_heads, hd]."""
     impl = _adapt(impl, page_table, k_pages.shape[1])
     if impl == "pallas":
+        assert self_kv is None, "self_kv is an xla-path feature"
         from .pallas_attention import decode_attention_pallas
 
         return decode_attention_pallas(
@@ -262,10 +278,26 @@ def decode_attention(
     L = k.shape[1]
     scores = _mqa_scores(q[:, None], k)[:, :, 0, :] * scale  # [B, H, L]
     pos = jnp.arange(L)[None, None, :]
-    valid = pos < seq_lens[:, None, None]
+    cached = seq_lens[:, None, None] - (0 if self_kv is None else 1)
+    valid = pos < cached
     if window is not None:
         valid &= (pos >= seq_lens[:, None, None] - window) | (window <= 0)
     scores = jnp.where(valid, scores, NEG_INF)
+    if self_kv is not None:
+        k_self, v_self = self_kv
+        n_kv = k_self.shape[1]
+        groups = n_heads // n_kv
+        s_self = jnp.einsum(
+            "bkgd,bkd->bkg",
+            q.reshape(B, n_kv, groups, hd), k_self,
+            preferred_element_type=jnp.float32,
+        ).reshape(B, n_heads, 1) * scale
+        weights = _sink_softmax(
+            jnp.concatenate([scores, s_self], axis=-1), sink)
+        w_cached, w_self = weights[..., :-1], weights[..., -1:]
+        out = _mqa_out(w_cached[:, :, None, :], v, q.dtype)[:, 0]
+        v_top = jnp.repeat(v_self, groups, axis=1)  # [B, n_heads, hd]
+        return out + (w_self * v_top.astype(jnp.float32)).astype(q.dtype)
     weights = _sink_softmax(scores, sink)
     out = _mqa_out(weights[:, :, None, :], v, q.dtype)  # [B, 1, H, hd]
     return out[:, 0]

@@ -56,6 +56,19 @@ class SamplingParams(NamedTuple):
         )
 
 
+def sample_tokens_maybe_greedy(logits, params, seeds, counters,
+                               greedy: bool = False):
+    """`sample_tokens`, or a STATICALLY greedy argmax when the caller
+    knows every row is temperature-0.  The runtime all-greedy lax.cond
+    below still costs ~0.9ms/step at a 128k vocab on v5e (XLA keeps the
+    sampling branch's top_k in the critical path) — the engine compiles
+    a separate greedy step variant instead (the benchmark/eval hot
+    path)."""
+    if greedy:
+        return jnp.argmax(logits.astype(jnp.float32), axis=-1)
+    return sample_tokens(logits, params, seeds, counters)
+
+
 def sample_tokens(
     logits: jax.Array,  # [B, V] float
     params: SamplingParams,

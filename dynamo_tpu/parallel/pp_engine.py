@@ -46,7 +46,8 @@ from ..models.llama import (
     param_pspecs,
     prefill_layers,
 )
-from ..ops import compute_logprobs, sample_tokens
+from ..ops import compute_logprobs
+from ..ops.sampling import sample_tokens_maybe_greedy
 from ._compat import shard_map
 
 
@@ -253,6 +254,7 @@ def forward_decode_pp(
     counts=None,  # [B, V] penalty histograms (None = unpenalized)
     top_k: int = 0,  # pack top-k (ids, logprobs) per step (0 = off)
     pooled: bool = False,  # kv_partition: page axis sharded over dp
+    greedy: bool = False,  # statically all-greedy sampling variant
 ):
     """`n_steps` decode steps with the pipeline kept full: the batch
     splits into pp microbatches; the last stage samples and ships the
@@ -341,9 +343,9 @@ def forward_decode_pp(
                     logits, cts_mb, mb_samp.frequency_penalty,
                     mb_samp.presence_penalty,
                 )
-            tok_new = sample_tokens(
+            tok_new = sample_tokens_maybe_greedy(
                 logits, mb_samp,
-                mb_slice(seeds_g, mb), mb_slice(ctr_g, mb) + step,
+                mb_slice(seeds_g, mb), mb_slice(ctr_g, mb) + step, greedy,
             )
             logp = compute_logprobs(logits, tok_new)
             write = (s == stages - 1) & valid

@@ -1,0 +1,246 @@
+#!/usr/bin/env python
+"""Micro-ablate the decode attention path at the headline's shapes:
+which op eats the ~2.9ms/step gap (write scatter, page gather, or the
+attention math)?  All variants: lax.scan over 16 layers × 64 steps."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+B, T, L = 8, 256, 16
+PAGES, PAGE, W = 385, 16, 32
+NKV, NH, HD = 8, 32, 64
+
+RTT_S = 0.0
+
+
+def _sync(out):
+    np.asarray(jax.device_get(out))
+
+
+def bench(name, fn, *args):
+    out = fn(*args)
+    _sync(out)
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        _sync(out)
+        times.append(time.perf_counter() - t0)
+    dt = min(times) - RTT_S
+    print(f"{name:16s}: {dt*1e3:8.2f}ms total  {dt/T*1e3:6.3f}ms/step "
+          f"({dt/T/L*1e6:6.1f}us/layer-step)")
+    return dt
+
+
+def main():
+    global RTT_S
+    from dynamo_tpu.ops.paged_attention import (
+        decode_attention,
+        gather_kv,
+        write_kv_pages,
+    )
+
+    kshape = (L, PAGES, PAGE, NKV, HD)
+    key = jax.random.PRNGKey(0)
+    k_pages = jax.random.normal(key, kshape, jnp.bfloat16)
+    v_pages = jax.random.normal(key, kshape, jnp.bfloat16)
+    table = jnp.tile(jnp.arange(1, W + 1, dtype=jnp.int32), (B, 1))
+    q = jax.random.normal(key, (B, NH, HD), jnp.bfloat16)
+    knew = jax.random.normal(key, (B, 1, NKV, HD), jnp.bfloat16)
+    pos = jnp.full((B,), 330, jnp.int32)
+    lens = jnp.full((B,), 331, jnp.int32)
+
+    triv = jax.jit(lambda t: t + 1)
+    _sync(triv(pos))
+    rtts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        _sync(triv(pos))
+        rtts.append(time.perf_counter() - t0)
+    RTT_S = min(rtts)
+    print(f"fetch RTT: {RTT_S*1e3:.1f}ms")
+
+    def scan_layers(per_layer):
+        """T steps × L layers; per_layer(kpl, vpl, acc) →
+        (acc', kpl', vpl')."""
+        def fn(kp, vp, q, knew, table, pos, lens):
+            def step(carry, _):
+                kp, vp, acc = carry
+
+                def layer(acc, xs):
+                    acc, kpl, vpl = per_layer(xs[0], xs[1], acc)
+                    return acc, (kpl, vpl)
+
+                acc, (kp, vp) = jax.lax.scan(layer, acc, (kp, vp))
+                return (kp, vp, acc), ()
+
+            (kp, vp, acc), _ = jax.lax.scan(
+                step, (kp, vp, jnp.zeros((B, NH, HD), jnp.float32)),
+                None, length=T)
+            return acc
+        return fn
+
+    # 1. write only
+    def w_only(kpl, vpl, acc):
+        kpl, vpl = write_kv_pages(kpl, vpl, knew, knew, table, pos,
+                                  jnp.ones((B,), jnp.int32))
+        return acc * 0.999, kpl, vpl
+
+    # 2. gather only
+    def g_only(kpl, vpl, acc):
+        k, v = gather_kv(kpl, vpl, table)
+        return acc + k[:, ::64, 0, :NH * 0 + 1].sum(1)[:, None, :].astype(
+            jnp.float32) * 1e-6, kpl, vpl
+
+    # 3. full decode attention (xla)
+    def a_xla(kpl, vpl, acc):
+        out = decode_attention(q, kpl, vpl, table, lens, impl="xla")
+        return acc + out.astype(jnp.float32) * 1e-6, kpl, vpl
+
+    # 4. full decode attention (pallas)
+    def a_pal(kpl, vpl, acc):
+        out = decode_attention(q, kpl, vpl, table, lens, impl="pallas")
+        return acc + out.astype(jnp.float32) * 1e-6, kpl, vpl
+
+    # 5. write + xla attention (the engine's per-layer combination)
+    def wa(kpl, vpl, acc):
+        kpl, vpl = write_kv_pages(kpl, vpl, knew, knew, table, pos,
+                                  jnp.ones((B,), jnp.int32))
+        out = decode_attention(q, kpl, vpl, table, lens, impl="xla")
+        return acc + out.astype(jnp.float32) * 1e-6, kpl, vpl
+
+    # 6. dense-pool attention: no gather — scores against the WHOLE pool
+    # with ownership masks (dense HBM streams instead of page gathers)
+    def pool_masks():
+        # owner[p] = batch row owning page p (-1 free); base[p] = page's
+        # token offset within its sequence — built once per step from
+        # the table (tiny scatters)
+        owner = jnp.full((PAGES,), -1, jnp.int32)
+        rows = jnp.broadcast_to(jnp.arange(B)[:, None], (B, W)).reshape(-1)
+        base = (jnp.broadcast_to(jnp.arange(W)[None, :] * PAGE, (B, W))
+                .reshape(-1))
+        flat = table.reshape(-1)
+        owner = owner.at[flat].set(rows, mode="drop")
+        pbase = jnp.zeros((PAGES,), jnp.int32).at[flat].set(
+            base, mode="drop")
+        return owner, pbase
+
+    owner, pbase = pool_masks()
+
+    def a_pool(kpl, vpl, acc):
+        scale = 1.0 / np.sqrt(HD)
+        kf = kpl.reshape(PAGES * PAGE, NKV, HD)
+        vf = vpl.reshape(PAGES * PAGE, NKV, HD)
+        groups = NH // NKV
+        qg = q.reshape(B, NKV, groups, HD)
+        scores = jnp.einsum("bkgd,skd->bkgs", qg, kf,
+                            preferred_element_type=jnp.float32) * scale
+        slot_pos = (pbase[:, None] + jnp.arange(PAGE)[None, :]).reshape(-1)
+        slot_owner = jnp.repeat(owner, PAGE)
+        valid = (slot_owner[None, :] == jnp.arange(B)[:, None]) & (
+            slot_pos[None, :] < lens[:, None])
+        scores = jnp.where(valid[:, None, None, :], scores, -1e30)
+        w = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bkgs,skd->bkgd", w, vf.astype(jnp.float32))
+        return acc + out.reshape(B, NH, HD) * 1e-6, kpl, vpl
+
+    def wap(kpl, vpl, acc):
+        kpl, vpl = write_kv_pages(kpl, vpl, knew, knew, table, pos,
+                                  jnp.ones((B,), jnp.int32))
+        return a_pool(kpl, vpl, acc)
+
+    # 7. attend-THEN-write: the new token attends to the OLD pool plus
+    # itself (explicit self term), and the scatter becomes the last op on
+    # the buffer — no read-after-write inside the layer
+    def atw(kpl, vpl, acc):
+        scale = 1.0 / np.sqrt(HD)
+        k, v = gather_kv(kpl, vpl, table)  # old pool (no new token)
+        groups = NH // NKV
+        qg = q.reshape(B, NKV, groups, HD)
+        scores = jnp.einsum("bkgd,bskd->bkgs", qg, k,
+                            preferred_element_type=jnp.float32) * scale
+        Lc = k.shape[1]
+        valid = jnp.arange(Lc)[None, :] < (lens - 1)[:, None]
+        scores = jnp.where(valid[:, None, None, :], scores, -1e30)
+        self_s = jnp.einsum(
+            "bkgd,bkd->bkg", qg, knew[:, 0].astype(qg.dtype),
+            preferred_element_type=jnp.float32)[..., None] * scale
+        w = jax.nn.softmax(
+            jnp.concatenate([scores, self_s], axis=-1), axis=-1)
+        out = (jnp.einsum("bkgs,bskd->bkgd", w[..., :-1],
+                          v.astype(jnp.float32))
+               + w[..., -1:] * knew[:, 0].reshape(
+                   B, NKV, 1, HD).astype(jnp.float32))
+        kpl, vpl = write_kv_pages(kpl, vpl, knew, knew, table, pos,
+                                  jnp.ones((B,), jnp.int32))
+        return acc + out.reshape(B, NH, HD) * 1e-6, kpl, vpl
+
+    for name, fn in (("write_only", w_only), ("gather_only", g_only),
+                     ("attn_xla", a_xla), ("attn_pallas", a_pal),
+                     ("write+attn_xla", wa), ("attn_pool", a_pool),
+                     ("write+attn_pool", wap), ("attn_then_write", atw)):
+        jf = jax.jit(scan_layers(fn))
+        bench(name, jf, k_pages, v_pages, q, knew, table, pos, lens)
+
+    # 8. read-only layer scan + ONE batched scatter per step: layers
+    # attend to the old pool + explicit self term and emit their new
+    # (k, v) as scan outputs; a single [L]-wide scatter lands them after
+    # the layer scan (the pool is never scatter+read in the same scope)
+    def batched_write(kp, vp, q, knew, table, pos, lens):
+        slot = (jnp.take_along_axis(
+            table, (pos // PAGE)[:, None], axis=1)[:, 0] * PAGE
+            + pos % PAGE)  # [B]
+
+        def step(carry, _):
+            kp, vp, acc = carry
+
+            def layer(acc, xs):
+                kpl, vpl = xs
+                scale = 1.0 / np.sqrt(HD)
+                k, v = gather_kv(kpl, vpl, table)
+                groups = NH // NKV
+                qg = q.reshape(B, NKV, groups, HD)
+                scores = jnp.einsum(
+                    "bkgd,bskd->bkgs", qg, k,
+                    preferred_element_type=jnp.float32) * scale
+                Lc = k.shape[1]
+                ok = jnp.arange(Lc)[None, :] < (lens - 1)[:, None]
+                scores = jnp.where(ok[:, None, None, :], scores, -1e30)
+                self_s = jnp.einsum(
+                    "bkgd,bkd->bkg", qg, knew[:, 0].astype(qg.dtype),
+                    preferred_element_type=jnp.float32)[..., None] * scale
+                w = jax.nn.softmax(
+                    jnp.concatenate([scores, self_s], axis=-1), axis=-1)
+                out = (jnp.einsum("bkgs,bskd->bkgd", w[..., :-1],
+                                  v.astype(jnp.float32))
+                       + w[..., -1:] * knew[:, 0].reshape(
+                           B, NKV, 1, HD).astype(jnp.float32))
+                return acc + out.reshape(B, NH, HD) * 1e-6, (
+                    knew[:, 0], knew[:, 0])
+
+            acc, (nk, nv) = jax.lax.scan(layer, acc, (kp, vp))
+            # one scatter for every layer's new token: [L, B, kv, hd]
+            kp = kp.reshape(L, PAGES * PAGE, NKV, HD).at[:, slot].set(
+                nk, mode="drop").reshape(kp.shape)
+            vp = vp.reshape(L, PAGES * PAGE, NKV, HD).at[:, slot].set(
+                nv, mode="drop").reshape(vp.shape)
+            return (kp, vp, acc), ()
+
+        (kp, vp, acc), _ = jax.lax.scan(
+            step, (kp, vp, jnp.zeros((B, NH, HD), jnp.float32)),
+            None, length=T)
+        return acc
+
+    bench("batched_write", jax.jit(batched_write), k_pages, v_pages, q,
+          knew, table, pos, lens)
+
+
+if __name__ == "__main__":
+    main()

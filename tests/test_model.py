@@ -194,3 +194,39 @@ def test_moe_dispatch_shards_on_ep_axis(setup):
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
     )
+
+
+def test_deferred_write_attention_equals_write_first():
+    """decode_attention with self_kv (the deferred-write fast path: the
+    new token joins as an explicit softmax column, the pool scatter
+    happens later) must equal write-first + full-table attention — with
+    GQA, sliding windows, and sink logits."""
+    from dynamo_tpu.ops.paged_attention import (
+        decode_attention,
+        write_kv_pages,
+    )
+
+    rng = np.random.RandomState(11)
+    B, NH, NKV, HD, PAGES, PAGE, W = 3, 8, 2, 16, 17, 4, 3
+    k_pages = jnp.asarray(rng.randn(PAGES, PAGE, NKV, HD), jnp.float32)
+    v_pages = jnp.asarray(rng.randn(PAGES, PAGE, NKV, HD), jnp.float32)
+    table = make_table(B, W)
+    q = jnp.asarray(rng.randn(B, NH, HD), jnp.float32)
+    k_new = jnp.asarray(rng.randn(B, 1, NKV, HD), jnp.float32)
+    v_new = jnp.asarray(rng.randn(B, 1, NKV, HD), jnp.float32)
+    positions = jnp.asarray([5, 9, 2], jnp.int32)
+    seq_lens = positions + 1
+    sink = jnp.asarray(rng.randn(NH), jnp.float32)
+
+    for window, snk in ((None, None), (4, None), (None, sink), (6, sink)):
+        kp, vp = write_kv_pages(
+            k_pages, v_pages, k_new, v_new, table, positions,
+            jnp.ones((B,), jnp.int32))
+        want = decode_attention(q, kp, vp, table, seq_lens,
+                                window=window, sink=snk)
+        got = decode_attention(q, k_pages, v_pages, table, seq_lens,
+                               window=window, sink=snk,
+                               self_kv=(k_new[:, 0], v_new[:, 0]))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5,
+                                   err_msg=f"window={window} sink={snk is not None}")

@@ -89,3 +89,20 @@ def test_top_p_high_entropy_stays_in_slice():
     out = _draw(row, temperature=1.0, top_k=0, top_p=0.95, n=2048)
     top = set(np.argsort(row)[::-1][:TOP_K_CAP].tolist())
     assert set(out.tolist()) <= top
+
+
+def test_static_greedy_variant_matches_sample_tokens():
+    """The engine's STATIC greedy step variant (compiled when every row
+    is temperature-0 — the runtime all-greedy cond costs real step time
+    at a 128k vocab) must agree with sample_tokens exactly."""
+    from dynamo_tpu.ops.sampling import sample_tokens_maybe_greedy
+
+    logits = jnp.asarray(
+        np.random.RandomState(9).randn(6, 257).astype(np.float32))
+    samp = SamplingParams.make([0.0] * 6, [0] * 6, [1.0] * 6)
+    seeds = jnp.zeros((6,), jnp.uint32)
+    ctr = jnp.zeros((6,), jnp.int32)
+    a = np.asarray(sample_tokens_maybe_greedy(
+        logits, samp, seeds, ctr, True))
+    b = np.asarray(sample_tokens(logits, samp, seeds, ctr))
+    np.testing.assert_array_equal(a, b)
