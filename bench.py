@@ -724,6 +724,11 @@ async def main_async():
             "itl_p50_idle_ms": round(itl_idle * 1e3, 2),
             "max_goodput_at_slo_tok_s": k1["max_goodput_at_slo_tok_s"],
             "knee_rate_rps": k1["knee_rate_rps"],
+            "n_req": k1["n_req"],
+            "repeat_agreement": k1["repeat_agreement"],
+            "knees_per_pass": k1["knees_per_pass"],
+            **({} if "knee_disagreement" not in k1
+               else {"knee_disagreement": k1["knee_disagreement"]}),
             "goodput_sweep": k1["sweep"],
         },
         "llama-3.1-8b-int8": {
@@ -736,6 +741,11 @@ async def main_async():
             "step_breakdown_ms": breakdown8,
             "max_goodput_at_slo_tok_s": k8["max_goodput_at_slo_tok_s"],
             "knee_rate_rps": k8["knee_rate_rps"],
+            "n_req": k8["n_req"],
+            "repeat_agreement": k8["repeat_agreement"],
+            "knees_per_pass": k8["knees_per_pass"],
+            **({} if "knee_disagreement" not in k8
+               else {"knee_disagreement": k8["knee_disagreement"]}),
             "goodput_sweep": k8["sweep"],
             "slo": SLO_8B,
         },

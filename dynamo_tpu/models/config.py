@@ -156,7 +156,8 @@ class ModelConfig:
             attention_bias=(attn_bias := d.get(
                 "attention_bias",
                 d.get("model_type") in ("qwen2", "qwen2_vl",
-                                        "qwen2_vl_text", "gpt_oss"),
+                                        "qwen2_vl_text", "qwen2_5_vl",
+                                        "qwen2_5_vl_text", "gpt_oss"),
             )),
             # gpt-oss biases o_proj too — ONE resolution of
             # attention_bias drives both fields so they cannot split

@@ -34,7 +34,7 @@ from __future__ import annotations
 
 import math
 from dataclasses import dataclass
-from typing import Any, Dict, List, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -61,6 +61,20 @@ class Qwen2VLVisionConfig:
     # smart-resize pixel budget (HF min_pixels/max_pixels)
     min_pixels: int = 56 * 56
     max_pixels: int = 14 * 14 * 4 * 1280
+    # -- qwen2.5-vl tower variant (HF Qwen2_5_VLVisionConfig) ---------- #
+    # gated SiLU MLP width (None → the 2.0 quick_gelu mlp_ratio mlp)
+    intermediate_size: Optional[int] = None
+    # windowed attention: every block attends within window_size-pixel
+    # tiles except `fullatt_block_indexes`, which attend frame-wide.
+    # 0 → all blocks frame-wide (the 2.0 tower)
+    window_size: int = 0
+    fullatt_block_indexes: Tuple[int, ...] = ()
+    rms_norm: bool = False  # 2.5: RMSNorm (no biases) incl. merger ln_q
+    # 2.5 video M-RoPE: temporal positions advance tokens_per_second *
+    # second_per_grid per frame (second_per_grid assumed 1.0; HF class
+    # default tokens_per_second = 4, published configs override to 2);
+    # 0 → the 2.0 arange(t) indexing
+    tokens_per_second: float = 0.0
 
     @property
     def head_dim(self) -> int:
@@ -77,6 +91,29 @@ class Qwen2VLVisionConfig:
 
     @staticmethod
     def from_hf_config(d: dict) -> "Qwen2VLVisionConfig":
+        # qwen2.5-vl renames the dims: `hidden_size` is the TOWER width
+        # and `out_hidden_size` the LLM hidden (2.0: embed_dim / hidden_
+        # size); its presence (or window_size) marks the 2.5 variant
+        v25 = "out_hidden_size" in d or "window_size" in d
+        if v25:
+            return Qwen2VLVisionConfig(
+                embed_dim=d.get("hidden_size", 1280),
+                depth=d.get("depth", 32),
+                num_heads=d.get("num_heads", 16),
+                in_channels=d.get("in_channels", d.get("in_chans", 3)),
+                patch_size=d.get("patch_size", 14),
+                temporal_patch_size=d.get("temporal_patch_size", 2),
+                spatial_merge_size=d.get("spatial_merge_size", 2),
+                out_hidden_size=d.get("out_hidden_size", 2048),
+                min_pixels=d.get("min_pixels", 56 * 56),
+                max_pixels=d.get("max_pixels", 14 * 14 * 4 * 1280),
+                intermediate_size=d.get("intermediate_size", 3420),
+                window_size=d.get("window_size", 112),
+                fullatt_block_indexes=tuple(
+                    d.get("fullatt_block_indexes", (7, 15, 23, 31))),
+                rms_norm=True,
+                tokens_per_second=d.get("tokens_per_second", 4.0),
+            )
         return Qwen2VLVisionConfig(
             embed_dim=d.get("embed_dim", 1280),
             depth=d.get("depth", 32),
@@ -106,9 +143,8 @@ def tiny_qwen_vl_vision_config(**over) -> Qwen2VLVisionConfig:
 def init_qwen_vl_vision_params(cfg: Qwen2VLVisionConfig, key,
                                dtype=jnp.float32) -> Params:
     e, L = cfg.embed_dim, cfg.depth
-    f = int(cfg.embed_dim * cfg.mlp_ratio)
     mu = cfg.merge_unit
-    ks = iter(jax.random.split(key, 8))
+    ks = iter(jax.random.split(key, 10))
 
     def w(k, *shape):
         return (jax.random.normal(k, shape, jnp.float32)
@@ -116,29 +152,45 @@ def init_qwen_vl_vision_params(cfg: Qwen2VLVisionConfig, key,
 
     layers = {
         "ln1_scale": jnp.ones((L, e), dtype),
-        "ln1_bias": jnp.zeros((L, e), dtype),
         # HF qkv is ONE [e, 3e] projection with bias
         "wqkv": w(next(ks), L, e, 3 * e),
         "bqkv": jnp.zeros((L, 3 * e), dtype),
         "wo": w(next(ks), L, e, e),
         "bo": jnp.zeros((L, e), dtype),
         "ln2_scale": jnp.ones((L, e), dtype),
-        "ln2_bias": jnp.zeros((L, e), dtype),
-        "w1": w(next(ks), L, e, f),
-        "b1": jnp.zeros((L, f), dtype),
-        "w2": w(next(ks), L, f, e),
-        "b2": jnp.zeros((L, e), dtype),
     }
-    return {
+    if cfg.intermediate_size:  # 2.5: gated SiLU MLP (biased)
+        f = cfg.intermediate_size
+        layers.update({
+            "w_gate": w(next(ks), L, e, f),
+            "b_gate": jnp.zeros((L, f), dtype),
+            "w_up": w(next(ks), L, e, f),
+            "b_up": jnp.zeros((L, f), dtype),
+            "w_down": w(next(ks), L, f, e),
+            "b_down": jnp.zeros((L, e), dtype),
+        })
+    else:  # 2.0: quick_gelu 2-layer MLP + LayerNorm biases
+        f = int(cfg.embed_dim * cfg.mlp_ratio)
+        layers.update({
+            "ln1_bias": jnp.zeros((L, e), dtype),
+            "ln2_bias": jnp.zeros((L, e), dtype),
+            "w1": w(next(ks), L, e, f),
+            "b1": jnp.zeros((L, f), dtype),
+            "w2": w(next(ks), L, f, e),
+            "b2": jnp.zeros((L, e), dtype),
+        })
+    out = {
         "patch_proj": w(next(ks), cfg.patch_dim, e),
         "layers": layers,
         "merge_ln_scale": jnp.ones((e,), dtype),
-        "merge_ln_bias": jnp.zeros((e,), dtype),
         "merge_w1": w(next(ks), mu * e, mu * e),
         "merge_b1": jnp.zeros((mu * e,), dtype),
         "merge_w2": w(next(ks), mu * e, cfg.out_hidden_size),
         "merge_b2": jnp.zeros((cfg.out_hidden_size,), dtype),
     }
+    if not cfg.rms_norm:
+        out["merge_ln_bias"] = jnp.zeros((e,), dtype)
+    return out
 
 
 def _ln(x, scale, bias, eps=1e-6):
@@ -180,6 +232,33 @@ def _frame_ids(grid: Tuple[int, int, int]) -> np.ndarray:
     return np.arange(t, dtype=np.int32).repeat(h * w)
 
 
+def _window_ids(grid: Tuple[int, int, int],
+                cfg: Qwen2VLVisionConfig) -> np.ndarray:
+    """Per-patch window id for the qwen2.5 tower, patches in the same
+    merge-group-major order as the stream: windows tile the MERGED grid
+    in (window_size // merge // patch) blocks per frame, truncated at
+    borders (HF get_window_index semantics — the HF permutation +
+    cu_window_seqlens is equivalent to same-window masking)."""
+    t, h, w = grid
+    m = cfg.spatial_merge_size
+    ws = max(cfg.window_size // m // cfg.patch_size, 1)
+    hpos = np.arange(h)[:, None].repeat(w, 1)
+    wpos = np.arange(w)[None, :].repeat(h, 0)
+
+    def merge_order(a):
+        return (a.reshape(h // m, m, w // m, m)
+                 .transpose(0, 2, 1, 3).reshape(-1))
+
+    mrow = merge_order(hpos) // m
+    mcol = merge_order(wpos) // m
+    nwc = -(-(w // m) // ws)
+    nwr = -(-(h // m) // ws)
+    wid = (mrow // ws) * nwc + (mcol // ws)  # [h*w]
+    per_frame = nwr * nwc
+    return np.concatenate(
+        [wid + f * per_frame for f in range(t)]).astype(np.int32)
+
+
 def encode_patches(params: Params, cfg: Qwen2VLVisionConfig,
                    patches: jax.Array,  # [L, patch_dim]
                    grid: Tuple[int, int, int]) -> jax.Array:
@@ -194,10 +273,29 @@ def encode_patches(params: Params, cfg: Qwen2VLVisionConfig,
     sin = jnp.sin(jnp.concatenate([angles, angles], -1))
     # attention is full WITHIN each temporal slice (HF cu_seqlens)
     fid = jnp.asarray(_frame_ids(grid))
-    mask = jnp.where(fid[:, None] == fid[None, :], 0.0, -1e9)[None]
+    mask_full = jnp.where(fid[:, None] == fid[None, :], 0.0, -1e9)[None]
+    v25 = bool(cfg.intermediate_size)
+    if cfg.window_size:
+        wid = jnp.asarray(_window_ids(grid, cfg))
+        mask_win = jnp.where(wid[:, None] == wid[None, :], 0.0, -1e9)[None]
+        fullatt = np.zeros((cfg.depth,), bool)
+        fullatt[list(cfg.fullatt_block_indexes)] = True
+        fullatt = jnp.asarray(fullatt)
+    else:
+        mask_win = mask_full
+        fullatt = jnp.ones((cfg.depth,), bool)
 
-    def block(x, lp):
-        a = _ln(x, lp["ln1_scale"], lp["ln1_bias"])
+    def norm(x, lp, pre):
+        if cfg.rms_norm:
+            from ..ops import rms_norm
+
+            return rms_norm(x, lp[pre + "_scale"], eps=1e-6)
+        return _ln(x, lp[pre + "_scale"], lp[pre + "_bias"])
+
+    def block(x, xs):
+        lp, full_l = xs
+        mask = jnp.where(full_l, mask_full, mask_win)
+        a = norm(x, lp, "ln1")
         qkv = a @ lp["wqkv"] + lp["bqkv"]
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(L, nh, hd)
@@ -210,15 +308,26 @@ def encode_patches(params: Params, cfg: Qwen2VLVisionConfig,
         p = jax.nn.softmax(s + mask, axis=-1)
         o = jnp.einsum("hqk,khd->qhd", p, v.astype(jnp.float32))
         x = x + (o.reshape(L, e).astype(x.dtype) @ lp["wo"] + lp["bo"])
-        m_in = _ln(x, lp["ln2_scale"], lp["ln2_bias"])
-        m = m_in @ lp["w1"] + lp["b1"]
-        m = m * jax.nn.sigmoid(1.702 * m)  # quick_gelu
-        x = x + (m @ lp["w2"] + lp["b2"]).astype(x.dtype)
+        m_in = norm(x, lp, "ln2")
+        if v25:  # gated SiLU MLP (qwen2.5)
+            g = m_in @ lp["w_gate"] + lp["b_gate"]
+            u = m_in @ lp["w_up"] + lp["b_up"]
+            m = jax.nn.silu(g) * u
+            x = x + (m @ lp["w_down"] + lp["b_down"]).astype(x.dtype)
+        else:
+            m = m_in @ lp["w1"] + lp["b1"]
+            m = m * jax.nn.sigmoid(1.702 * m)  # quick_gelu
+            x = x + (m @ lp["w2"] + lp["b2"]).astype(x.dtype)
         return x, None
 
-    x, _ = jax.lax.scan(block, x, params["layers"])
-    # merger: LN, concat each 2x2 spatial group, 2-layer GELU MLP
-    x = _ln(x, params["merge_ln_scale"], params["merge_ln_bias"])
+    x, _ = jax.lax.scan(block, x, (params["layers"], fullatt))
+    # merger: LN/RMS, concat each 2x2 spatial group, 2-layer GELU MLP
+    if cfg.rms_norm:
+        from ..ops import rms_norm
+
+        x = rms_norm(x, params["merge_ln_scale"], eps=1e-6)
+    else:
+        x = _ln(x, params["merge_ln_scale"], params["merge_ln_bias"])
     x = x.reshape(L // cfg.merge_unit, cfg.merge_unit * e)
     x = jax.nn.gelu(x @ params["merge_w1"] + params["merge_b1"],
                     approximate=False)
@@ -280,6 +389,19 @@ def merged_tokens(grid: Tuple[int, int, int],
     return t * h * w // cfg.merge_unit
 
 
+def _temporal_index(t: int, cfg: Qwen2VLVisionConfig):
+    """Per-frame temporal rope indices and the span they occupy.  2.5
+    scales frames by tokens_per_second * second_per_grid (HF
+    get_rope_index; second_per_grid assumed 1.0 — the processor default
+    of temporal_patch_size / fps at fps 2); 2.0 counts frames."""
+    if cfg.tokens_per_second:
+        tt = (np.arange(t) * cfg.tokens_per_second * 1.0).astype(np.int32)
+    else:
+        tt = np.arange(t, dtype=np.int32)
+    span = int(tt[-1]) + 1 if t else 1
+    return tt, span
+
+
 def mrope_positions(
     token_ids: Sequence[int],
     image_token_id: int,
@@ -302,13 +424,13 @@ def mrope_positions(
             t, h, w = next(g)
             lh, lw = h // m, w // m
             n = t * lh * lw
-            tt = np.arange(t, dtype=np.int32).repeat(lh * lw)
+            tt, t_span = _temporal_index(t, cfg)
             hh = np.tile(np.arange(lh, dtype=np.int32).repeat(lw), t)
             ww = np.tile(np.tile(np.arange(lw, dtype=np.int32), lh), t)
-            pos[0, i:i + n] = nxt + tt
+            pos[0, i:i + n] = nxt + tt.repeat(lh * lw)
             pos[1, i:i + n] = nxt + hh
             pos[2, i:i + n] = nxt + ww
-            nxt = nxt + max(t, lh, lw)
+            nxt = nxt + max(t_span, lh, lw)
             i += n
         else:
             pos[:, i] = nxt
@@ -344,12 +466,13 @@ def mrope_positions_from_runs(
         n = t * lh * lw
         if off + n > total_len:
             raise ValueError("vision run exceeds the prompt")
-        pos[0, i:i + n] = nxt + np.arange(t, dtype=np.int32).repeat(lh * lw)
+        tt, t_span = _temporal_index(t, cfg)
+        pos[0, i:i + n] = nxt + tt.repeat(lh * lw)
         pos[1, i:i + n] = nxt + np.tile(
             np.arange(lh, dtype=np.int32).repeat(lw), t)
         pos[2, i:i + n] = nxt + np.tile(
             np.tile(np.arange(lw, dtype=np.int32), lh), t)
-        nxt += max(t, lh, lw)
+        nxt += max(t_span, lh, lw)
         i += n
     while i < total_len:
         pos[:, i] = nxt

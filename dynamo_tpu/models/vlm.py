@@ -186,32 +186,48 @@ def load_qwen_vl_vision_params(path: str, vcfg, dtype=jnp.float32,
         return stack_layers(r, L, fmt, transpose=transpose, dtype=dtype)
 
     conv = r.get(prefix + "patch_embed.proj.weight")  # [e, C, tp, p, p]
-    return {
-        # voxel flatten order is (C, tp, p, p) — matches frames_to_patches
-        "patch_proj": jnp.asarray(
-            np.ascontiguousarray(conv.reshape(conv.shape[0], -1).T), dtype
-        ),
-        "layers": {
-            "ln1_scale": stack(B + "norm1.weight", False),
+    layers = {
+        "ln1_scale": stack(B + "norm1.weight", False),
+        "wqkv": stack(B + "attn.qkv.weight"),
+        "bqkv": stack(B + "attn.qkv.bias", False),
+        "wo": stack(B + "attn.proj.weight"),
+        "bo": stack(B + "attn.proj.bias", False),
+        "ln2_scale": stack(B + "norm2.weight", False),
+    }
+    if vcfg.intermediate_size:  # qwen2.5: RMS norms + gated SiLU MLP
+        layers.update({
+            "w_gate": stack(B + "mlp.gate_proj.weight"),
+            "b_gate": stack(B + "mlp.gate_proj.bias", False),
+            "w_up": stack(B + "mlp.up_proj.weight"),
+            "b_up": stack(B + "mlp.up_proj.bias", False),
+            "w_down": stack(B + "mlp.down_proj.weight"),
+            "b_down": stack(B + "mlp.down_proj.bias", False),
+        })
+    else:
+        layers.update({
             "ln1_bias": stack(B + "norm1.bias", False),
-            "wqkv": stack(B + "attn.qkv.weight"),
-            "bqkv": stack(B + "attn.qkv.bias", False),
-            "wo": stack(B + "attn.proj.weight"),
-            "bo": stack(B + "attn.proj.bias", False),
-            "ln2_scale": stack(B + "norm2.weight", False),
             "ln2_bias": stack(B + "norm2.bias", False),
             "w1": stack(B + "mlp.fc1.weight"),
             "b1": stack(B + "mlp.fc1.bias", False),
             "w2": stack(B + "mlp.fc2.weight"),
             "b2": stack(B + "mlp.fc2.bias", False),
-        },
+        })
+    out = {
+        # voxel flatten order is (C, tp, p, p) — matches frames_to_patches
+        "patch_proj": jnp.asarray(
+            np.ascontiguousarray(conv.reshape(conv.shape[0], -1).T), dtype
+        ),
+        "layers": layers,
         "merge_ln_scale": jnp.asarray(r.get(prefix + "merger.ln_q.weight"), dtype),
-        "merge_ln_bias": jnp.asarray(r.get(prefix + "merger.ln_q.bias"), dtype),
         "merge_w1": jnp.asarray(r.get(prefix + "merger.mlp.0.weight").T, dtype),
         "merge_b1": jnp.asarray(r.get(prefix + "merger.mlp.0.bias"), dtype),
         "merge_w2": jnp.asarray(r.get(prefix + "merger.mlp.2.weight").T, dtype),
         "merge_b2": jnp.asarray(r.get(prefix + "merger.mlp.2.bias"), dtype),
     }
+    if not vcfg.rms_norm:  # 2.5's merger ln_q is RMSNorm (no bias)
+        out["merge_ln_bias"] = jnp.asarray(
+            r.get(prefix + "merger.ln_q.bias"), dtype)
+    return out
 
 
 def load_qwen_vl(path: str, dtype=jnp.bfloat16) -> Tuple:

@@ -117,3 +117,23 @@ async def local_cluster(n: int = 1):
         for rt in runtimes:
             await rt.shutdown(graceful=False)
         await server.stop()
+
+
+def export_vl_state_dict(model) -> dict:
+    """Flatten an HF Qwen-VL-class state_dict into the PUBLISHED
+    checkpoint layout (`visual.*` + `model.*` + `lm_head.weight`) as
+    float32 numpy — shared by the verify drivers and the round-trip
+    tests so they always write the same key mapping."""
+    import numpy as np
+
+    tensors = {}
+    for k, v in model.state_dict().items():
+        if k.startswith("model.visual."):
+            k2 = k[len("model."):]
+        elif k.startswith("model.language_model."):
+            k2 = "model." + k[len("model.language_model."):]
+        else:
+            k2 = k
+        tensors[k2] = np.ascontiguousarray(
+            np.asarray(v.detach().to("cpu").numpy(), np.float32))
+    return tensors

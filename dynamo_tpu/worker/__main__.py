@@ -457,7 +457,7 @@ def _build_engine(args):
 
         model_dir = resolve_model(args.model)
         cfg = ModelConfig.from_pretrained(model_dir)
-        if cfg.model_type == "qwen2_vl":
+        if cfg.model_type in ("qwen2_vl", "qwen2_5_vl"):
             # qwen-vl checkpoints carry their own tower + mrope config
             from ..models.vlm import load_qwen_vl
 

@@ -60,15 +60,9 @@ def make_checkpoint(out_dir: str) -> None:
         ),
     )
     model = Qwen2VLForConditionalGeneration(cfg).eval().float()
-    tensors = {}
-    for k, v in model.state_dict().items():
-        if k.startswith("model.visual."):
-            k2 = k[len("model."):]
-        elif k.startswith("model.language_model."):
-            k2 = "model." + k[len("model.language_model."):]
-        else:
-            k2 = k
-        tensors[k2] = np.asarray(v.detach().numpy(), np.float32)
+    from dynamo_tpu.testing import export_vl_state_dict
+
+    tensors = export_vl_state_dict(model)
     os.makedirs(out_dir, exist_ok=True)
     save_file(tensors, os.path.join(out_dir, "model.safetensors"))
     d = cfg.to_dict()
@@ -78,6 +72,53 @@ def make_checkpoint(out_dir: str) -> None:
     with open(os.path.join(out_dir, "tokenizer.json"), "w") as f:
         f.write(tok.to_json_str())
     print(f"[checkpoint] {out_dir} (image token id {img_id[0]})")
+
+
+def make_checkpoint_25(out_dir: str) -> None:
+    """Tiny qwen2.5-vl checkpoint: WINDOWED tower (fullatt exception),
+    RMSNorm, gated SiLU MLP — the r5 family addition."""
+    import numpy as np
+    import torch
+    from safetensors.numpy import save_file
+    from transformers.models.qwen2_5_vl.configuration_qwen2_5_vl import (
+        Qwen2_5_VLConfig,
+    )
+    from transformers.models.qwen2_5_vl.modeling_qwen2_5_vl import (
+        Qwen2_5_VLForConditionalGeneration,
+    )
+
+    sys.path.insert(0, ROOT)
+    from dynamo_tpu.testing import tiny_tokenizer
+
+    tok = tiny_tokenizer()
+    img_id = tok.encode("<image>")[0]
+    torch.manual_seed(2)
+    cfg = Qwen2_5_VLConfig(
+        vocab_size=tok.vocab_size, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        rms_norm_eps=1e-6, tie_word_embeddings=False,
+        image_token_id=img_id, video_token_id=img_id,
+        rope_scaling={"type": "mrope", "mrope_section": [2, 3, 3]},
+        vision_config=dict(
+            depth=2, hidden_size=32, out_hidden_size=64, num_heads=2,
+            intermediate_size=48, in_channels=3, patch_size=4,
+            temporal_patch_size=2, spatial_merge_size=2,
+            window_size=16, fullatt_block_indexes=[1],
+        ),
+    )
+    model = Qwen2_5_VLForConditionalGeneration(cfg).eval().float()
+    from dynamo_tpu.testing import export_vl_state_dict
+
+    tensors = export_vl_state_dict(model)
+    os.makedirs(out_dir, exist_ok=True)
+    save_file(tensors, os.path.join(out_dir, "model.safetensors"))
+    d = cfg.to_dict()
+    d["model_type"] = "qwen2_5_vl"
+    with open(os.path.join(out_dir, "config.json"), "w") as f:
+        json.dump(d, f)
+    with open(os.path.join(out_dir, "tokenizer.json"), "w") as f:
+        f.write(tok.to_json_str())
+    print(f"[checkpoint] {out_dir} (qwen2.5-vl windowed tower)")
 
 
 
@@ -219,6 +260,39 @@ def main():
             f"meshed mrope diverged from flat: {red_m!r} vs {red!r}")
         assert vid_m == vid, "meshed mrope video diverged from flat"
         print("[ok] dp=2 kv-partition worker serves mrope greedy-equal")
+
+        # qwen2.5-vl: windowed tower + RMS + gated MLP through the same
+        # CLI (auto-detected model_type)
+        ckpt25 = os.path.join(tmp, "tiny-qwen25-vl")
+        make_checkpoint_25(ckpt25)
+        w25, w25log = spawn([sys.executable, "-m", "dynamo_tpu.worker",
+                             "--control", control, "--model", ckpt25,
+                             "--dtype", "float32", "--platform", "cpu",
+                             "--max-prefill-tokens", "128"], "worker-25")
+        wait_ready(w25, w25log, needle="READY worker")
+        deadline = time.time() + 60
+        m25 = None
+        while time.time() < deadline:
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{http_port}/v1/models", timeout=5
+                ) as r:
+                    ids = [x["id"] for x in json.loads(r.read())["data"]]
+                m25 = next((i for i in ids if "qwen25" in i), None)
+                if m25:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.5)
+        assert m25, "qwen2.5 model never appeared"
+        a25, p25 = chat(http_port, m25, img_parts((200, 30, 30)),
+                        with_usage=True)
+        b25 = chat(http_port, m25, img_parts((200, 30, 30)))
+        _, p25w = chat(http_port, m25,
+                       img_parts((200, 30, 30), (64, 24)), with_usage=True)
+        assert a25 == b25, "qwen2.5 image chat must be deterministic"
+        assert p25 != p25w, "qwen2.5 dynamic resolution must change grids"
+        print("[ok] qwen2.5-vl windowed tower serves image chat via CLI")
         print("VERIFY PASS")
     finally:
         ps.stop()

@@ -154,6 +154,179 @@ def test_tower_matches_hf_image_and_video():
         assert diff < 2e-4, f"{name}: {diff}"
 
 
+def _hf_model_25(vocab=128):
+    from transformers.models.qwen2_5_vl.configuration_qwen2_5_vl import (
+        Qwen2_5_VLConfig,
+    )
+    from transformers.models.qwen2_5_vl.modeling_qwen2_5_vl import (
+        Qwen2_5_VLForConditionalGeneration,
+    )
+
+    torch.manual_seed(1)
+    hf_cfg = Qwen2_5_VLConfig(
+        vocab_size=vocab, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        rope_theta=10000.0, rms_norm_eps=1e-6, tie_word_embeddings=False,
+        image_token_id=IMG_ID, video_token_id=6,
+        vision_start_token_id=VS_ID, vision_end_token_id=VE_ID,
+        rope_scaling={"type": "mrope", "mrope_section": [2, 3, 3]},
+        vision_config=dict(
+            depth=2, hidden_size=32, out_hidden_size=64, num_heads=2,
+            intermediate_size=48, in_channels=3, patch_size=4,
+            temporal_patch_size=2, spatial_merge_size=2,
+            window_size=16, fullatt_block_indexes=[1],
+        ),
+    )
+    return (Qwen2_5_VLForConditionalGeneration(hf_cfg).eval().float(),
+            hf_cfg)
+
+
+def _map_tower_25(sd, L=2, prefix="model.visual."):
+    def vs(key):
+        return np.stack([_t2n(sd[prefix + f"blocks.{i}.{key}"])
+                         for i in range(L)])
+
+    def vst(key):
+        return np.stack([_t2n(sd[prefix + f"blocks.{i}.{key}"]).T
+                         for i in range(L)])
+
+    return jax.tree.map(jnp.asarray, {
+        "patch_proj": _t2n(sd[prefix + "patch_embed.proj.weight"])
+        .reshape(32, -1).T,
+        "layers": {
+            "ln1_scale": vs("norm1.weight"),
+            "wqkv": vst("attn.qkv.weight"), "bqkv": vs("attn.qkv.bias"),
+            "wo": vst("attn.proj.weight"), "bo": vs("attn.proj.bias"),
+            "ln2_scale": vs("norm2.weight"),
+            "w_gate": vst("mlp.gate_proj.weight"),
+            "b_gate": vs("mlp.gate_proj.bias"),
+            "w_up": vst("mlp.up_proj.weight"),
+            "b_up": vs("mlp.up_proj.bias"),
+            "w_down": vst("mlp.down_proj.weight"),
+            "b_down": vs("mlp.down_proj.bias"),
+        },
+        "merge_ln_scale": _t2n(sd[prefix + "merger.ln_q.weight"]),
+        "merge_w1": _t2n(sd[prefix + "merger.mlp.0.weight"]).T,
+        "merge_b1": _t2n(sd[prefix + "merger.mlp.0.bias"]),
+        "merge_w2": _t2n(sd[prefix + "merger.mlp.2.weight"]).T,
+        "merge_b2": _t2n(sd[prefix + "merger.mlp.2.bias"]),
+    })
+
+
+_VCFG25 = Qwen2VLVisionConfig(
+    embed_dim=32, depth=2, num_heads=2, patch_size=4,
+    temporal_patch_size=2, spatial_merge_size=2, out_hidden_size=64,
+    intermediate_size=48, window_size=16, fullatt_block_indexes=(1,),
+    rms_norm=True,
+)
+
+
+def test_tower_25_matches_hf_windowed():
+    """qwen2.5-vl tower (RMSNorm, gated SiLU MLP, WINDOWED attention
+    with full-attention exceptions): our mask-equivalent of HF's
+    window_index permutation matches Qwen2_5 numerics on grids whose
+    window tiling truncates at the borders."""
+    model, _ = _hf_model_25()
+    vparams = _map_tower_25(model.state_dict())
+    rng = np.random.default_rng(7)
+    # 40x24 px -> 10x6 patch grid -> 5x3 merged -> ragged 2x2 windows
+    for T, hw, name in [(1, (40, 24), "image-ragged"),
+                        (1, (16, 16), "image-exact"),
+                        (4, (24, 16), "video")]:
+        frames = rng.random((T, *hw, 3), np.float32)
+        patches, grid = frames_to_patches(frames, _VCFG25)
+        hf_out = model.visual(torch.from_numpy(patches),
+                              grid_thw=torch.tensor([list(grid)]))
+        ours = np.asarray(
+            encode_patches(vparams, _VCFG25, jnp.asarray(patches), grid)
+        )
+        diff = np.abs(ours - _t2n(hf_out)).max()
+        assert diff < 2e-4, f"{name}: {diff}"
+
+
+def test_mrope_positions_25_video_match_hf():
+    """qwen2.5 video temporal rope: frames advance tokens_per_second *
+    second_per_grid positions (assumed 1.0s/grid), not 1 — parity with
+    HF Qwen2_5 get_rope_index including the post-video delta."""
+    model, _ = _hf_model_25()
+    grid = (4, 4, 4)
+    n = merged_tokens(grid, _VCFG25)
+    VID_ID = 6
+    prompt = [10, VS_ID] + [VID_ID] * n + [VE_ID, 12, 13]
+    hf_pos, hf_delta = model.model.get_rope_index(
+        torch.tensor([prompt]), video_grid_thw=torch.tensor([list(grid)]),
+        second_per_grid_ts=torch.tensor([1.0]),
+    )
+    vcfg = Qwen2VLVisionConfig(
+        **{**_VCFG25.__dict__, "tokens_per_second": 4.0})
+    pos, delta = mrope_positions(prompt, VID_ID, [grid], vcfg)
+    assert np.array_equal(pos.astype(np.int64),
+                          _t2n(hf_pos[:, 0]).astype(np.int64))
+    assert delta == int(hf_delta[0])
+    pos2, delta2 = mrope_positions_from_runs(len(prompt), [(2, grid)], vcfg)
+    assert np.array_equal(pos, pos2) and delta == delta2
+
+
+def test_full_splice_25_matches_hf():
+    """qwen2.5-vl end to end: windowed tower embeds spliced into the
+    mrope LLM — prefill logits and a rope-offset decode step match HF."""
+    model, hf_cfg = _hf_model_25()
+    sd = model.state_dict()
+    vparams = _map_tower_25(sd)
+    params = _map_llm(sd)
+    cfg = tiny_config(vocab_size=128, mrope_section=(2, 3, 3),
+                      model_type="qwen2_5_vl", name="tiny-qwen25-vl",
+                      num_hidden_layers=2, hidden_size=64,
+                      intermediate_size=128, num_attention_heads=4,
+                      num_key_value_heads=2, rms_norm_eps=1e-6)
+    rng = np.random.default_rng(9)
+    frames = rng.random((1, 40, 24, 3), np.float32)
+    patches, grid = frames_to_patches(frames, _VCFG25)
+    n = merged_tokens(grid, _VCFG25)
+    prompt = [10, 11, VS_ID] + [IMG_ID] * n + [VE_ID, 12, 13]
+    S = len(prompt)
+    with torch.no_grad():
+        hf_out = model(
+            input_ids=torch.tensor([prompt]),
+            pixel_values=torch.from_numpy(patches),
+            image_grid_thw=torch.tensor([list(grid)]),
+        )
+    hf_logits = _t2n(hf_out.logits)[0]
+
+    embeds = np.asarray(
+        encode_patches(vparams, _VCFG25, jnp.asarray(patches), grid))
+    pos, delta = mrope_positions(prompt, IMG_ID, [grid], _VCFG25)
+    extra = np.zeros((1, S, cfg.hidden_size), np.float32)
+    mask = np.zeros((S,), bool)
+    extra[0, 3:3 + n] = embeds
+    mask[3:3 + n] = True
+    n_pages = S // 8 + 3
+    kv = KVCache.create(cfg, 1 + n_pages, 8, jnp.float32)
+    table = jnp.arange(1, n_pages + 1, dtype=jnp.int32)[None]
+    logits, kv = forward_prefill(
+        params, cfg, kv, jnp.asarray([prompt], jnp.int32), table,
+        jnp.zeros((1,), jnp.int32), jnp.asarray([S], jnp.int32),
+        extra_embeds=jnp.asarray(extra), extra_mask=jnp.asarray(mask[None]),
+        mm_positions=jnp.asarray(pos[None]),
+    )
+    d = np.abs(np.asarray(logits)[0] - hf_logits[-1]).max()
+    assert d < 3e-3, f"prefill diff {d}"
+    nxt = int(hf_logits[-1].argmax())
+    with torch.no_grad():
+        hf2 = model(
+            input_ids=torch.tensor([prompt + [nxt]]),
+            pixel_values=torch.from_numpy(patches),
+            image_grid_thw=torch.tensor([list(grid)]),
+        )
+    logits2, kv = forward_decode(
+        params, cfg, kv, jnp.asarray([nxt], jnp.int32),
+        jnp.asarray([S], jnp.int32), table,
+        rope_offset=jnp.asarray([delta], jnp.int32),
+    )
+    d2 = np.abs(np.asarray(logits2)[0] - _t2n(hf2.logits)[0, -1]).max()
+    assert d2 < 3e-3, f"decode diff {d2}"
+
+
 def test_mrope_positions_match_hf():
     model, _ = _hf_model()
     grid = (1, 4, 6)
@@ -547,6 +720,53 @@ def test_preprocessor_rejects_video_for_clip_models():
                  "video_url": {"url": _gif_data_uri([(1, 2, 3)])}},
             ]}],
         })
+
+
+def test_qwen_25_vl_checkpoint_round_trip(tmp_path):
+    """A qwen2.5-vl-layout checkpoint (window config, RMS tower, gated
+    MLP) loads through load_qwen_vl with the 2.5 key mapping and
+    reproduces the hand-mapped params bit-exactly."""
+    safetensors_np = pytest.importorskip("safetensors.numpy")
+    import json
+    import os
+
+    from dynamo_tpu.models.vlm import load_qwen_vl
+
+    model, hf_cfg = _hf_model_25()
+    sd = model.state_dict()
+    from dynamo_tpu.testing import export_vl_state_dict
+
+    tensors = export_vl_state_dict(model)
+    safetensors_np.save_file(
+        tensors, os.path.join(tmp_path, "model.safetensors"))
+    cfg_d = hf_cfg.to_dict()
+    cfg_d["model_type"] = "qwen2_5_vl"
+    with open(os.path.join(tmp_path, "config.json"), "w") as f:
+        json.dump(cfg_d, f)
+
+    llm_params, llm_cfg, vparams, vcfg = load_qwen_vl(
+        str(tmp_path), dtype=jnp.float32)
+    assert llm_cfg.mrope_section == (2, 3, 3)
+    assert vcfg.rms_norm and vcfg.window_size == 16
+    assert vcfg.fullatt_block_indexes == (1,)
+    want_llm = _map_llm(sd)
+    want_tower = _map_tower_25(sd)
+    for got, want in [(llm_params, want_llm), (vparams, want_tower)]:
+        flat_w = dict(jax.tree_util.tree_leaves_with_path(want))
+        for path, leaf in jax.tree_util.tree_leaves_with_path(got):
+            np.testing.assert_array_equal(
+                np.asarray(leaf), np.asarray(flat_w[path]),
+                err_msg=str(path),
+            )
+    # the loaded tower runs and matches the HF forward
+    rng = np.random.default_rng(3)
+    frames = rng.random((1, 24, 16, 3), np.float32)
+    patches, grid = frames_to_patches(frames, vcfg)
+    hf_out = model.visual(torch.from_numpy(patches),
+                          grid_thw=torch.tensor([list(grid)]))
+    ours = np.asarray(
+        encode_patches(vparams, vcfg, jnp.asarray(patches), grid))
+    assert np.abs(ours - _t2n(hf_out)).max() < 2e-4
 
 
 def test_qwen_vl_checkpoint_round_trip(tmp_path):
