@@ -41,6 +41,11 @@ def pytest_configure(config):
         "markers",
         "async_timeout(seconds): per-test cap for async tests (default 600)",
     )
+    config.addinivalue_line(
+        "markers",
+        "timeout(seconds): documented cap for subprocess-heavy tests "
+        "(inert without pytest-timeout; the harness async cap governs)",
+    )
     import shutil
     import subprocess
 
