@@ -323,7 +323,53 @@ def _make_decode_scan(cfg: ModelConfig, n_steps: int, max_valid_pos: int,
                       greedy: bool = False):
     """The traced decode-block body shared by the pure decode step and the
     mixed (prefill+decode) step: scans `n_steps` forward+sample steps,
-    returning per-step packed outputs plus the carries."""
+    returning per-step packed outputs plus the carries.
+
+    On the xla/deferred path the whole block runs through
+    `decode_block_scan` (models/llama.py): the pool gathers ONCE per
+    block, in-block tokens ride ring buffers, and one batched scatter
+    lands the block's KV — per-step paged gathers were ~1.2ms/step of
+    scattered-DMA at 1B/batch-8 (r5 ablations).  The Pallas long-context
+    path keeps the per-step layout (the kernel reads pages directly)."""
+    from ..models.llama import decode_block_scan
+    from ..ops.paged_attention import _adapt
+
+    def sample_tail(logits, cts, samp, seeds, ctr):
+        """ONE sampling tail for both the per-step and block paths:
+        penalties → sample → counts update → logprobs → pack."""
+        if penalized:
+            logits = apply_penalties(
+                logits, cts, samp.frequency_penalty, samp.presence_penalty)
+        out = sample_tokens_maybe_greedy(logits, samp, seeds, ctr, greedy)
+        if penalized:
+            cts = cts.at[jnp.arange(out.shape[0]), out].add(1.0)
+        logp = compute_logprobs(logits, out)
+        packed = _pack_out(out, logp, logits if with_top else None)
+        return out, cts, packed
+
+    # the block path is bounded by the materialized context's HBM cost:
+    # kg+vg live across the whole step scan (~2*L*B*S*nkv*hd bytes) —
+    # past ~2GB (forced-xla meshed engines at very long contexts) the
+    # per-step path's layer-at-a-time gathers are the safer footprint
+    _BLOCK_KV_BYTE_BUDGET = 2 << 30
+
+    def block_scan(params, kv, tokens, positions, counters, counts,
+                   page_table, samp, seeds, rope_off=None):
+        def sample_step(eng, logits, tok_prev, t):
+            ctr, cts = eng
+            out, cts, packed = sample_tail(logits, cts, samp, seeds, ctr)
+            return (ctr + 1, cts), out, packed
+
+        cts0 = counts if penalized else jnp.zeros((), jnp.float32)
+        (ctr, cts), packed, tok, pos, kv = decode_block_scan(
+            params, cfg, kv, tokens, positions, page_table, n_steps,
+            max_valid_pos, sample_step, (counters, cts0),
+            rope_offset=rope_off,
+        )
+        if penalized:
+            return packed, tok, pos, ctr, cts, kv
+        return packed, tok, pos, ctr, kv
+
     def body_common(kv, tok, pos, ctr, counts, page_table, samp, seeds,
                     params, rope_off=None):
         ok = pos < max_valid_pos
@@ -334,20 +380,21 @@ def _make_decode_scan(cfg: ModelConfig, n_steps: int, max_valid_pos: int,
             params, cfg, kv, tok, safe_pos, table, attn_impl=attn_impl,
             rope_offset=rope_off,
         )
-        if penalized:
-            logits = apply_penalties(
-                logits, counts, samp.frequency_penalty, samp.presence_penalty
-            )
-        out = sample_tokens_maybe_greedy(logits, samp, seeds, ctr, greedy)
-        if penalized:
-            counts = counts.at[jnp.arange(out.shape[0]), out].add(1.0)
-        logp = compute_logprobs(logits, out)
-        packed = _pack_out(out, logp, logits if with_top else None)
+        out, counts, packed = sample_tail(logits, counts, samp, seeds, ctr)
         return kv, out, counts, packed
 
     if penalized:
         def scan(params, kv, tokens, positions, counters, counts,
                  page_table, samp, seeds, rope_off=None):
+            blk_bytes = (2 * kv.k.shape[0] * page_table.shape[0]
+                         * page_table.shape[1] * kv.k.shape[2]
+                         * kv.k.shape[3] * kv.k.shape[4] * kv.k.dtype.itemsize)
+            if (_adapt(attn_impl, page_table, kv.k.shape[2]) != "pallas"
+                    and blk_bytes <= _BLOCK_KV_BYTE_BUDGET):
+                return block_scan(params, kv, tokens, positions, counters,
+                                  counts, page_table, samp, seeds,
+                                  rope_off)
+
             def body(carry, _):
                 kv, tok, pos, ctr, cts = carry
                 kv, out, cts, packed = body_common(
@@ -365,6 +412,14 @@ def _make_decode_scan(cfg: ModelConfig, n_steps: int, max_valid_pos: int,
         def scan(params, kv, tokens, positions, counters, counts,
                  page_table, samp, seeds, rope_off=None):
             del counts
+            blk_bytes = (2 * kv.k.shape[0] * page_table.shape[0]
+                         * page_table.shape[1] * kv.k.shape[2]
+                         * kv.k.shape[3] * kv.k.shape[4] * kv.k.dtype.itemsize)
+            if (_adapt(attn_impl, page_table, kv.k.shape[2]) != "pallas"
+                    and blk_bytes <= _BLOCK_KV_BYTE_BUDGET):
+                return block_scan(params, kv, tokens, positions, counters,
+                                  None, page_table, samp, seeds, rope_off)
+
             def body(carry, _):
                 kv, tok, pos, ctr = carry
                 kv, out, _, packed = body_common(
