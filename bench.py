@@ -566,8 +566,11 @@ async def main_async():
         "fixed by deferred writes (attend to old pool + self column, "
         "one batched scatter per step); matmul weight streams run at "
         "~720-760 GB/s of the 819 peak; a STATIC greedy sampling "
-        "variant replaces the runtime all-greedy cond (~0.1ms/step). "
-        "step_breakdown_* fields carry the on-device phase shares."
+        "variant replaces the runtime all-greedy cond (~0.1ms/step); "
+        "block-materialized KV decode (gather once per 64-step block, "
+        "ring buffers, one batched scatter) removed the per-step paged "
+        "gather (~1.2ms/step of scattered DMA). step_breakdown_* "
+        "fields carry the on-device phase shares."
     )
 
     # sustained (192-token generations, tuned dispatch): bf16 and int8
