@@ -527,10 +527,12 @@ async def main_async():
             max_model_len=PROMPT_LEN + gen + 16,
             decode_batch_buckets=[BATCH, 2 * BATCH],
             chunk_buckets=[PROMPT_LEN],
-            # measured sweeps on the tunneled chip: r2 64x2=1129;
-            # r3 int8 sweep: 96x4=1724 > 96x6=1709 > 64x4=1593 (gen 192);
-            # r4: fuse_projections +1-3%, dispatch measured ~0 (the 1B
-            # ceiling is device-side small-kernel efficiency, not host)
+            # measured sweeps on the tunneled chip: r3 (pre-block-KV)
+            # preferred int8 96x4 (1724 > 64x4's 1593); r5's
+            # block-materialized KV flipped it — ring-buffer attention
+            # reads scale with the block length, so 64x4 now wins
+            # (interleaved: 2130 vs 96x4's 1861) and both engines run
+            # the SAME 64x4 dispatch shape
             decode_steps=steps,
             decode_chain=chain,
             mixed_prefill_tokens=mixed,
@@ -578,7 +580,7 @@ async def main_async():
     # per-phase samples + spread ride the JSON (a headline that can
     # silently lose 12% to environment is not a measurement)
     e_bf = JaxEngine(cfg, params, ecfg("none", 64, 4), eos_token_ids=[])
-    e_q = JaxEngine(cfg, params, ecfg("int8", 96, 4), eos_token_ids=[])
+    e_q = JaxEngine(cfg, params, ecfg("int8", 64, 4), eos_token_ids=[])
     (bf16_sus, bf_rates, bf_med), (int8_sus, q_rates, _) = (
         await interleaved_ab([e_bf, e_q], rounds=3)
     )
