@@ -230,3 +230,54 @@ def test_deferred_write_attention_equals_write_first():
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=1e-5, atol=1e-5,
                                    err_msg=f"window={window} sink={snk is not None}")
+
+
+def test_block_scan_equals_per_step_decode(setup):
+    """decode_block_scan (block-materialized KV: one gather, ring
+    buffers, one scatter) must match T iterations of the per-step
+    forward_decode path exactly — greedy tokens AND the resulting pool
+    contents.  This is the drift tripwire between the two decode
+    forward paths (models/llama.py); the per-step deferred-vs-write-
+    first equivalence is pinned separately above."""
+    from dynamo_tpu.models.llama import decode_block_scan, forward_decode
+
+    cfg, params = setup
+    T, B = 6, 3
+    pages_per = 4
+    kv_a = KVCache.create(cfg, 1 + B * pages_per, 8, jnp.float32)
+    table = make_table(B, pages_per)
+    prompts = jnp.asarray(
+        np.random.RandomState(5).randint(1, cfg.vocab_size, (B, 9)),
+        jnp.int32)
+    lens = jnp.asarray([9, 6, 4], jnp.int32)
+    logits, kv_a = forward_prefill(
+        params, cfg, kv_a, prompts, table,
+        jnp.zeros((B,), jnp.int32), lens)
+    tok0 = jnp.argmax(logits, -1).astype(jnp.int32)
+    kv_b = KVCache(kv_a.k, kv_a.v)
+
+    # per-step write-first reference
+    toks_ref, kv_r, tok = [], kv_a, tok0
+    pos = lens
+    for _ in range(T):
+        lg, kv_r = forward_decode(params, cfg, kv_r, tok, pos, table,
+                                  attn_impl="xla")
+        tok = jnp.argmax(lg, -1).astype(jnp.int32)
+        toks_ref.append(np.asarray(tok))
+        pos = pos + 1
+
+    def sample_step(eng, logits, tok_prev, t):
+        out = jnp.argmax(logits, -1).astype(jnp.int32)
+        return eng, out, out
+
+    _, ys, tok_b, pos_b, kv_blk = decode_block_scan(
+        params, cfg, kv_b, tok0, lens, table, T,
+        max_valid_pos=10_000, sample_step=sample_step, carry_init=(),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ys), np.stack(toks_ref))
+    np.testing.assert_array_equal(np.asarray(tok_b), toks_ref[-1])
+    np.testing.assert_allclose(
+        np.asarray(kv_blk.k), np.asarray(kv_r.k), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(kv_blk.v), np.asarray(kv_r.v), rtol=1e-5, atol=1e-6)
