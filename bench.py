@@ -373,6 +373,89 @@ async def disagg_phase(cfg, params, n=8, prompt_len=512, gen=8):
     return out
 
 
+async def spec_decode_phase(cfg, params, prompt_len=128, gen=96, k=4,
+                            rounds=2):
+    """Batch-1 self-speculative decoding on a REPETITIVE workload (the
+    prompt is a repeated 16-token cycle — the case prompt-lookup
+    drafting exists for): ITL with speculation on vs off, plus the
+    engine's own tokens-per-dispatch and acceptance telemetry.  Batch-1
+    ITL is steps-per-token on a bandwidth-bound chip (8 GB of weights
+    per step at 8B-int8 no matter how few tokens come out), which is
+    exactly what the accepted drafts compress."""
+    from dynamo_tpu.engine import EngineConfig, JaxEngine
+
+    period = 16
+    prompt = [((i % period) * 31 + 7) % 997 + 1 for i in range(prompt_len)]
+    pages_per = (prompt_len + gen) // 16 + 2
+
+    def mk(spec_k):
+        return JaxEngine(cfg, params, EngineConfig(
+            page_size=16, num_pages=1 + 2 * pages_per + 16, max_num_seqs=2,
+            max_prefill_tokens=prompt_len, prefill_batch_size=1,
+            max_model_len=prompt_len + gen + 16,
+            decode_batch_buckets=[1, 2], chunk_buckets=[prompt_len],
+            # the spec engine pays one dispatch per <=k+1 tokens (drafts
+            # come from the fetched history), so it runs unblocked;
+            # the plain engine keeps a block shape of the same order so
+            # the comparison is dispatch-for-dispatch honest
+            decode_steps=1 if spec_k else k + 1, decode_chain=1,
+            enable_prefix_caching=False, quantization="int8",
+            speculative_ngram_k=spec_k,
+        ), eos_token_ids=[])
+
+    async def one(engine):
+        req = {
+            "token_ids": prompt,
+            "sampling_options": {"temperature": 0.0},
+            "stop_conditions": {"max_tokens": gen, "ignore_eos": True},
+        }
+        n = 0
+        t_first = t_last = None
+        async for out in engine.generate(req):
+            if out["token_ids"]:
+                t_last = time.perf_counter()
+                if t_first is None:
+                    t_first = t_last
+                n += len(out["token_ids"])
+        return ((t_last - t_first) / max(n - 1, 1)) * 1e3 if t_first else 0.0
+
+    plain, spec = mk(0), mk(k)
+    out = {}
+    try:
+        for e in (plain, spec):  # compile off the clock
+            await one(e)
+        # the engine counters are lifetime: snapshot after warmup so the
+        # reported acceptance/dispatch numbers cover exactly the
+        # ITL-measured rounds
+        m0 = spec.metrics()
+        disp0 = spec._spec_dispatch_total  # noqa: SLF001
+        itl_plain, itl_spec = [], []
+        for _ in range(rounds):  # interleave so a tunnel phase moves both
+            itl_plain.append(await one(plain))
+            itl_spec.append(await one(spec))
+        m = spec.metrics()
+        dispatches = spec._spec_dispatch_total - disp0  # noqa: SLF001
+        accepted = m.spec_accepted_tokens_total - m0.spec_accepted_tokens_total
+        drafted = m.spec_draft_tokens_total - m0.spec_draft_tokens_total
+        out = {
+            "k": k,
+            "prompt_period": period,
+            "batch": 1,
+            "itl_plain_p50_ms": round(_p50(itl_plain), 2),
+            "itl_spec_p50_ms": round(_p50(itl_spec), 2),
+            "itl_ratio": round(
+                _p50(itl_plain) / max(_p50(itl_spec), 1e-9), 3),
+            "tokens_per_dispatch": round(
+                (accepted + dispatches) / max(dispatches, 1), 3),
+            "acceptance_rate": round(accepted / max(drafted, 1), 4),
+            "spec_dispatches": dispatches,
+        }
+    finally:
+        await plain.shutdown()
+        await spec.shutdown()
+    return out
+
+
 def phase_breakdown(cfg, params, T=32, B=8, table_w=32):
     """Per-phase decode-step shares measured ON DEVICE (VERDICT r5 item
     4): full forward vs no-lm-head vs matmuls-only scans at the serving
@@ -626,9 +709,12 @@ async def main_async():
     # leaks a ~30s tunnel compile into the measured TTFTs
     mixed_warm_ok = await warm_mixed(engine)
     # rate LADDER up to the knee: one light-load point where attained ≈
-    # offered measures SLO compliance, not capacity (VERDICT r3 item 3)
+    # offered measures SLO compliance, not capacity (VERDICT r3 item 3).
+    # Intermediate rungs (6, 12) make repeat_agreement load-bearing —
+    # r5's passes disagreed by a full 2x rung ([4.0, 8.0]) and the
+    # coarse ladder let the gate pass anyway (VERDICT r5 weak #4)
     k1 = await goodput_knee(
-        engine, rates=[2.0, 4.0, 8.0, 16.0], n_req=50,
+        engine, rates=[2.0, 4.0, 6.0, 8.0, 12.0, 16.0], n_req=50,
         prompt_len=PROMPT_LEN, gen=96, slo=SLO_1B,
     )
     # the rate-4 point keeps round-3 field compatibility
@@ -641,6 +727,13 @@ async def main_async():
     del engine  # fused 1B copy — free before the 8B weights arrive
     import gc
 
+    gc.collect()
+
+    # batch-1 self-speculative decode ITL on a repetitive workload (the
+    # VERDICT r5 item-5 lever: steps-per-token, not FLOPs, gates batch-1
+    # ITL on a bandwidth-bound chip); reports tokens-per-dispatch and
+    # acceptance from the engine's own SpecDecodeStats counters
+    out["spec_decode_1b_int8"] = await spec_decode_phase(cfg, params)
     gc.collect()
 
     # disaggregated prefill→decode KV-transfer latency (the missing half
@@ -694,8 +787,10 @@ async def main_async():
         mixed_prefill_tokens=2 * PROMPT_LEN, enable_prefix_caching=False,
     ), eos_token_ids=[])
     mixed_warm_ok8 = await warm_mixed(engine8g)
+    # half-rungs (1.5, 3) for the same repeat-agreement reason as the 1B
+    # ladder — r5's 8B passes disagreed 2.0 vs 1.0 (VERDICT r5 weak #4)
     k8 = await goodput_knee(
-        engine8g, rates=[1.0, 2.0, 4.0], n_req=50,
+        engine8g, rates=[1.0, 1.5, 2.0, 3.0, 4.0], n_req=50,
         prompt_len=PROMPT_LEN, gen=64, slo=SLO_8B,
     )
     await engine8g.shutdown()
@@ -852,16 +947,70 @@ def previous_round_value():
     return best
 
 
+def _compact_summary(full):
+    """The flagship numbers as a handful of scalars: headline, sustained
+    A/B, goodput knees, disagg p50, spec-decode phase.  Small enough
+    that no artifact tail can truncate it away (VERDICT r5 weak #2)."""
+    m1 = full.get("models", {}).get("llama-3.2-1b", {})
+    m8 = full.get("models", {}).get("llama-3.1-8b-int8", {})
+    spec = full.get("spec_decode_1b_int8", {})
+    phase = full.get("phase_samples_tok_s", {})
+    return {
+        "headline_bf16_tok_s": full.get("value"),
+        "ttft_p50_ms": full.get("ttft_p50_ms"),
+        "itl_p50_ms": full.get("itl_p50_ms"),
+        "bf16_sustained_tok_s": m1.get("bf16_sustained_tok_s"),
+        "int8_sustained_tok_s": m1.get("int8_sustained_tok_s"),
+        "int8_vs_bf16_sustained": phase.get("int8_vs_bf16_sustained"),
+        "goodput_1b_max_tok_s": m1.get("max_goodput_at_slo_tok_s"),
+        "goodput_1b_knee_rps": m1.get("knee_rate_rps"),
+        "goodput_1b_knees_per_pass": m1.get("knees_per_pass"),
+        "goodput_8b_max_tok_s": m8.get("max_goodput_at_slo_tok_s"),
+        "goodput_8b_knee_rps": m8.get("knee_rate_rps"),
+        "goodput_8b_knees_per_pass": m8.get("knees_per_pass"),
+        "tok_s_8b": m8.get("tok_s"),
+        "weight_read_gbps": full.get("weight_read_gbps"),
+        "disagg_kv_transfer_p50_ms": full.get("disagg_kv_transfer_p50_ms"),
+        "disagg_ttft_delta_ms": full.get("disagg", {}).get("ttft_delta_ms"),
+        "isl2000_c4_tok_s": full.get("isl2000_osl256", {}).get("tok_s"),
+        "prefix_cache_ttft_ms": full.get("prefix_cache_ttft_ms"),
+        "spec_itl_plain_p50_ms": spec.get("itl_plain_p50_ms"),
+        "spec_itl_spec_p50_ms": spec.get("itl_spec_p50_ms"),
+        "spec_itl_ratio": spec.get("itl_ratio"),
+        "spec_tokens_per_dispatch": spec.get("tokens_per_dispatch"),
+        "spec_acceptance_rate": spec.get("acceptance_rate"),
+    }
+
+
 def main():
     out = asyncio.run(main_async())
     prev = previous_round_value()
     vs = round(out["value"] / prev, 3) if prev else 1.0
-    print(json.dumps({
+    record = {
         "metric": "llama1b_serve_decode_throughput",
         "value": out["value"],
         "unit": "tok/s",
         "vs_baseline": vs,
         **{k: v for k, v in out.items() if k != "value"},
+    }
+    # the FULL record goes to a committed file: the driver's stdout tail
+    # repeatedly truncated the head of this (large) JSON line and the
+    # round's flagship numbers survived only in prose (VERDICT r5
+    # weak #2)
+    with open("BENCH_full.json", "w") as f:
+        json.dump(record, f, indent=1)
+        f.write("\n")
+    print(json.dumps(record))
+    # …and the compact summary prints LAST so any tail keeps it.  It is
+    # itself a valid {metric, value, unit, vs_baseline} record, so a
+    # parser that takes the final JSON line still gets the headline.
+    print(json.dumps({
+        "metric": "llama1b_serve_decode_throughput",
+        "value": out["value"],
+        "unit": "tok/s",
+        "vs_baseline": vs,
+        "full_results": "BENCH_full.json",
+        "summary": _compact_summary(record),
     }))
 
 

@@ -51,6 +51,30 @@ class EngineConfig:
     # max_prefill_tokens, 0 disables mixing (prefill-first scheduling)
     mixed_prefill_tokens: Optional[int] = None
 
+    # self-speculative decoding: draft k tokens per decode dispatch from
+    # the sequence's own prompt+output history (n-gram / prompt lookup —
+    # no draft model, no extra weights) and verify them in ONE fused
+    # forward over k+1 positions (models.llama.forward_verify).  0
+    # disables.  Greedy output is token-identical to plain decode, and
+    # seeded temperature>0 sampling too: the verify samples each
+    # position from the same (seed, counter) PRNG stream plain decode
+    # would use.  On acceptance a dispatch emits up to k+1 tokens for
+    # one weight read — the lever for batch-1 ITL on a bandwidth-bound
+    # chip.  The engine falls back to the plain block path per DISPATCH
+    # (the whole co-scheduled batch, not per row): any penalized /
+    # top-logprobs row, a partitioned pool, a pp/sp mesh, or a row
+    # within k+1 tokens of the context cap sends that dispatch down
+    # the plain path.
+    speculative_ngram_k: int = 0
+    # drafter match window: the longest trailing m-gram (max_match down
+    # to min_match) with an earlier occurrence in the last
+    # `speculative_history` tokens supplies the draft; no match falls
+    # back to repeating the last token (wrong drafts only cost
+    # acceptance, never correctness)
+    speculative_min_match: int = 1
+    speculative_max_match: int = 4
+    speculative_history: int = 256
+
     enable_prefix_caching: bool = True
     block_hash_salt: str = ""
 
@@ -101,6 +125,23 @@ class EngineConfig:
                 f"attention_impl must be auto|adaptive|pallas|xla, "
                 f"got {self.attention_impl!r}"
             )
+        if self.speculative_ngram_k < 0:
+            raise ValueError("speculative_ngram_k must be >= 0")
+        if self.speculative_ngram_k and not (
+            1 <= self.speculative_min_match <= self.speculative_max_match
+        ):
+            raise ValueError(
+                "speculative matching requires 1 <= speculative_min_match "
+                f"<= speculative_max_match, got "
+                f"[{self.speculative_min_match}, {self.speculative_max_match}]"
+            )
+        if self.speculative_ngram_k and self.speculative_history < 1:
+            # tokens[-0:] would silently mean UNBOUNDED history, turning
+            # the per-dispatch host lookup into a full-context scan
+            raise ValueError(
+                "speculative_history must be >= 1, got "
+                f"{self.speculative_history}"
+            )
         if self.decode_batch_buckets is None:
             self.decode_batch_buckets = _pow2_buckets(self.max_num_seqs)
         if self.chunk_buckets is None:
@@ -118,6 +159,16 @@ class EngineConfig:
     @property
     def usable_pages(self) -> int:
         return self.num_pages - 1  # page 0 is the trash page
+
+    @property
+    def decode_advance(self) -> int:
+        """Worst-case positions ONE decode dispatch may write KV for —
+        what the scheduler must reserve pages against: the T-step block,
+        or the (1+k)-position draft-verify chunk when speculation is on
+        (the engine picks the path per dispatch, so reservation covers
+        both)."""
+        spec = (1 + self.speculative_ngram_k) if self.speculative_ngram_k else 0
+        return max(self.decode_steps, spec)
 
     @property
     def hard_cap(self) -> int:

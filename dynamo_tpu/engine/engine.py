@@ -54,13 +54,20 @@ logger = logging.getLogger(__name__)
 @dataclass
 class ForwardPassMetrics:
     """Load snapshot published to the router (reference
-    kv_router/protocols.rs ForwardPassMetrics)."""
+    kv_router/protocols.rs ForwardPassMetrics).
+
+    The spec_* fields are the SpecDecodeStats analog (reference
+    _core.pyi:428-435): lifetime draft/accept counters plus a rolling
+    acceptance rate over the engine's recent verify dispatches."""
 
     active_seqs: int = 0
     waiting_seqs: int = 0
     kv_usage: float = 0.0
     kv_total_pages: int = 0
     num_requests_total: int = 0
+    spec_draft_tokens_total: int = 0
+    spec_accepted_tokens_total: int = 0
+    spec_acceptance_rate: float = 0.0
 
 
 # static top-k width for OpenAI `top_logprobs` responses (API max is 20)
@@ -99,6 +106,42 @@ def _unpack_out(packed: np.ndarray, b: int, with_top: bool = False):
         ids.reshape(*packed.shape[:-1], b, TOPLP),
         lps.reshape(*packed.shape[:-1], b, TOPLP),
     )
+
+
+def _ngram_draft(tokens: List[int], k: int, min_match: int,
+                 max_match: int = 4, history: int = 256) -> List[int]:
+    """Prompt-lookup / n-gram draft (host side, no draft model): propose
+    the k tokens that followed the MOST RECENT earlier occurrence of the
+    sequence's trailing m-gram, preferring the longest m in
+    [min_match, max_match].  No match falls back to repeating the last
+    token — a wrong draft only costs acceptance, never correctness (the
+    verify step emits the model's own sample at the first mismatch)."""
+    hist = np.asarray(tokens[-history:], np.int64)
+    n = len(hist)
+    for m in range(min(max_match, n - 1), min_match - 1, -1):
+        # all length-m windows whose continuation exists (start <= n-m-1),
+        # compared against the trailing m-gram in one vectorized pass —
+        # this runs per row ahead of every spec dispatch, so no Python
+        # inner loop
+        windows = np.lib.stride_tricks.sliding_window_view(hist, m)[:n - m]
+        hits = np.nonzero((windows == hist[n - m:]).all(axis=1))[0]
+        if hits.size:
+            s = int(hits[-1])  # most recent earlier occurrence
+            # s + m <= n - 1, so at least one continuation token exists
+            cont = hist[s + m:s + m + k].tolist()
+            return (cont + [cont[-1]] * k)[:k]
+    last = int(tokens[-1]) if tokens else 0
+    return [last] * k
+
+
+def _unpack_spec(packed: np.ndarray, b: int, s: int):
+    """Inverse of the spec verify step's packing: (tokens [B, S] int32,
+    logprobs [B, S] float32, accepted draft count [B] int32)."""
+    n = b * s
+    toks = np.ascontiguousarray(packed[:n]).view(np.int32).reshape(b, s)
+    logp = packed[n:2 * n].reshape(b, s)
+    n_acc = np.ascontiguousarray(packed[2 * n:2 * n + b]).view(np.int32)
+    return toks, logp, n_acc
 
 
 def _lockstep_out_shardings(mesh, *extra):
@@ -495,6 +538,61 @@ def _build_decode_step(cfg: ModelConfig, n_steps: int, max_valid_pos: int,
                      samp, seeds):
                 return run(params, kv, tokens, positions, counters, None,
                            page_table, samp, seeds)
+
+    return step
+
+
+def _build_spec_verify_step(cfg: ModelConfig, *, greedy: bool = False,
+                            attn_impl: str = "xla", lockstep_mesh=None):
+    """Fused draft-verify decode step (self-speculative decoding): one
+    forward scores k+1 positions — the last accepted token plus k
+    host-drafted tokens — through the PREFILL layer path
+    (`forward_verify`), then an on-device verify tail samples every
+    position from its own (seed, counter) PRNG stream and counts the
+    accepted draft prefix.  One weight read buys up to k+1 tokens.
+
+    KV pages for all k+1 positions are written; rejected positions are
+    logically rolled back by position masking (never attended,
+    overwritten as decode advances) — the same trash-page/table
+    discipline every other step relies on.  Packed result:
+    [tok(B*(k+1)) | logp(B*(k+1)) | n_accepted(B)] in one fetch."""
+    from ..models import forward_verify
+    from ..ops.sampling import sample_tokens_block, speculative_accept
+
+    kw = ({"out_shardings": _lockstep_out_shardings(lockstep_mesh)}
+          if lockstep_mesh is not None else {})
+    mrope = bool(cfg.mrope_section)  # +rope_off operand (qwen2_vl)
+
+    def body(params, kv, tokens, positions, page_table, samp, seeds,
+             counters, rope_off=None):
+        B, S = tokens.shape  # S == k + 1
+        logits, kv = forward_verify(
+            params, cfg, kv, tokens, page_table, positions,
+            jnp.full((B,), S, jnp.int32), attn_impl=attn_impl,
+            rope_offset=rope_off,
+        )  # [B, S, V]
+        out, logp = sample_tokens_block(logits, samp, seeds, counters,
+                                        greedy)
+        n_acc = speculative_accept(out, tokens)
+        packed = jnp.concatenate([
+            jax.lax.bitcast_convert_type(out.reshape(-1), jnp.float32),
+            logp.reshape(-1),
+            jax.lax.bitcast_convert_type(n_acc, jnp.float32),
+        ])
+        return packed, kv
+
+    if mrope:
+        @partial(jax.jit, donate_argnums=(1,), **kw)
+        def step(params, kv, tokens, positions, page_table, samp, seeds,
+                 counters, rope_off):
+            return body(params, kv, tokens, positions, page_table, samp,
+                        seeds, counters, rope_off)
+    else:
+        @partial(jax.jit, donate_argnums=(1,), **kw)
+        def step(params, kv, tokens, positions, page_table, samp, seeds,
+                 counters):
+            return body(params, kv, tokens, positions, page_table, samp,
+                        seeds, counters)
 
     return step
 
@@ -1199,6 +1297,15 @@ class JaxEngine:
         self._pending_adds: List = []  # ("add"|"imported", Sequence)
         self._requests_total = 0
         self._step_count = 0
+        # speculative decoding telemetry (SpecDecodeStats analog):
+        # lifetime counters + a rolling per-dispatch window for the
+        # acceptance rate surfaced in ForwardPassMetrics
+        from collections import deque as _deque
+
+        self._spec_draft_total = 0
+        self._spec_accepted_total = 0
+        self._spec_dispatch_total = 0
+        self._spec_window = _deque(maxlen=128)  # (drafted, accepted)
 
     def attach_connector(self, connector) -> None:
         """Attach a KVBM connector (kvbm.KvConnector shape: on_event /
@@ -1413,6 +1520,18 @@ class JaxEngine:
                 )
         return self._decode_steps[key]
 
+    def _get_spec_step(self, greedy: bool = False):
+        """The draft-verify decode variant, cached beside the plain
+        variants under a `spec` key (one compile per greedy flag; jit
+        shape-caches the batch/table buckets)."""
+        key = ("spec", greedy)
+        if key not in self._decode_steps:
+            self._decode_steps[key] = _build_spec_verify_step(
+                self.model_cfg, greedy=greedy, attn_impl=self._attn_impl,
+                lockstep_mesh=self.mesh if self._multihost else None,
+            )
+        return self._decode_steps[key]
+
     def _get_mixed_step(self, penalized: bool, with_top: bool,
                         greedy: bool = False):
         key = (penalized, with_top, greedy)
@@ -1462,6 +1581,9 @@ class JaxEngine:
             # partitioned pools aggregate capacity across their ranks
             kv_total_pages=self.cfg.usable_pages * self.pool.ranks,
             num_requests_total=self._requests_total,
+            spec_draft_tokens_total=self._spec_draft_total,
+            spec_accepted_tokens_total=self._spec_accepted_total,
+            spec_acceptance_rate=self._spec_acceptance_rate(),
         )
         if self.pool.ranks > 1:
             m.kv_usage_aggregate = self.pool.usage()
@@ -1990,6 +2112,8 @@ class JaxEngine:
         hard_cap = self.cfg.hard_cap
         if (
             not self.cfg.fuse_prefill_decode
+            or self.cfg.speculative_ngram_k > 0  # spec drafts need the
+            # fetched prefill token; the verify path starts next dispatch
             or self._multihost  # followers replay from host arrays only
             or not items
             or not all(it.samples for it in items)
@@ -2536,7 +2660,121 @@ class JaxEngine:
             for s in seqs
         )
 
+    # -- speculative decoding (n-gram draft + fused verify) ------------------ #
+
+    def _spec_acceptance_rate(self) -> float:
+        """Rolling acceptance over the recent verify dispatches."""
+        drafted = sum(d for d, _ in self._spec_window)
+        if not drafted:
+            return 0.0
+        return sum(a for _, a in self._spec_window) / drafted
+
+    def _spec_ok(self, seqs: List[Sequence]) -> bool:
+        """May this decode batch take the draft-verify path?  Falls back
+        to the plain block per dispatch: partitioned/pp/sp pools keep
+        their own step layouts, penalties need sequential count updates
+        the fused verify cannot thread, top-logprobs rows want the full
+        packed layout, and rows within k+1 tokens of the context cap
+        would write drafts past their page-table horizon."""
+        k = self.cfg.speculative_ngram_k
+        if k <= 0 or self._pooled or self._pp > 1 or self._sp > 1:
+            return False
+        if any(s.opts.penalized or s.opts.top_logprobs > 0 for s in seqs):
+            return False
+        return all(
+            s.num_computed + k + 1 <= self.cfg.hard_cap for s in seqs
+        )
+
+    def _run_spec_decode(self, seqs: List[Sequence]) -> None:
+        """One draft-verify dispatch: host n-gram drafts feed the fused
+        (k+1)-position verify forward; the accepted prefix plus the
+        model's own sample at the first divergence come back in one
+        fetch and are consumed through the ordinary per-token stop
+        path (variable acceptance == variable tokens per dispatch)."""
+        k = self.cfg.speculative_ngram_k
+        rows = self._decode_rows(seqs)
+        B = len(rows)
+        tokens = np.zeros((B, k + 1), np.int32)
+        positions = np.zeros((B,), np.int32)
+        for i, s in enumerate(rows):
+            if s is None:
+                continue
+            tokens[i, 0] = s.output_tokens[-1] if s.output_tokens else (
+                s.prompt[-1] if s.prompt else 0
+            )
+            tokens[i, 1:] = _ngram_draft(
+                s.all_tokens(), k, self.cfg.speculative_min_match,
+                self.cfg.speculative_max_match, self.cfg.speculative_history,
+            )
+            positions[i] = s.num_computed
+        seeds, counters = self._seed_arrays(rows)
+        table = self._table_array(rows)
+        samp = self._samp_arrays(rows)
+        rope_off = self._rope_array(rows)
+        greedy = self._is_greedy(samp)
+        if self._multihost:
+            self._lockstep_send({
+                "kind": "spec", "greedy": greedy,
+                "arrays": [tokens, positions, counters, table,
+                           *[np.asarray(a) for a in samp], seeds],
+                "rope_off": rope_off,
+            })
+        packed_d = self._dispatch_spec(
+            tokens, positions, counters, table, samp, seeds, greedy,
+            rope_off=rope_off,
+        )
+        out, logp, n_acc = _unpack_spec(
+            np.asarray(jax.device_get(packed_d)), B, k + 1
+        )
+        self._spec_dispatch_total += 1
+        drafted = accepted = 0
+        for i, s in enumerate(rows):
+            if s is None or s.status != "running":
+                continue
+            a = int(n_acc[i])
+            drafted += k
+            accepted += a
+            s.spec_draft_tokens += k
+            s.spec_accepted_tokens += a
+            for t in range(a + 1):
+                s.num_computed += 1
+                self.scheduler.commit_full_pages(s)
+                self._append_token(s, int(out[i, t]), float(logp[i, t]))
+                if s.status != "running":
+                    break  # stop hit inside the accepted run; rest discarded
+        self._spec_draft_total += drafted
+        self._spec_accepted_total += accepted
+        self._spec_window.append((drafted, accepted))
+
+    def _dispatch_spec(self, tokens, positions, counters, table, samp,
+                       seeds, greedy, rope_off=None):
+        """Issue the jitted draft-verify step (identical on leader and
+        followers); returns the packed device output."""
+        step = self._get_spec_step(greedy)
+        rope = ()
+        if self.model_cfg.mrope_section:
+            if rope_off is None:
+                rope_off = np.zeros_like(positions)
+            rope = (self._put(rope_off, self._bax),)
+        packed_d, self.kv = step(
+            self.params, self.kv,
+            self._put(tokens, self._bax, None),
+            self._put(positions, self._bax),
+            self._put(table, self._bax, None),
+            self._put_samp(samp),
+            self._put(seeds, self._bax),
+            self._put(counters, self._bax),
+            *rope,
+        )
+        try:  # start the host copy early
+            packed_d.copy_to_host_async()
+        except Exception:  # noqa: BLE001 — sharded arrays may not support it
+            pass
+        return packed_d
+
     def _run_decode(self, seqs: List[Sequence]) -> None:
+        if self._spec_ok(seqs):
+            return self._run_spec_decode(seqs)
         T = self.cfg.decode_steps
         hard_cap = self.cfg.hard_cap
         # decide the chain length upfront and pre-reserve pages for the
@@ -2720,6 +2958,13 @@ class JaxEngine:
                         d_seeds, desc["penalized"], desc["with_top"],
                         rope_off=desc.get("rope_off"),
                         greedy=desc.get("greedy", False),
+                    )
+                elif kind == "spec":
+                    a = desc["arrays"]
+                    self._dispatch_spec(
+                        a[0], a[1], a[2], a[3],
+                        SamplingParams(*a[4:4 + samp_n]), a[4 + samp_n],
+                        desc["greedy"], rope_off=desc.get("rope_off"),
                     )
                 elif kind == "kv_export":
                     self._export_replay(desc["padded"], desc["rank"])
@@ -3342,6 +3587,16 @@ class JaxEngine:
             out["log_probs"] = [logprob]
         if tops is not None:
             out["top_logprobs"] = [tops]  # aligned with token_ids
+        if seq.spec_draft_tokens:
+            # per-request speculative stats (CUMULATIVE) ride every
+            # delta so the frontend can aggregate per-model acceptance
+            # on /metrics from the last delta it saw — a stop STRING is
+            # detected frontend-side mid-stream, so the engine's final
+            # delta may never be consumed
+            out["spec"] = {
+                "draft_tokens": seq.spec_draft_tokens,
+                "accepted_tokens": seq.spec_accepted_tokens,
+            }
         # may be called from the executor thread — hop back to the loop
         self._post_threadsafe(queue, out)
 

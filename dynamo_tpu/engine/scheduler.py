@@ -88,6 +88,11 @@ class Sequence:
         self.status = "waiting"
         self.finish_reason: Optional[str] = None
         self.preemptions = 0
+        # speculative decoding telemetry: drafts proposed for / accepted
+        # by this sequence (ride the final delivery so the frontend can
+        # aggregate per-model acceptance)
+        self.spec_draft_tokens = 0
+        self.spec_accepted_tokens = 0
 
     @property
     def total_len(self) -> int:
@@ -332,15 +337,21 @@ class Scheduler:
         return items
 
     def _plan_decode(self) -> List[Sequence]:
-        """Every prefill-done running sequence advances decode_steps
-        tokens (page reservation clamped to the model window so the
-        table never outgrows its largest bucket)."""
+        """Every prefill-done running sequence advances up to
+        `decode_advance` tokens — decode_steps on the block path, or the
+        1+k draft-verify chunk when speculation is on; reservation
+        covers the worst case of whichever path the engine dispatches
+        (page reservation clamped to the model window so the table
+        never outgrows its largest bucket).  Variable multi-token
+        acceptance is handled at consume time: `check_stop` runs per
+        appended token, so a stop inside an accepted run discards the
+        tail exactly like a stop inside a decode block."""
         hard_cap = self.cfg.hard_cap
         decodable: List[Sequence] = []
         for seq in list(self.running):
             if seq.status != "running" or not seq.prefill_done:
                 continue
-            target = min(seq.num_computed + self.cfg.decode_steps, hard_cap)
+            target = min(seq.num_computed + self.cfg.decode_advance, hard_cap)
             if not self._ensure_pages(seq, target):
                 continue
             decodable.append(seq)

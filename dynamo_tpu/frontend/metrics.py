@@ -58,6 +58,48 @@ class FrontendMetrics:
             ["model"],
             registry=self.registry,
         )
+        # speculative decoding (cumulative per-request stats ride the
+        # engine stream's deltas; the last one seen carries the totals,
+        # even when a frontend-side stop string ends the stream early):
+        # draft/accept counters plus a rolling per-model acceptance
+        # rate over recent requests
+        self.spec_draft_tokens = Counter(
+            "dynamo_frontend_spec_draft_tokens",
+            "Speculative draft tokens proposed",
+            ["model"],
+            registry=self.registry,
+        )
+        self.spec_accepted_tokens = Counter(
+            "dynamo_frontend_spec_accepted_tokens",
+            "Speculative draft tokens accepted",
+            ["model"],
+            registry=self.registry,
+        )
+        self.spec_acceptance_rate = Gauge(
+            "dynamo_frontend_spec_acceptance_rate",
+            "Rolling speculative acceptance rate (recent requests)",
+            ["model"],
+            registry=self.registry,
+        )
+        self._spec_windows: dict = {}  # model -> deque[(draft, accepted)]
+
+    def observe_spec(self, model: str, spec: dict) -> None:
+        """Account one request's speculative stats ({draft_tokens,
+        accepted_tokens}) and refresh the rolling acceptance gauge."""
+        from collections import deque
+
+        draft = int(spec.get("draft_tokens", 0) or 0)
+        accepted = int(spec.get("accepted_tokens", 0) or 0)
+        if draft <= 0:
+            return
+        self.spec_draft_tokens.labels(model).inc(draft)
+        self.spec_accepted_tokens.labels(model).inc(accepted)
+        win = self._spec_windows.setdefault(model, deque(maxlen=256))
+        win.append((draft, accepted))
+        total = sum(d for d, _ in win)
+        self.spec_acceptance_rate.labels(model).set(
+            sum(a for _, a in win) / total if total else 0.0
+        )
 
     def exposition(self) -> bytes:
         return generate_latest(self.registry)

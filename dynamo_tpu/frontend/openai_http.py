@@ -506,6 +506,7 @@ class HttpService:
         ntokens = 0
         last_t = t0
         status = "200"
+        spec_seen: list = [None] * n  # last cumulative spec stats per choice
         contexts = [Context() for _ in range(n)]
         parsers = (
             [_ChoiceParsers(entry.mdc) for _ in range(n)]
@@ -562,6 +563,8 @@ class HttpService:
                     self.metrics.itl.labels(model_name).observe(now - last_t)
                 last_t = now
                 ntokens += len(out.get("token_ids", []))
+                if out.get("spec"):  # cumulative: the last delta seen
+                    spec_seen[i] = out["spec"]  # carries the totals
                 finish = out.get("finish_reason")
                 if parsers is not None:
                     if finish:
@@ -595,6 +598,9 @@ class HttpService:
         self.metrics.requests.labels(model_name, kind, status).inc()
         self.metrics.output_tokens.labels(model_name).inc(ntokens)
         self.metrics.duration.labels(model_name).observe(time.monotonic() - t0)
+        for spec in spec_seen:
+            if spec:  # a stop string may cut the stream before the
+                self.metrics.observe_spec(model_name, spec)  # final delta
         if self.audit is not None:
             self.audit.response(
                 rid, model_name, kind, status,
@@ -610,6 +616,7 @@ class HttpService:
         logprobs: list = []
         tops: list = []
         finish_reason = None
+        spec = None
         async for out in entry.generate(preq, context):
             if out.get("finish_reason") == "error":
                 return {"error": out.get("error", "engine error")}
@@ -617,6 +624,7 @@ class HttpService:
             token_ids.extend(out.get("token_ids", []))
             logprobs.extend(out.get("log_probs", []))
             tops.extend(out.get("top_logprobs", []))
+            spec = out.get("spec") or spec
             finish_reason = out.get("finish_reason") or finish_reason
         return {
             "text": "".join(text_parts),
@@ -625,6 +633,7 @@ class HttpService:
             "log_probs": logprobs,
             "top_logprobs": tops,
             "finish_reason": finish_reason or "stop",
+            "spec": spec,
         }
 
     async def _unary_response(
@@ -660,6 +669,9 @@ class HttpService:
                 return _error_response(500, r["error"])
         created = int(time.time())
         prompt_tokens = len(preprocessed.get("token_ids", []))
+        for r in results:
+            if r.get("spec"):
+                self.metrics.observe_spec(model_name, r["spec"])
         token_count = sum(r["token_count"] for r in results)
         usage = {
             "prompt_tokens": prompt_tokens,

@@ -78,7 +78,8 @@ async def postprocess_stream(
         text = post.push_tokens(out.get("token_ids", []))
         reason = out.get("finish_reason")
         passthrough = {
-            k: out[k] for k in ("log_probs", "top_logprobs") if k in out
+            k: out[k]
+            for k in ("log_probs", "top_logprobs", "spec") if k in out
         }
         if post.finished_by_stop is not None:
             yield {"text": text, "finish_reason": "stop",

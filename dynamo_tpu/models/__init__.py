@@ -5,6 +5,7 @@ from .llama import (
     KVCache,
     forward_decode,
     forward_prefill,
+    forward_verify,
     init_params,
     kv_cache_pspec,
     param_pspecs,
@@ -16,6 +17,7 @@ __all__ = [
     "ModelConfig",
     "forward_decode",
     "forward_prefill",
+    "forward_verify",
     "init_params",
     "kv_cache_pspec",
     "param_pspecs",

@@ -810,6 +810,47 @@ def forward_decode(
     return _lm_logits(params, cfg, x), kv
 
 
+def forward_verify(
+    params: Params,
+    cfg: ModelConfig,
+    kv: KVCache,
+    tokens: jax.Array,  # [B, S] — last accepted token + S-1 draft tokens
+    page_table: jax.Array,  # [B, max_pages]
+    prefix_lens: jax.Array,  # [B] — tokens whose KV is already written
+    chunk_lens: jax.Array,  # [B]
+    attn_impl: str = "xla",
+    rope_offset: Optional[jax.Array] = None,  # [B] mrope delta (rope
+    # position = slot + delta; KV slots stay raw token indices)
+) -> Tuple[jax.Array, KVCache]:
+    """Score EVERY position of a short draft chunk in one forward: the
+    fused verify step of self-speculative decoding.  Identical to
+    `forward_prefill` except the logits come back for all S positions
+    ([B, S, V]), so the caller can verify S-1 drafted tokens against the
+    model's own per-position samples in a single weight read.
+
+    KV for the whole chunk is written through the normal prefill path;
+    positions whose draft is later REJECTED are rolled back logically,
+    not physically — `prefix_lens`/`positions` masking means no later
+    dispatch ever attends a slot at or beyond its row's committed
+    length, and the slots are overwritten as decode advances.  Rides
+    `prefill_layers`, so every model feature (sinks, windows, MoE,
+    biases, mrope-as-shifted-rope) stays in ONE implementation — no
+    drift tripwire needed against the prefill path."""
+    B, S = tokens.shape
+    positions = prefix_lens[:, None] + jnp.arange(S)[None, :]
+    if rope_offset is not None:
+        # positions feed ONLY rope inside _layer_prefill (the KV write is
+        # addressed by prefix/chunk), so the mrope delta rides here —
+        # exactly `_layer_decode`'s rope_pos = slot + delta
+        positions = positions + rope_offset[:, None]
+    x = params["embed"][tokens]  # [B, S, h]
+    x, kv = prefill_layers(
+        params["layers"], cfg, kv, x, positions, page_table, prefix_lens,
+        chunk_lens, attn_impl,
+    )
+    return _lm_logits(params, cfg, x), kv
+
+
 def decode_block_scan(
     params: Params,
     cfg: ModelConfig,

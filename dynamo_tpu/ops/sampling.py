@@ -135,6 +135,52 @@ def _sample_nongreedy(logits, greedy, params, seeds, counters, K):
     return jnp.where(params.temperature <= 0.0, greedy, sampled)
 
 
+def sample_tokens_block(
+    logits: jax.Array,  # [B, S, V] — one distribution per chunk position
+    params: SamplingParams,  # [B] each
+    seeds: jax.Array,  # [B]
+    counters: jax.Array,  # [B] — stream position of the FIRST chunk slot
+    greedy: bool = False,
+):
+    """Sample one token per POSITION of a logits block: position j of row
+    b draws from the row's PRNG stream at counter ``counters[b] + j`` —
+    exactly the tokens S sequential decode steps would sample, computed
+    in one fused pass (the verify tail of self-speculative decoding;
+    this counter alignment is what makes speculative decode
+    token-identical to plain decode even for seeded sampling).
+    Returns (tokens [B, S] int32, logprobs [B, S] float32)."""
+    B, S, V = logits.shape
+    flat = logits.reshape(B * S, V)
+    if greedy:
+        out = jnp.argmax(flat.astype(jnp.float32), axis=-1)
+    else:
+        flat_params = jax.tree.map(lambda a: jnp.repeat(a, S, axis=0), params)
+        out = sample_tokens(
+            flat, flat_params, jnp.repeat(seeds, S, axis=0),
+            (counters[:, None] + jnp.arange(S)[None, :]).reshape(-1),
+        )
+    logp = compute_logprobs(flat, out)
+    return out.reshape(B, S), logp.reshape(B, S)
+
+
+def speculative_accept(
+    sampled: jax.Array,  # [B, S] — per-position verify samples
+    fed: jax.Array,  # [B, S] — [last accepted token | S-1 draft tokens]
+) -> jax.Array:
+    """Length of the accepted draft prefix per row ([B] int32): draft j
+    (``fed[:, j+1]``) is accepted iff every earlier draft matched AND the
+    model's own sample at its position (``sampled[:, j]``) equals it.
+
+    For a DETERMINISTIC drafter (n-gram lookup proposes a point mass)
+    this token-matching rule IS Leviathan-style rejection sampling:
+    accept probability = p(draft) either way, and on rejection the
+    emitted token ``sampled[:, j]`` is already distributed as the target
+    conditional with the draft token's mass excluded — so temperature>0
+    verification preserves the sampling distribution exactly."""
+    match = (sampled[:, :-1] == fed[:, 1:]).astype(jnp.int32)
+    return jnp.cumprod(match, axis=1).sum(axis=1)
+
+
 def compute_logprobs(logits: jax.Array, tokens: jax.Array) -> jax.Array:
     """Log-probability of `tokens` [B] under `logits` [B, V]."""
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)

@@ -101,3 +101,63 @@ class _Partial:
 
     def labels(self, **kw):
         return self._metric.labels(**{**self._bound, **kw})
+
+
+# ForwardPassMetrics fields that are monotonic counters (so rate() is
+# well-typed on the exposed series); everything else exports as a gauge
+ENGINE_COUNTER_STATS = (
+    "num_requests_total",
+    "kv_transfer_count",
+    "kv_transfer_device_count",
+    "kv_transfer_ms_total",
+    "kv_transfer_bytes_total",
+    "kvbm_onboarded_blocks_total",
+    "spec_draft_tokens_total",
+    "spec_accepted_tokens_total",
+)
+# prometheus appends _total to counter families: name these so the
+# exposed series match the dashboard queries exactly
+ENGINE_STAT_RENAMES = {
+    "kv_transfer_count": "kv_transfers_total",
+    "kv_transfer_device_count": "kv_transfers_device_total",
+}
+
+
+class EngineStatsCollector:
+    """Prometheus custom collector over a live engine-stats dict
+    (``vars(engine.metrics())`` — ForwardPassMetrics incl. dynamic
+    attrs): builds ``dynamo_tpu_worker_*`` metric families on every
+    scrape, counters for the monotonic fields so rate() is well-typed,
+    gauges for the rest.  Shared by the worker CLI status server and
+    any test/embedded scrape surface (reference dynamo_component_*
+    worker metrics)."""
+
+    def __init__(self, stats_fn, namespace: str = "", component: str = ""):
+        self._stats_fn = stats_fn
+        self._labels = {
+            "dynamo_namespace": namespace,
+            "dynamo_component": component,
+        }
+
+    def collect(self):
+        from prometheus_client.core import (
+            CounterMetricFamily,
+            GaugeMetricFamily,
+        )
+
+        try:
+            stats = self._stats_fn() or {}
+        except Exception:  # noqa: BLE001 — a scrape must not take down /metrics
+            stats = {}
+        for key, value in stats.items():
+            if not isinstance(value, (int, float)) or isinstance(value, bool):
+                continue
+            name = f"dynamo_tpu_worker_{ENGINE_STAT_RENAMES.get(key, key)}"
+            fam_cls = (CounterMetricFamily if key in ENGINE_COUNTER_STATS
+                       else GaugeMetricFamily)
+            if fam_cls is CounterMetricFamily and name.endswith("_total"):
+                name = name[: -len("_total")]  # client re-appends
+            fam = fam_cls(name, f"engine {key} (live)",
+                          labels=list(self._labels))
+            fam.add_metric(list(self._labels.values()), value)
+            yield fam

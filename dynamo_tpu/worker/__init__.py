@@ -71,12 +71,23 @@ class DpRankEngine:
     def metrics(self) -> ForwardPassMetrics:
         """Aggregate snapshot (per-rank states publish separately)."""
         per = [e.metrics() for e in self.engines]
+        drafted = sum(m.spec_draft_tokens_total for m in per)
         return ForwardPassMetrics(
             active_seqs=sum(m.active_seqs for m in per),
             waiting_seqs=sum(m.waiting_seqs for m in per),
             kv_usage=sum(m.kv_usage for m in per) / len(per),
             kv_total_pages=sum(m.kv_total_pages for m in per),
             num_requests_total=sum(m.num_requests_total for m in per),
+            spec_draft_tokens_total=drafted,
+            spec_accepted_tokens_total=sum(
+                m.spec_accepted_tokens_total for m in per
+            ),
+            # lifetime ratio across ranks (the per-rank rolling windows
+            # don't aggregate meaningfully)
+            spec_acceptance_rate=(
+                sum(m.spec_accepted_tokens_total for m in per) / drafted
+                if drafted else 0.0
+            ),
         )
 
     def clear_kv_blocks(self) -> int:

@@ -52,6 +52,13 @@ def build_parser() -> argparse.ArgumentParser:
                          "Raise on remote-attached chips (bench.py sweep)")
     ap.add_argument("--decode-chain", type=int, default=1,
                     help="decode dispatches in flight before fetching")
+    ap.add_argument("--speculative-ngram-k", type=int, default=0,
+                    help="self-speculative decoding: draft K tokens per "
+                         "decode dispatch from the sequence's own history "
+                         "(n-gram prompt lookup, no draft model) and "
+                         "verify them in one fused forward; 0 disables. "
+                         "Output is token-identical to plain decode; "
+                         "acceptance telemetry lands on /metrics")
     ap.add_argument("--mixed-prefill-tokens", type=int, default=None,
                     help="prefill token budget inside a mixed "
                          "(prefill+decode) dispatch; default = "
@@ -155,6 +162,7 @@ def check_args(ap: argparse.ArgumentParser, args) -> None:
     if args.mock and (args.quantization != "none"
                       or args.attention_impl != "auto"
                       or args.decode_steps != 1 or args.decode_chain != 1
+                      or args.speculative_ngram_k
                       or args.no_prefix_caching or args.vision
                       or args.encode_component):
         ap.error("engine-tuning/vision flags require a real JAX engine "
@@ -189,6 +197,7 @@ def engine_config_from_args(args):
         attention_impl=args.attention_impl,
         decode_steps=args.decode_steps,
         decode_chain=args.decode_chain,
+        speculative_ngram_k=args.speculative_ngram_k,
         mixed_prefill_tokens=args.mixed_prefill_tokens,
         kv_partition=args.kv_partition,
         enable_prefix_caching=not args.no_prefix_caching,
@@ -337,42 +346,19 @@ async def _run(args) -> None:
             except Exception:  # noqa: BLE001
                 return {}
 
-        # Prometheus worker metrics (reference dynamo_component_*): a
-        # custom collector builds metric families from live engine
-        # ForwardPassMetrics on every scrape — counters for monotonic
-        # fields so rate() is well-typed, gauges for the rest
-        from prometheus_client.core import CounterMetricFamily, GaugeMetricFamily
+        # Prometheus worker metrics (reference dynamo_component_*): the
+        # shared EngineStatsCollector builds metric families from live
+        # engine ForwardPassMetrics on every scrape — counters for
+        # monotonic fields (incl. the spec_decode draft/accept pair) so
+        # rate() is well-typed, gauges for the rest
+        from ..runtime.metrics import EngineStatsCollector
 
         scope = MetricsScope(
             namespace=args.namespace, component=args.component,
         )
-        _COUNTERS = ("num_requests_total", "kv_transfer_count",
-                     "kv_transfer_device_count",
-                     "kv_transfer_ms_total", "kv_transfer_bytes_total",
-                     "kvbm_onboarded_blocks_total")
-        # prometheus appends _total to counter families: name them so the
-        # exposed series match the dashboard queries exactly
-        _RENAME = {"kv_transfer_count": "kv_transfers_total",
-                   "kv_transfer_device_count": "kv_transfers_device_total"}
-
-        class _EngineCollector:
-            def collect(self):
-                labels = {"dynamo_namespace": args.namespace,
-                          "dynamo_component": args.component}
-                for key, value in _stats().items():
-                    if not isinstance(value, (int, float)):
-                        continue
-                    name = f"dynamo_tpu_worker_{_RENAME.get(key, key)}"
-                    fam_cls = (CounterMetricFamily if key in _COUNTERS
-                               else GaugeMetricFamily)
-                    if fam_cls is CounterMetricFamily and name.endswith("_total"):
-                        name = name[: -len("_total")]  # client re-appends
-                    fam = fam_cls(name, f"engine {key} (live)",
-                                  labels=list(labels))
-                    fam.add_metric(list(labels.values()), value)
-                    yield fam
-
-        scope.registry.register(_EngineCollector())
+        scope.registry.register(EngineStatsCollector(
+            _stats, namespace=args.namespace, component=args.component,
+        ))
 
         status = await SystemStatusServer(
             metrics=scope,

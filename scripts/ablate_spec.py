@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Ablate self-speculative decoding: sweep draft length k × workload
+repetitiveness on the mock/CPU engine and report acceptance rate and
+tokens-per-dispatch per point.
+
+Runs under `JAX_PLATFORMS=cpu python scripts/ablate_spec.py` (CI-safe:
+tiny model, no chip).  Two model modes per point:
+
+  random   — random tiny weights: acceptance is whatever the drafter
+             earns against a real (if tiny) greedy stream;
+  constant — zeroed weights (constant greedy output): the structural
+             upper bound — after the output history warms up, every
+             draft is accepted, so tokens-per-dispatch → k+1.
+
+Workload repetitiveness = the period of the repeated prompt pattern
+("p2" = [a, b, a, b, ...], "p8" = an 8-token cycle, "random" = no
+structure) — the lever the n-gram drafter keys on.
+
+Emits ONE JSON line (the `scripts/ablate_decode.py` artifact shape):
+  {"metric": "spec_decode_ablation", "points": [{k, workload, model,
+   acceptance_rate, tokens_per_dispatch, dispatches, tokens}, ...]}
+"""
+
+import asyncio
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.engine import EngineConfig, JaxEngine
+from dynamo_tpu.models import init_params, tiny_config
+
+GEN = 48
+KS = (2, 4, 8)
+WORKLOADS = {
+    "p2": lambda n: [(7, 11)[i % 2] for i in range(n)],
+    "p8": lambda n: [13 + (i % 8) for i in range(n)],
+    "random": lambda n: [((i * 37 + 11) % 199) + 1 for i in range(n)],
+}
+
+
+def _req(tokens, gen=GEN):
+    return {
+        "token_ids": tokens,
+        "sampling_options": {"temperature": 0.0},
+        "stop_conditions": {"max_tokens": gen, "ignore_eos": True},
+    }
+
+
+async def _measure(cfg, params, k, prompt):
+    engine = JaxEngine(
+        cfg, params,
+        EngineConfig(
+            page_size=8, num_pages=128, max_num_seqs=2,
+            max_prefill_tokens=64, max_model_len=256,
+            speculative_ngram_k=k,
+        ),
+        eos_token_ids=[], kv_dtype=jnp.float32,
+    )
+    n = 0
+    async for out in engine.generate(_req(prompt)):
+        assert out.get("finish_reason") != "error", out
+        n += len(out["token_ids"])
+    m = engine.metrics()
+    dispatches = engine._spec_dispatch_total  # noqa: SLF001
+    await engine.shutdown()
+    tpd = ((m.spec_accepted_tokens_total + dispatches) / dispatches
+           if dispatches else 1.0)
+    rate = (m.spec_accepted_tokens_total / m.spec_draft_tokens_total
+            if m.spec_draft_tokens_total else 0.0)
+    return {
+        "acceptance_rate": round(rate, 4),
+        "tokens_per_dispatch": round(tpd, 3),
+        "dispatches": dispatches,
+        "tokens": n,
+    }
+
+
+async def main_async():
+    cfg = tiny_config()
+    models = {
+        "random": init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32),
+    }
+    models["constant"] = jax.tree.map(jnp.zeros_like, models["random"])
+    points = []
+    for model_name, params in models.items():
+        for wname, gen in WORKLOADS.items():
+            prompt = gen(32)
+            for k in KS:
+                res = await _measure(cfg, params, k, prompt)
+                points.append({
+                    "k": k, "workload": wname, "model": model_name, **res,
+                })
+                print(
+                    f"# {model_name:8s} {wname:7s} k={k}: "
+                    f"accept={res['acceptance_rate']:.3f} "
+                    f"tok/dispatch={res['tokens_per_dispatch']:.2f}",
+                    file=sys.stderr, flush=True,
+                )
+    return points
+
+
+def main():
+    points = asyncio.run(main_async())
+    print(json.dumps({
+        "metric": "spec_decode_ablation",
+        "gen_tokens": GEN,
+        "points": points,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -252,13 +252,42 @@ def main():
         print("OK multimodal chat:",
               repr(out["choices"][0]["message"]["content"]))
 
+        # speculative worker (n-gram draft + fused verify) serving the
+        # same tiny weights under its own name: greedy output must be
+        # token-identical to the plain workers' (spec is output-invisible)
+        # and the acceptance telemetry must land on BOTH /metrics surfaces
+        sw_status = free_port()
+        spawn([*worker_args, "--model-name", "tiny-spec",
+               "--speculative-ngram-k", "4",
+               "--status-port", str(sw_status)], "spec-worker")
+        deadline = time.time() + 30
+        while True:
+            models = http_json(f"{base}/v1/models")
+            if "tiny-spec" in [m["id"] for m in models["data"]]:
+                break
+            assert time.time() < deadline, models
+            time.sleep(0.5)
+        out = http_json(f"{base}/v1/chat/completions",
+                        {**chat, "model": "tiny-spec"})
+        assert out["choices"][0]["message"]["content"] == text1, out
+        m = http_json(f"http://127.0.0.1:{sw_status}/metrics.json")
+        assert m.get("spec_draft_tokens_total", 0) > 0, m
+        with urllib.request.urlopen(f"{base}/metrics", timeout=30) as r:
+            fprom = r.read().decode()
+        assert ("dynamo_frontend_spec_draft_tokens_total"
+                '{model="tiny-spec"}') in fprom, fprom[-1500:]
+        print(f"OK speculative worker: greedy-identical to plain, "
+              f"{m['spec_draft_tokens_total']} drafted / "
+              f"{m['spec_accepted_tokens_total']} accepted")
+
         # kill worker1 → requests keep working on worker2
         w1.send_signal(signal.SIGKILL)
         time.sleep(7)  # > lease TTL
         out = http_json(f"{base}/v1/chat/completions", chat)
         assert out["choices"][0]["message"]["content"] == text1
         models = http_json(f"{base}/v1/models")
-        assert set(m["id"] for m in models["data"]) == {"tiny-chat", "tiny-vlm"}
+        assert set(m["id"] for m in models["data"]) == {
+            "tiny-chat", "tiny-vlm", "tiny-spec"}
         print("OK survives worker kill")
 
         print("VERIFY PASS")

@@ -148,6 +148,102 @@ async def test_e2e_chat_and_completion(model_setup):
         await stop_stack(control, worker_rt, front_rt, engine, watcher, http)
 
 
+async def test_e2e_spec_decode_metrics(model_setup):
+    """Speculative decoding acceptance telemetry end to end: a
+    spec-enabled engine serves a greedy chat request through the full
+    HTTP stack, and the draft/accept counters + rolling acceptance rate
+    show up on BOTH /metrics surfaces — the frontend exposition
+    (cumulative per-request stats ride the stream's deltas) and the worker
+    status server (ForwardPassMetrics via EngineStatsCollector)."""
+    import jax.numpy as _jnp
+
+    from dynamo_tpu.runtime.metrics import EngineStatsCollector, MetricsScope
+    from dynamo_tpu.runtime.status import SystemStatusServer
+
+    tok, cfg, params = model_setup
+    # zeroed params → constant greedy output → deterministic acceptance
+    zero = jax.tree.map(_jnp.zeros_like, params)
+    control = await ControlPlaneServer().start()
+    worker_rt = await DistributedRuntime.connect(control.address)
+    engine = JaxEngine(
+        cfg, zero,
+        EngineConfig(page_size=8, num_pages=128, max_num_seqs=4,
+                     max_prefill_tokens=64, max_model_len=256,
+                     speculative_ngram_k=4),
+        eos_token_ids=list(tok.eos_token_ids), kv_dtype=jnp.float32,
+    )
+    mdc = ModelDeploymentCard(
+        name="tiny-spec", tokenizer_json=tok.to_json_str(),
+        eos_token_ids=list(tok.eos_token_ids),
+    )
+    await serve_engine(worker_rt, engine, mdc)
+    front_rt = await DistributedRuntime.connect(control.address)
+    manager = ModelManager()
+    watcher = await ModelWatcher(front_rt, manager).start()
+    await watcher.wait_for_model("tiny-spec")
+    http = await HttpService(manager, host="127.0.0.1", port=0).start()
+    scope = MetricsScope(namespace="test", component="backend")
+    scope.registry.register(EngineStatsCollector(
+        lambda: vars(engine.metrics()),
+        namespace="test", component="backend",
+    ))
+    status = await SystemStatusServer(
+        metrics=scope, host="127.0.0.1", port=0,
+    ).start()
+    base = f"http://127.0.0.1:{http.port}"
+    try:
+        async with aiohttp.ClientSession() as session:
+            req = {
+                "model": "tiny-spec",
+                "messages": [{"role": "user", "content": "repeat"}],
+                "max_tokens": 40,
+                "temperature": 0,
+                "nvext": {"ignore_eos": True},
+            }
+            async with session.post(
+                f"{base}/v1/chat/completions", json=req
+            ) as r:
+                assert r.status == 200, await r.text()
+                out = await r.json()
+            assert out["usage"]["completion_tokens"] == 40
+
+            # engine-side telemetry accumulated
+            m = engine.metrics()
+            assert m.spec_draft_tokens_total > 0
+            assert m.spec_accepted_tokens_total > 0
+            assert 0.0 < m.spec_acceptance_rate <= 1.0
+
+            # frontend /metrics: per-model spec family
+            async with session.get(f"{base}/metrics") as r:
+                body = await r.text()
+            assert "dynamo_frontend_spec_draft_tokens_total" in body
+            assert "dynamo_frontend_spec_accepted_tokens_total" in body
+            assert "dynamo_frontend_spec_acceptance_rate" in body
+            line = next(
+                ln for ln in body.splitlines()
+                if ln.startswith("dynamo_frontend_spec_accepted_tokens_total")
+                and 'model="tiny-spec"' in ln
+            )
+            assert float(line.rsplit(" ", 1)[1]) > 0
+
+            # worker status /metrics: ForwardPassMetrics counters
+            async with session.get(
+                f"http://127.0.0.1:{status.port}/metrics"
+            ) as r:
+                wbody = await r.text()
+            assert "dynamo_tpu_worker_spec_draft_tokens_total" in wbody
+            assert "dynamo_tpu_worker_spec_accepted_tokens_total" in wbody
+            assert "dynamo_tpu_worker_spec_acceptance_rate" in wbody
+    finally:
+        await status.stop()
+        await http.stop()
+        await watcher.stop()
+        await engine.shutdown()
+        await front_rt.shutdown(graceful=False)
+        await worker_rt.shutdown(graceful=False)
+        await control.stop()
+
+
 async def test_e2e_worker_removal(model_setup):
     """Killing the worker's lease must remove the model from the frontend."""
     control, worker_rt, front_rt, engine, watcher, http = await start_stack(model_setup)

@@ -292,6 +292,102 @@ async def test_top_logprobs_delivered(engine_setup):
     await engine.shutdown()
 
 
+def test_ngram_draft_semantics():
+    """The host drafter: longest trailing m-gram wins, the MOST RECENT
+    earlier occurrence supplies the continuation, short continuations
+    pad by repeating their last token, and no match falls back to
+    repeating the sequence's last token."""
+    from dynamo_tpu.engine.engine import _ngram_draft
+
+    # trailing [1, 2] occurred twice; most recent earlier occurrence is
+    # at index 4 → continuation [9, 1, 2]
+    assert _ngram_draft([1, 2, 7, 8, 1, 2, 9, 1, 2], 3, 1) == [9, 1, 2]
+    # longest match preferred: trailing [5, 1, 2] has an occurrence, so
+    # its continuation [6] beats the shorter [1, 2] match's
+    assert _ngram_draft([5, 1, 2, 6, 0, 5, 1, 2], 1, 1) == [6]
+    # continuation shorter than k pads with its last token
+    assert _ngram_draft([4, 4, 7, 4, 4], 4, 2) == [7, 4, 4, 4]
+    # no repetition at all: repeat the last token
+    assert _ngram_draft([10, 20, 30], 2, 2) == [30, 30]
+    # degenerate histories never raise
+    assert _ngram_draft([3], 2, 1) == [3, 3]
+    assert _ngram_draft([], 2, 1) == [0, 0]
+
+
+async def test_spec_decode_matches_plain(engine_setup):
+    """Self-speculative decoding (n-gram draft + fused verify) must be
+    output-invisible: token-identical streams with speculation on and
+    off, across prompt shapes incl. repetitive ones (where drafts
+    actually get accepted), (a) under greedy sampling, (b) under
+    SEEDED temperature>0 sampling — the verify tail samples each
+    position from the same (seed, counter) PRNG stream plain decode
+    would use, the strongest form of 'rejection verification preserves
+    the sampling distribution' — and (c) a stop token landing INSIDE
+    an accepted draft run must end the request there with later
+    accepted tokens discarded and pages freed."""
+    prompts = [[1, 2, 3, 4, 5], [9, 8, 7], [5, 6, 5, 6, 5, 6, 5, 6]]
+
+    def seeded():
+        out = req([1, 2, 3], max_tokens=10, temperature=0.9)
+        out["sampling_options"]["seed"] = 42
+        return out
+
+    plain = make_engine(engine_setup)
+    want = [await collect(plain, req(p, max_tokens=13)) for p in prompts]
+    want_seeded, _ = await collect(plain, seeded())
+    await plain.shutdown()
+
+    spec = make_engine(engine_setup, speculative_ngram_k=4)
+    got = await asyncio.gather(
+        *[collect(spec, req(p, max_tokens=13)) for p in prompts]
+    )
+    assert [g[0] for g in got] == [w[0] for w in want]
+    assert all(g[1] == "length" for g in got)
+    got_seeded, _ = await collect(spec, seeded())
+    assert got_seeded == want_seeded
+    m = spec.metrics()
+    assert m.spec_draft_tokens_total > 0  # the verify path actually ran
+
+    # stop token mid-acceptance: reuse the greedy continuation as probe
+    probe = want[0][0]
+    r = req(prompts[0], max_tokens=13)
+    r["stop_conditions"]["stop_token_ids"] = [probe[2]]
+    tokens, reason = await collect(spec, r)
+    assert tokens == probe[:3]
+    assert reason == "stop"
+    assert spec.pool.free_pages + spec.pool.evictable_pages == \
+        spec.pool.num_pages - 1
+    await spec.shutdown()
+
+
+async def test_spec_decode_tokens_per_dispatch(engine_setup):
+    """On a repetitive stream with k=4 the accepted drafts must compress
+    dispatches: > 1.5 tokens per verify dispatch, with the acceptance
+    telemetry visible in ForwardPassMetrics.  Uses a zeroed-parameter
+    model (constant greedy output) so acceptance is deterministic."""
+    cfg, params = engine_setup
+    zero = jax.tree.map(jnp.zeros_like, params)
+    engine = JaxEngine(
+        cfg, zero,
+        EngineConfig(page_size=8, num_pages=64, max_num_seqs=4,
+                     max_prefill_tokens=32, max_model_len=256,
+                     speculative_ngram_k=4),
+        eos_token_ids=[], kv_dtype=jnp.float32,
+    )
+    toks, reason = await collect(engine, req([7, 9, 11, 13], max_tokens=40))
+    m = engine.metrics()
+    dispatches = engine._spec_dispatch_total  # noqa: SLF001
+    await engine.shutdown()
+    assert len(toks) == 40 and reason == "length"
+    assert dispatches > 0
+    # tokens per verify dispatch = accepted drafts + the per-dispatch
+    # bonus/corrected token
+    tpd = (m.spec_accepted_tokens_total + dispatches) / dispatches
+    assert tpd > 1.5, (tpd, dispatches, m.spec_accepted_tokens_total)
+    assert m.spec_draft_tokens_total == 4 * dispatches
+    assert 0.0 < m.spec_acceptance_rate <= 1.0
+
+
 async def test_fused_prefill_decode_matches_unfused():
     """The fused prefill→decode dispatch (first decode chain fed by the
     prefill's device-side sampled token) must be output-invisible:

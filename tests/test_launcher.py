@@ -166,9 +166,10 @@ async def test_standalone_router_service():
 @pytest.mark.timeout(300)
 def test_worker_cli_engine_tuning_flags():
     """The engine-tuning CLI surface (--quantization int8,
-    --attention-impl, --decode-steps/-chain, --no-prefix-caching) must
-    build a serving worker that answers requests — the int8 path is
-    otherwise unreachable from the CLIs."""
+    --attention-impl, --decode-steps/-chain, --speculative-ngram-k,
+    --no-prefix-caching) must build a serving worker that answers
+    requests — the int8 and speculative paths are otherwise
+    unreachable from the CLIs."""
     import socket as _socket
     import threading
     import urllib.request
@@ -215,7 +216,7 @@ def test_worker_cli_engine_tuning_flags():
                "--max-prefill-tokens", "64", "--max-model-len", "128",
                "--quantization", "int8", "--attention-impl", "xla",
                "--decode-steps", "4", "--decode-chain", "2",
-               "--no-prefix-caching"])
+               "--speculative-ngram-k", "2", "--no-prefix-caching"])
         spawn(["-m", "dynamo_tpu.frontend", "--control", control,
                "--host", "127.0.0.1", "--port", str(http_port)])
         body = json.dumps({

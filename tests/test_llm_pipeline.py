@@ -101,3 +101,36 @@ def test_stop_holdback_released_when_not_matched(tok):
     out = "".join(post.push_tokens([t]) for t in ids) + post.flush()
     assert out == "abcX del"
     assert post.finished_by_stop is None
+
+
+async def test_stop_string_keeps_spec_payload(tok):
+    """A stop STRING is detected frontend-side mid-stream, so the
+    engine's final delta never reaches the postprocessor — the
+    cumulative per-request spec stats riding earlier deltas must
+    survive onto the yielded stop delta so /metrics accounting sees
+    them (speculative acceptance telemetry)."""
+    import asyncio
+
+    from dynamo_tpu.llm.backend import postprocess_stream
+
+    ids = tok.encode("hello STOP world")
+
+    async def engine_stream():
+        # per-dispatch deltas, spec stats cumulative — the engine's
+        # would-be final delta (with the totals) is never emitted
+        for i, t in enumerate(ids[:-1]):
+            await asyncio.sleep(0)
+            yield {"token_ids": [t], "finish_reason": None,
+                   "spec": {"draft_tokens": 4 * (i + 1),
+                            "accepted_tokens": 2 * (i + 1)}}
+
+    items = [
+        out async for out in postprocess_stream(
+            engine_stream(), tok, stop_sequences=["STOP"],
+        )
+    ]
+    final = items[-1]
+    assert final["finish_reason"] == "stop"
+    assert final["spec"]["draft_tokens"] > 0
+    assert final["spec"]["accepted_tokens"] > 0
+    assert "".join(it["text"] for it in items) == "hello "

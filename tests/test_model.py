@@ -232,47 +232,95 @@ def test_deferred_write_attention_equals_write_first():
                                    err_msg=f"window={window} sink={snk is not None}")
 
 
-def test_block_scan_equals_per_step_decode(setup):
-    """decode_block_scan (block-materialized KV: one gather, ring
-    buffers, one scatter) must match T iterations of the per-step
-    forward_decode path exactly — greedy tokens AND the resulting pool
-    contents.  This is the drift tripwire between the two decode
-    forward paths (models/llama.py); the per-step deferred-vs-write-
-    first equivalence is pinned separately above."""
-    from dynamo_tpu.models.llama import decode_block_scan, forward_decode
+# The decode forward-path feature matrix: every entry must behave
+# identically through the per-step path (forward_decode), the
+# block-materialized path (decode_block_scan) and the fused verify path
+# (forward_verify) — a model feature landing in only one of them is a
+# silent-drift CI failure, not a review finding.
+FEATURE_CFGS = {
+    "plain": lambda: tiny_config(),
+    "swa": lambda: tiny_config(sliding_window=8, model_type="mistral"),
+    "moe_sinks_windows": lambda: tiny_moe_config(
+        attention_sinks=True, sliding_window=8,
+        layer_types=("sliding_attention", "full_attention"),
+        attention_bias=True, attention_out_bias=True,
+        moe_bias=True, moe_act="gpt_oss_glu", model_type="gpt_oss"),
+    "mrope": lambda: tiny_config(mrope_section=(2, 3, 3),
+                                 attention_bias=True,
+                                 model_type="qwen2_vl"),
+}
 
-    cfg, params = setup
-    T, B = 6, 3
+
+def _prefilled(cfg, params, B=3):
+    """Prefill a small ragged batch; returns (tok0, lens, table, kv)."""
     pages_per = 4
-    kv_a = KVCache.create(cfg, 1 + B * pages_per, 8, jnp.float32)
+    kv = KVCache.create(cfg, 1 + B * pages_per, 8, jnp.float32)
     table = make_table(B, pages_per)
     prompts = jnp.asarray(
         np.random.RandomState(5).randint(1, cfg.vocab_size, (B, 9)),
         jnp.int32)
     lens = jnp.asarray([9, 6, 4], jnp.int32)
-    logits, kv_a = forward_prefill(
-        params, cfg, kv_a, prompts, table,
+    logits, kv = forward_prefill(
+        params, cfg, kv, prompts, table,
         jnp.zeros((B,), jnp.int32), lens)
-    tok0 = jnp.argmax(logits, -1).astype(jnp.int32)
+    return jnp.argmax(logits, -1).astype(jnp.int32), lens, table, kv
+
+
+@pytest.mark.parametrize("feature", sorted(FEATURE_CFGS))
+@pytest.mark.parametrize("sampling", ["greedy", "penalized"])
+def test_block_scan_equals_per_step_decode(feature, sampling):
+    """decode_block_scan (block-materialized KV: one gather, ring
+    buffers, one scatter) must match T iterations of the per-step
+    forward_decode path exactly — greedy tokens AND the resulting pool
+    contents — across the full model-feature matrix (sinks+windows+MoE,
+    mrope, SWA) and with frequency/presence penalties in the sampling
+    tail.  This is the drift tripwire between the two decode forward
+    paths (models/llama.py); the per-step deferred-vs-write-first
+    equivalence is pinned separately above."""
+    from dynamo_tpu.models.llama import decode_block_scan, forward_decode
+    from dynamo_tpu.ops import apply_penalties
+
+    cfg = FEATURE_CFGS[feature]()
+    params = init_params(cfg, jax.random.PRNGKey(7), dtype=jnp.float32)
+    T, B = 6, 3
+    tok0, lens, table, kv_a = _prefilled(cfg, params, B)
+    rope_off = (jnp.asarray([0, 3, 11], jnp.int32)
+                if cfg.mrope_section else None)
+    fp = jnp.asarray([1.5, 0.0, 0.7], jnp.float32)
+    pp = jnp.asarray([0.0, 0.9, 0.4], jnp.float32)
+    penalized = sampling == "penalized"
     kv_b = KVCache(kv_a.k, kv_a.v)
 
-    # per-step write-first reference
+    # per-step write-first reference (host loop, host-side counts)
     toks_ref, kv_r, tok = [], kv_a, tok0
+    counts = np.zeros((B, cfg.vocab_size), np.float32)
     pos = lens
     for _ in range(T):
         lg, kv_r = forward_decode(params, cfg, kv_r, tok, pos, table,
-                                  attn_impl="xla")
+                                  attn_impl="xla", rope_offset=rope_off)
+        if penalized:
+            lg = apply_penalties(lg, jnp.asarray(counts), fp, pp)
         tok = jnp.argmax(lg, -1).astype(jnp.int32)
+        if penalized:
+            counts[np.arange(B), np.asarray(tok)] += 1.0
         toks_ref.append(np.asarray(tok))
         pos = pos + 1
 
     def sample_step(eng, logits, tok_prev, t):
+        cts = eng
+        if penalized:
+            logits = apply_penalties(logits, cts, fp, pp)
         out = jnp.argmax(logits, -1).astype(jnp.int32)
-        return eng, out, out
+        if penalized:
+            cts = cts.at[jnp.arange(B), out].add(1.0)
+        return cts, out, out
 
+    cts0 = (jnp.zeros((B, cfg.vocab_size), jnp.float32) if penalized
+            else jnp.zeros(()))
     _, ys, tok_b, pos_b, kv_blk = decode_block_scan(
         params, cfg, kv_b, tok0, lens, table, T,
-        max_valid_pos=10_000, sample_step=sample_step, carry_init=(),
+        max_valid_pos=10_000, sample_step=sample_step, carry_init=cts0,
+        rope_offset=rope_off,
     )
     np.testing.assert_array_equal(
         np.asarray(ys), np.stack(toks_ref))
@@ -281,3 +329,49 @@ def test_block_scan_equals_per_step_decode(setup):
         np.asarray(kv_blk.k), np.asarray(kv_r.k), rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(
         np.asarray(kv_blk.v), np.asarray(kv_r.v), rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("feature", sorted(FEATURE_CFGS))
+@pytest.mark.parametrize("k", [0, 2, 4])
+def test_verify_matches_per_step_decode(feature, k):
+    """forward_verify (the fused k+1-position draft-verify forward of
+    self-speculative decoding, riding the prefill layer path) must
+    produce the same per-position logits AND pool contents as feeding
+    the identical tokens through k+1 per-step forward_decode calls —
+    over the same feature matrix as the block tripwire, including
+    off-distribution draft tokens (rejected drafts still score
+    identically).  k=0 pins the degenerate single-position chunk."""
+    from dynamo_tpu.models.llama import forward_decode, forward_verify
+
+    cfg = FEATURE_CFGS[feature]()
+    params = init_params(cfg, jax.random.PRNGKey(9), dtype=jnp.float32)
+    B = 3
+    tok0, lens, table, kv_a = _prefilled(cfg, params, B)
+    rope_off = (jnp.asarray([0, 3, 11], jnp.int32)
+                if cfg.mrope_section else None)
+    # fed chunk: last sampled token + k arbitrary "draft" tokens
+    drafts = jnp.asarray(
+        np.random.RandomState(17).randint(1, cfg.vocab_size, (B, k)),
+        jnp.int32)
+    fed = jnp.concatenate([tok0[:, None], drafts], axis=1)  # [B, k+1]
+    kv_b = KVCache(kv_a.k, kv_a.v)
+
+    # per-step reference: feed the same tokens sequentially
+    logits_ref, kv_r, pos = [], kv_a, lens
+    for j in range(k + 1):
+        lg, kv_r = forward_decode(
+            params, cfg, kv_r, fed[:, j], pos, table,
+            attn_impl="xla", rope_offset=rope_off)
+        logits_ref.append(np.asarray(lg))
+        pos = pos + 1
+
+    logits_v, kv_v = forward_verify(
+        params, cfg, kv_b, fed, table, lens,
+        jnp.full((B,), k + 1, jnp.int32), rope_offset=rope_off)
+    np.testing.assert_allclose(
+        np.asarray(logits_v), np.stack(logits_ref, axis=1),
+        rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(
+        np.asarray(kv_v.k), np.asarray(kv_r.k), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(kv_v.v), np.asarray(kv_r.v), rtol=1e-5, atol=1e-6)
