@@ -118,6 +118,34 @@ async def interleaved_ab(engines, rounds=3, gen_tokens=SUSTAINED_GEN):
     return out
 
 
+async def _goodput_pass(engine, *, rates, n_req, prompt_len, gen, slo,
+                        min_fraction, rep):
+    """One rate-ladder pass: sweep Poisson offered rates until the SLO
+    breaks; returns (sweep_points, knee_rate)."""
+    sweep, knee, broken = [], None, False
+    for i, rate in enumerate(rates):
+        g = await poisson_goodput(
+            engine, n_req=n_req, rate_rps=rate, prompt_len=prompt_len,
+            gen=gen, slo=slo, seed=17 + 31 * rep + i,
+        )
+        sweep.append({
+            "rate_rps": rate,
+            "goodput_tok_s": round(g[0], 2),
+            "attained_tok_s": round(g[1], 2),
+            "ttft_p50_ms": round(g[2], 1),
+            "itl_p99_ms": round(g[3], 2),
+            "slo_met_fraction": round(g[4], 3),
+        })
+        if g[4] >= min_fraction and not broken:
+            # knee = top of the CONTIGUOUS passing prefix
+            knee = rate
+        else:
+            broken = True
+            if g[4] < 0.5:
+                break  # far past the knee — stop burning chip time
+    return sweep, knee
+
+
 async def goodput_knee(engine, *, rates, n_req, prompt_len, gen, slo,
                        min_fraction=0.9, repeats=2):
     """Sweep Poisson offered rates up a ladder until the SLO breaks:
@@ -131,32 +159,36 @@ async def goodput_knee(engine, *, rates, n_req, prompt_len, gen, slo,
     agree within one rung (otherwise knee_rate_rps is null and the
     disagreement rides the JSON), and max_goodput is the max over ALL
     SLO-passing points of the reported sweep — never contradicting it."""
+    return (await goodput_knee_ab(
+        [engine], rates=rates, n_req=n_req, prompt_len=prompt_len,
+        gen=gen, slo=slo, min_fraction=min_fraction, repeats=repeats,
+    ))[0]
 
-    async def one_pass(rep):
-        sweep, knee, broken = [], None, False
-        for i, rate in enumerate(rates):
-            g = await poisson_goodput(
-                engine, n_req=n_req, rate_rps=rate, prompt_len=prompt_len,
-                gen=gen, slo=slo, seed=17 + 31 * rep + i,
-            )
-            sweep.append({
-                "rate_rps": rate,
-                "goodput_tok_s": round(g[0], 2),
-                "attained_tok_s": round(g[1], 2),
-                "ttft_p50_ms": round(g[2], 1),
-                "itl_p99_ms": round(g[3], 2),
-                "slo_met_fraction": round(g[4], 3),
-            })
-            if g[4] >= min_fraction and not broken:
-                # knee = top of the CONTIGUOUS passing prefix
-                knee = rate
-            else:
-                broken = True
-                if g[4] < 0.5:
-                    break  # far past the knee — stop burning chip time
-        return sweep, knee
 
-    passes = [await one_pass(rep) for rep in range(repeats)]
+async def goodput_knee_ab(engines, *, rates, n_req, prompt_len, gen, slo,
+                          min_fraction=0.9, repeats=2):
+    """A/B-interleave whole goodput-ladder passes across engines within
+    ONE run (same rationale as `interleaved_ab`: a multi-hour tunnel
+    phase shifts every engine's passes together, so the reported deltas
+    — e.g. block ladder on vs off — are real, not environment).
+    Returns one `goodput_knee`-shaped summary per engine."""
+    passes = {id(e): [] for e in engines}
+    for rep in range(repeats):
+        for e in engines:
+            passes[id(e)].append(await _goodput_pass(
+                e, rates=rates, n_req=n_req, prompt_len=prompt_len,
+                gen=gen, slo=slo, min_fraction=min_fraction, rep=rep,
+            ))
+    return [
+        _knee_summary(passes[id(e)], rates, n_req, min_fraction, slo)
+        for e in engines
+    ]
+
+
+def _knee_summary(passes, rates, n_req, min_fraction, slo):
+    """Aggregate ladder passes into the reported knee record (repeat
+    agreement, conservative representative pass, max SLO-passing
+    goodput)."""
     knees = [k for _, k in passes]
     # agreement: all passes found a knee within one rung of each other,
     # or none did — a zero-capacity pass vs any real knee is DISagreement
@@ -236,8 +268,9 @@ async def poisson_goodput(engine, *, n_req, rate_rps, prompt_len, gen,
 async def warm_mixed(engine, prompt_len=PROMPT_LEN) -> bool:
     """Warm prefill/decode/MIXED programs off the clock: solo request
     first, then overlap a prefill with a LIVE decode until the mixed
-    program has actually compiled (engine._mixed_steps non-empty) — a
-    racy warmup leaks a ~30s tunnel compile into measured TTFTs."""
+    program has actually compiled (a non-empty "mixed" entry in
+    `engine.compiled_variants`) — a racy warmup leaks a ~30s tunnel
+    compile into measured TTFTs."""
     await run_round(engine, 0, batch=1, prompt_len=prompt_len,
                     gen_tokens=40)
 
@@ -265,15 +298,93 @@ async def warm_mixed(engine, prompt_len=PROMPT_LEN) -> bool:
             await task
 
     for attempt in range(4):
-        if engine._mixed_steps:  # noqa: SLF001 — compiled-variant cache
+        if engine.compiled_variants["mixed"]:
             return True
         await _mixed_warm(300 + 40 * attempt)
-    ok = bool(engine._mixed_steps)  # noqa: SLF001
+    ok = bool(engine.compiled_variants["mixed"])
     if not ok:
         print("WARNING: mixed-step warmup never compiled; goodput "
               "TTFTs include an on-clock XLA compile",
               file=sys.stderr, flush=True)
     return ok
+
+
+async def warm_ladder(engine, prompt_len=PROMPT_LEN) -> bool:
+    """Compile every block-ladder rung's decode program off the clock:
+    a burst (short prompt landing on a live decode) resets the
+    scheduler's ramp to the bottom rung, and the quiet tail climbs back
+    up one rung per dispatch — so one long generation with a mid-stream
+    burst walks the whole ladder.  Checked against
+    `engine.compiled_decode_rungs`; a rung compiling ON the clock costs
+    a ~30-40s tunnel compile inside a measured TTFT."""
+    ladder = list(engine.cfg.block_ladder)
+    if len(ladder) <= 1:
+        return True
+    for attempt in range(4):
+        if set(ladder) <= engine.compiled_decode_rungs:
+            return True
+        first = asyncio.Event()
+
+        async def bg(seed):
+            req = {"token_ids": [(seed + j) % 997 + 1
+                                 for j in range(prompt_len)],
+                   "sampling_options": {"temperature": 0.0},
+                   # enough tokens past the burst to climb every rung
+                   "stop_conditions": {"max_tokens": 3 * sum(ladder) + 32,
+                                       "ignore_eos": True}}
+            async for out in engine.generate(req):
+                if out["token_ids"]:
+                    first.set()
+            first.set()  # errored/empty streams must not hang the bench
+
+        task = asyncio.get_running_loop().create_task(bg(500 + 40 * attempt))
+        try:
+            await asyncio.wait_for(first.wait(), timeout=120)
+            # decode is live: this burst forces the bottom rung, then
+            # the bg request's tail ramps back through the ladder
+            await run_round(engine, 600 + 40 * attempt, batch=1,
+                            prompt_len=prompt_len, gen_tokens=4)
+        finally:
+            await task
+    ok = set(ladder) <= engine.compiled_decode_rungs
+    if not ok:
+        print(f"WARNING: ladder warmup missed rungs "
+              f"{sorted(set(ladder) - engine.compiled_decode_rungs)}; "
+              f"an XLA compile may land on the clock",
+              file=sys.stderr, flush=True)
+    return ok
+
+
+def _ttft_attr_means(engine, m0=None):
+    """Mean per-request TTFT attribution (ms) — block-wait vs
+    queue-wait vs prefill, the split that proves where a goodput/TTFT
+    win came from.  `m0` is a post-warmup metrics() snapshot: the
+    engine totals are lifetime, and warmup traffic differs per A/B arm
+    (warm_ladder only runs on laddered engines), so the measured means
+    must be diffs."""
+
+    m = engine.metrics()  # ONE snapshot: fields must be consistent
+
+    def d(field):
+        return getattr(m, field) - (getattr(m0, field) if m0 is not None
+                                    else 0)
+
+    n = max(d("ttft_attributed_total"), 1)
+    return {
+        "requests": d("ttft_attributed_total"),
+        "block_wait_ms_mean": round(d("ttft_block_wait_ms_total") / n, 2),
+        "queue_wait_ms_mean": round(d("ttft_queue_wait_ms_total") / n, 2),
+        "prefill_ms_mean": round(d("ttft_prefill_ms_total") / n, 2),
+    }
+
+
+def _rung_delta(engine, h0=None):
+    """Chosen-rung dispatch counts since the `h0` snapshot (warmup
+    walks the whole ladder by design — exclude it from the reported
+    mix)."""
+    h0 = h0 or {}
+    return {k: v - h0.get(k, 0) for k, v in engine.rung_histogram.items()
+            if v - h0.get(k, 0)}
 
 
 def _p50(xs):
@@ -428,13 +539,12 @@ async def spec_decode_phase(cfg, params, prompt_len=128, gen=96, k=4,
         # reported acceptance/dispatch numbers cover exactly the
         # ITL-measured rounds
         m0 = spec.metrics()
-        disp0 = spec._spec_dispatch_total  # noqa: SLF001
         itl_plain, itl_spec = [], []
         for _ in range(rounds):  # interleave so a tunnel phase moves both
             itl_plain.append(await one(plain))
             itl_spec.append(await one(spec))
         m = spec.metrics()
-        dispatches = spec._spec_dispatch_total - disp0  # noqa: SLF001
+        dispatches = m.spec_dispatches_total - m0.spec_dispatches_total
         accepted = m.spec_accepted_tokens_total - m0.spec_accepted_tokens_total
         drafted = m.spec_draft_tokens_total - m0.spec_draft_tokens_total
         out = {
@@ -702,12 +812,20 @@ async def main_async():
         table_width_buckets=[16], decode_steps=32, decode_chain=2,
         mixed_prefill_tokens=4 * PROMPT_LEN, enable_prefix_caching=False,
         quantization="int8", fuse_projections=True,
+        # block ladder (ISSUE 2): full 32-step blocks while the queue is
+        # idle, 1-step blocks (chaining suppressed) the moment prompts
+        # are pending — a Poisson arrival's first chunk rides the next
+        # dispatch instead of waiting out a 2×32-step chained run
+        decode_block_ladder=[1, 4, 8],
     ), eos_token_ids=[])
     # warmup: solo request (prefill + decode programs), then overlap a
     # prefill with a LIVE decode until the mixed program has actually
-    # compiled (engine._mixed_steps non-empty) — a racy warmup here
-    # leaks a ~30s tunnel compile into the measured TTFTs
+    # compiled (compiled_variants["mixed"] non-empty) — a racy warmup
+    # here leaks a ~30s tunnel compile into the measured TTFTs — then
+    # walk the block ladder so every rung's program is warm too
     mixed_warm_ok = await warm_mixed(engine)
+    mixed_warm_ok = (await warm_ladder(engine)) and mixed_warm_ok
+    m0_1b, rungs0_1b = engine.metrics(), engine.rung_histogram
     # rate LADDER up to the knee: one light-load point where attained ≈
     # offered measures SLO compliance, not capacity (VERDICT r3 item 3).
     # Intermediate rungs (6, 12) make repeat_agreement load-bearing —
@@ -723,6 +841,10 @@ async def main_async():
          p["itl_p99_ms"], p["slo_met_fraction"])
         for p in k1["sweep"] if p["rate_rps"] == 4.0
     ), None) or (0.0, 0.0, 0.0, 0.0, 0.0)
+    # chosen-rung histogram + TTFT attribution over the goodput phases
+    # (post-warmup deltas: warmup walks the ladder by design)
+    rungs_1b = _rung_delta(engine, rungs0_1b)
+    ttft_attr_1b = _ttft_attr_means(engine, m0_1b)
     await engine.shutdown()
     del engine  # fused 1B copy — free before the 8B weights arrive
     import gc
@@ -771,34 +893,59 @@ async def main_async():
     await engine8.shutdown()
     tps8 = t8 / dt8
     breakdown8 = phase_breakdown(cfg8, params8)
+    # drop the throughput engine's KV pool before building TWO goodput
+    # engines (ladder A/B) — ~1 GB of pages each beside 8 GB of weights
+    del engine8
+    import gc
+
+    gc.collect()
 
     # 8B goodput: REAL Poisson arrivals over the mixed scheduler (the
     # round-3 batch-burst proxy is gone), swept up a rate ladder to the
     # knee.  Shapes pinned to one prefill/decode/chunk bucket each so
-    # the programs all warm off the clock
-    engine8g = JaxEngine(cfg8, params8, EngineConfig(
-        page_size=16, num_pages=1 + 12 * 16 + 32, max_num_seqs=8,
-        # two prompts per mixed dispatch (burst handling, see the 1B
-        # goodput engine); 32-step decode blocks amortize the tunnel RTT
-        max_prefill_tokens=2 * PROMPT_LEN, prefill_batch_size=2,
-        max_model_len=PROMPT_LEN + 96 + 16,
-        decode_batch_buckets=[8], chunk_buckets=[PROMPT_LEN],
-        table_width_buckets=[16], decode_steps=32, decode_chain=2,
-        mixed_prefill_tokens=2 * PROMPT_LEN, enable_prefix_caching=False,
-    ), eos_token_ids=[])
-    mixed_warm_ok8 = await warm_mixed(engine8g)
+    # the programs all warm off the clock.  Run as an interleaved A/B —
+    # block ladder ON vs fixed 32-step blocks — so the ISSUE 2 win
+    # (prompts admitted within one short rung instead of a chained
+    # 2×32-step run) is measured against environment drift, not
+    # inferred (VERDICT #1)
+    def ecfg8g(ladder):
+        return EngineConfig(
+            page_size=16, num_pages=1 + 12 * 16 + 32, max_num_seqs=8,
+            # two prompts per mixed dispatch (burst handling, see the 1B
+            # goodput engine); 32-step decode blocks amortize the tunnel
+            # RTT when the queue is idle
+            max_prefill_tokens=2 * PROMPT_LEN, prefill_batch_size=2,
+            max_model_len=PROMPT_LEN + 96 + 16,
+            decode_batch_buckets=[8], chunk_buckets=[PROMPT_LEN],
+            table_width_buckets=[16], decode_steps=32, decode_chain=2,
+            mixed_prefill_tokens=2 * PROMPT_LEN,
+            enable_prefix_caching=False,
+            decode_block_ladder=ladder,
+        )
+
+    engine8g = JaxEngine(cfg8, params8, ecfg8g([1, 4, 8]), eos_token_ids=[])
+    engine8f = JaxEngine(cfg8, params8, ecfg8g(None), eos_token_ids=[])
+    mixed_warm_ok8 = (await warm_mixed(engine8g)) & (await warm_mixed(engine8f))
+    mixed_warm_ok8 = (await warm_ladder(engine8g)) and mixed_warm_ok8
+    # post-warmup snapshots: the arms warm asymmetrically (warm_ladder
+    # only runs on the laddered engine), so the reported attribution
+    # means must cover the measured traffic only
+    m0_8g, rungs0_8g = engine8g.metrics(), engine8g.rung_histogram
+    m0_8f = engine8f.metrics()
     # half-rungs (1.5, 3) for the same repeat-agreement reason as the 1B
     # ladder — r5's 8B passes disagreed 2.0 vs 1.0 (VERDICT r5 weak #4)
-    k8 = await goodput_knee(
-        engine8g, rates=[1.0, 1.5, 2.0, 3.0, 4.0], n_req=50,
+    k8, k8_fixed = await goodput_knee_ab(
+        [engine8g, engine8f], rates=[1.0, 1.5, 2.0, 3.0, 4.0], n_req=50,
         prompt_len=PROMPT_LEN, gen=64, slo=SLO_8B,
     )
+    rungs_8b = _rung_delta(engine8g, rungs0_8g)
+    ttft_attr_8b = _ttft_attr_means(engine8g, m0_8g)
+    ttft_attr_8b_fixed = _ttft_attr_means(engine8f, m0_8f)
     await engine8g.shutdown()
+    await engine8f.shutdown()
     # release the ~8GB of 8B weights before the remaining 1B phases —
     # holding them through the ISL-2000 + prefix-cache engines OOMs HBM
-    del engine8, engine8g, params8
-    import gc
-
+    del engine8g, engine8f, params8
     gc.collect()
 
     gb_1b_bf16 = cfg.num_params() * 2 / 1e9
@@ -830,6 +977,10 @@ async def main_async():
             **({} if "knee_disagreement" not in k1
                else {"knee_disagreement": k1["knee_disagreement"]}),
             "goodput_sweep": k1["sweep"],
+            # block-ladder telemetry over the goodput phases: which rungs
+            # actually dispatched, and where each request's TTFT went
+            "rung_dispatches": {str(k): v for k, v in rungs_1b.items()},
+            "ttft_attribution_ms": ttft_attr_1b,
         },
         "llama-3.1-8b-int8": {
             **({} if mixed_warm_ok8 else {"goodput_warmup_failed": True}),
@@ -848,6 +999,27 @@ async def main_async():
                else {"knee_disagreement": k8["knee_disagreement"]}),
             "goodput_sweep": k8["sweep"],
             "slo": SLO_8B,
+            # interleaved A/B: block ladder on (the headline above) vs
+            # fixed 32-step blocks, same run, alternating passes
+            "ladder_ab": {
+                "ladder": {
+                    "max_goodput_at_slo_tok_s":
+                        k8["max_goodput_at_slo_tok_s"],
+                    "knee_rate_rps": k8["knee_rate_rps"],
+                    "knees_per_pass": k8["knees_per_pass"],
+                    "rung_dispatches":
+                        {str(k): v for k, v in rungs_8b.items()},
+                    "ttft_attribution_ms": ttft_attr_8b,
+                },
+                "fixed": {
+                    "max_goodput_at_slo_tok_s":
+                        k8_fixed["max_goodput_at_slo_tok_s"],
+                    "knee_rate_rps": k8_fixed["knee_rate_rps"],
+                    "knees_per_pass": k8_fixed["knees_per_pass"],
+                    "ttft_attribution_ms": ttft_attr_8b_fixed,
+                    "goodput_sweep": k8_fixed["sweep"],
+                },
+            },
         },
     }
 
@@ -968,6 +1140,16 @@ def _compact_summary(full):
         "goodput_8b_max_tok_s": m8.get("max_goodput_at_slo_tok_s"),
         "goodput_8b_knee_rps": m8.get("knee_rate_rps"),
         "goodput_8b_knees_per_pass": m8.get("knees_per_pass"),
+        # ladder A/B headline: fixed-block arm + the TTFT share the
+        # ladder exists to shrink (block-wait), both arms
+        "goodput_8b_fixed_max_tok_s": m8.get("ladder_ab", {})
+        .get("fixed", {}).get("max_goodput_at_slo_tok_s"),
+        "ttft_block_wait_8b_ladder_ms": m8.get("ladder_ab", {})
+        .get("ladder", {}).get("ttft_attribution_ms", {})
+        .get("block_wait_ms_mean"),
+        "ttft_block_wait_8b_fixed_ms": m8.get("ladder_ab", {})
+        .get("fixed", {}).get("ttft_attribution_ms", {})
+        .get("block_wait_ms_mean"),
         "tok_s_8b": m8.get("tok_s"),
         "weight_read_gbps": full.get("weight_read_gbps"),
         "disagg_kv_transfer_p50_ms": full.get("disagg_kv_transfer_p50_ms"),

@@ -35,6 +35,23 @@ class EngineConfig:
     # (host RTT) overlaps the next block's compute.  1 = no chaining.
     decode_chain: int = 1
 
+    # adaptive decode-block sizing ("block ladder"): compile the decode/
+    # mixed step at THIS ladder of block sizes instead of only
+    # `decode_steps`, and let the scheduler pick the rung per dispatch —
+    # full blocks while the prompt queue is empty, the shortest rung
+    # (with dispatch chaining suppressed) the moment prompts are
+    # pending, so a waiting prompt rides the next mixed dispatch within
+    # one short block instead of a full chained run (the Sarathi-Serve /
+    # Orca stall-free property, host-side policy form).  After the
+    # queue drains the scheduler climbs back up one rung per quiet
+    # dispatch, so a Poisson burst's stragglers still find short
+    # blocks.  None disables (single fixed `decode_steps` block —
+    # today's behavior).  Rungs must be positive and <= decode_steps;
+    # `decode_steps` itself is always appended as the top rung.  Each
+    # rung is one more compiled program per (penalized, top_logprobs,
+    # greedy) step variant actually used — keep ladders short (~4).
+    decode_block_ladder: Optional[Sequence[int]] = None
+
     # chain the first decode block straight off a prompt-completing
     # prefill's device-side sampled tokens (skips the prefill fetch
     # barrier — one host round-trip saved per request); falls back to
@@ -135,6 +152,26 @@ class EngineConfig:
                 f"<= speculative_max_match, got "
                 f"[{self.speculative_min_match}, {self.speculative_max_match}]"
             )
+        if self.decode_block_ladder is not None:
+            rungs = list(self.decode_block_ladder)
+            bad = [r for r in rungs
+                   if not isinstance(r, int) or isinstance(r, bool) or r < 1]
+            if bad:
+                raise ValueError(
+                    f"decode_block_ladder rungs must be positive ints, "
+                    f"got {bad}"
+                )
+            over = [r for r in rungs if r > self.decode_steps]
+            if over:
+                raise ValueError(
+                    f"decode_block_ladder rungs {over} exceed decode_steps="
+                    f"{self.decode_steps} (the scheduler reserves pages for "
+                    f"at most decode_steps positions per dispatch)"
+                )
+            # normalize: ascending, deduped, decode_steps as the top rung
+            self.decode_block_ladder = sorted(
+                set(rungs) | {self.decode_steps}
+            )
         if self.speculative_ngram_k and self.speculative_history < 1:
             # tokens[-0:] would silently mean UNBOUNDED history, turning
             # the per-dispatch host lookup into a full-context scan
@@ -155,6 +192,15 @@ class EngineConfig:
             # sequence actually in the batch, bucketed so XLA compiles a few
             # variants (coarser than pow2 to bound variant count)
             self.table_width_buckets = _pow2_buckets(self.max_pages_per_seq)
+
+    @property
+    def block_ladder(self) -> tuple:
+        """The decode-block rung sizes the scheduler may pick from,
+        ascending, always ending in `decode_steps` — `(decode_steps,)`
+        when adaptive sizing is off."""
+        if not self.decode_block_ladder:
+            return (self.decode_steps,)
+        return tuple(self.decode_block_ladder)
 
     @property
     def usable_pages(self) -> int:

@@ -58,7 +58,16 @@ class ForwardPassMetrics:
 
     The spec_* fields are the SpecDecodeStats analog (reference
     _core.pyi:428-435): lifetime draft/accept counters plus a rolling
-    acceptance rate over the engine's recent verify dispatches."""
+    acceptance rate over the engine's recent verify dispatches.
+
+    The ttft_* fields attribute time-to-first-token across the three
+    host-side phases the block ladder acts on: block-wait (request
+    enqueued → scheduler first saw it, i.e. the in-flight decode block
+    the pump was committed to), queue-wait (seen → admitted to running)
+    and prefill (admitted → first token).  Lifetime ms totals plus the
+    attributed-request count, so dashboards can plot means and the
+    bench can prove where a TTFT win came from.  Per-rung dispatch
+    counts ride as dynamic `decode_rung{n}_dispatches_total` attrs."""
 
     active_seqs: int = 0
     waiting_seqs: int = 0
@@ -67,7 +76,12 @@ class ForwardPassMetrics:
     num_requests_total: int = 0
     spec_draft_tokens_total: int = 0
     spec_accepted_tokens_total: int = 0
+    spec_dispatches_total: int = 0
     spec_acceptance_rate: float = 0.0
+    ttft_block_wait_ms_total: float = 0.0
+    ttft_queue_wait_ms_total: float = 0.0
+    ttft_prefill_ms_total: float = 0.0
+    ttft_attributed_total: int = 0
 
 
 # static top-k width for OpenAI `top_logprobs` responses (API max is 20)
@@ -1306,6 +1320,18 @@ class JaxEngine:
         self._spec_accepted_total = 0
         self._spec_dispatch_total = 0
         self._spec_window = _deque(maxlen=128)  # (drafted, accepted)
+        # block-ladder telemetry: dispatches per chosen rung, plus the
+        # TTFT attribution accumulators (block-wait vs queue-wait vs
+        # prefill — per-request values ride the first delivered delta,
+        # lifetime totals surface in ForwardPassMetrics)
+        self._rung_dispatches: Dict[int, int] = {}
+        self._ttft_block_wait_ms_total = 0.0
+        self._ttft_queue_wait_ms_total = 0.0
+        self._ttft_prefill_ms_total = 0.0
+        self._ttft_attributed_total = 0
+        # optional dispatch trace (tests / debugging): set to a list and
+        # every device dispatch appends {kind, n_steps, pending}
+        self.dispatch_trace: Optional[List[dict]] = None
 
     def attach_connector(self, connector) -> None:
         """Attach a KVBM connector (kvbm.KvConnector shape: on_event /
@@ -1491,12 +1517,19 @@ class JaxEngine:
         return self._prefill_steps[key]
 
     def _get_decode_step(self, penalized: bool, with_top: bool,
-                         greedy: bool = False):
-        key = (penalized, with_top, greedy)
+                         greedy: bool = False,
+                         n_steps: Optional[int] = None):
+        """The decode-block step for one (variant, n_steps) key.
+        `n_steps` is the block-ladder rung (None → `decode_steps`): each
+        rung is its own compiled program, cached alongside the variant
+        flags, so the scheduler can switch block sizes per dispatch with
+        zero retraces after warmup."""
+        n_steps = n_steps or self.cfg.decode_steps
+        key = (penalized, with_top, greedy, n_steps)
         if key not in self._decode_steps:
             if self._pp > 1:
                 self._decode_steps[key] = _build_decode_step_pp(
-                    self.model_cfg, self.mesh, self.cfg.decode_steps,
+                    self.model_cfg, self.mesh, n_steps,
                     self.cfg.hard_cap, penalized=penalized,
                     with_top=with_top, attn_impl=self._attn_impl,
                     lockstep=self._multihost, pooled=self._pooled,
@@ -1505,14 +1538,14 @@ class JaxEngine:
             elif self._pooled:
                 self._decode_steps[key] = _build_decode_step_pooled(
                     self.model_cfg, self.mesh, self._pool_axes,
-                    self.cfg.decode_steps, self.cfg.hard_cap,
+                    n_steps, self.cfg.hard_cap,
                     penalized=penalized, with_top=with_top,
                     attn_impl=self._attn_impl, lockstep=self._multihost,
                     greedy=greedy,
                 )
             else:
                 self._decode_steps[key] = _build_decode_step(
-                    self.model_cfg, self.cfg.decode_steps, self.cfg.hard_cap,
+                    self.model_cfg, n_steps, self.cfg.hard_cap,
                     penalized=penalized, with_top=with_top,
                     attn_impl=self._attn_impl,
                     lockstep_mesh=self.mesh if self._multihost else None,
@@ -1533,26 +1566,59 @@ class JaxEngine:
         return self._decode_steps[key]
 
     def _get_mixed_step(self, penalized: bool, with_top: bool,
-                        greedy: bool = False):
-        key = (penalized, with_top, greedy)
+                        greedy: bool = False,
+                        n_steps: Optional[int] = None):
+        n_steps = n_steps or self.cfg.decode_steps
+        key = (penalized, with_top, greedy, n_steps)
         if key not in self._mixed_steps:
             if self._pooled:
                 self._mixed_steps[key] = _build_mixed_step_pooled(
                     self.model_cfg, self.mesh, self._pool_axes,
-                    self.cfg.decode_steps, self.cfg.hard_cap,
+                    n_steps, self.cfg.hard_cap,
                     penalized=penalized, with_top=with_top,
                     attn_impl=self._attn_impl, lockstep=self._multihost,
                     greedy=greedy,
                 )
             else:
                 self._mixed_steps[key] = _build_mixed_step(
-                    self.model_cfg, self.cfg.decode_steps, self.cfg.hard_cap,
+                    self.model_cfg, n_steps, self.cfg.hard_cap,
                     penalized=penalized, with_top=with_top,
                     attn_impl=self._attn_impl,
                     lockstep_mesh=self.mesh if self._multihost else None,
                     greedy=greedy,
                 )
         return self._mixed_steps[key]
+
+    @property
+    def compiled_variants(self) -> Dict[str, List]:
+        """Public view of the compiled step-variant cache keys per step
+        family ({"prefill": [...], "decode": [...], "mixed": [...]}).
+        Prefill keys are (with_top, with_mm, greedy); decode/mixed keys
+        are (penalized, with_top, greedy, n_steps) — plus ("spec",
+        greedy) for the draft-verify variant.  Benchmarks and warmup
+        harnesses key off this instead of the private caches (e.g. "has
+        the mixed program compiled yet", "is every ladder rung warm")."""
+        return {
+            "prefill": sorted(self._prefill_steps, key=repr),
+            "decode": sorted(self._decode_steps, key=repr),
+            "mixed": sorted(self._mixed_steps, key=repr),
+        }
+
+    @property
+    def compiled_decode_rungs(self) -> set:
+        """Block-ladder rungs with a compiled decode OR mixed program
+        (ladder-aware warmup checks coverage against
+        `cfg.block_ladder`)."""
+        return {
+            k[3] for k in (*self._decode_steps, *self._mixed_steps)
+            if isinstance(k, tuple) and len(k) == 4
+        }
+
+    @property
+    def rung_histogram(self) -> Dict[int, int]:
+        """Dispatch count per chosen decode-block rung (decode, mixed
+        and fused dispatches; chained blocks count once per block)."""
+        return dict(self._rung_dispatches)
 
     # -- events -------------------------------------------------------------- #
 
@@ -1583,8 +1649,18 @@ class JaxEngine:
             num_requests_total=self._requests_total,
             spec_draft_tokens_total=self._spec_draft_total,
             spec_accepted_tokens_total=self._spec_accepted_total,
+            spec_dispatches_total=self._spec_dispatch_total,
             spec_acceptance_rate=self._spec_acceptance_rate(),
+            ttft_block_wait_ms_total=self._ttft_block_wait_ms_total,
+            ttft_queue_wait_ms_total=self._ttft_queue_wait_ms_total,
+            ttft_prefill_ms_total=self._ttft_prefill_ms_total,
+            ttft_attributed_total=self._ttft_attributed_total,
         )
+        # chosen-rung histogram (block ladder): one dynamic counter attr
+        # per rung — bounded by the ladder size, picked up by vars()
+        # consumers (/metrics.json, the worker Prometheus collector)
+        for rung, n in sorted(self._rung_dispatches.items()):
+            setattr(m, f"decode_rung{rung}_dispatches_total", n)
         if self.pool.ranks > 1:
             m.kv_usage_aggregate = self.pool.usage()
         if self.tiered is not None:
@@ -1633,6 +1709,7 @@ class JaxEngine:
             yield {"token_ids": [], "finish_reason": "length"}
             return
         seq = Sequence(context.id, prompt, opts)
+        seq.t_arrival = time.monotonic()
         seq.seed = opts.seed if opts.seed is not None else self._py_rng.getrandbits(31)
         seq.hold_pages = bool(request.get("_hold_pages"))
         if (request.get("mm_pixels") or request.get("mm_embeds")
@@ -2008,7 +2085,24 @@ class JaxEngine:
             offs.append(len(flat))
         return [np.asarray(flat, np.int32), np.asarray(offs, np.int64)]
 
+    def _note_dispatch(self, kind: str, n_steps: int = 0,
+                       blocks: int = 1) -> None:
+        """Account one device dispatch: rung histogram (decode-bearing
+        kinds; a chained run counts once per block) + the optional
+        dispatch trace."""
+        if n_steps:
+            self._rung_dispatches[n_steps] = (
+                self._rung_dispatches.get(n_steps, 0) + blocks
+            )
+        if self.dispatch_trace is not None:
+            self.dispatch_trace.append({
+                "kind": kind, "n_steps": n_steps, "blocks": blocks,
+                "pending": self.scheduler.prompts_pending(),
+                "t": time.monotonic(),
+            })
+
     def _run_prefill(self, items: List[PrefillItem]) -> None:
+        self._note_dispatch("prefill")
         item_rows = self._prefill_rows(items)
         B = len(item_rows)
         seq_rows = [it.seq if it else None for it in item_rows]
@@ -2108,7 +2202,6 @@ class JaxEngine:
         Returns the decode dispatches, or [] when the batch is not
         eligible."""
         seqs = [it.seq for it in items]
-        T = self.cfg.decode_steps
         hard_cap = self.cfg.hard_cap
         if (
             not self.cfg.fuse_prefill_decode
@@ -2139,6 +2232,12 @@ class JaxEngine:
             return []
         if self.tiered is not None and self.tiered.pending_offloads:
             return []
+        # the fused chain is a decode dispatch for ladder purposes: it
+        # rides the scheduler's ramp rung (eligibility above guarantees
+        # no prompts are pending, so this is never the forced-short
+        # case).  PEEK first — the page extension below may still abort
+        # the fusion, and an aborted dispatch must not consume a rung
+        T, allow_chain = self.scheduler.peek_decode_rung()
         if not all(
             self.scheduler.try_extend_pages(
                 s, min(s.num_computed + T, hard_cap)
@@ -2146,10 +2245,12 @@ class JaxEngine:
             for s in seqs
         ):
             return []
+        self.scheduler.commit_decode_rung()
         chain_len = 1
-        while (chain_len < max(1, self.cfg.decode_chain)
+        while (allow_chain and chain_len < max(1, self.cfg.decode_chain)
                and self._chain_ok(seqs, chain_len, T, hard_cap)):
             chain_len += 1
+        self._note_dispatch("fused", T, blocks=chain_len)
         positions = np.zeros((B,), np.int32)
         decode_ctr = np.zeros((B,), np.int32)
         for i, s in enumerate(seqs):
@@ -2164,7 +2265,7 @@ class JaxEngine:
         return self._dispatch_decode(
             tok_d, positions, decode_ctr, None, table, samp, seeds,
             False, with_top, chain_len, rope_off=rope_off,
-            greedy=self._is_greedy(samp),
+            greedy=self._is_greedy(samp), n_steps=T,
         )
 
     def _consume_decode(self, dispatches, rows, Bb, with_top) -> None:
@@ -2194,8 +2295,13 @@ class JaxEngine:
                     and s.total_len + T < self.cfg.max_model_len
                     and s.num_computed + T <= self.cfg.hard_cap
                 ):
+                    first = not s.output_tokens
                     s.num_computed += T
                     s.output_tokens.extend(int(x) for x in out[:, i])
+                    if first:  # a first token CAN ride a decode block
+                        # (e.g. future paths without a prefill sample) —
+                        # keep the TTFT attribution complete
+                        self._note_first_token(s)
                     self.scheduler.commit_full_pages(s)
                     self._deliver_block(s, out[:, i], logp[:, i],
                                         tids, tlps, i, with_top)
@@ -2229,6 +2335,10 @@ class JaxEngine:
                 _tops_for(seq, tids, tlps, (t, col))
                 for t in range(len(out["token_ids"]))
             ]
+        if seq.ttft_attr is not None:
+            # one-shot TTFT attribution (see _deliver)
+            out["ttft"] = seq.ttft_attr
+            seq.ttft_attr = None
         self._post_threadsafe(queue, out)
 
     def _post_threadsafe(self, queue, out) -> None:
@@ -2271,6 +2381,12 @@ class JaxEngine:
         counts = self._counts_array(d_rows) if penalized else None
         d_rope = self._rope_array(d_rows)
         greedy_m = self._is_greedy(p_samp) and self._is_greedy(d_samp)
+        # a mixed plan means prompts are pending by construction, so the
+        # ladder policy picks the shortest rung — the prefill side's NEXT
+        # chunk (or the next waiting prompt) rides the following dispatch
+        # one short block from now
+        T, _ = self.scheduler.select_decode_rung()
+        self._note_dispatch("mixed", T)
         if self._multihost:
             sparse = (self._encode_counts_sparse(d_rows)
                       if penalized else None)
@@ -2284,11 +2400,13 @@ class JaxEngine:
                 "counts_sparse": sparse,
                 "rope_off": d_rope,
                 "greedy": greedy_m,
+                "n_steps": T,
             })
         p_packed_d, d_packed_d = self._dispatch_mixed(
             p_tokens, p_table, p_prefix, p_chunk, p_samp, p_seeds, p_ctr,
             d_tokens, d_pos, d_ctr, counts, d_table, d_samp, d_seeds,
             penalized, with_top, rope_off=d_rope, greedy=greedy_m,
+            n_steps=T,
         )
         # dispatch committed: account prefill chunks now (consume order
         # below matches the device program: prefill first, then decode)
@@ -2316,10 +2434,10 @@ class JaxEngine:
     def _dispatch_mixed(self, p_tokens, p_table, p_prefix, p_chunk, p_samp,
                         p_seeds, p_ctr, d_tokens, d_pos, d_ctr, d_counts,
                         d_table, d_samp, d_seeds, penalized, with_top,
-                        rope_off=None, greedy=False):
+                        rope_off=None, greedy=False, n_steps=None):
         """Issue the jitted mixed step (identical on leader and followers);
         returns the two packed device outputs."""
-        step = self._get_mixed_step(penalized, with_top, greedy)
+        step = self._get_mixed_step(penalized, with_top, greedy, n_steps)
         cts_d = self._put(d_counts, self._bax, None) if penalized else None
         rope = ()
         if self.model_cfg.mrope_section:
@@ -2692,6 +2810,7 @@ class JaxEngine:
         fetch and are consumed through the ordinary per-token stop
         path (variable acceptance == variable tokens per dispatch)."""
         k = self.cfg.speculative_ngram_k
+        self._note_dispatch("spec")
         rows = self._decode_rows(seqs)
         B = len(rows)
         tokens = np.zeros((B, k + 1), np.int32)
@@ -2775,7 +2894,11 @@ class JaxEngine:
     def _run_decode(self, seqs: List[Sequence]) -> None:
         if self._spec_ok(seqs):
             return self._run_spec_decode(seqs)
-        T = self.cfg.decode_steps
+        # block ladder: the scheduler picks this dispatch's block size —
+        # full blocks while the prompt queue is empty, the shortest rung
+        # (chaining suppressed) while prompts are pending, so a waiting
+        # prompt rides the next mixed dispatch within one short block
+        T, allow_chain = self.scheduler.select_decode_rung()
         hard_cap = self.cfg.hard_cap
         # decide the chain length upfront and pre-reserve pages for the
         # whole horizon, so ONE page table serves every block: chained
@@ -2783,9 +2906,10 @@ class JaxEngine:
         # exactly block k's device-side outputs (any fresh host buffer
         # mid-chain serializes on remote-attached TPUs)
         chain_len = 1
-        while (chain_len < max(1, self.cfg.decode_chain)
+        while (allow_chain and chain_len < max(1, self.cfg.decode_chain)
                and self._chain_ok(seqs, chain_len, T, hard_cap)):
             chain_len += 1
+        self._note_dispatch("decode", T, blocks=chain_len)
         rows = self._decode_rows(seqs)
         Bb = len(rows)
         tokens, positions = self._decode_arrays(rows)
@@ -2811,11 +2935,12 @@ class JaxEngine:
                 "counts_sparse": sparse,
                 "rope_off": rope_off,
                 "greedy": self._is_greedy(samp),
+                "n_steps": T,
             })
         dispatches = self._dispatch_decode(
             tokens, positions, counters, counts, table, samp, seeds,
             penalized, with_top, chain_len, rope_off=rope_off,
-            greedy=self._is_greedy(samp),
+            greedy=self._is_greedy(samp), n_steps=T,
         )
         # page frees deferred until the whole chain drains: an in-flight
         # dispatch must never see its table's pages reallocated (unchained
@@ -2832,10 +2957,10 @@ class JaxEngine:
 
     def _dispatch_decode(self, tokens, positions, counters, counts, table,
                          samp, seeds, penalized, with_top, chain_len,
-                         rope_off=None, greedy=False):
+                         rope_off=None, greedy=False, n_steps=None):
         """Issue the chained decode dispatches (identical on leader and
         followers); returns the per-block packed outputs."""
-        step = self._get_decode_step(penalized, with_top, greedy)
+        step = self._get_decode_step(penalized, with_top, greedy, n_steps)
         tok_d = self._put(tokens, self._bax)
         pos_d = self._put(positions, self._bax)
         ctr_d = self._put(counters, self._bax)
@@ -2940,6 +3065,7 @@ class JaxEngine:
                         desc["penalized"], desc["with_top"],
                         desc["chain_len"], rope_off=desc.get("rope_off"),
                         greedy=desc.get("greedy", False),
+                        n_steps=desc.get("n_steps"),
                     )
                 elif kind == "mixed":
                     a = desc["arrays"]
@@ -2958,6 +3084,7 @@ class JaxEngine:
                         d_seeds, desc["penalized"], desc["with_top"],
                         rope_off=desc.get("rope_off"),
                         greedy=desc.get("greedy", False),
+                        n_steps=desc.get("n_steps"),
                     )
                 elif kind == "spec":
                     a = desc["arrays"]
@@ -3563,10 +3690,35 @@ class JaxEngine:
     def _append_token(self, seq: Sequence, token: int, logprob: float,
                       tops=None) -> None:
         seq.output_tokens.append(token)
+        if len(seq.output_tokens) == 1:
+            self._note_first_token(seq)
         reason = self.scheduler.check_stop(seq, self.eos_token_ids)
         if reason:
             self.scheduler.finish(seq, reason)
         self._deliver(seq, [token], reason, logprob, tops)
+
+    def _note_first_token(self, seq: Sequence) -> None:
+        """Attribute this request's TTFT (block-wait / queue-wait /
+        prefill) into the engine totals and stage the per-request dict
+        on the sequence — the next delivered delta carries it to the
+        frontend (one-shot, unlike the cumulative spec stats: the first
+        delta of a stream is always consumed)."""
+        if seq.t_first_token is not None or seq.t_arrival is None:
+            return
+        now = time.monotonic()
+        seq.t_first_token = now
+        seen = seq.t_seen if seq.t_seen is not None else seq.t_arrival
+        admitted = seq.t_admitted if seq.t_admitted is not None else seen
+        attr = {
+            "block_wait_ms": max(0.0, (seen - seq.t_arrival) * 1e3),
+            "queue_wait_ms": max(0.0, (admitted - seen) * 1e3),
+            "prefill_ms": max(0.0, (now - admitted) * 1e3),
+        }
+        seq.ttft_attr = attr
+        self._ttft_block_wait_ms_total += attr["block_wait_ms"]
+        self._ttft_queue_wait_ms_total += attr["queue_wait_ms"]
+        self._ttft_prefill_ms_total += attr["prefill_ms"]
+        self._ttft_attributed_total += 1
 
     def _deliver(
         self,
@@ -3597,6 +3749,10 @@ class JaxEngine:
                 "draft_tokens": seq.spec_draft_tokens,
                 "accepted_tokens": seq.spec_accepted_tokens,
             }
+        if seq.ttft_attr is not None:
+            # one-shot TTFT attribution on the first-token delta
+            out["ttft"] = seq.ttft_attr
+            seq.ttft_attr = None
         # may be called from the executor thread — hop back to the loop
         self._post_threadsafe(queue, out)
 

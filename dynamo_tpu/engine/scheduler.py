@@ -21,6 +21,7 @@ Policy (vLLM-style):
 from __future__ import annotations
 
 import logging
+import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Deque, List, Optional, Sequence as Seq, Tuple
@@ -93,6 +94,17 @@ class Sequence:
         # aggregate per-model acceptance)
         self.spec_draft_tokens = 0
         self.spec_accepted_tokens = 0
+        # TTFT attribution timestamps (time.monotonic): request enqueued
+        # at the engine; first seen by the scheduler (the gap is the
+        # in-flight decode block the pump was committed to — what the
+        # block ladder shortens); admitted to running; first token
+        # sampled.  `ttft_attr` is the one-shot attribution dict the
+        # first delivered delta carries to the frontend.
+        self.t_arrival: Optional[float] = None
+        self.t_seen: Optional[float] = None
+        self.t_admitted: Optional[float] = None
+        self.t_first_token: Optional[float] = None
+        self.ttft_attr: Optional[dict] = None
 
     @property
     def total_len(self) -> int:
@@ -144,6 +156,12 @@ class Scheduler:
         # optional multi-tier onboarding hook (KVBM): called with the hash
         # run missed by the device cache, returns onboarded page ids
         self.onboard_fn = None
+        # block-ladder ramp position: 0 = shortest rung.  Reset whenever
+        # prompts are pending; climbs one rung per quiet dispatch so the
+        # engine eases back into full blocks instead of jumping (a burst
+        # straggler arriving right after the queue drains still finds a
+        # short block in flight)
+        self._rung_idx = 0
 
     def drain_errored(self) -> List[Sequence]:
         out, self.errored = self.errored, []
@@ -155,6 +173,8 @@ class Scheduler:
         if seq.prompt_len + seq.opts.max_tokens > self.cfg.max_model_len:
             # clamp generation budget to the model window
             seq.opts.max_tokens = max(0, self.cfg.max_model_len - seq.prompt_len)
+        if seq.t_seen is None:
+            seq.t_seen = time.monotonic()
         self.waiting.append(seq)
 
     def abort(self, request_id: str) -> None:
@@ -179,26 +199,38 @@ class Scheduler:
     def _watermark_pages(self) -> int:
         return int(self.cfg.watermark * self.cfg.usable_pages)
 
+    def _admit_check(self, seq: Sequence) -> Tuple[bool, int]:
+        """(admissible, rank): the non-mutating capacity half of
+        admission — the single source of truth shared by `_try_admit`
+        and `prompts_pending`, so the block-ladder policy can never
+        desynchronize from real admissibility."""
+        first_chunk = min(seq.prompt_len, self.cfg.max_prefill_tokens)
+        need = seq.pages_needed(first_chunk, self.cfg.page_size)
+        if seq.num_computed > 0 or self.pool.ranks == 1:
+            # imported KV keeps the rank its pages live on; single
+            # pools skip partition scoring entirely
+            rank = seq.kv_rank
+        else:
+            # pick the pool partition: longest cached prefix wins,
+            # ties spread by availability
+            rank, _ = self.pool.best_rank(self._seq_hashes(seq))
+        ok = self.pool.available_on(rank) >= need + self._watermark_pages()
+        return ok, rank
+
     def _try_admit(self) -> None:
         while self.waiting and len(self.running) < self.cfg.max_num_seqs:
             seq = self.waiting[0]
-            first_chunk = min(seq.prompt_len, self.cfg.max_prefill_tokens)
-            need = seq.pages_needed(first_chunk, self.cfg.page_size)
-            if seq.num_computed > 0 or self.pool.ranks == 1:
-                # imported KV keeps the rank its pages live on; single
-                # pools skip partition scoring entirely
-                rank = seq.kv_rank
-            else:
-                # pick the pool partition: longest cached prefix wins,
-                # ties spread by availability
-                rank, _ = self.pool.best_rank(self._seq_hashes(seq))
-            if self.pool.available_on(rank) < need + self._watermark_pages():
+            ok, rank = self._admit_check(seq)
+            if not ok:
                 break
             seq.kv_rank = rank
             self.waiting.popleft()
             if self.cfg.enable_prefix_caching:
                 self._apply_prefix_cache(seq)
             seq.status = "running"
+            if seq.t_admitted is None:  # keep the FIRST admission:
+                # re-admission after preemption is not queue wait
+                seq.t_admitted = time.monotonic()
             self.running.append(seq)
 
     def _seq_hashes(self, seq: Sequence) -> List[int]:
@@ -221,6 +253,8 @@ class Scheduler:
     def add_imported(self, seq: Sequence) -> None:
         """Admit a sequence whose KV was injected externally (disagg decode
         side): pages and num_computed are already set; skip prefix cache."""
+        if seq.t_seen is None:
+            seq.t_seen = time.monotonic()
         self.waiting.append(seq)
 
     def _apply_prefix_cache(self, seq: Sequence) -> None:
@@ -246,6 +280,88 @@ class Scheduler:
             seq.committed_pages = len(hit_pages)
 
     # -- planning ------------------------------------------------------------ #
+
+    def _head_admissible(self) -> bool:
+        """Could the head-of-queue prompt be admitted right now?  The
+        same `_admit_check` `_try_admit` runs, minus the mutation."""
+        if not self.waiting or len(self.running) >= self.cfg.max_num_seqs:
+            return False
+        return self._admit_check(self.waiting[0])[0]
+
+    def prompts_pending(self) -> bool:
+        """True when a prompt could make progress next plan — a running
+        sequence still mid-chunked-prefill, or an ADMISSIBLE waiting
+        prompt — i.e. the states whose TTFT a committed full decode
+        block would hold hostage.  A waiting prompt that CANNOT be
+        admitted (pages/slots exhausted) is excluded on purpose: short
+        rungs buy it nothing (it is blocked on capacity, not on the
+        in-flight block — that wait lands in queue-wait, not
+        block-wait), and pinning every decode to 1-step unchained
+        dispatches for its whole wait would tax the running streams'
+        ITL indefinitely.  `_chain_ok` still refuses chaining while
+        anything waits, so once capacity frees the prompt is admitted
+        within at most one (full) block."""
+        return any(
+            not s.prefill_done for s in self.running
+        ) or self._head_admissible()
+
+    def select_decode_rung(self) -> Tuple[int, bool]:
+        """(n_steps, allow_chain) for the next decode-bearing dispatch
+        (pure decode, mixed, or the fused prefill→decode chain).
+
+        Policy (the block ladder, ISSUE 2 / Sarathi-Serve's stall-free
+        property in host-side form): while prompts are pending, dispatch
+        the SHORTEST rung with chaining suppressed, so the pump replans
+        — and the waiting prompt rides a mixed dispatch — within one
+        short block instead of `chain × decode_steps` steps.  Once the
+        queue drains, climb one rung per quiet dispatch back to the full
+        block; chaining is only allowed at the top rung (a chain is a
+        commitment of chain × n_steps steps, exactly what short rungs
+        exist to avoid).
+
+        Page reservation is unaffected: `decode_advance` covers the
+        worst case (`decode_steps`, or the 1+k speculative chunk) and
+        every rung is <= decode_steps, so a rung switch never outgrows
+        the reserved tables — including under speculative-verify
+        reservations."""
+        ladder = self.cfg.block_ladder
+        if len(ladder) == 1:
+            return ladder[-1], True
+        # ONE pending evaluation per call: prompts_pending walks the
+        # running list and scores head-of-queue admissibility — pump
+        # hot-path work the ladder exists to keep short
+        pending = self.prompts_pending()
+        rung = self._rung_for(pending)
+        self._rung_idx = (0 if pending
+                          else min(self._rung_idx + 1, len(ladder) - 1))
+        return rung
+
+    def peek_decode_rung(self) -> Tuple[int, bool]:
+        """`select_decode_rung` without the ramp advance — for callers
+        that may still abort the dispatch (the fused path's page
+        extension): a rung is only consumed when a block actually
+        dispatches."""
+        ladder = self.cfg.block_ladder
+        if len(ladder) == 1:
+            return ladder[-1], True
+        return self._rung_for(self.prompts_pending())
+
+    def _rung_for(self, pending: bool) -> Tuple[int, bool]:
+        ladder = self.cfg.block_ladder
+        if pending:
+            return ladder[0], False
+        idx = min(self._rung_idx, len(ladder) - 1)
+        return ladder[idx], idx == len(ladder) - 1
+
+    def commit_decode_rung(self) -> None:
+        """Advance the ramp for a dispatch whose rung was taken via
+        `peek_decode_rung` (the fused path: its eligibility already
+        guaranteed no prompts were pending, so this is always the
+        quiet-ramp advance — no second pending evaluation, and the
+        committed rung is exactly the peeked one)."""
+        ladder = self.cfg.block_ladder
+        if len(ladder) > 1:
+            self._rung_idx = min(self._rung_idx + 1, len(ladder) - 1)
 
     def schedule(self) -> StepPlan:
         self._try_admit()

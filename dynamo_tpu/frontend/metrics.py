@@ -46,6 +46,33 @@ class FrontendMetrics:
             buckets=_ITL_BUCKETS,
             registry=self.registry,
         )
+        # TTFT attribution (block ladder, docs/adaptive_dispatch.md):
+        # the engine splits each request's TTFT into block-wait (the
+        # in-flight decode block the pump was committed to at arrival),
+        # queue-wait (scheduler admission) and prefill, and ships the
+        # split on the first delivered delta — so a TTFT regression is
+        # attributable from /metrics alone, not inferred
+        self.ttft_block_wait = Histogram(
+            "dynamo_frontend_ttft_block_wait_seconds",
+            "TTFT share spent behind the in-flight decode block",
+            ["model"],
+            buckets=_TTFT_BUCKETS,
+            registry=self.registry,
+        )
+        self.ttft_queue_wait = Histogram(
+            "dynamo_frontend_ttft_queue_wait_seconds",
+            "TTFT share spent waiting for scheduler admission",
+            ["model"],
+            buckets=_TTFT_BUCKETS,
+            registry=self.registry,
+        )
+        self.ttft_prefill = Histogram(
+            "dynamo_frontend_ttft_prefill_seconds",
+            "TTFT share spent prefilling the prompt",
+            ["model"],
+            buckets=_TTFT_BUCKETS,
+            registry=self.registry,
+        )
         self.duration = Histogram(
             "dynamo_frontend_request_duration_seconds",
             "Whole-request duration",
@@ -82,6 +109,19 @@ class FrontendMetrics:
             registry=self.registry,
         )
         self._spec_windows: dict = {}  # model -> deque[(draft, accepted)]
+
+    def observe_ttft_attr(self, model: str, ttft: dict) -> None:
+        """Account one request's engine-side TTFT attribution ({
+        block_wait_ms, queue_wait_ms, prefill_ms} — the one-shot dict
+        riding the first-token delta)."""
+        for hist, key in (
+            (self.ttft_block_wait, "block_wait_ms"),
+            (self.ttft_queue_wait, "queue_wait_ms"),
+            (self.ttft_prefill, "prefill_ms"),
+        ):
+            v = ttft.get(key)
+            if isinstance(v, (int, float)) and v >= 0:
+                hist.labels(model).observe(v / 1e3)
 
     def observe_spec(self, model: str, spec: dict) -> None:
         """Account one request's speculative stats ({draft_tokens,

@@ -565,6 +565,8 @@ class HttpService:
                 ntokens += len(out.get("token_ids", []))
                 if out.get("spec"):  # cumulative: the last delta seen
                     spec_seen[i] = out["spec"]  # carries the totals
+                if out.get("ttft"):  # one-shot, first-token delta only
+                    self.metrics.observe_ttft_attr(model_name, out["ttft"])
                 finish = out.get("finish_reason")
                 if parsers is not None:
                     if finish:
@@ -617,6 +619,7 @@ class HttpService:
         tops: list = []
         finish_reason = None
         spec = None
+        ttft = None
         async for out in entry.generate(preq, context):
             if out.get("finish_reason") == "error":
                 return {"error": out.get("error", "engine error")}
@@ -625,6 +628,7 @@ class HttpService:
             logprobs.extend(out.get("log_probs", []))
             tops.extend(out.get("top_logprobs", []))
             spec = out.get("spec") or spec
+            ttft = out.get("ttft") or ttft
             finish_reason = out.get("finish_reason") or finish_reason
         return {
             "text": "".join(text_parts),
@@ -634,6 +638,7 @@ class HttpService:
             "top_logprobs": tops,
             "finish_reason": finish_reason or "stop",
             "spec": spec,
+            "ttft": ttft,
         }
 
     async def _unary_response(
@@ -672,6 +677,8 @@ class HttpService:
         for r in results:
             if r.get("spec"):
                 self.metrics.observe_spec(model_name, r["spec"])
+            if r.get("ttft"):
+                self.metrics.observe_ttft_attr(model_name, r["ttft"])
         token_count = sum(r["token_count"] for r in results)
         usage = {
             "prompt_tokens": prompt_tokens,

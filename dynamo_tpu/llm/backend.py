@@ -79,7 +79,8 @@ async def postprocess_stream(
         reason = out.get("finish_reason")
         passthrough = {
             k: out[k]
-            for k in ("log_probs", "top_logprobs", "spec") if k in out
+            for k in ("log_probs", "top_logprobs", "spec", "ttft")
+            if k in out
         }
         if post.finished_by_stop is not None:
             yield {"text": text, "finish_reason": "stop",

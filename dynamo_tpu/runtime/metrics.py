@@ -104,16 +104,16 @@ class _Partial:
 
 
 # ForwardPassMetrics fields that are monotonic counters (so rate() is
-# well-typed on the exposed series); everything else exports as a gauge
+# well-typed on the exposed series); everything else exports as a gauge.
+# Any stat named `*_total` is ALSO treated as a counter — this list only
+# needs the counters whose names don't say so (ForwardPassMetrics grows
+# dynamic `*_total` counter attrs, e.g. the per-rung
+# `decode_rung{n}_dispatches_total` block-ladder histogram and the
+# `ttft_*_ms_total` attribution accumulators, that cannot be enumerated
+# here).
 ENGINE_COUNTER_STATS = (
-    "num_requests_total",
     "kv_transfer_count",
     "kv_transfer_device_count",
-    "kv_transfer_ms_total",
-    "kv_transfer_bytes_total",
-    "kvbm_onboarded_blocks_total",
-    "spec_draft_tokens_total",
-    "spec_accepted_tokens_total",
 )
 # prometheus appends _total to counter families: name these so the
 # exposed series match the dashboard queries exactly
@@ -153,7 +153,9 @@ class EngineStatsCollector:
             if not isinstance(value, (int, float)) or isinstance(value, bool):
                 continue
             name = f"dynamo_tpu_worker_{ENGINE_STAT_RENAMES.get(key, key)}"
-            fam_cls = (CounterMetricFamily if key in ENGINE_COUNTER_STATS
+            is_counter = (key in ENGINE_COUNTER_STATS
+                          or key.endswith("_total"))
+            fam_cls = (CounterMetricFamily if is_counter
                        else GaugeMetricFamily)
             if fam_cls is CounterMetricFamily and name.endswith("_total"):
                 name = name[: -len("_total")]  # client re-appends

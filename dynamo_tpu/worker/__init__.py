@@ -72,7 +72,7 @@ class DpRankEngine:
         """Aggregate snapshot (per-rank states publish separately)."""
         per = [e.metrics() for e in self.engines]
         drafted = sum(m.spec_draft_tokens_total for m in per)
-        return ForwardPassMetrics(
+        agg = ForwardPassMetrics(
             active_seqs=sum(m.active_seqs for m in per),
             waiting_seqs=sum(m.waiting_seqs for m in per),
             kv_usage=sum(m.kv_usage for m in per) / len(per),
@@ -82,13 +82,28 @@ class DpRankEngine:
             spec_accepted_tokens_total=sum(
                 m.spec_accepted_tokens_total for m in per
             ),
+            spec_dispatches_total=sum(m.spec_dispatches_total for m in per),
             # lifetime ratio across ranks (the per-rank rolling windows
             # don't aggregate meaningfully)
             spec_acceptance_rate=(
                 sum(m.spec_accepted_tokens_total for m in per) / drafted
                 if drafted else 0.0
             ),
+            ttft_block_wait_ms_total=sum(
+                m.ttft_block_wait_ms_total for m in per
+            ),
+            ttft_queue_wait_ms_total=sum(
+                m.ttft_queue_wait_ms_total for m in per
+            ),
+            ttft_prefill_ms_total=sum(m.ttft_prefill_ms_total for m in per),
+            ttft_attributed_total=sum(m.ttft_attributed_total for m in per),
         )
+        # per-rung dispatch counters are dynamic attrs — sum the union
+        # across ranks so the block-ladder histogram survives dp>1
+        for key in {k for m in per for k in vars(m)
+                    if k.startswith("decode_rung")}:
+            setattr(agg, key, sum(getattr(m, key, 0) for m in per))
+        return agg
 
     def clear_kv_blocks(self) -> int:
         return sum(e.clear_kv_blocks() for e in self.engines)

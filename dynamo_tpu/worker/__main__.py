@@ -13,6 +13,20 @@ import logging
 import signal
 
 
+def _ladder_arg(s: str):
+    """Comma-separated rung list for --decode-block-ladder (empty →
+    None, i.e. fixed blocks); a clean usage error on malformed input."""
+    if not s:
+        return None
+    try:
+        return [int(r) for r in s.split(",") if r.strip()] or None
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"invalid ladder {s!r}: expected comma-separated ints, "
+            f"e.g. 1,4,8"
+        )
+
+
 def build_parser() -> argparse.ArgumentParser:
     """The worker's argparse surface, exposed so deployment graphs and
     recipe tests can validate worker argv without starting a worker."""
@@ -52,6 +66,15 @@ def build_parser() -> argparse.ArgumentParser:
                          "Raise on remote-attached chips (bench.py sweep)")
     ap.add_argument("--decode-chain", type=int, default=1,
                     help="decode dispatches in flight before fetching")
+    ap.add_argument("--decode-block-ladder", type=_ladder_arg, default=None,
+                    help="adaptive decode-block sizing: comma-separated "
+                         "rung sizes (e.g. 1,4,16) compiled alongside "
+                         "--decode-steps; the scheduler runs full blocks "
+                         "while the prompt queue is empty and drops to "
+                         "the shortest rung (chaining suppressed) the "
+                         "moment prompts are pending, so a waiting "
+                         "prompt's first chunk rides the next dispatch. "
+                         "Empty disables (fixed blocks)")
     ap.add_argument("--speculative-ngram-k", type=int, default=0,
                     help="self-speculative decoding: draft K tokens per "
                          "decode dispatch from the sequence's own history "
@@ -162,6 +185,7 @@ def check_args(ap: argparse.ArgumentParser, args) -> None:
     if args.mock and (args.quantization != "none"
                       or args.attention_impl != "auto"
                       or args.decode_steps != 1 or args.decode_chain != 1
+                      or args.decode_block_ladder
                       or args.speculative_ngram_k
                       or args.no_prefix_caching or args.vision
                       or args.encode_component):
@@ -197,6 +221,7 @@ def engine_config_from_args(args):
         attention_impl=args.attention_impl,
         decode_steps=args.decode_steps,
         decode_chain=args.decode_chain,
+        decode_block_ladder=args.decode_block_ladder,
         speculative_ngram_k=args.speculative_ngram_k,
         mixed_prefill_tokens=args.mixed_prefill_tokens,
         kv_partition=args.kv_partition,

@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""Ablate adaptive decode-block sizing (the block ladder): sweep rung
+policy × Poisson arrival rate on the mock/CPU engine and report TTFT
+and its attribution per point.
+
+Runs under `JAX_PLATFORMS=cpu python scripts/ablate_block_ladder.py`
+(CI-safe: tiny model, no chip).  Each point drives one long-running
+decode stream plus Poisson prompt arrivals — the exact interference
+pattern the ladder targets: with fixed blocks an arrival waits out the
+in-flight `chain × decode_steps`-step commitment before its first
+chunk is admitted; with the ladder the scheduler drops to short blocks
+the moment the queue is non-empty.
+
+Emits ONE JSON line PER CONFIG (policy × rate), each carrying TTFT
+percentiles over the arrivals, the engine's own TTFT attribution
+(block-wait vs queue-wait vs prefill) and the chosen-rung histogram.
+"""
+
+import asyncio
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.engine import EngineConfig, JaxEngine
+from dynamo_tpu.models import init_params, tiny_config
+
+POLICIES = {
+    "fixed": None,          # one decode_steps block, chaining allowed
+    "ladder": [1, 2, 4],    # + decode_steps appended as the top rung
+}
+RATES = (4.0, 8.0, 16.0)    # Poisson prompt arrivals per second
+N_ARRIVALS = 6
+PROMPT_LEN = 24
+DECODE_STEPS = 8
+
+
+def _req(tokens, gen, temperature=0.0):
+    return {
+        "token_ids": tokens,
+        "sampling_options": {"temperature": temperature},
+        "stop_conditions": {"max_tokens": gen, "ignore_eos": True},
+    }
+
+
+def _pct(xs, q):
+    s = sorted(xs)
+    return s[min(len(s) - 1, int(len(s) * q))]
+
+
+async def _measure(cfg, params, ladder, rate, seed=11):
+    engine = JaxEngine(
+        cfg, params,
+        EngineConfig(
+            page_size=8, num_pages=256, max_num_seqs=8,
+            max_prefill_tokens=2 * PROMPT_LEN, max_model_len=256,
+            decode_steps=DECODE_STEPS, decode_chain=2,
+            decode_block_ladder=ladder,
+        ),
+        eos_token_ids=[], kv_dtype=jnp.float32,
+    )
+    rng = random.Random(seed)
+
+    async def base():
+        # the long-running decode stream arrivals interfere with
+        async for out in engine.generate(
+            _req([((7 * j) % 101) + 1 for j in range(PROMPT_LEN)], 160)
+        ):
+            assert out.get("finish_reason") != "error", out
+
+    async def arrival(i, wait):
+        await asyncio.sleep(wait)
+        t0 = time.perf_counter()
+        ttft = None
+        async for out in engine.generate(
+            _req([((i * 13 + j) % 97) + 1 for j in range(PROMPT_LEN)], 4)
+        ):
+            assert out.get("finish_reason") != "error", out
+            if ttft is None and out["token_ids"]:
+                ttft = (time.perf_counter() - t0) * 1e3
+        return ttft
+
+    # warm every program (prefill/decode/mixed at whatever rungs the
+    # policy picks) off the clock
+    await base()
+    await asyncio.gather(base(), arrival(99, 0.2))
+    m0 = engine.metrics()
+    hist0 = engine.rung_histogram  # warmup walks the ladder by design
+
+    waits, acc = [], 0.3  # let the base stream get going first
+    for _ in range(N_ARRIVALS):
+        acc += rng.expovariate(rate)
+        waits.append(acc)
+    results = await asyncio.gather(
+        base(), *[arrival(i, w) for i, w in enumerate(waits)]
+    )
+    ttfts = [t for t in results[1:] if t is not None]
+    m = engine.metrics()
+    hist = {k: v - hist0.get(k, 0)
+            for k, v in engine.rung_histogram.items()
+            if v - hist0.get(k, 0)}
+    await engine.shutdown()
+    n = max(m.ttft_attributed_total - m0.ttft_attributed_total, 1)
+    return {
+        "ttft_p50_ms": round(_pct(ttfts, 0.5), 2),
+        "ttft_p90_ms": round(_pct(ttfts, 0.9), 2),
+        "arrivals": len(ttfts),
+        "ttft_attribution_ms": {
+            "block_wait_mean": round(
+                (m.ttft_block_wait_ms_total
+                 - m0.ttft_block_wait_ms_total) / n, 2),
+            "queue_wait_mean": round(
+                (m.ttft_queue_wait_ms_total
+                 - m0.ttft_queue_wait_ms_total) / n, 2),
+            "prefill_mean": round(
+                (m.ttft_prefill_ms_total
+                 - m0.ttft_prefill_ms_total) / n, 2),
+        },
+        "rung_dispatches": {str(k): v for k, v in sorted(hist.items())},
+    }
+
+
+async def main_async():
+    cfg = tiny_config()
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    for policy, ladder in POLICIES.items():
+        for rate in RATES:
+            res = await _measure(cfg, params, ladder, rate)
+            print(json.dumps({
+                "metric": "block_ladder_ablation",
+                "policy": policy,
+                "decode_steps": DECODE_STEPS,
+                "ladder": ladder,
+                "arrival_rate_rps": rate,
+                **res,
+            }), flush=True)
+            print(
+                f"# {policy:6s} rate={rate:5.1f}: "
+                f"ttft_p50={res['ttft_p50_ms']:.1f}ms "
+                f"block_wait={res['ttft_attribution_ms']['block_wait_mean']:.1f}ms",
+                file=sys.stderr, flush=True,
+            )
+
+
+def main():
+    asyncio.run(main_async())
+
+
+if __name__ == "__main__":
+    main()

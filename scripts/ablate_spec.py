@@ -66,7 +66,7 @@ async def _measure(cfg, params, k, prompt):
         assert out.get("finish_reason") != "error", out
         n += len(out["token_ids"])
     m = engine.metrics()
-    dispatches = engine._spec_dispatch_total  # noqa: SLF001
+    dispatches = m.spec_dispatches_total
     await engine.shutdown()
     tpd = ((m.spec_accepted_tokens_total + dispatches) / dispatches
            if dispatches else 1.0)

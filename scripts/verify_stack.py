@@ -280,6 +280,36 @@ def main():
               f"{m['spec_draft_tokens_total']} drafted / "
               f"{m['spec_accepted_tokens_total']} accepted")
 
+        # block-ladder worker (adaptive decode-block sizing): greedy
+        # output must be token-identical to the plain workers' (rung
+        # schedules are output-invisible), and the TTFT attribution +
+        # chosen-rung telemetry must land on BOTH /metrics surfaces
+        lw_status = free_port()
+        spawn([*worker_args, "--model-name", "tiny-ladder",
+               "--decode-steps", "8", "--decode-block-ladder", "1,2",
+               "--status-port", str(lw_status)], "ladder-worker")
+        deadline = time.time() + 30
+        while True:
+            models = http_json(f"{base}/v1/models")
+            if "tiny-ladder" in [m["id"] for m in models["data"]]:
+                break
+            assert time.time() < deadline, models
+            time.sleep(0.5)
+        out = http_json(f"{base}/v1/chat/completions",
+                        {**chat, "model": "tiny-ladder"})
+        assert out["choices"][0]["message"]["content"] == text1, out
+        m = http_json(f"http://127.0.0.1:{lw_status}/metrics.json")
+        assert m.get("ttft_attributed_total", 0) > 0, m
+        rungs = {k: v for k, v in m.items()
+                 if k.startswith("decode_rung")}
+        assert rungs, m
+        with urllib.request.urlopen(f"{base}/metrics", timeout=30) as r:
+            fprom = r.read().decode()
+        assert ("dynamo_frontend_ttft_block_wait_seconds_count"
+                '{model="tiny-ladder"}') in fprom, fprom[-1500:]
+        print(f"OK block-ladder worker: greedy-identical to plain, "
+              f"rungs {rungs}, ttft attribution on both /metrics")
+
         # kill worker1 → requests keep working on worker2
         w1.send_signal(signal.SIGKILL)
         time.sleep(7)  # > lease TTL
@@ -287,7 +317,7 @@ def main():
         assert out["choices"][0]["message"]["content"] == text1
         models = http_json(f"{base}/v1/models")
         assert set(m["id"] for m in models["data"]) == {
-            "tiny-chat", "tiny-vlm", "tiny-spec"}
+            "tiny-chat", "tiny-vlm", "tiny-spec", "tiny-ladder"}
         print("OK survives worker kill")
 
         print("VERIFY PASS")
