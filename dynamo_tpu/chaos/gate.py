@@ -1,0 +1,172 @@
+"""Process-local fault-injection gate.
+
+The reference proves fault tolerance with a `tests/fault_tolerance/` suite
+that kills live workers under traffic; the failure *mechanisms* there are
+real (SIGKILL, dropped sockets).  For the failure modes that are awkward to
+produce from outside a process — a control-plane partition, a dropped disagg
+handoff, an engine that wedges while its process stays healthy — dynamo_tpu
+instruments a handful of points in the transports and handlers with a chaos
+gate: a module-global that is ``None`` in production (one attribute read per
+request) and, when installed by the chaos harness, decides per *point*
+whether to raise, delay, or block.
+
+Points instrumented in product code:
+
+- ``control.call``    — ControlPlaneClient._call (partition from control plane)
+- ``service.call``    — ServiceClient.call_stream (drop a worker stream)
+- ``worker.generate`` — EngineWorker.handle (wedge: accept, never yield)
+- ``disagg.handoff``  — DisaggDecodeHandler remote-prefill path (drop/delay
+  the next KV handoff)
+
+Faults are armed with a *kind* (partition | drop | delay | wedge), an
+optional ``count`` (fire N times then disarm) and/or ``duration_s``
+(self-heal on a monotonic deadline — the only way a *partition* can end,
+since the disarm channel is the thing being partitioned).  Every applied
+fault increments a ``fired`` counter the scenario runner asserts on.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+# fault kinds
+PARTITION = "partition"  # raise ConnectionError at the point
+DROP = "drop"            # raise the point's retryable error
+DELAY = "delay"          # sleep delay_s, then proceed
+WEDGE = "wedge"          # block until disarmed/expired, then proceed
+
+
+@dataclass
+class ArmedFault:
+    kind: str
+    duration_s: float = 0.0  # 0 = until disarmed
+    count: int = 0           # >0 = fire at most N times, then disarm
+    delay_s: float = 0.0
+    armed_at: float = field(default_factory=time.monotonic)
+    fired: int = 0
+
+    def expired(self) -> bool:
+        return (self.duration_s > 0
+                and time.monotonic() - self.armed_at >= self.duration_s)
+
+
+class FaultGate:
+    """One per process; hooks consult :func:`gate_check`."""
+
+    _active: Optional["FaultGate"] = None
+
+    def __init__(self) -> None:
+        self._faults: Dict[str, ArmedFault] = {}
+        self.fired: Dict[str, int] = {}
+
+    # -- lifecycle ----------------------------------------------------------- #
+
+    @classmethod
+    def install(cls) -> "FaultGate":
+        if cls._active is None:
+            cls._active = cls()
+        return cls._active
+
+    @classmethod
+    def uninstall(cls) -> None:
+        cls._active = None
+
+    @classmethod
+    def active(cls) -> Optional["FaultGate"]:
+        return cls._active
+
+    # -- arming -------------------------------------------------------------- #
+
+    def arm(self, point: str, kind: str, *, duration_s: float = 0.0,
+            count: int = 0, delay_s: float = 0.0) -> ArmedFault:
+        if kind == WEDGE and count:
+            # a count-scoped wedge would be popped by consume() before
+            # wedge_wait ever blocks — wedges are duration/disarm-scoped
+            raise ValueError("wedge faults take duration_s (or an explicit "
+                             "disarm), not count")
+        fault = ArmedFault(kind=kind, duration_s=duration_s, count=count,
+                           delay_s=delay_s)
+        self._faults[point] = fault
+        return fault
+
+    def disarm(self, point: str) -> None:
+        self._faults.pop(point, None)
+
+    def heal_all(self) -> None:
+        self._faults.clear()
+
+    def armed(self, point: str) -> Optional[ArmedFault]:
+        fault = self._faults.get(point)
+        if fault is None:
+            return None
+        if fault.expired():
+            self._faults.pop(point, None)
+            return None
+        return fault
+
+    # -- hook side ----------------------------------------------------------- #
+
+    def consume(self, point: str) -> Optional[ArmedFault]:
+        """An instrumented point asking whether to fault.  Returns the
+        fault to apply (and accounts the firing), or None."""
+        fault = self.armed(point)
+        if fault is None:
+            return None
+        if fault.count > 0:
+            fault.count -= 1
+            if fault.count == 0:
+                self._faults.pop(point, None)
+        fault.fired += 1
+        self.fired[point] = self.fired.get(point, 0) + 1
+        return fault
+
+    async def wedge_wait(self, point: str) -> None:
+        """Block while a wedge at `point` is active (the wedged handler
+        *accepts* the request and simply never yields)."""
+        while True:
+            fault = self._faults.get(point)
+            if fault is None or fault.kind != WEDGE or fault.expired():
+                return
+            await asyncio.sleep(0.02)
+
+
+def gate_check(point: str) -> Optional[ArmedFault]:
+    """Sync fault check for hook points that cannot await (and for
+    tests).  Instrumented product paths use :func:`gate_async_check`,
+    which can also apply DELAY/WEDGE semantics.  ``None`` (the
+    overwhelmingly common case) costs a global read and a None test."""
+    gate = FaultGate._active
+    if gate is None:
+        return None
+    return gate.consume(point)
+
+
+async def gate_async_check(point: str, retryable_exc=None,
+                           on_partition=None) -> None:
+    """Apply whatever fault is armed at `point`: DELAY sleeps, WEDGE blocks
+    until healed, PARTITION calls `on_partition` (e.g. sever the live
+    socket) then raises ConnectionError, DROP raises `retryable_exc` (the
+    point's retryable error class)."""
+    gate = FaultGate._active  # captured: uninstall() must not race a wedge
+    if gate is None:
+        return
+    fault = gate.consume(point)
+    if fault is None:
+        return
+    if fault.kind == DELAY:
+        await asyncio.sleep(fault.delay_s)
+    elif fault.kind == WEDGE:
+        await gate.wedge_wait(point)
+    elif fault.kind == PARTITION:
+        if on_partition is not None:
+            on_partition()
+        raise ConnectionError(f"chaos: partition at {point}")
+    elif fault.kind == DROP:
+        raise (retryable_exc or ConnectionError)(f"chaos: dropped at {point}")
+
+
+def gate_active() -> Optional[FaultGate]:
+    return FaultGate._active

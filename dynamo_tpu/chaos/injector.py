@@ -1,0 +1,118 @@
+"""Cross-process fault arming over the control plane.
+
+The scenario runner arms gate faults in *other* processes by writing
+``/chaos/{namespace}/{target}/{point}`` keys into the control-plane KV; a
+:class:`FaultInjector` running inside each chaos-enabled process (workers
+start one when ``DYN_TPU_CHAOS=1`` — see ``worker/__main__.py``) watches the
+prefix, fnmatches ``target`` against its own identity
+(``"{component}:{instance_id}"``), and arms/disarms the process-local
+:class:`~dynamo_tpu.chaos.gate.FaultGate`.
+
+Arming rides the same transport the stack already trusts — no side channel
+to keep alive — which is also why *partition* faults carry ``duration_s``
+and self-heal: once a process is partitioned from the control plane it can
+no longer hear the disarm.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import fnmatch
+import logging
+from typing import Optional
+
+from ..runtime.transport.wire import pack, unpack
+from .gate import FaultGate
+
+logger = logging.getLogger(__name__)
+
+CHAOS_ROOT = "/chaos"
+
+
+def chaos_key(namespace: str, target: str, point: str) -> str:
+    return f"{CHAOS_ROOT}/{namespace}/{target}/{point}"
+
+
+async def arm_remote(control, namespace: str, target: str, point: str,
+                     kind: str, *, duration_s: float = 0.0, count: int = 0,
+                     delay_s: float = 0.0) -> None:
+    """Arm a gate fault in every chaos-enabled process whose identity
+    matches `target` (an fnmatch pattern, e.g. ``backend:*``)."""
+    await control.put(
+        chaos_key(namespace, target, point),
+        pack({"kind": kind, "duration_s": duration_s, "count": count,
+              "delay_s": delay_s}),
+    )
+
+
+async def disarm_remote(control, namespace: str, target: str,
+                        point: str) -> None:
+    await control.delete(chaos_key(namespace, target, point))
+
+
+class FaultInjector:
+    """In-process watcher translating /chaos keys into FaultGate state."""
+
+    def __init__(self, runtime, namespace: str = "dynamo", ident: str = ""):
+        self.runtime = runtime
+        self.namespace = namespace
+        self.ident = ident or f"proc:{runtime.primary_lease}"
+        self.gate = FaultGate.install()
+        self._task: Optional[asyncio.Task] = None
+        # key -> last applied value: a watch RECONNECT replays surviving
+        # keys as fresh puts; re-arming an identical spec would reset a
+        # duration fault's deadline and break the self-heal guarantee
+        # (re-arm the same fault by disarming first, or changing a param)
+        self._applied: dict = {}
+
+    async def start(self) -> "FaultInjector":
+        self._task = asyncio.create_task(self._watch())
+        return self
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            await asyncio.gather(self._task, return_exceptions=True)
+
+    def _parse(self, key: str):
+        """/chaos/{ns}/{target}/{point} -> (target, point) or None."""
+        prefix = f"{CHAOS_ROOT}/{self.namespace}/"
+        if not key.startswith(prefix):
+            return None
+        rest = key[len(prefix):]
+        if "/" not in rest:
+            return None
+        target, point = rest.split("/", 1)
+        if not fnmatch.fnmatch(self.ident, target):
+            return None
+        return target, point
+
+    async def _watch(self) -> None:
+        from ..runtime.transport.control_plane import watch_resilient
+
+        async for ev in watch_resilient(self.runtime.control,
+                                        f"{CHAOS_ROOT}/{self.namespace}/",
+                                        "chaos"):
+            parsed = self._parse(ev.key)
+            if parsed is None:
+                continue
+            _, point = parsed
+            if ev.type == "put":
+                if self._applied.get(ev.key) == ev.value:
+                    continue  # snapshot replay of a seen fault
+                self._applied[ev.key] = ev.value
+                spec = unpack(ev.value)
+                logger.warning("chaos: arming %s at %s (%s)",
+                               spec.get("kind"), point, self.ident)
+                self.gate.arm(
+                    point, spec["kind"],
+                    duration_s=float(spec.get("duration_s", 0.0)),
+                    count=int(spec.get("count", 0)),
+                    delay_s=float(spec.get("delay_s", 0.0)),
+                )
+            elif ev.type in ("delete", "forget"):
+                # "forget" replays a disarm that happened while the watch
+                # was down — the fault must not stay armed forever
+                logger.warning("chaos: disarming %s (%s)", point, self.ident)
+                self._applied.pop(ev.key, None)
+                self.gate.disarm(point)

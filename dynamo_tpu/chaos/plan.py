@@ -1,0 +1,87 @@
+"""Deterministic fault plans.
+
+A :class:`FaultPlan` is the declarative description of what a chaos
+scenario does to the stack: a seed (all randomized choices — e.g. *which*
+replica to SIGKILL — come from ``random.Random(seed)`` so a scenario replays
+identically) plus an ordered list of :class:`FaultSpec` entries.  Specs are
+either *runner-side* actions executed against the operator's actuator
+(``kill_replica``, ``kill_rank``) or *gate* faults armed at an instrumented
+point in some process (``partition``, ``drop``, ``delay``, ``wedge`` — see
+``chaos/gate.py``), locally or across process boundaries via the
+control-plane injector (``chaos/injector.py``).
+
+Triggers are deterministic too: ``after_tokens`` fires once the observed
+client token count crosses a threshold; ``at_s`` fires on the traffic
+clock.  Plans serialize to/from JSON so ``scripts/chaos_stack.py`` can
+replay a scenario from a file.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+from dataclasses import asdict, dataclass, field
+from typing import List, Optional
+
+# runner-side fault kinds (executed against the controller's actuator)
+KILL_REPLICA = "kill_replica"
+KILL_RANK = "kill_rank"
+# gate fault kinds re-exported for plan authors
+from .gate import DELAY, DROP, PARTITION, WEDGE  # noqa: E402,F401
+
+_RUNNER_KINDS = {KILL_REPLICA, KILL_RANK}
+_GATE_KINDS = {PARTITION, DROP, DELAY, WEDGE}
+
+
+@dataclass
+class FaultSpec:
+    kind: str                  # kill_replica|kill_rank|partition|drop|delay|wedge
+    # gate faults: which process ("component:instance_id" fnmatch pattern,
+    # "local" = the runner's own process) and which instrumented point
+    target: str = "local"
+    point: str = ""
+    # triggers (0 = immediately when the plan steps)
+    after_tokens: int = 0
+    at_s: float = 0.0
+    # parameters
+    duration_s: float = 0.0
+    count: int = 0
+    delay_s: float = 0.0
+    component: str = ""        # kill faults: actuator component name
+    replica: Optional[int] = None  # kill_replica: index; None = seeded pick
+    rank: Optional[int] = None     # kill_rank: rank in the multinode group
+
+    def __post_init__(self) -> None:
+        if self.kind not in _RUNNER_KINDS | _GATE_KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}")
+        if self.kind in _GATE_KINDS and not self.point:
+            raise ValueError(f"{self.kind} fault needs a gate point")
+        if self.kind in _RUNNER_KINDS and not self.component:
+            raise ValueError(f"{self.kind} fault needs a component")
+        if self.kind == WEDGE and self.count:
+            raise ValueError("wedge faults take duration_s, not count")
+        if (self.kind == PARTITION and self.target != "local"
+                and self.duration_s <= 0 and self.count <= 0):
+            # an unbounded remote partition can never be disarmed: the
+            # disarm channel is the thing being partitioned
+            raise ValueError("a remote partition fault needs duration_s "
+                             "(or count) — it cannot hear a disarm")
+
+
+@dataclass
+class FaultPlan:
+    seed: int = 0
+    faults: List[FaultSpec] = field(default_factory=list)
+
+    def rng(self) -> random.Random:
+        return random.Random(self.seed)
+
+    def to_json(self) -> str:
+        return json.dumps({"seed": self.seed,
+                           "faults": [asdict(f) for f in self.faults]})
+
+    @classmethod
+    def from_json(cls, text: str) -> "FaultPlan":
+        d = json.loads(text)
+        return cls(seed=int(d.get("seed", 0)),
+                   faults=[FaultSpec(**f) for f in d.get("faults", [])])

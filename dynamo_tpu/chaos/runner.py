@@ -1,0 +1,517 @@
+"""Chaos scenario runner: operator-managed graph + live traffic + faults.
+
+One :class:`ScenarioRunner` run is the full proof obligation for a fault
+scenario (ROADMAP VERDICT #9):
+
+1. stand up an operator-managed deployment — an in-process control plane, a
+   :class:`~dynamo_tpu.deploy.GraphController` whose ``LocalActuator``
+   spawns the graph's worker processes (chaos-enabled via ``DYN_TPU_CHAOS``),
+   and an in-process frontend (discovery watcher + HTTP service + the real
+   FrontendMetrics surface);
+2. drive a wave of concurrent, seeded, streaming client requests through the
+   frontend *unfaulted* and record every stream's text;
+3. drive the identical wave again while executing the scenario's
+   :class:`~dynamo_tpu.chaos.plan.FaultPlan` (SIGKILL replicas/ranks through
+   the actuator, arm gate faults locally or via the control-plane injector);
+4. assert the invariants: **zero client-visible errors**, **streams
+   identical to the unfaulted run** (the mocker's tokens are conditioned on
+   the full context, so a migrated stream must continue exactly), **the
+   controller re-converges** (observed == desired within a deadline), and
+   scenario-specific **telemetry** (``migrations_total``, health flips,
+   fault fired counts).
+
+The topology is the north-star composition's shape (frontend → operator
+graph of worker components, multinode groups included) scaled to what CI
+can run deterministically in seconds: MockEngine workers with the real
+scheduler/page-pool, slowed via ``--mock-speedup`` so kills land
+mid-stream.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import signal
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from ..deploy import GraphController, GraphSpec
+from ..frontend import (
+    FrontendMetrics,
+    HealthWatcher,
+    HttpService,
+    ModelManager,
+    ModelWatcher,
+)
+from ..runtime import ControlPlaneServer, DistributedRuntime
+from ..runtime.transport.control_plane import ControlPlaneClient
+from .gate import FaultGate
+from .injector import arm_remote, disarm_remote
+from .plan import KILL_RANK, KILL_REPLICA, FaultPlan, FaultSpec
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class TrafficSpec:
+    """One wave of concurrent streaming chat requests."""
+
+    model: str = "mock-model"
+    requests: int = 4
+    max_tokens: int = 32
+    seed_base: int = 1000
+    prompt: str = "chaos probe"
+    stagger_s: float = 0.0  # delay between request starts
+    timeout_s: float = 90.0
+
+
+@dataclass
+class StreamOutcome:
+    index: int
+    status: int = 0
+    text: str = ""
+    finish: Optional[str] = None
+    errors: List[str] = field(default_factory=list)
+    chunks: int = 0
+
+
+@dataclass
+class Scenario:
+    name: str
+    graph: str                      # deployment-graph YAML
+    traffic: TrafficSpec
+    plan: FaultPlan
+    description: str = ""
+    env: Dict[str, str] = field(default_factory=dict)  # for graph processes
+    # expected live instances per model once converged (post-fault)
+    expect_instances: int = 1
+    # extra per-scenario checks: (runner) -> dict of telemetry notes,
+    # raising AssertionError on violation
+    extra_checks: Optional[Callable[["ScenarioRunner"], Any]] = None
+    # fully custom scenarios (e.g. the in-process disagg handoff drop)
+    # bypass the graph machinery: () -> ScenarioResult
+    custom: Optional[Callable[[], Any]] = None
+
+
+@dataclass
+class ScenarioResult:
+    name: str
+    passed: bool
+    client_errors: int = 0
+    stream_mismatches: int = 0
+    streams: int = 0
+    converge_s: float = -1.0
+    migrations_total: float = 0.0
+    telemetry: Dict[str, Any] = field(default_factory=dict)
+    failure: str = ""
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "scenario": self.name,
+            "passed": self.passed,
+            "client_errors": self.client_errors,
+            "stream_mismatches": self.stream_mismatches,
+            "streams": self.streams,
+            "converge_s": round(self.converge_s, 3),
+            "migrations_total": self.migrations_total,
+            "telemetry": self.telemetry,
+            **({"failure": self.failure} if self.failure else {}),
+        })
+
+
+class ChaosStack:
+    """Control plane + operator graph + in-process frontend, shared by a
+    scenario's baseline and faulted traffic waves."""
+
+    def __init__(self, graph_yaml: str, env: Dict[str, str], log_path: str = ""):
+        self.graph_yaml = graph_yaml
+        self.env = env
+        self.log_path = log_path
+        self.control: Optional[ControlPlaneServer] = None
+        self.controller: Optional[GraphController] = None
+        self.front_rt: Optional[DistributedRuntime] = None
+        self.metrics: Optional[FrontendMetrics] = None
+        self.manager: Optional[ModelManager] = None
+        self.watcher: Optional[ModelWatcher] = None
+        self.health_watcher: Optional[HealthWatcher] = None
+        self.http: Optional[HttpService] = None
+        self.chaos_control: Optional[ControlPlaneClient] = None
+        self.last_status: Dict[str, Dict] = {}
+        self._saved_env: Dict[str, Optional[str]] = {}
+        self._log_file = None
+        self.spec: Optional[GraphSpec] = None
+
+    @property
+    def namespace(self) -> str:
+        return self.spec.namespace
+
+    @property
+    def base_url(self) -> str:
+        return f"http://127.0.0.1:{self.http.port}"
+
+    async def start(self) -> "ChaosStack":
+        # graph processes inherit os.environ — install the scenario's env
+        # (chaos enablement, health knobs, lease TTLs) for their lifetime
+        for k, v in self.env.items():
+            self._saved_env[k] = os.environ.get(k)
+            os.environ[k] = v
+        self.control = await ControlPlaneServer().start()
+        self.spec = GraphSpec.parse(self.graph_yaml)
+        self.chaos_control = await ControlPlaneClient(
+            self.control.address
+        ).connect()
+
+        async def status_cb(status):
+            self.last_status = status
+
+        if self.log_path:
+            os.makedirs(os.path.dirname(self.log_path) or ".", exist_ok=True)
+            self._log_file = open(self.log_path, "ab")
+        self.controller = GraphController(
+            self.spec, self.control.address, interval=0.25,
+            stdout=self._log_file, status_cb=status_cb,
+        )
+        await self.controller.start()
+
+        self.front_rt = await DistributedRuntime.connect(self.control.address)
+        self.metrics = FrontendMetrics()
+        self.manager = ModelManager()
+        self.watcher = await ModelWatcher(
+            self.front_rt, self.manager, metrics=self.metrics
+        ).start()
+        self.health_watcher = await HealthWatcher(
+            self.front_rt, self.metrics
+        ).start()
+        self.http = await HttpService(
+            self.manager, host="127.0.0.1", port=0, metrics=self.metrics
+        ).start()
+        return self
+
+    async def stop(self) -> None:
+        FaultGate.uninstall()
+        if self.chaos_control is not None:
+            # clear leftover /chaos keys so a reconnecting injector's
+            # snapshot replay can't re-arm an expired fault
+            try:
+                kvs = await self.chaos_control.get_prefix(
+                    f"/chaos/{self.namespace}/"
+                )
+                for key, _ in kvs:
+                    await self.chaos_control.delete(key)
+            except (ConnectionError, RuntimeError):
+                pass
+        if self.http:
+            await self.http.stop()
+        if self.health_watcher:
+            await self.health_watcher.stop()
+        if self.watcher:
+            await self.watcher.stop()
+        if self.front_rt:
+            await self.front_rt.shutdown(graceful=False)
+        if self.chaos_control:
+            await self.chaos_control.close()
+        if self.controller:
+            await self.controller.stop()
+        if self.control:
+            await self.control.stop()
+        if self._log_file:
+            self._log_file.close()
+        for k, old in self._saved_env.items():
+            if old is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = old
+
+    # -- discovery helpers --------------------------------------------------- #
+
+    async def wait_model(self, model: str, instances: int,
+                         timeout: float = 90.0) -> None:
+        """Until the frontend can actually route to `instances` live
+        workers for `model` (cards discovered AND endpoints live)."""
+        deadline = asyncio.get_running_loop().time() + timeout
+        while True:
+            entry = self.manager.get(model)
+            if entry is not None:
+                live = set(entry.client._instances) & entry.instances  # noqa: SLF001
+                if len(live) >= instances:
+                    return
+            if asyncio.get_running_loop().time() > deadline:
+                raise TimeoutError(
+                    f"model {model} never reached {instances} live "
+                    f"instance(s): entry={entry and entry.instances}"
+                )
+            await asyncio.sleep(0.1)
+
+    async def instance_ids(self, component: str,
+                           endpoint: str = "generate") -> List[int]:
+        kvs = await self.chaos_control.get_prefix(
+            f"/services/{self.namespace}/{component}/{endpoint}/"
+        )
+        return sorted(int(k.rsplit("/", 1)[-1]) for k, _ in kvs)
+
+    async def wait_converged(self, timeout: float = 90.0,
+                             model: str = "", instances: int = 0) -> float:
+        """Until the controller's observed state matches desired (and,
+        optionally, the frontend again routes to `instances` workers).
+        Returns seconds taken."""
+        t0 = time.monotonic()
+        deadline = t0 + timeout
+        while True:
+            # read the loop's own post-pass status (a second concurrent
+            # reconcile here could double-spawn replicas)
+            status = self.last_status
+            ok = bool(status) and all(
+                st.get("observed") == st.get("desired")
+                and not st.get("restarting")
+                for st in status.values()
+            )
+            if ok and model:
+                try:
+                    await self.wait_model(model, instances, timeout=0.2)
+                except TimeoutError:
+                    ok = False
+            if ok:
+                return time.monotonic() - t0
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"controller never re-converged: {status}"
+                )
+            await asyncio.sleep(0.2)
+
+    # -- traffic ------------------------------------------------------------- #
+
+    async def drive(
+        self,
+        traffic: TrafficSpec,
+        plan: Optional[FaultPlan] = None,
+        seed_offset: int = 0,
+    ) -> List[StreamOutcome]:
+        """Run one traffic wave; if `plan` is given, execute it
+        concurrently (triggers keyed on the wave's observed progress)."""
+        import aiohttp
+
+        progress = {"chunks": 0}
+        t_start = time.monotonic()
+        outcomes = [StreamOutcome(i) for i in range(traffic.requests)]
+
+        async def one(i: int, session) -> None:
+            if traffic.stagger_s:
+                await asyncio.sleep(traffic.stagger_s * i)
+            body = {
+                "model": traffic.model,
+                "messages": [{"role": "user",
+                              "content": f"{traffic.prompt} {i}"}],
+                "max_tokens": traffic.max_tokens,
+                "temperature": 0,
+                "seed": traffic.seed_base + seed_offset + i,
+                "stream": True,
+                "nvext": {"ignore_eos": True},
+            }
+            out = outcomes[i]
+            try:
+                async with session.post(
+                    f"{self.base_url}/v1/chat/completions", json=body
+                ) as resp:
+                    out.status = resp.status
+                    if resp.status != 200:
+                        out.errors.append(
+                            f"http {resp.status}: {await resp.text()}"
+                        )
+                        return
+                    async for raw in resp.content:
+                        line = raw.decode().strip()
+                        if not line.startswith("data: ") or line == "data: [DONE]":
+                            continue
+                        chunk = json.loads(line[len("data: "):])
+                        if "error" in chunk:
+                            out.errors.append(str(chunk["error"]))
+                            continue
+                        if not chunk.get("choices"):
+                            continue
+                        choice = chunk["choices"][0]
+                        delta = choice.get("delta", {})
+                        out.text += delta.get("content") or ""
+                        # every delivered delta advances the fault-trigger
+                        # clock (content may detokenize empty for special
+                        # tokens; the stream still made progress)
+                        out.chunks += 1
+                        progress["chunks"] += 1
+                        out.finish = choice.get("finish_reason") or out.finish
+            except Exception as e:  # noqa: BLE001 — a client-visible error
+                out.errors.append(f"{type(e).__name__}: {e}")
+
+        async def execute_plan() -> None:
+            if plan is None:
+                return
+            rng = plan.rng()
+            for spec in plan.faults:
+                while (progress["chunks"] < spec.after_tokens
+                       or time.monotonic() - t_start < spec.at_s):
+                    await asyncio.sleep(0.02)
+                await self._execute_fault(spec, rng)
+
+        timeout = aiohttp.ClientTimeout(total=traffic.timeout_s)
+        async with aiohttp.ClientSession(timeout=timeout) as session:
+            plan_task = asyncio.create_task(execute_plan())
+            await asyncio.gather(*(one(i, session)
+                                   for i in range(traffic.requests)))
+            try:
+                # traffic has drained; any still-waiting trigger will
+                # never advance — fail the scenario instead of hanging
+                await asyncio.wait_for(plan_task, timeout=5.0)
+            except asyncio.TimeoutError:
+                plan_task.cancel()
+                await asyncio.gather(plan_task, return_exceptions=True)
+                raise AssertionError(
+                    "fault plan never fully executed: a trigger "
+                    f"(chunks={progress['chunks']}) was unreached when "
+                    "traffic drained"
+                )
+        return outcomes
+
+    # -- fault execution ----------------------------------------------------- #
+
+    async def _execute_fault(self, spec: FaultSpec, rng) -> None:
+        logger.warning("chaos: executing %s", spec)
+        if spec.kind == KILL_REPLICA:
+            procs = self.controller.actuator._procs.get(  # noqa: SLF001
+                spec.component, [])
+            live = [p for p in procs if p.poll() is None]
+            if not live:
+                raise AssertionError(
+                    f"no live replica of {spec.component} to kill")
+            idx = (spec.replica if spec.replica is not None
+                   else rng.randrange(len(live)))
+            victim = live[idx % len(live)]
+            logger.warning("chaos: SIGKILL %s replica pid %d",
+                           spec.component, victim.pid)
+            victim.send_signal(signal.SIGKILL)
+        elif spec.kind == KILL_RANK:
+            groups = self.controller.actuator._groups.get(  # noqa: SLF001
+                spec.component, [])
+            if not groups:
+                raise AssertionError(
+                    f"no live group of {spec.component} to kill a rank of")
+            group = groups[0]
+            rank = spec.rank if spec.rank is not None else rng.randrange(
+                len(group))
+            victim = group[rank % len(group)]
+            logger.warning("chaos: SIGKILL %s rank %d pid %d",
+                           spec.component, rank, victim.pid)
+            victim.send_signal(signal.SIGKILL)
+        elif spec.target == "local":
+            FaultGate.install().arm(
+                spec.point, spec.kind, duration_s=spec.duration_s,
+                count=spec.count, delay_s=spec.delay_s,
+            )
+        else:
+            target = spec.target
+            if "{instance}" in target:
+                # late-bound instance targeting: pick a live instance of
+                # the component deterministically from the plan's rng
+                component = target.split(":", 1)[0]
+                ids = await self.instance_ids(component)
+                if not ids:
+                    raise AssertionError(f"no live instance of {component}")
+                target = target.replace(
+                    "{instance}", str(ids[rng.randrange(len(ids))])
+                )
+            await arm_remote(
+                self.chaos_control, self.namespace, target, spec.point,
+                spec.kind, duration_s=spec.duration_s, count=spec.count,
+                delay_s=spec.delay_s,
+            )
+
+    async def disarm(self, target: str, point: str) -> None:
+        if target == "local":
+            gate = FaultGate.active()
+            if gate is not None:
+                gate.disarm(point)
+            return
+        await disarm_remote(self.chaos_control, self.namespace, target, point)
+
+
+class ScenarioRunner:
+    """Runs one Scenario end to end and scores the invariants."""
+
+    def __init__(self, scenario: Scenario, log_dir: str = ""):
+        self.scenario = scenario
+        self.log_dir = log_dir
+        self.stack: Optional[ChaosStack] = None
+        self.baseline: List[StreamOutcome] = []
+        self.outcomes: List[StreamOutcome] = []
+
+    async def run(self) -> ScenarioResult:
+        s = self.scenario
+        if s.custom is not None:
+            return await s.custom()
+        log_path = (os.path.join(self.log_dir, f"chaos_{s.name}.log")
+                    if self.log_dir else "")
+        self.stack = ChaosStack(s.graph, s.env, log_path)
+        result = ScenarioResult(name=s.name, passed=False,
+                                streams=s.traffic.requests)
+        try:
+            await self.stack.start()
+            total = sum(
+                c.replicas for c in self.stack.spec.components
+                if c.kind == "worker"
+            )
+            await self.stack.wait_model(s.traffic.model, total)
+
+            # unfaulted reference wave (same seeds as the faulted wave)
+            self.baseline = await self.stack.drive(s.traffic)
+            for out in self.baseline:
+                if out.errors or out.finish != "length":
+                    raise AssertionError(f"baseline not clean: {out}")
+
+            # faulted wave
+            self.outcomes = await self.stack.drive(s.traffic, plan=s.plan)
+            result.client_errors = sum(len(o.errors) for o in self.outcomes)
+            result.stream_mismatches = sum(
+                1 for b, o in zip(self.baseline, self.outcomes)
+                if (b.text, "length") != (o.text, o.finish)
+            )
+
+            result.converge_s = await self.stack.wait_converged(
+                model=s.traffic.model, instances=s.expect_instances,
+            )
+            result.migrations_total = _counter_total(
+                self.stack.metrics.migrations)
+            if s.extra_checks is not None:
+                extra = s.extra_checks(self)
+                if asyncio.iscoroutine(extra):
+                    extra = await extra
+                result.telemetry.update(extra or {})
+            if result.client_errors:
+                raise AssertionError(
+                    f"{result.client_errors} client-visible error(s): "
+                    f"{[o.errors for o in self.outcomes if o.errors]}"
+                )
+            if result.stream_mismatches:
+                diffs = [
+                    (i, b.text, o.text, o.finish)
+                    for i, (b, o) in enumerate(
+                        zip(self.baseline, self.outcomes))
+                    if (b.text, "length") != (o.text, o.finish)
+                ]
+                raise AssertionError(f"stream mismatch vs unfaulted: {diffs}")
+            result.passed = True
+        except (AssertionError, TimeoutError) as e:
+            result.failure = str(e)
+        finally:
+            if self.stack is not None:
+                await self.stack.stop()
+        return result
+
+
+def _counter_total(counter) -> float:
+    """Sum a labelled prometheus Counter across its label sets."""
+    total = 0.0
+    for metric in counter.collect():
+        for sample in metric.samples:
+            if sample.name.endswith("_total"):
+                total += sample.value
+    return total

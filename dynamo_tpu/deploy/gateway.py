@@ -57,9 +57,7 @@ async def register_frontend(runtime, port: int, scheme: str = "http") -> str:
     Returns the registration key."""
     key = f"{FRONTEND_ROOT}/{runtime.primary_lease}"
     addr = f"{scheme}://{runtime._advertise_host}:{port}"  # noqa: SLF001
-    await runtime.control.put(
-        key, pack({"url": addr}), lease=runtime.primary_lease
-    )
+    await runtime.put_leased(key, pack({"url": addr}))
     return key
 
 

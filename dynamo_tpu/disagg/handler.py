@@ -5,6 +5,7 @@ from __future__ import annotations
 import logging
 from typing import Any, AsyncIterator, Dict, Optional
 
+from ..chaos.gate import gate_async_check
 from ..engine import JaxEngine
 from ..llm import ModelDeploymentCard
 from ..router.worker_key import unpack_worker
@@ -178,6 +179,9 @@ class DisaggDecodeHandler:
         self.kv_transfer_ms_total = 0.0
         self.kv_transfer_bytes_total = 0
         self.kv_transfer_device_count = 0  # colocated device-lane fetches
+        # handoffs that fell back to a local prefill (remote failure,
+        # transfer loss, import rejection — incl. injected chaos drops)
+        self.prefill_fallback_total = 0
 
     async def _prefill_available(self) -> bool:
         if not self._started:
@@ -212,6 +216,11 @@ class DisaggDecodeHandler:
         prefill_ctx = context.child()
         self._inflight_prefills += 1
         try:
+            # chaos "drop"/"delay" of the disagg KV handoff: raising here
+            # rides the same recovery path a real prefill-worker loss does
+            await gate_async_check(
+                "disagg.handoff", retryable_exc=ServiceUnavailable
+            )
             if self.prefill_router is not None:
                 key = await self.prefill_router.choose(
                     {**request, "request_id": prefill_ctx.id}
@@ -226,8 +235,12 @@ class DisaggDecodeHandler:
             async for item in stream:
                 result = item
                 break
-        except (ServiceUnavailable, RemoteStreamError) as e:
+        except (ServiceUnavailable, RemoteStreamError, OSError) as e:
+            # OSError covers raw socket failures dialing a dead prefill
+            # worker whose stale instance key hasn't expired yet — those
+            # must take the local fallback, not error the decode stream
             logger.warning("remote prefill failed (%s); prefilling locally", e)
+            self.prefill_fallback_total += 1
             async for out in self.engine.generate(request, context):
                 yield out
             return
@@ -240,6 +253,7 @@ class DisaggDecodeHandler:
         ):
             logger.warning("remote prefill rejected (%s); local fallback",
                            (result or {}).get("error"))
+            self.prefill_fallback_total += 1
             async for out in self.engine.generate(request, context):
                 yield out
             return
@@ -252,6 +266,7 @@ class DisaggDecodeHandler:
                 )
             except Exception as e:  # noqa: BLE001 — any failure → local
                 logger.warning("kv transfer failed (%s); prefilling locally", e)
+                self.prefill_fallback_total += 1
                 async for out in self.engine.generate(request, context):
                     yield out
                 return
@@ -281,6 +296,7 @@ class DisaggDecodeHandler:
             yield out
         if import_failed:
             logger.warning("kv import rejected; prefilling locally")
+            self.prefill_fallback_total += 1
             async for out in self.engine.generate(request, context):
                 yield out
 
@@ -299,6 +315,7 @@ class DisaggDecodeHandler:
         m.kv_transfer_ms_total = round(self.kv_transfer_ms_total, 3)
         m.kv_transfer_bytes_total = self.kv_transfer_bytes_total
         m.kv_transfer_device_count = self.kv_transfer_device_count
+        m.prefill_fallback_total = self.prefill_fallback_total
         return m
 
     def clear_kv_blocks(self):

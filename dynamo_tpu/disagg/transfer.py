@@ -151,7 +151,7 @@ class KvTransferSource:
         (the reference registers NIXL metadata in etcd)."""
         key = f"{LAYOUT_PREFIX}/{namespace}/{component}/{runtime.primary_lease}"
         value = pack({"layout": self.layout.to_dict(), "addr": self.address})
-        await runtime.control.put(key, value, lease=runtime.primary_lease)
+        await runtime.put_leased(key, value)
 
     # -- handle lifecycle --------------------------------------------------- #
 

@@ -2,10 +2,17 @@
 
 from .metrics import FrontendMetrics
 from .openai_http import HttpService
-from .service import ModelEntry, ModelManager, ModelWatcher, register_llm
+from .service import (
+    HealthWatcher,
+    ModelEntry,
+    ModelManager,
+    ModelWatcher,
+    register_llm,
+)
 
 __all__ = [
     "FrontendMetrics",
+    "HealthWatcher",
     "HttpService",
     "ModelEntry",
     "ModelManager",

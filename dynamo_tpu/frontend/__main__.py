@@ -63,12 +63,32 @@ def main() -> None:
 
 async def _run(args) -> None:
     from ..runtime import DistributedRuntime
-    from . import HttpService, ModelManager, ModelWatcher
+    from . import (
+        FrontendMetrics,
+        HealthWatcher,
+        HttpService,
+        ModelManager,
+        ModelWatcher,
+    )
 
     runtime = await DistributedRuntime.connect(
         args.control, advertise_host=args.advertise_host or None
     )
+    import os
+
+    chaos_injector = None
+    if os.environ.get("DYN_TPU_CHAOS"):
+        from ..chaos import FaultInjector
+
+        chaos_injector = await FaultInjector(
+            runtime, namespace=args.namespace,
+            ident=f"frontend:{runtime.primary_lease}",
+        ).start()
     manager = ModelManager()
+    # one metrics surface shared by the HTTP service AND the discovery/
+    # migration layers, so fault-tolerance counters (migrations_total,
+    # endpoint health) land on the same /metrics exposition
+    metrics = FrontendMetrics()
     kv_factory = None
     if args.router_mode == "kv":
         from ..router import kv_chooser_factory
@@ -78,14 +98,15 @@ async def _run(args) -> None:
         )
     watcher = await ModelWatcher(
         runtime, manager, router_mode=args.router_mode,
-        kv_chooser_factory=kv_factory,
+        kv_chooser_factory=kv_factory, metrics=metrics,
     ).start()
+    health_watcher = await HealthWatcher(runtime, metrics).start()
     enabled = (
         {r.strip() for r in args.routes.split(",") if r.strip()}
         if args.routes else None
     )
     http = await HttpService(
-        manager, host=args.host, port=args.port,
+        manager, host=args.host, port=args.port, metrics=metrics,
         tls_cert=args.tls_cert, tls_key=args.tls_key,
         enabled_routes=enabled,
     ).start()
@@ -126,7 +147,10 @@ async def _run(args) -> None:
     if kserve:
         await kserve.stop()
     await http.stop()
+    await health_watcher.stop()
     await watcher.stop()
+    if chaos_injector:
+        await chaos_injector.stop()
     await runtime.shutdown()
 
 

@@ -109,6 +109,49 @@ class FrontendMetrics:
             registry=self.registry,
         )
         self._spec_windows: dict = {}  # model -> deque[(draft, accepted)]
+        # fault tolerance: migration counters incremented straight from
+        # migrating_stream (frontend/service.py wires the callback), and
+        # per-endpoint worker health as published to the control plane by
+        # each worker's HealthCheckManager (frontend/service.py
+        # HealthWatcher keeps the gauge in sync)
+        self.migrations = Counter(
+            "dynamo_frontend_migrations_total",
+            "Streams transparently re-issued to another worker",
+            ["model"],
+            registry=self.registry,
+        )
+        self.migration_exhausted = Counter(
+            "dynamo_frontend_migration_exhausted_total",
+            "Streams that hit the migration limit (client saw an error)",
+            ["model"],
+            registry=self.registry,
+        )
+        self.endpoint_health = Gauge(
+            "dynamo_frontend_endpoint_healthy",
+            "Worker-reported endpoint health (1 healthy, 0 unhealthy)",
+            ["endpoint", "instance"],
+            registry=self.registry,
+        )
+
+    def observe_migration(self, model: str, event: str) -> None:
+        """Account one migrating_stream event ('migrated'/'exhausted')."""
+        if event == "exhausted":
+            self.migration_exhausted.labels(model).inc()
+        else:
+            self.migrations.labels(model).inc()
+
+    def set_endpoint_health(self, endpoint: str, instance: int,
+                            healthy: bool | None) -> None:
+        """Track (or forget, healthy=None) a worker endpoint's health."""
+        if healthy is None:
+            try:
+                self.endpoint_health.remove(endpoint, str(instance))
+            except KeyError:
+                pass
+            return
+        self.endpoint_health.labels(endpoint, str(instance)).set(
+            1.0 if healthy else 0.0
+        )
 
     def observe_ttft_attr(self, model: str, ttft: dict) -> None:
         """Account one request's engine-side TTFT attribution ({

@@ -36,12 +36,14 @@ class ModelEntry:
     """One served model: card, tokenizer, preprocessor, routed client."""
 
     def __init__(self, mdc: ModelDeploymentCard, tokenizer: HuggingFaceTokenizer,
-                 client: Client, router_mode: str = "round_robin"):
+                 client: Client, router_mode: str = "round_robin",
+                 metrics=None):
         self.mdc = mdc
         self.tokenizer = tokenizer
         self.preprocessor = OpenAIPreprocessor(mdc, tokenizer)
         self.client = client
         self.router_mode = router_mode
+        self.metrics = metrics  # FrontendMetrics (migration counters)
         self.instances: set[int] = set()
         self.kv_chooser = None  # set by the KV router integration (M2)
 
@@ -79,13 +81,20 @@ class ModelEntry:
         async for item in stream:
             yield item
 
+    def _on_migration(self, event: str) -> None:
+        if self.metrics is not None:
+            self.metrics.observe_migration(self.mdc.name, event)
+
     def generate(self, request: Dict[str, Any], context: Context
                  ) -> AsyncIterator[Dict[str, Any]]:
         """Preprocessed-request in, postprocessed text deltas out (with
         transparent migration on worker loss)."""
         return postprocess_stream(
             migrating_stream(
-                request, context, self.route, self.mdc.migration_limit
+                request, context, self.route, self.mdc.migration_limit,
+                backoff_ms=self.mdc.migration_backoff_ms,
+                backoff_max_ms=self.mdc.migration_backoff_max_ms,
+                on_migration=self._on_migration,
             ),
             self.tokenizer,
             prompt_ids=request.get("token_ids"),
@@ -120,11 +129,12 @@ class ModelWatcher:
 
     def __init__(self, runtime: DistributedRuntime, manager: ModelManager,
                  router_mode: str = "round_robin",
-                 kv_chooser_factory=None):
+                 kv_chooser_factory=None, metrics=None):
         self.runtime = runtime
         self.manager = manager
         self.router_mode = router_mode
         self.kv_chooser_factory = kv_chooser_factory
+        self.metrics = metrics  # shared FrontendMetrics, or None
         self._task: Optional[asyncio.Task] = None
         self._ready = asyncio.Event()
 
@@ -151,24 +161,32 @@ class ModelWatcher:
             await asyncio.sleep(0.05)
 
     async def _watch(self) -> None:
-        backoff = 0.2
+        from ..runtime.transport.control_plane import watch_resilient
+
         while True:
             try:
-                stream = await self.runtime.control.watch_prefix(MODEL_ROOT + "/")
-                async for ev in stream:
+                async for ev in watch_resilient(self.runtime.control,
+                                                MODEL_ROOT + "/", "models"):
                     if ev.type == "sync":
                         self._ready.set()
-                        backoff = 0.2
                     elif ev.type == "put":
+                        # _handle_put dials the control plane (client
+                        # start, kv-chooser snapshot load) — a transient
+                        # failure must restart the watch (the fresh
+                        # snapshot replays and retries the card), not
+                        # kill this task
                         await self._handle_put(ev.key, ev.value)
-                    elif ev.type == "delete":
+                    elif ev.type in ("delete", "forget"):
+                        # "forget": a card deleted while the watch was
+                        # down (e.g. its worker's lease expired during a
+                        # control-plane partition) — without it the stale
+                        # ModelEntry would keep routing to a dead
+                        # instance set forever
                         self._handle_delete(ev.key)
-            except asyncio.CancelledError:
-                return
             except (ConnectionError, RuntimeError) as e:
-                logger.warning("model watch lost (%s); retrying", e)
-            await asyncio.sleep(backoff)
-            backoff = min(backoff * 2, 5.0)
+                logger.warning("model watch handler failed (%s); "
+                               "re-watching", e)
+                await asyncio.sleep(0.2)
 
     async def _handle_put(self, key: str, value: bytes) -> None:
         try:
@@ -192,7 +210,8 @@ class ModelWatcher:
                 .endpoint(mdc.endpoint)
             )
             client = await endpoint.client().start()
-            entry = ModelEntry(mdc, tokenizer, client, self.router_mode)
+            entry = ModelEntry(mdc, tokenizer, client, self.router_mode,
+                               metrics=self.metrics)
             if self.kv_chooser_factory is not None:
                 entry.kv_chooser = await self.kv_chooser_factory(mdc, client)
             self.manager.add(mdc.name, entry)
@@ -229,6 +248,69 @@ class ModelWatcher:
         return None
 
 
+class HealthWatcher:
+    """Mirrors worker-published endpoint health (`/health/...` keys,
+    written by each worker's HealthCheckManager under its lease) into the
+    frontend's Prometheus surface — `dynamo_frontend_endpoint_healthy`
+    {endpoint, instance}.  A worker that dies takes its keys with it
+    (lease expiry), which shows up here as the series disappearing."""
+
+    def __init__(self, runtime: DistributedRuntime, metrics):
+        self.runtime = runtime
+        self.metrics = metrics
+        self._task: Optional[asyncio.Task] = None
+        self.state: Dict[str, bool] = {}  # key -> healthy
+        # bounded flip log (key, healthy) — the chaos harness asserts an
+        # injected fault actually SHOWED UP in health telemetry, which
+        # live state alone can't prove once the worker is replaced
+        from collections import deque
+
+        self.events: Any = deque(maxlen=512)
+
+    async def start(self) -> "HealthWatcher":
+        self._task = asyncio.create_task(self._watch())
+        return self
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            await asyncio.gather(self._task, return_exceptions=True)
+
+    @staticmethod
+    def _parse(key: str):
+        """/health/{ns}/{component}/{endpoint}/{instance} ->
+        ("ns.component.endpoint", instance) or None."""
+        parts = key.strip("/").split("/")
+        if len(parts) != 5 or parts[0] != "health":
+            return None
+        try:
+            return ".".join(parts[1:4]), int(parts[4])
+        except ValueError:
+            return None
+
+    async def _watch(self) -> None:
+        from ..runtime.health import HEALTH_ROOT
+        from ..runtime.transport.control_plane import watch_resilient
+
+        async for ev in watch_resilient(self.runtime.control,
+                                        HEALTH_ROOT + "/", "health"):
+            parsed = self._parse(ev.key)
+            if parsed is None:
+                continue
+            endpoint, instance = parsed
+            if ev.type == "put":
+                healthy = bool(unpack(ev.value).get("healthy"))
+                if self.state.get(ev.key) != healthy:
+                    self.events.append((ev.key, healthy))
+                self.state[ev.key] = healthy
+                self.metrics.set_endpoint_health(endpoint, instance, healthy)
+            elif ev.type in ("delete", "forget"):
+                # "forget": a delete that happened while the watch was
+                # down, replayed by watch_resilient's reconcile
+                self.state.pop(ev.key, None)
+                self.metrics.set_endpoint_health(endpoint, instance, None)
+
+
 async def register_llm(
     runtime: DistributedRuntime,
     served_endpoint,
@@ -242,6 +324,6 @@ async def register_llm(
     mdc.component = served_endpoint.instance.component
     mdc.endpoint = served_endpoint.instance.endpoint
     key = mdc.card_path(instance_id)
-    await runtime.control.put(key, pack(mdc.to_dict()), lease=runtime.primary_lease)
+    await runtime.put_leased(key, pack(mdc.to_dict()))
     logger.info("registered model %s at %s", mdc.name, key)
     return key

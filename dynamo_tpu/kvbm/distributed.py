@@ -70,9 +70,8 @@ class KvbmLeader:
 
     async def start(self, timeout: float = 60.0) -> "KvbmLeader":
         c = self.runtime.control
-        await c.put(
-            f"{PREFIX}/{self.ns}/config", pack(self.config.to_dict()),
-            lease=self.runtime.primary_lease,
+        await self.runtime.put_leased(
+            f"{PREFIX}/{self.ns}/config", pack(self.config.to_dict())
         )
         deadline = time.monotonic() + timeout
         prefix = f"{PREFIX}/{self.ns}/workers/"
@@ -94,9 +93,8 @@ class KvbmLeader:
         if len(distinct) != 1:
             raise ValueError(f"kvbm layout mismatch across workers: {layouts}")
         self.members = sorted(layouts)
-        await c.put(
-            f"{PREFIX}/{self.ns}/ready", pack({"members": self.members}),
-            lease=self.runtime.primary_lease,
+        await self.runtime.put_leased(
+            f"{PREFIX}/{self.ns}/ready", pack({"members": self.members})
         )
         logger.info("kvbm leader: %d workers barriered", len(self.members))
         return self
@@ -127,9 +125,8 @@ class KvbmWorker:
             await asyncio.sleep(0.1)
         # 2. register our layout
         layout = KvLayout.of_engine(self.engine).to_dict()
-        await c.put(
-            f"{PREFIX}/{self.ns}/workers/{self.worker_id}", pack(layout),
-            lease=self.runtime.primary_lease,
+        await self.runtime.put_leased(
+            f"{PREFIX}/{self.ns}/workers/{self.worker_id}", pack(layout)
         )
         # 3. barrier: wait until the leader lists us as a member
         while True:

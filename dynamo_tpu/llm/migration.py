@@ -7,12 +7,21 @@ request is re-issued to another worker with `prompt + generated` as the new
 prompt and the generation budget reduced — the client sees an uninterrupted
 token stream.  Works because engines treat any token prefix as a prompt
 (and the prefix cache usually makes the re-prefill cheap).
+
+Retries are paced: a failure with no progress since the last attempt waits
+a capped exponential backoff with jitter before re-issuing (a deterministic
+rejection — every worker refusing — would otherwise burn the whole
+migration budget in microseconds); a failure *after* progress is a fresh
+incident and retries immediately.  Both knobs ride the
+ModelDeploymentCard (`migration_backoff_ms`, `migration_backoff_max_ms`).
 """
 
 from __future__ import annotations
 
+import asyncio
 import logging
-from typing import Any, AsyncIterator, Callable, Dict
+import random
+from typing import Any, AsyncIterator, Callable, Dict, Optional
 
 from ..runtime import Context
 from ..runtime.transport.service import (
@@ -26,12 +35,30 @@ logger = logging.getLogger(__name__)
 # engine stream factory: (request, context) -> async iterator
 StreamFactory = Callable[[Dict[str, Any], Context], AsyncIterator[Dict[str, Any]]]
 
+# migration telemetry events handed to `on_migration`
+MIGRATED = "migrated"      # stream re-issued to another worker
+EXHAUSTED = "exhausted"    # migration limit hit; error surfaced to client
+
+
+def _backoff_s(attempt: int, base_ms: int, max_ms: int,
+               rng: Optional[random.Random] = None) -> float:
+    """Capped exponential backoff with jitter in [0.5, 1.0) of the step."""
+    if base_ms <= 0:
+        return 0.0
+    step = min(base_ms * (2 ** max(attempt - 1, 0)), max(max_ms, base_ms))
+    r = rng.random() if rng is not None else random.random()
+    return step * (0.5 + r / 2) / 1e3
+
 
 async def migrating_stream(
     request: Dict[str, Any],
     context: Context,
     stream_factory: StreamFactory,
     migration_limit: int = 3,
+    backoff_ms: int = 0,
+    backoff_max_ms: int = 2000,
+    on_migration: Optional[Callable[[str], None]] = None,
+    _rng: Optional[random.Random] = None,
 ) -> AsyncIterator[Dict[str, Any]]:
     """Stream engine outputs, transparently migrating on transport failure."""
     prompt = list(request.get("token_ids") or [])
@@ -82,6 +109,8 @@ async def migrating_stream(
                     "request %s: migration limit (%d) exhausted: %s",
                     context.id, migration_limit, e,
                 )
+                if on_migration is not None:
+                    on_migration(EXHAUSTED)
                 yield {"token_ids": [], "finish_reason": "error",
                        "error": f"migration exhausted after {attempts - 1} "
                                 f"retries; last error: {e}"}
@@ -90,3 +119,13 @@ async def migrating_stream(
                 "request %s: migrating after %d tokens (attempt %d): %s",
                 context.id, len(generated), attempts, e,
             )
+            if on_migration is not None:
+                on_migration(MIGRATED)
+            if not progressed:
+                # no progress since the last attempt: pace the retry so a
+                # cluster-wide incident isn't hammered by every stream
+                delay = _backoff_s(attempts, backoff_ms, backoff_max_ms, _rng)
+                if delay > 0:
+                    await asyncio.sleep(delay)
+                    if context.is_killed() or context.is_stopped():
+                        return

@@ -38,6 +38,11 @@ class ModelDeploymentCard:
     context_length: int = 4096
     kv_cache_block_size: int = 16
     migration_limit: int = 3
+    # retry pacing between migration attempts that made NO progress
+    # (capped exponential + jitter; a post-progress failure is a fresh
+    # incident and retries immediately).  0 disables the backoff.
+    migration_backoff_ms: int = 50
+    migration_backoff_max_ms: int = 2000
     # tokenization (None → frontend loads from checkpoint_path)
     checkpoint_path: Optional[str] = None
     tokenizer_json: Optional[str] = None  # inline tokenizer.json contents

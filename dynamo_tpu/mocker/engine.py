@@ -11,8 +11,12 @@ TPU — and only fakes the device step with a timing model:
     prefill_time = base + per_token * chunk + quadratic * chunk * context
     decode_time  = base + per_seq * batch_size        (all / speedup_ratio)
 
-Generated tokens are a deterministic hash of (request seed, position), so
-tests can assert determinism across topologies.
+Generated tokens are a deterministic hash of (request seed, absolute
+sequence position = prompt length + output index), so tests can assert
+determinism across topologies — AND across request migration: a stream
+re-issued with `prompt + generated` as the new prompt continues the exact
+token sequence the original worker would have produced, mirroring how a
+real engine's output is conditioned on the full context.
 """
 
 from __future__ import annotations
@@ -240,8 +244,8 @@ class MockEngine:
             self.scheduler.commit_full_pages(s)
             if it.samples:
                 self._append(s, _mock_token(
-                    s.seed, len(s.output_tokens), a.vocab_size,
-                    a.eos_token_id, a.eos_probability,
+                    s.seed, len(s.prompt) + len(s.output_tokens),
+                    a.vocab_size, a.eos_token_id, a.eos_probability,
                 ))
 
     async def _run_decode(self, seqs: List[Sequence]) -> None:
@@ -254,8 +258,8 @@ class MockEngine:
             s.num_computed += 1
             self.scheduler.commit_full_pages(s)
             self._append(s, _mock_token(
-                s.seed, len(s.output_tokens), a.vocab_size,
-                a.eos_token_id, a.eos_probability,
+                s.seed, len(s.prompt) + len(s.output_tokens),
+                a.vocab_size, a.eos_token_id, a.eos_probability,
             ))
 
     def _append(self, seq: Sequence, token: int) -> None:

@@ -46,41 +46,23 @@ class Client:
         return self
 
     async def _watch(self) -> None:
-        backoff = 0.2
-        while True:
-            try:
-                stream = await self.runtime.control.watch_prefix(
-                    self.endpoint.path_prefix
-                )
-                seen: set[int] = set()
-                async for ev in stream:
-                    if ev.type == "sync":
-                        # Drop instances that vanished while we were away.
-                        for iid in [i for i in self._instances if i not in seen]:
-                            self._instances.pop(iid, None)
-                        self._synced.set()
-                        backoff = 0.2
-                    elif ev.type == "put":
-                        inst = Instance.from_bytes(ev.value)
-                        self._instances[inst.instance_id] = inst
-                        seen.add(inst.instance_id)
-                    elif ev.type == "delete":
-                        iid = int(ev.key.rsplit("/", 1)[-1])
-                        self._instances.pop(iid, None)
-                # Stream ended: control-plane connection lost. Retry.
-                logger.warning(
-                    "discovery watch for %s lost; retrying in %.1fs",
-                    self.endpoint.wire_name, backoff,
-                )
-            except asyncio.CancelledError:
-                return
-            except (ConnectionError, RuntimeError) as e:
-                logger.warning(
-                    "discovery watch for %s failed (%s); retrying in %.1fs",
-                    self.endpoint.wire_name, e, backoff,
-                )
-            await asyncio.sleep(backoff)
-            backoff = min(backoff * 2, 5.0)
+        from .transport.control_plane import watch_resilient
+
+        async for ev in watch_resilient(
+            self.runtime.control, self.endpoint.path_prefix,
+            f"discovery:{self.endpoint.wire_name}",
+        ):
+            if ev.type == "sync":
+                self._synced.set()
+            elif ev.type == "put":
+                inst = Instance.from_bytes(ev.value)
+                self._instances[inst.instance_id] = inst
+            elif ev.type in ("delete", "forget"):
+                # "forget" replays a deregistration that happened while
+                # the watch was down (watch_resilient's reconcile), so
+                # vanished instances are dropped here too
+                iid = int(ev.key.rsplit("/", 1)[-1])
+                self._instances.pop(iid, None)
 
     async def wait_for_instances(self, timeout: float = 10.0) -> list[Instance]:
         """Block until at least one instance is live."""

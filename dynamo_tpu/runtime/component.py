@@ -128,7 +128,7 @@ class Endpoint:
             instance_id=rt.primary_lease,
             address=rt.advertise_address(),
         )
-        await rt.control.put(instance.path, instance.to_bytes(), lease=rt.primary_lease)
+        await rt.put_leased(instance.path, instance.to_bytes())
         served = ServedEndpoint(self, instance, graceful_shutdown, health_check_payload)
         rt._served.append(served)
         logger.info("serving endpoint %s at %s", instance.path, instance.address)
@@ -159,5 +159,5 @@ class ServedEndpoint:
             for one in (svc if isinstance(svc, list) else [svc]):
                 if one is not None:
                     await one.stop()  # dp-rank workers attach one per rank
-        await self.endpoint.runtime.control.delete(self.instance.path)
+        await self.endpoint.runtime.delete_leased(self.instance.path)
         self.endpoint.runtime.service_server.unregister(self.endpoint.wire_name)

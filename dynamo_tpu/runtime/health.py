@@ -6,6 +6,23 @@ manager periodically sends it through the endpoint's actual handler (not a
 side channel), so a wedged engine fails its health check even while the
 process is alive.  `SystemHealth` aggregation feeds the status server's
 /health.
+
+Two consumers beyond the local /health route:
+
+- **Publication**: when constructed with ``publish=True`` the manager
+  mirrors each endpoint's health into the control-plane KV under the
+  process's primary lease (``/health/{ns}/{component}/{endpoint}/{id}``),
+  so frontends and the chaos harness can observe worker-side health
+  without dialing every status port (the state vanishes with the lease).
+- **Eviction**: ``on_unhealthy`` fires once per unhealthy episode (when
+  ``consecutive_failures`` crosses the threshold) — the worker CLI uses it
+  for opt-in self-eviction (``DYN_TPU_HEALTH_SELF_EVICT``): a wedged
+  process exits nonzero, the controller's reconcile loop respawns it, and
+  in-flight streams migrate to surviving replicas.
+
+Knobs default from the environment (``DYN_TPU_HEALTH_INTERVAL``,
+``DYN_TPU_HEALTH_TIMEOUT``, ``DYN_TPU_HEALTH_THRESHOLD``) so deployment
+graphs can tighten detection without growing every CLI surface.
 """
 
 from __future__ import annotations
@@ -13,12 +30,15 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
-from dataclasses import dataclass, field
-from typing import Dict, Optional
+from dataclasses import dataclass
+from typing import Callable, Dict, Optional
 
+from .config import env_float
 from .engine import Context
 
 logger = logging.getLogger(__name__)
+
+HEALTH_ROOT = "/health"
 
 
 @dataclass
@@ -31,14 +51,25 @@ class EndpointHealth:
 
 
 class HealthCheckManager:
-    def __init__(self, runtime, interval: float = 5.0, timeout: float = 10.0,
-                 failure_threshold: int = 3):
+    def __init__(self, runtime, interval: float | None = None,
+                 timeout: float | None = None,
+                 failure_threshold: int | None = None,
+                 publish: bool = False,
+                 on_unhealthy: Optional[Callable[[str, EndpointHealth], None]] = None):
         self.runtime = runtime
-        self.interval = interval
-        self.timeout = timeout
-        self.failure_threshold = failure_threshold
+        self.interval = interval if interval is not None else env_float(
+            "DYN_TPU_HEALTH_INTERVAL", 5.0)
+        self.timeout = timeout if timeout is not None else env_float(
+            "DYN_TPU_HEALTH_TIMEOUT", 10.0)
+        self.failure_threshold = (
+            failure_threshold if failure_threshold is not None
+            else int(env_float("DYN_TPU_HEALTH_THRESHOLD", 3))
+        )
+        self.publish = publish
+        self.on_unhealthy = on_unhealthy
         self.state: Dict[str, EndpointHealth] = {}
         self._task: Optional[asyncio.Task] = None
+        self._published: Dict[str, bool] = {}  # key -> last published healthy
 
     def start(self) -> "HealthCheckManager":
         self._task = asyncio.get_running_loop().create_task(self._loop())
@@ -72,9 +103,11 @@ class HealthCheckManager:
                 st.last_error = "handler not registered"
                 continue
             t0 = time.monotonic()
+            ctx = Context()
+            crossed = False
             try:
                 async def probe():
-                    gen = handler(payload, Context())
+                    gen = handler(payload, ctx)
                     try:
                         async for _first in gen:
                             return True
@@ -92,14 +125,60 @@ class HealthCheckManager:
                 else:
                     raise RuntimeError("health probe yielded nothing")
             except (asyncio.TimeoutError, Exception) as e:  # noqa: BLE001
+                # kill the probe context so a wedged/slow handler can't
+                # keep generating for an observer that already gave up
+                # (the probe must not leak into the engine's queues)
+                ctx.kill()
                 st.consecutive_failures += 1
                 st.last_error = repr(e)
                 if st.consecutive_failures >= self.failure_threshold:
                     st.healthy = False
+                    crossed = (st.consecutive_failures
+                               == self.failure_threshold)
                 logger.warning(
                     "health check failed for %s (%d consecutive): %r",
                     name, st.consecutive_failures, e,
                 )
+            if self.publish:
+                await self._publish_state(served, st)
+            if crossed and self.on_unhealthy is not None:
+                # AFTER publication: an eviction callback may never return
+                # (self-evict is os._exit), and the unhealthy flip must be
+                # visible in the control plane first
+                try:
+                    self.on_unhealthy(name, st)
+                except Exception:  # noqa: BLE001 — advisory hook
+                    logger.exception("on_unhealthy callback failed")
+
+    def _health_key(self, served) -> str:
+        inst = served.instance
+        return (f"{HEALTH_ROOT}/{inst.namespace}/{inst.component}/"
+                f"{inst.endpoint}/{inst.instance_id}")
+
+    async def _publish_state(self, served, st: EndpointHealth) -> None:
+        """Mirror health into the control plane on every flip (and the
+        first pass), lease-scoped so it dies with the worker."""
+        key = self._health_key(served)
+        if self._published.get(key) == st.healthy:
+            return
+        from .transport.wire import pack
+
+        try:
+            # put_leased (not a bare put): a lease lost to a long partition
+            # re-publishes the last health state along with the instance
+            # record, instead of the series silently vanishing forever
+            await self.runtime.put_leased(
+                key,
+                pack({
+                    "healthy": st.healthy,
+                    "consecutive_failures": st.consecutive_failures,
+                    "latency_ms": round(st.last_latency_ms, 2),
+                    "error": st.last_error,
+                }),
+            )
+            self._published[key] = st.healthy
+        except (ConnectionError, RuntimeError) as e:
+            logger.warning("health publish failed for %s: %s", key, e)
 
     def system_health(self) -> dict:
         """Aggregate for the status server's /health."""

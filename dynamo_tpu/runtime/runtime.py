@@ -53,6 +53,12 @@ class DistributedRuntime:
         self._embedded_server: ControlPlaneServer | None = None
         self._served: list = []
         self._shutdown = asyncio.Event()
+        # lease-scoped keys this process owns (instance records, model
+        # cards, transfer layouts): remembered so that when a lease is
+        # lost to a control-plane partition longer than the TTL, the
+        # keepalive loop can re-grant and re-publish them — the process
+        # re-converges into discovery instead of silently vanishing
+        self._leased_keys: dict[str, bytes] = {}
 
     # -- construction ------------------------------------------------------- #
 
@@ -83,15 +89,48 @@ class DistributedRuntime:
         self._keepalive_task = asyncio.create_task(self._keepalive_loop())
 
     async def _keepalive_loop(self) -> None:
-        try:
-            while True:
+        """Keep the primary lease alive; survive transient control-plane
+        loss (partition, restart).  A ConnectionError is NOT fatal — retry
+        until shutdown; if the lease actually expired meanwhile, re-grant
+        and re-publish every lease-scoped key this process owns."""
+        republish = False
+        while not self._shutdown.is_set():
+            try:
                 await asyncio.sleep(self._lease_ttl / 3)
                 ok = await self.control.keepalive(self.primary_lease)
                 if not ok:
-                    logger.error("primary lease %d lost", self.primary_lease)
-                    return
-        except (asyncio.CancelledError, ConnectionError):
-            pass
+                    logger.warning(
+                        "primary lease %d lost — re-granting and "
+                        "re-publishing %d key(s)", self.primary_lease,
+                        len(self._leased_keys),
+                    )
+                    self.primary_lease = await self.control.grant_lease(
+                        self._lease_ttl
+                    )
+                    republish = True
+                if republish:
+                    # sticky until it fully succeeds: a partition returning
+                    # mid-recovery must not strand half the keys
+                    for key, value in list(self._leased_keys.items()):
+                        await self.control.put(key, value,
+                                               lease=self.primary_lease)
+                    republish = False
+            except asyncio.CancelledError:
+                return
+            except (ConnectionError, RuntimeError) as e:
+                logger.warning("lease keepalive failed (%s); retrying", e)
+
+    # -- lease-scoped state -------------------------------------------------- #
+
+    async def put_leased(self, key: str, value: bytes) -> None:
+        """Publish a key under the primary lease AND remember it for
+        re-publication after a lease loss."""
+        self._leased_keys[key] = value
+        await self.control.put(key, value, lease=self.primary_lease)
+
+    async def delete_leased(self, key: str) -> None:
+        self._leased_keys.pop(key, None)
+        await self.control.delete(key)
 
     # -- component tree ----------------------------------------------------- #
 

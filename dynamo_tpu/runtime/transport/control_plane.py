@@ -36,6 +36,7 @@ from collections import defaultdict, deque
 from dataclasses import dataclass, field
 from typing import Any, AsyncIterator, Callable
 
+from ...chaos.gate import gate_async_check
 from .wire import Frame, K_CTRL, K_DATA, K_END, K_ERR, read_frame, pack, unpack
 
 logger = logging.getLogger(__name__)
@@ -575,7 +576,14 @@ class ControlPlaneClient:
             if self._writer is not None:
                 self._writer.close()
 
+    def _sever(self) -> None:
+        """Chaos partition: drop the live socket too, so server-pushed
+        streams (watches, subscriptions) end as in a real partition."""
+        if self._writer is not None and not self._writer.is_closing():
+            self._writer.close()
+
     async def _call(self, op: str, args: dict, stream: bool = False) -> Any:
+        await gate_async_check("control.call", on_partition=self._sever)
         async with self._send_lock:
             await self._ensure_connection()
             sid = next(self._ids)
@@ -677,6 +685,49 @@ class ControlPlaneClient:
 
     async def queue_depth(self, queue: str) -> int:
         return (await self._call("queue_depth", {"queue": queue}))["depth"]
+
+
+async def watch_resilient(control: "ControlPlaneClient", prefix: str,
+                          what: str = "") -> AsyncIterator[WatchEvent]:
+    """Watch `prefix` forever, transparently re-watching on connection
+    loss with exponential backoff (reset once a watch reaches its 'sync'
+    marker) AND reconciling across reconnects: a key that was present but
+    is absent from a reconnect's snapshot was deleted while the watch was
+    down — its lost delete is replayed as a synthetic ``forget`` event
+    (emitted just before the ``sync`` marker).  Consumers therefore only
+    handle ``put``, ``delete``/``forget`` (same meaning), and optionally
+    ``sync`` — no per-consumer seen-set bookkeeping."""
+    backoff = 0.2
+    known: set[str] = set()  # keys live per the server, across reconnects
+    while True:
+        try:
+            stream = await control.watch_prefix(prefix)
+            seen: set[str] = set()
+            synced = False
+            async for ev in stream:
+                if ev.type == "sync":
+                    backoff = 0.2
+                    synced = True
+                    for key in known - seen:
+                        yield WatchEvent("forget", key, b"")
+                    known = seen
+                elif ev.type == "put":
+                    if not synced:
+                        # also into `known` NOW: if this stream dies before
+                        # its sync, the next reconnect must still be able
+                        # to emit a forget for this key
+                        seen.add(ev.key)
+                    known.add(ev.key)
+                elif ev.type == "delete":
+                    known.discard(ev.key)
+                yield ev
+            logger.warning("watch on %s ended; retrying in %.1fs",
+                           what or prefix, backoff)
+        except (ConnectionError, RuntimeError) as e:
+            logger.warning("watch on %s failed (%s); retrying in %.1fs",
+                           what or prefix, e, backoff)
+        await asyncio.sleep(backoff)
+        backoff = min(backoff * 2, 5.0)
 
 
 class WatchStream:

@@ -17,6 +17,7 @@ import itertools
 import logging
 from typing import Any, AsyncIterator, Awaitable, Callable
 
+from ...chaos.gate import gate_async_check
 from ..engine import Context
 from .wire import (
     Frame,
@@ -268,6 +269,7 @@ class ServiceClient:
     ) -> AsyncIterator[Any]:
         """Send a request; yield response items until the end sentinel.
         Cancelling `context` sends CANCEL (graceful) / KILL to the worker."""
+        await gate_async_check("service.call", retryable_exc=ServiceUnavailable)
         conn = await self._get_conn(address)
         sid = next(conn.ids)
         q: asyncio.Queue = asyncio.Queue()

@@ -12,6 +12,7 @@ from __future__ import annotations
 import logging
 from typing import Any, AsyncIterator, Optional
 
+from ..chaos.gate import gate_async_check
 from ..engine import ForwardPassMetrics, JaxEngine
 from ..frontend.service import register_llm
 from ..llm import ModelDeploymentCard, RuntimeConfig
@@ -125,6 +126,10 @@ class EngineWorker:
         self.engine = engine
 
     async def handle(self, request: Any, context: Context) -> AsyncIterator[Any]:
+        # chaos "wedge": accept the request and never yield — the process
+        # stays alive, so ONLY the through-the-request-path health check
+        # can catch it (health probes run this same handler)
+        await gate_async_check("worker.generate")
         if isinstance(request, dict) and "control" in request:
             async for out in self._control(request):
                 yield out

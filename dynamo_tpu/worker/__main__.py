@@ -42,6 +42,10 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--component", default="backend")
     ap.add_argument("--endpoint", default="generate")
     ap.add_argument("--mock", action="store_true", help="MockEngine simulator")
+    ap.add_argument("--mock-speedup", type=float, default=10.0,
+                    help="MockEngine speedup_ratio (with --mock): <1 slows "
+                         "the simulator down — chaos scenarios use this to "
+                         "make mid-stream kills deterministic")
     ap.add_argument("--vision", default="", choices=["", "tiny"],
                     help="attach a vision tower (multimodal chat); 'tiny' "
                          "pairs the test tower with --model tiny")
@@ -353,6 +357,18 @@ async def _run(args) -> None:
             namespace=args.namespace, component=args.component,
             endpoint=args.endpoint,
         )
+    import os as _os
+
+    chaos_injector = None
+    if _os.environ.get("DYN_TPU_CHAOS"):
+        # chaos-enabled deployment: arm/disarm gate faults in this process
+        # via /chaos control-plane keys (chaos/injector.py)
+        from ..chaos import FaultInjector
+
+        chaos_injector = await FaultInjector(
+            runtime, namespace=args.namespace,
+            ident=f"{args.component}:{runtime.primary_lease}",
+        ).start()
     # per-process observability: /health probes the engine through its real
     # request path (reference system_status_server.rs:74, health_check.rs:353)
     status = health = None
@@ -362,7 +378,23 @@ async def _run(args) -> None:
 
         from ..runtime.metrics import MetricsScope
 
-        health = HealthCheckManager(runtime).start()
+        def _self_evict(name, st):
+            # the liveness-kill analog: a wedged engine (alive process,
+            # dead request path) exits nonzero so the operator's reconcile
+            # loop replaces it; in-flight streams migrate to survivors
+            logging.getLogger(__name__).error(
+                "endpoint %s unhealthy (%d consecutive failures) — "
+                "self-evicting", name, st.consecutive_failures,
+            )
+            _os._exit(3)  # noqa: SLF001 — hard exit IS the semantics
+
+        health = HealthCheckManager(
+            runtime, publish=True,
+            on_unhealthy=(
+                _self_evict if _os.environ.get("DYN_TPU_HEALTH_SELF_EVICT")
+                else None
+            ),
+        ).start()
 
         def _stats():
             try:
@@ -402,6 +434,8 @@ async def _run(args) -> None:
         await status.stop()
     if health:
         await health.stop()
+    if chaos_injector:
+        await chaos_injector.stop()
     await runtime.shutdown()
     if hasattr(engine, "shutdown"):
         await engine.shutdown()
@@ -417,19 +451,26 @@ def _build_engine(args):
     ecfg = engine_config_from_args(args)
     if args.mock:
         from ..mocker import MockEngine, MockEngineArgs
+        from ..testing import tiny_tokenizer
 
+        tok = tiny_tokenizer()
         margs = MockEngineArgs(
             num_pages=args.num_pages,
             page_size=args.page_size,
             max_num_seqs=args.max_num_seqs,
             max_prefill_tokens=args.max_prefill_tokens,
             max_model_len=args.max_model_len,
-            speedup_ratio=10.0,
+            speedup_ratio=args.mock_speedup,
+            # generate INSIDE the tokenizer's vocab: the simulated tokens
+            # detokenize to visible text, so e2e clients (and the chaos
+            # harness's stream-identity checks) see real content.  The eos
+            # id must come from the same tokenizer — the 32000-vocab
+            # default of 2 is a special token here, and _mock_token avoids
+            # emitting whatever id is designated eos
+            vocab_size=tok.vocab_size,
+            eos_token_id=list(tok.eos_token_ids)[0],
         )
         engine = MockEngine(margs)
-        from ..testing import tiny_tokenizer
-
-        tok = tiny_tokenizer()
         mdc = ModelDeploymentCard(
             name=args.model_name or "mock-model",
             tokenizer_json=tok.to_json_str(),

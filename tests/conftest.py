@@ -46,6 +46,16 @@ def pytest_configure(config):
         "timeout(seconds): documented cap for subprocess-heavy tests "
         "(inert without pytest-timeout; the harness async cap governs)",
     )
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from tier-1 (`-m 'not slow'`)",
+    )
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection scenario over the operator-managed stack "
+        "(tests/test_chaos.py; deliberately NOT slow — the 5 core "
+        "kill/partition scenarios are tier-1 gates, select with -m chaos)",
+    )
     import shutil
     import subprocess
 

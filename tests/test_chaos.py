@@ -1,0 +1,247 @@
+"""Chaos harness: deterministic fault injection over the operator stack.
+
+The ≥5 kill/partition scenarios of ROADMAP VERDICT #9, each run through
+`dynamo_tpu.chaos.ScenarioRunner` against an operator-managed graph with
+live streaming traffic, asserting: zero client-visible errors, token/text
+streams identical to an unfaulted run, controller re-convergence, and the
+fault visible in telemetry (migrations_total on the frontend /metrics,
+health flips, gate fired counts).
+
+Reference: tests/fault_tolerance/ in the study reference (worker kills
+under live traffic); these scenarios add control-plane partitions, disagg
+handoff loss and wedged-engine eviction on top, all seeded/deterministic.
+"""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.chaos import FaultGate, FaultPlan, FaultSpec
+from dynamo_tpu.chaos.gate import DROP, PARTITION, WEDGE
+from dynamo_tpu.chaos.scenarios import run_scenario
+
+pytestmark = pytest.mark.chaos
+
+
+async def _run(name, tmp_path):
+    result = await run_scenario(name, log_dir=str(tmp_path))
+    print(result.to_json())
+    assert result.passed, result.failure
+    assert result.client_errors == 0
+    assert result.stream_mismatches == 0
+    return result
+
+
+@pytest.mark.timeout(240)
+async def test_scenario_worker_kill_midstream(tmp_path):
+    """SIGKILL a serving replica under 4 live streams: every stream
+    completes token-identically via migration, the controller respawns
+    the replica, and migrations_total advances on frontend /metrics."""
+    result = await _run("worker_kill_midstream", tmp_path)
+    assert result.migrations_total >= 1
+    assert result.converge_s >= 0
+
+
+@pytest.mark.timeout(240)
+async def test_scenario_multinode_rank_death(tmp_path):
+    """Killing ONE rank of a 2-host worker group tears down and respawns
+    the whole group (lockstep state is indivisible) while traffic
+    survives on the sibling component."""
+    result = await _run("multinode_rank_death", tmp_path)
+    assert result.telemetry.get("group_pids")
+
+
+@pytest.mark.timeout(240)
+async def test_scenario_control_plane_partition(tmp_path):
+    """A 2s control-plane partition of the frontend: streams keep flowing
+    (the service plane is direct TCP), the primary lease survives via
+    keepalive retry, and post-heal discovery observes a scale-up."""
+    result = await _run("control_plane_partition", tmp_path)
+    assert result.telemetry.get("lease_survived") is True
+    assert result.telemetry.get("post_heal_instances") == 3
+
+
+@pytest.mark.timeout(240)
+async def test_scenario_disagg_handoff_drop(tmp_path):
+    """Dropping the next prefill→decode KV handoff falls back to a local
+    prefill token-identically, then the handoff path recovers."""
+    result = await _run("disagg_handoff_drop", tmp_path)
+    assert result.telemetry == {
+        "kv_transfers": 2, "prefill_fallbacks": 1, "gate_fired": 1,
+    }
+
+
+@pytest.mark.timeout(240)
+async def test_scenario_wedged_engine_eviction(tmp_path):
+    """A wedged engine (alive process, dead request path) is caught only
+    by the health check, publishes unhealthy, self-evicts; streams
+    migrate and the operator respawns a healthy replica."""
+    result = await _run("wedged_engine_eviction", tmp_path)
+    assert result.migrations_total >= 1
+    assert result.telemetry.get("unhealthy_flips", 0) >= 1
+
+
+# --------------------------------------------------------------------------- #
+# Unit: the fault gate, plan serialization, cross-process arming
+# --------------------------------------------------------------------------- #
+
+
+def test_fault_gate_count_and_duration():
+    gate = FaultGate.install()
+    try:
+        gate.arm("p", DROP, count=2)
+        assert gate.consume("p").kind == DROP
+        assert gate.consume("p").kind == DROP
+        assert gate.consume("p") is None  # count exhausted → disarmed
+        assert gate.fired["p"] == 2
+
+        gate.arm("q", PARTITION, duration_s=0.01)
+        assert gate.consume("q") is not None
+        import time
+
+        time.sleep(0.02)
+        assert gate.consume("q") is None  # self-healed on the deadline
+    finally:
+        FaultGate.uninstall()
+    # with no gate installed the hook is inert
+    from dynamo_tpu.chaos.gate import gate_check
+
+    assert gate_check("p") is None
+
+
+async def test_wedge_blocks_until_disarmed():
+    gate = FaultGate.install()
+    try:
+        gate.arm("w", WEDGE)
+        waiter = asyncio.create_task(gate.wedge_wait("w"))
+        await asyncio.sleep(0.05)
+        assert not waiter.done()
+        gate.disarm("w")
+        await asyncio.wait_for(waiter, 1.0)
+    finally:
+        FaultGate.uninstall()
+
+
+def test_fault_plan_roundtrip_and_validation():
+    plan = FaultPlan(seed=7, faults=[
+        FaultSpec(kind="kill_replica", component="backend", after_tokens=3),
+        FaultSpec(kind="partition", target="local", point="control.call",
+                  duration_s=1.5),
+    ])
+    back = FaultPlan.from_json(plan.to_json())
+    assert back.seed == 7 and len(back.faults) == 2
+    assert back.faults[1].point == "control.call"
+    # seeded choices replay identically
+    assert plan.rng().randrange(100) == back.rng().randrange(100)
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        FaultSpec(kind="nope")
+    with pytest.raises(ValueError, match="gate point"):
+        FaultSpec(kind="drop")
+    with pytest.raises(ValueError, match="component"):
+        FaultSpec(kind="kill_replica")
+
+
+async def test_injector_arms_gate_from_control_plane():
+    """arm_remote → /chaos key → FaultInjector (fnmatch on its identity)
+    → process-local gate armed; delete → disarmed; foreign targets are
+    ignored."""
+    from dynamo_tpu.chaos import FaultInjector, arm_remote, disarm_remote
+    from dynamo_tpu.runtime import ControlPlaneServer, DistributedRuntime
+
+    control = await ControlPlaneServer().start()
+    rt = await DistributedRuntime.connect(control.address)
+    FaultGate.uninstall()  # fresh gate owned by the injector
+    injector = await FaultInjector(rt, namespace="ns",
+                                   ident="backend:42").start()
+    try:
+        await arm_remote(rt.control, "ns", "backend:*", "worker.generate",
+                         WEDGE, duration_s=30.0)
+        deadline = asyncio.get_running_loop().time() + 5
+        while injector.gate.armed("worker.generate") is None:
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.02)
+
+        # a fault for some OTHER worker must not arm here
+        await arm_remote(rt.control, "ns", "backend:7", "disagg.handoff",
+                         DROP, count=1)
+        await asyncio.sleep(0.2)
+        assert injector.gate.armed("disagg.handoff") is None
+
+        await disarm_remote(rt.control, "ns", "backend:*", "worker.generate")
+        deadline = asyncio.get_running_loop().time() + 5
+        while injector.gate.armed("worker.generate") is not None:
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.02)
+    finally:
+        await injector.stop()
+        FaultGate.uninstall()
+        await rt.shutdown(graceful=False)
+        await control.stop()
+
+
+async def test_injector_reconciles_missed_disarm_on_reconnect():
+    """A disarm issued while the injector's watch was down produces no
+    delete event; the reconnect snapshot + sync reconcile must disarm the
+    fault anyway (and must NOT re-arm surviving faults afresh)."""
+    from dynamo_tpu.chaos import FaultInjector, arm_remote, disarm_remote
+    from dynamo_tpu.runtime import ControlPlaneServer, DistributedRuntime
+
+    control = await ControlPlaneServer().start()
+    rt = await DistributedRuntime.connect(control.address)      # injector's
+    admin = await DistributedRuntime.connect(control.address)   # runner's
+    FaultGate.uninstall()
+    injector = await FaultInjector(rt, namespace="ns",
+                                   ident="backend:1").start()
+    try:
+        await arm_remote(admin.control, "ns", "backend:*",
+                         "worker.generate", WEDGE, duration_s=60.0)
+        deadline = asyncio.get_running_loop().time() + 5
+        while injector.gate.armed("worker.generate") is None:
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.02)
+        armed = injector.gate.armed("worker.generate")
+
+        # sever the injector's control connection, then disarm while it
+        # is down — the delete event is lost
+        rt.control._writer.close()  # noqa: SLF001
+        await disarm_remote(admin.control, "ns", "backend:*",
+                            "worker.generate")
+
+        deadline = asyncio.get_running_loop().time() + 10
+        while injector.gate.armed("worker.generate") is not None:
+            assert asyncio.get_running_loop().time() < deadline, (
+                "missed disarm never reconciled on reconnect"
+            )
+            await asyncio.sleep(0.05)
+        # the original fault object was disarmed, not replaced by a
+        # fresh re-arm with a reset deadline
+        assert injector.gate.armed("worker.generate") is not armed
+    finally:
+        await injector.stop()
+        FaultGate.uninstall()
+        for r in (rt, admin):
+            await r.shutdown(graceful=False)
+        await control.stop()
+
+
+async def test_control_plane_partition_gate_severs_and_heals():
+    """The control.call gate makes a live client behave exactly like a
+    partitioned one: calls raise ConnectionError, the socket drops, and
+    after the fault expires the client transparently reconnects."""
+    from dynamo_tpu.runtime import ControlPlaneServer
+    from dynamo_tpu.runtime.transport.control_plane import ControlPlaneClient
+
+    control = await ControlPlaneServer().start()
+    client = await ControlPlaneClient(control.address).connect()
+    try:
+        await client.put("/k", b"v")
+        gate = FaultGate.install()
+        gate.arm("control.call", PARTITION, duration_s=0.3)
+        with pytest.raises(ConnectionError):
+            await client.get("/k")
+        await asyncio.sleep(0.35)
+        assert await client.get("/k") == b"v"  # healed + reconnected
+    finally:
+        FaultGate.uninstall()
+        await client.close()
+        await control.stop()

@@ -93,6 +93,195 @@ async def test_migration_limit_exhausted():
     assert out[-1]["finish_reason"] == "error"
 
 
+async def test_migration_backoff_pacing_and_telemetry():
+    """No-progress retries are paced by capped exponential backoff with
+    jitter; post-progress failures retry immediately; every migration
+    event reaches the on_migration callback."""
+    import random
+
+    from dynamo_tpu.llm.migration import _backoff_s
+
+    # the backoff curve itself: exponential, jittered in [0.5, 1.0) of
+    # the step, capped, and disabled at base 0
+    rng = random.Random(0)
+    steps = [_backoff_s(a, 50, 400, rng) for a in range(1, 6)]
+    for attempt, s in enumerate(steps, start=1):
+        cap = min(50 * 2 ** (attempt - 1), 400)
+        assert cap * 0.5 / 1e3 <= s < cap / 1e3, (attempt, s)
+    assert _backoff_s(3, 0, 400) == 0.0
+
+    # a dead factory (never progresses): exhaustion after `limit` paced
+    # retries, with the event trail on the callback
+    events = []
+    loop = asyncio.get_running_loop()
+
+    async def dead_factory(request, context):
+        raise RemoteStreamError("worker gone")
+        yield  # pragma: no cover
+
+    t0 = loop.time()
+    out = []
+    async for o in migrating_stream(req([1], 5), Context(), dead_factory,
+                                    migration_limit=2, backoff_ms=40,
+                                    backoff_max_ms=80,
+                                    on_migration=events.append,
+                                    _rng=random.Random(1)):
+        out.append(o)
+    elapsed = loop.time() - t0
+    assert out[-1]["finish_reason"] == "error"
+    assert events == ["migrated", "migrated", "exhausted"]
+    # two no-progress retries: at least half of 40ms + half of 80ms
+    assert elapsed >= 0.055, elapsed
+
+    # progress resets the budget AND skips the backoff
+    calls = {"n": 0}
+
+    async def flaky(request, context):
+        calls["n"] += 1
+        yield {"token_ids": [calls["n"]]}
+        raise RemoteStreamError("died after progress")
+
+    events.clear()
+    t0 = loop.time()
+    out = []
+    async for o in migrating_stream(req([1], 3), Context(), flaky,
+                                    migration_limit=1, backoff_ms=200,
+                                    backoff_max_ms=200,
+                                    on_migration=events.append):
+        out.append(o)
+    # 3 tokens delivered across 3 attempts, each a fresh incident: no
+    # exhaustion despite limit=1, and no 200ms pauses (progress path)
+    assert [t for o in out for t in o.get("token_ids", [])] == [1, 2, 3]
+    assert out[-1]["finish_reason"] == "length"
+    assert "exhausted" not in events
+    assert loop.time() - t0 < 0.15
+
+
+async def test_health_check_wedged_engine_recovers():
+    """A wedged engine (accepts requests, never yields) crosses
+    failure_threshold through probe timeouts — and a later recovery
+    resets the state (healthy, failures 0)."""
+    wedged = {"on": True}
+    probes = {"contexts": [], "closed": 0}
+
+    async def handler(request, context):
+        probes["contexts"].append(context)
+        try:
+            if wedged["on"]:
+                await asyncio.Event().wait()  # accepts, never yields
+            yield {"ok": True}
+        finally:
+            probes["closed"] += 1
+
+    control = await ControlPlaneServer().start()
+    rt = await DistributedRuntime.connect(control.address)
+    ep = rt.namespace("ns").component("c").endpoint("generate")
+    await ep.serve_endpoint(handler, health_check_payload={"probe": 1})
+    crossed = []
+    hc = HealthCheckManager(rt, interval=0.05, timeout=0.1,
+                            failure_threshold=2,
+                            on_unhealthy=lambda n, st: crossed.append(n))
+    name = "ns.c.generate"
+    try:
+        await hc.check_all()
+        assert hc.state[name].consecutive_failures == 1
+        assert not crossed  # below threshold: no eviction callback yet
+        await hc.check_all()
+        st = hc.state[name]
+        assert not st.healthy and st.consecutive_failures == 2
+        assert crossed == [name]  # fired exactly once per episode
+        await hc.check_all()
+        assert crossed == [name]
+
+        # probe timeout must not leak the probe: context killed, async
+        # generator closed
+        assert probes["contexts"] and all(
+            c.is_killed() for c in probes["contexts"]
+        )
+        await asyncio.sleep(0.05)  # let cancelled probes unwind
+        assert probes["closed"] == len(probes["contexts"])
+
+        wedged["on"] = False
+        await hc.check_all()
+        st = hc.state[name]
+        assert st.healthy and st.consecutive_failures == 0
+        # recovery probes complete normally and are not killed
+        assert not probes["contexts"][-1].is_killed()
+    finally:
+        await rt.shutdown(graceful=False)
+        await control.stop()
+
+
+async def test_health_state_published_to_control_plane():
+    """publish=True mirrors per-endpoint health into lease-scoped
+    /health keys on every flip (workers' HealthCheckManager feeds the
+    frontend's HealthWatcher + endpoint_healthy gauge through these)."""
+    from dynamo_tpu.runtime.transport.wire import unpack
+
+    ok = {"on": True}
+
+    async def handler(request, context):
+        if not ok["on"]:
+            raise RuntimeError("boom")
+        yield {"ok": True}
+
+    control = await ControlPlaneServer().start()
+    rt = await DistributedRuntime.connect(control.address)
+    ep = rt.namespace("ns").component("c").endpoint("generate")
+    await ep.serve_endpoint(handler, health_check_payload={"probe": 1})
+    hc = HealthCheckManager(rt, interval=0.05, timeout=0.2,
+                            failure_threshold=2, publish=True)
+    key = f"/health/ns/c/generate/{rt.primary_lease}"
+    try:
+        await hc.check_all()
+        data = await rt.control.get(key)
+        assert data is not None and unpack(data)["healthy"] is True
+
+        ok["on"] = False
+        await hc.check_all()
+        await hc.check_all()
+        data = await rt.control.get(key)
+        state = unpack(data)
+        assert state["healthy"] is False
+        assert state["consecutive_failures"] >= 2
+    finally:
+        await rt.shutdown(graceful=False)
+        await control.stop()
+
+
+async def test_keepalive_survives_lease_loss_and_republishes():
+    """A lease lost to a partition longer than the TTL: the keepalive
+    loop re-grants and re-publishes every lease-scoped key, so the
+    worker re-converges into discovery instead of silently vanishing."""
+    control = await ControlPlaneServer().start()
+    rt = await DistributedRuntime.connect(control.address, lease_ttl=0.6)
+
+    async def handler(request, context):
+        yield {"ok": True}
+
+    ep = rt.namespace("ns").component("c").endpoint("generate")
+    served = await ep.serve_endpoint(handler)
+    path = served.instance.path
+    try:
+        assert await rt.control.get(path) is not None
+        # simulate lease expiry server-side (the partition outlived the
+        # TTL): the key vanishes with the lease
+        old_lease = rt.primary_lease
+        await rt.control.revoke(old_lease)
+        assert await rt.control.get(path) is None
+
+        deadline = asyncio.get_running_loop().time() + 10
+        while await rt.control.get(path) is None:
+            assert asyncio.get_running_loop().time() < deadline, (
+                "instance key never re-published after lease loss"
+            )
+            await asyncio.sleep(0.05)
+        assert rt.primary_lease != old_lease  # re-granted
+    finally:
+        await rt.shutdown(graceful=False)
+        await control.stop()
+
+
 async def test_health_check_through_request_path():
     control = await ControlPlaneServer().start()
     rt = await DistributedRuntime.connect(control.address)
