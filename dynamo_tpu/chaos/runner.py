@@ -435,11 +435,20 @@ class ChaosStack:
 
 
 class ScenarioRunner:
-    """Runs one Scenario end to end and scores the invariants."""
+    """Runs one Scenario end to end and scores the invariants.
 
-    def __init__(self, scenario: Scenario, log_dir: str = ""):
+    With `timeline_dir` set, the run also produces a per-scenario
+    TIMELINE ARTIFACT: every process (the in-process frontend AND the
+    graph's worker processes, which inherit the env) exports OTLP spans
+    to a shared per-scenario file, and after the run the spans merge into
+    one Chrome-trace/Perfetto JSON — so a fault's effect on live streams
+    is a timeline you open, not a counter you infer from."""
+
+    def __init__(self, scenario: Scenario, log_dir: str = "",
+                 timeline_dir: str = ""):
         self.scenario = scenario
         self.log_dir = log_dir
+        self.timeline_dir = timeline_dir
         self.stack: Optional[ChaosStack] = None
         self.baseline: List[StreamOutcome] = []
         self.outcomes: List[StreamOutcome] = []
@@ -450,6 +459,20 @@ class ScenarioRunner:
             return await s.custom()
         log_path = (os.path.join(self.log_dir, f"chaos_{s.name}.log")
                     if self.log_dir else "")
+        spans_path = ""
+        if self.timeline_dir:
+            from ..runtime import tracing
+
+            os.makedirs(self.timeline_dir, exist_ok=True)
+            spans_path = os.path.join(
+                self.timeline_dir, f"chaos_{s.name}_spans.jsonl"
+            )
+            # drop any cached exporter so the in-process frontend re-reads
+            # the scenario's DYN_OTEL_FILE; graph processes inherit it
+            import dataclasses as _dc
+
+            tracing.close_exporter()
+            s = _dc.replace(s, env={**s.env, "DYN_OTEL_FILE": spans_path})
         self.stack = ChaosStack(s.graph, s.env, log_path)
         result = ScenarioResult(name=s.name, passed=False,
                                 streams=s.traffic.requests)
@@ -504,7 +527,33 @@ class ScenarioRunner:
         finally:
             if self.stack is not None:
                 await self.stack.stop()
+            if spans_path:
+                result.telemetry["timeline"] = self._attach_timeline(
+                    s.name, spans_path
+                )
         return result
+
+    def _attach_timeline(self, name: str, spans_path: str) -> str:
+        """Flush the in-process exporter and merge this scenario's span
+        file into a Chrome-trace artifact; returns its path ("" on
+        failure — the timeline is an artifact, never a gate)."""
+        from ..runtime import timeline, tracing
+
+        tracing.close_exporter()
+        out = os.path.join(self.timeline_dir, f"chaos_{name}_timeline.json")
+        try:
+            doc = timeline.merge_timeline([spans_path], out_path=out)
+            errors = timeline.validate_chrome_trace(doc)
+            if errors:
+                logger.warning("chaos timeline for %s failed schema "
+                               "validation (%d issue(s)); artifact kept "
+                               "at %s for debugging", name, len(errors), out)
+                return ""
+            return out
+        except Exception:  # noqa: BLE001 — the timeline is an artifact,
+            # never a gate: a merge bug must not fail a passing scenario
+            logger.exception("chaos timeline merge failed for %s", name)
+            return ""
 
 
 def _counter_total(counter) -> float:

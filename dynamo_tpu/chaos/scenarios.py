@@ -355,12 +355,15 @@ SCENARIOS = {
 }
 
 
-async def run_scenario(name: str, log_dir: str = "") -> ScenarioResult:
-    return await ScenarioRunner(SCENARIOS[name](), log_dir=log_dir).run()
+async def run_scenario(name: str, log_dir: str = "",
+                       timeline_dir: str = "") -> ScenarioResult:
+    return await ScenarioRunner(SCENARIOS[name](), log_dir=log_dir,
+                                timeline_dir=timeline_dir).run()
 
 
-async def run_all(log_dir: str = "") -> list:
+async def run_all(log_dir: str = "", timeline_dir: str = "") -> list:
     results = []
     for name in SCENARIOS:
-        results.append(await run_scenario(name, log_dir=log_dir))
+        results.append(await run_scenario(name, log_dir=log_dir,
+                                          timeline_dir=timeline_dir))
     return results

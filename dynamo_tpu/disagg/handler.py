@@ -213,28 +213,40 @@ class DisaggDecodeHandler:
                 yield out
             return
         # -- remote prefill ------------------------------------------------- #
+        from ..runtime.tracing import span
+
         prefill_ctx = context.child()
         self._inflight_prefills += 1
+        events = getattr(self.engine, "events", None)
+        t0_ev = events.now() if events is not None else None
         try:
-            # chaos "drop"/"delay" of the disagg KV handoff: raising here
-            # rides the same recovery path a real prefill-worker loss does
-            await gate_async_check(
-                "disagg.handoff", retryable_exc=ServiceUnavailable
-            )
-            if self.prefill_router is not None:
-                key = await self.prefill_router.choose(
-                    {**request, "request_id": prefill_ctx.id}
+            # the prefill→decode handoff as one span under the request's
+            # trace: the remote prefill worker's spans nest under it via
+            # the wire headers, so a disaggregated request still reads as
+            # ONE connected trace
+            with span("disagg.handoff", prompt_len=len(prompt)):
+                # chaos "drop"/"delay" of the disagg KV handoff: raising
+                # here rides the same recovery path a real prefill-worker
+                # loss does
+                await gate_async_check(
+                    "disagg.handoff", retryable_exc=ServiceUnavailable
                 )
-                inst, dp_rank = unpack_worker(key)
-                stream = self.prefill_client.direct(
-                    {**request, "dp_rank": dp_rank}, inst, prefill_ctx
-                )
-            else:
-                stream = self.prefill_client.round_robin(request, prefill_ctx)
-            result = None
-            async for item in stream:
-                result = item
-                break
+                if self.prefill_router is not None:
+                    key = await self.prefill_router.choose(
+                        {**request, "request_id": prefill_ctx.id}
+                    )
+                    inst, dp_rank = unpack_worker(key)
+                    stream = self.prefill_client.direct(
+                        {**request, "dp_rank": dp_rank}, inst, prefill_ctx
+                    )
+                else:
+                    stream = self.prefill_client.round_robin(
+                        request, prefill_ctx
+                    )
+                result = None
+                async for item in stream:
+                    result = item
+                    break
         except (ServiceUnavailable, RemoteStreamError, OSError) as e:
             # OSError covers raw socket failures dialing a dead prefill
             # worker whose stale instance key hasn't expired yet — those
@@ -261,9 +273,15 @@ class DisaggDecodeHandler:
         if "kv_descriptor" in result:
             # block-ID data plane: fetch pages, then adopt them
             try:
-                pages, stats = await self.transfer_client.fetch(
-                    result["kv_descriptor"]
-                )
+                with span("disagg.kv_transfer") as tsp:
+                    pages, stats = await self.transfer_client.fetch(
+                        result["kv_descriptor"]
+                    )
+                    tsp.attrs.update(
+                        bytes=stats.bytes, ms=round(stats.ms, 3),
+                        lane=stats.lane, src_pages=stats.src_pages,
+                        dest_pages=stats.dest_pages,
+                    )
             except Exception as e:  # noqa: BLE001 — any failure → local
                 logger.warning("kv transfer failed (%s); prefilling locally", e)
                 self.prefill_fallback_total += 1
@@ -275,6 +293,10 @@ class DisaggDecodeHandler:
             self.kv_transfer_bytes_total += stats.bytes
             if stats.lane in ("device", "dma"):
                 self.kv_transfer_device_count += 1
+            if events is not None:
+                # handoff lands on the decode engine's step timeline too
+                events.record("handoff", t0_ns=t0_ev,
+                              bytes=stats.bytes, lane=stats.lane)
             logger.debug(
                 "kv transfer %d pages -> %d pages, %.1fKB in %.1fms",
                 stats.src_pages, stats.dest_pages, stats.bytes / 1024, stats.ms,

@@ -293,6 +293,10 @@ class KvTransferSource:
             ))
             await writer.drain()
             return
+        from ..runtime.tracing import trace_from_headers
+
+        trace = trace_from_headers(frame.header)
+        t0_wall = time.time_ns()
         held.deadline = time.monotonic() + self.ttl  # claimed; re-arm
         chunk_pages = max(1, _CHUNK_BYTES // max(self.layout.bytes_per_page, 1))
         pages = held.pages
@@ -327,6 +331,14 @@ class KvTransferSource:
                 await writer.drain()
         write_frame(writer, Frame(K_END, frame.stream_id, {}, b""))
         await writer.drain()
+        if trace is not None:
+            # the source side of the data-plane hop on the request's
+            # trace — adopted from the fetch frame's headers
+            from ..runtime.tracing import export_span
+
+            export_span("transfer.serve_fetch", trace, t0_wall,
+                        time.time_ns(), transfer_id=tid,
+                        pages=len(pages), seq_frames=seq)
 
 
 @dataclass
@@ -516,8 +528,15 @@ class KvTransferClient:
         ddtype = np.dtype(dst.dtype)
         L, kvh, hd = src.layers, src.n_kv_heads, src.head_dim
         try:
+            from ..runtime.tracing import trace_headers
+
+            # the data plane is a trace hop too: the source side adopts
+            # these headers so its serve-side span joins the request's
+            # trace (every egress point propagates, not just service.call)
             write_frame(writer, Frame(
-                K_REQ, 1, {"op": "fetch", "transfer_id": descriptor["transfer_id"]},
+                K_REQ, 1,
+                {"op": "fetch", "transfer_id": descriptor["transfer_id"],
+                 **trace_headers()},
                 b"",
             ))
             await writer.drain()

@@ -1332,6 +1332,27 @@ class JaxEngine:
         # optional dispatch trace (tests / debugging): set to a list and
         # every device dispatch appends {kind, n_steps, pending}
         self.dispatch_trace: Optional[List[dict]] = None
+        # step-event ring (runtime.events): admit/dispatch/rung/spec/pool
+        # events with monotonic-ns stamps — dumped by the worker debug
+        # endpoint and merged into the Perfetto timeline.  Scheduler and
+        # pool record through the same ring so one dump is the whole
+        # engine's step history
+        from ..runtime.events import StepEventRecorder
+
+        self.events = StepEventRecorder.from_env()
+        self.scheduler.events = self.events
+        for p in getattr(self.pool, "pools", [self.pool]):
+            p.events = self.events
+        # env-gated jax.profiler capture: DYN_TPU_XPROF_STEPS=N traces the
+        # next N engine steps into DYN_TPU_XPROF_DIR (default profiles/)
+        # once the pump starts dispatching — the on-chip attribution the
+        # ROADMAP perf items need, off unless asked for
+        from ..runtime.config import env_int, env_str
+
+        self._xprof_steps = env_int("DYN_TPU_XPROF_STEPS", 0)
+        self._xprof_dir = env_str("DYN_TPU_XPROF_DIR", "profiles")
+        self._xprof_started_at: Optional[int] = None
+        self._xprof_done = self._xprof_steps <= 0
 
     def attach_connector(self, connector) -> None:
         """Attach a KVBM connector (kvbm.KvConnector shape: on_event /
@@ -1712,6 +1733,9 @@ class JaxEngine:
         seq.t_arrival = time.monotonic()
         seq.seed = opts.seed if opts.seed is not None else self._py_rng.getrandbits(31)
         seq.hold_pages = bool(request.get("_hold_pages"))
+        from ..runtime.tracing import current_trace
+
+        seq.trace = current_trace()  # milestone spans join this trace
         if (request.get("mm_pixels") or request.get("mm_embeds")
                 or request.get("mm_patches")):
             err = self._attach_mm(seq, request)
@@ -1779,6 +1803,12 @@ class JaxEngine:
     async def shutdown(self) -> None:
         self._closed = True
         self._wake.set()
+        if self._xprof_started_at is not None and not self._xprof_done:
+            self._xprof_done = True
+            try:
+                jax.profiler.stop_trace()
+            except Exception:  # noqa: BLE001 — best-effort flush on exit
+                pass
         if self._pump_task:
             await asyncio.gather(self._pump_task, return_exceptions=True)
         if self._multihost and self._lockstep_leader:
@@ -1870,6 +1900,8 @@ class JaxEngine:
                 else:
                     await asyncio.sleep(0)
                 continue
+            if not self._xprof_done:
+                self._xprof_start()
             try:
                 if plan.kind == "prefill":
                     await loop.run_in_executor(
@@ -1884,7 +1916,42 @@ class JaxEngine:
                 logger.exception("engine step failed; resetting KV state")
                 self._recover_after_error()
             self._step_count += 1
+            if not self._xprof_done:
+                self._xprof_stop_if_due()
             await asyncio.sleep(0)
+
+    # -- xprof capture (DYN_TPU_XPROF_STEPS) --------------------------------- #
+
+    def _xprof_start(self) -> None:
+        """First non-idle plan with capture armed: start the jax.profiler
+        trace.  A failed start disables capture for the engine's lifetime
+        (profiling must never take down serving)."""
+        if self._xprof_started_at is not None:
+            return
+        try:
+            import os as _os
+
+            _os.makedirs(self._xprof_dir, exist_ok=True)
+            jax.profiler.start_trace(self._xprof_dir)
+            self._xprof_started_at = self._step_count
+            logger.info("xprof: tracing %d engine step(s) into %s",
+                        self._xprof_steps, self._xprof_dir)
+        except Exception:  # noqa: BLE001
+            self._xprof_done = True
+            logger.exception("xprof start failed; capture disabled")
+
+    def _xprof_stop_if_due(self) -> None:
+        if (self._xprof_started_at is None
+                or self._step_count - self._xprof_started_at
+                < self._xprof_steps):
+            return
+        self._xprof_done = True
+        try:
+            jax.profiler.stop_trace()
+            logger.info("xprof: capture complete (%d steps) in %s",
+                        self._xprof_steps, self._xprof_dir)
+        except Exception:  # noqa: BLE001
+            logger.exception("xprof stop failed")
 
     # -- device steps (worker thread) ---------------------------------------- #
 
@@ -2094,6 +2161,8 @@ class JaxEngine:
             self._rung_dispatches[n_steps] = (
                 self._rung_dispatches.get(n_steps, 0) + blocks
             )
+        self.events.record("dispatch", step=kind, n_steps=n_steps,
+                           blocks=blocks)
         if self.dispatch_trace is not None:
             self.dispatch_trace.append({
                 "kind": kind, "n_steps": n_steps, "blocks": blocks,
@@ -2102,6 +2171,7 @@ class JaxEngine:
             })
 
     def _run_prefill(self, items: List[PrefillItem]) -> None:
+        t0_ev = self.events.now()
         self._note_dispatch("prefill")
         item_rows = self._prefill_rows(items)
         B = len(item_rows)
@@ -2192,6 +2262,11 @@ class JaxEngine:
             self.scheduler.deferred_free = None
             if deferred:
                 self.pool.free(deferred)
+            self.events.record(
+                "prefill_chunk", t0_ns=t0_ev, batch=len(items),
+                tokens=int(sum(it.chunk_len for it in items)),
+                fused_blocks=len(fused) if fused else 0,
+            )
 
     def _maybe_fuse_decode(self, items, B, tok_d, samp, seeds, counters,
                            with_top):
@@ -2357,6 +2432,7 @@ class JaxEngine:
         plan).  Decode rows' pages were reserved preemptively at planning;
         prefill rows extended non-preemptively, so the two sides cannot
         invalidate each other."""
+        t0_ev = self.events.now()
         items, dseqs = plan.prefill, plan.decode
         # prefill side (same array construction as _run_prefill)
         item_rows = self._prefill_rows(items)
@@ -2430,6 +2506,9 @@ class JaxEngine:
                     _tops_for(s, p_tids, p_tlps, i),
                 )
         self._consume_decode([d_packed_d], d_rows, Bd, with_top)
+        self.events.record("mixed_step", t0_ns=t0_ev, rung=T,
+                           prefill_batch=len(items),
+                           decode_batch=len(dseqs))
 
     def _dispatch_mixed(self, p_tokens, p_table, p_prefix, p_chunk, p_samp,
                         p_seeds, p_ctr, d_tokens, d_pos, d_ctr, d_counts,
@@ -2810,6 +2889,7 @@ class JaxEngine:
         fetch and are consumed through the ordinary per-token stop
         path (variable acceptance == variable tokens per dispatch)."""
         k = self.cfg.speculative_ngram_k
+        t0_ev = self.events.now()
         self._note_dispatch("spec")
         rows = self._decode_rows(seqs)
         B = len(rows)
@@ -2864,6 +2944,9 @@ class JaxEngine:
         self._spec_draft_total += drafted
         self._spec_accepted_total += accepted
         self._spec_window.append((drafted, accepted))
+        self.events.record("spec_round", t0_ns=t0_ev, k=k,
+                           batch=len(seqs), drafted=drafted,
+                           accepted=accepted)
 
     def _dispatch_spec(self, tokens, positions, counters, table, samp,
                        seeds, greedy, rope_off=None):
@@ -2898,6 +2981,7 @@ class JaxEngine:
         # full blocks while the prompt queue is empty, the shortest rung
         # (chaining suppressed) while prompts are pending, so a waiting
         # prompt rides the next mixed dispatch within one short block
+        t0_ev = self.events.now()
         T, allow_chain = self.scheduler.select_decode_rung()
         hard_cap = self.cfg.hard_cap
         # decide the chain length upfront and pre-reserve pages for the
@@ -2954,6 +3038,8 @@ class JaxEngine:
             self.scheduler.deferred_free = None
             if deferred:
                 self.pool.free(deferred)
+            self.events.record("decode_block", t0_ns=t0_ev, rung=T,
+                               batch=len(seqs), chain=chain_len)
 
     def _dispatch_decode(self, tokens, positions, counters, counts, table,
                          samp, seeds, penalized, with_top, chain_len,
@@ -3621,6 +3707,9 @@ class JaxEngine:
         prompt = list(request["token_ids"])
         seq = Sequence(context.id, prompt, opts)
         seq.seed = opts.seed if opts.seed is not None else self._py_rng.getrandbits(31)
+        from ..runtime.tracing import current_trace
+
+        seq.trace = current_trace()  # the disagg handoff's adopted trace
         seq.pages = pages
         if self._pooled and pages:
             seq.kv_rank = self.pool.rank_of(pages[0])
@@ -3678,6 +3767,8 @@ class JaxEngine:
             self._lockstep_send({"kind": "recover"})
         self.kv = self._make_kv()
         self.pool = self._make_pool()
+        for p in getattr(self.pool, "pools", [self.pool]):
+            p.events = self.events
         self._emit_event(KvEvent("cleared", []))
         self.scheduler.pool = self.pool
         for seq in self.scheduler.waiting:
@@ -3719,6 +3810,23 @@ class JaxEngine:
         self._ttft_queue_wait_ms_total += attr["queue_wait_ms"]
         self._ttft_prefill_ms_total += attr["prefill_ms"]
         self._ttft_attributed_total += 1
+        # milestone spans reconstructed from the attribution timestamps,
+        # exported under the request's adopted trace so the engine's TTFT
+        # anatomy nests inside the caller's service.handle span
+        if seq.trace is not None:
+            from ..runtime.tracing import export_span, wall_ns_from_monotonic
+
+            wall = wall_ns_from_monotonic
+            export_span("engine.block_wait", seq.trace,
+                        wall(seq.t_arrival), wall(seen),
+                        block_wait_ms=round(attr["block_wait_ms"], 3))
+            export_span("engine.queue_wait", seq.trace,
+                        wall(seen), wall(admitted),
+                        queue_wait_ms=round(attr["queue_wait_ms"], 3))
+            export_span("engine.prefill", seq.trace,
+                        wall(admitted), wall(now),
+                        prefill_ms=round(attr["prefill_ms"], 3),
+                        prompt_len=seq.prompt_len, cached=seq.num_cached)
 
     def _deliver(
         self,
@@ -3753,6 +3861,28 @@ class JaxEngine:
             # one-shot TTFT attribution on the first-token delta
             out["ttft"] = seq.ttft_attr
             seq.ttft_attr = None
+        if finish_reason and seq.trace is not None and (
+            seq.t_first_token is not None
+        ):
+            # close the request's engine timeline: one decode-phase span
+            # (first token → finish) carrying the stream's totals + the
+            # TTFT attribution, so a single slice answers "where did this
+            # request's time go" without cross-referencing
+            from ..runtime.tracing import export_span, wall_ns_from_monotonic
+
+            attrs = {
+                "finish_reason": finish_reason,
+                "output_tokens": len(seq.output_tokens),
+                "preemptions": seq.preemptions,
+            }
+            if seq.spec_draft_tokens:
+                attrs["spec_draft_tokens"] = seq.spec_draft_tokens
+                attrs["spec_accepted_tokens"] = seq.spec_accepted_tokens
+            export_span(
+                "engine.decode", seq.trace,
+                wall_ns_from_monotonic(seq.t_first_token),
+                wall_ns_from_monotonic(time.monotonic()), **attrs,
+            )
         # may be called from the executor thread — hop back to the loop
         self._post_threadsafe(queue, out)
 

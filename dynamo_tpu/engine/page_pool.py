@@ -55,6 +55,10 @@ class PagePool:
         # unreferenced cached pages in LRU order (evictable)
         self._lru: "OrderedDict[int, None]" = OrderedDict()
         self._event_sink = event_sink
+        # optional StepEventRecorder (runtime.events): alloc/free land on
+        # the engine step timeline; None-checked so the hot path stays a
+        # single attribute load when unwired
+        self.events = None
 
     # -- stats --------------------------------------------------------------- #
 
@@ -99,6 +103,9 @@ class PagePool:
                 out.append(self._evict_one())
         for p in out:
             self._refs[p] = self._refs.get(p, 0) + 1
+        if self.events is not None:
+            self.events.record("pool_alloc", n=n,
+                               available=self.available_pages)
         return out
 
     def _evict_one(self) -> int:
@@ -111,6 +118,8 @@ class PagePool:
     def free(self, pages: Sequence[int]) -> None:
         """Release a sequence's hold. Cached pages become evictable; others
         return to the free list."""
+        if self.events is not None and pages:
+            self.events.record("pool_free", n=len(pages))
         for p in pages:
             refs = self._refs.get(p, 0) - 1
             if refs > 0:

@@ -105,6 +105,11 @@ class Sequence:
         self.t_admitted: Optional[float] = None
         self.t_first_token: Optional[float] = None
         self.ttft_attr: Optional[dict] = None
+        # the request's TraceContext, captured at generate() where the
+        # transport's contextvar is still live — the pump thread exports
+        # per-request milestone spans (block-wait/queue-wait/prefill/
+        # decode) under it so engine time joins the caller's trace
+        self.trace = None
 
     @property
     def total_len(self) -> int:
@@ -162,6 +167,9 @@ class Scheduler:
         # straggler arriving right after the queue drains still finds a
         # short block in flight)
         self._rung_idx = 0
+        # optional StepEventRecorder (runtime.events): admissions and rung
+        # selections land on the engine step timeline
+        self.events = None
 
     def drain_errored(self) -> List[Sequence]:
         out, self.errored = self.errored, []
@@ -232,6 +240,11 @@ class Scheduler:
                 # re-admission after preemption is not queue wait
                 seq.t_admitted = time.monotonic()
             self.running.append(seq)
+            if self.events is not None:
+                self.events.record(
+                    "admit", rid=seq.request_id, rank=rank,
+                    prompt_len=seq.prompt_len, cached=seq.num_cached,
+                )
 
     def _seq_hashes(self, seq: Sequence) -> List[int]:
         """Block-hash chain for admission-time cache scoring (never hits
@@ -334,6 +347,9 @@ class Scheduler:
         rung = self._rung_for(pending)
         self._rung_idx = (0 if pending
                           else min(self._rung_idx + 1, len(ladder) - 1))
+        if self.events is not None:
+            self.events.record("rung_select", rung=rung[0],
+                               chain=rung[1], pending=pending)
         return rung
 
     def peek_decode_rung(self) -> Tuple[int, bool]:

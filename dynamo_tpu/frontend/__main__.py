@@ -152,6 +152,11 @@ async def _run(args) -> None:
     if chaos_injector:
         await chaos_injector.stop()
     await runtime.shutdown()
+    # flush + close the span exporter: SIGTERM shutdowns must not lose
+    # the final OTLP push window (atexit alone misses this path)
+    from ..runtime.tracing import close_exporter
+
+    close_exporter()
 
 
 if __name__ == "__main__":

@@ -132,6 +132,12 @@ class FrontendMetrics:
             ["endpoint", "instance"],
             registry=self.registry,
         )
+        # span-exporter visibility: a full OTLP push queue drops spans —
+        # dynamo_tracing_spans_sent_total/_dropped_total make that loss a
+        # counter on /metrics instead of a silent trace gap
+        from ..runtime.metrics import TracingSpanCollector
+
+        self.registry.register(TracingSpanCollector())
 
     def observe_migration(self, model: str, event: str) -> None:
         """Account one migrating_stream event ('migrated'/'exhausted')."""

@@ -443,16 +443,20 @@ class HttpService:
                 400, f"model '{model_name}' does not support {required}"
             )
         from ..runtime.compute import run_compute
+        from ..runtime.tracing import span
 
         try:
-            if kind == "chat":
-                preprocessed = await run_compute(
-                    entry.preprocessor.preprocess_chat, body
-                )
-            else:
-                preprocessed = await run_compute(
-                    entry.preprocessor.preprocess_completion, body
-                )
+            # the preprocessor hop (template render + tokenize) gets its
+            # own span under http.* so prompt-side TTFT cost is visible
+            with span("frontend.preprocess", model=model_name, kind=kind):
+                if kind == "chat":
+                    preprocessed = await run_compute(
+                        entry.preprocessor.preprocess_chat, body
+                    )
+                else:
+                    preprocessed = await run_compute(
+                        entry.preprocessor.preprocess_completion, body
+                    )
         except RequestError as e:
             self.metrics.requests.labels(model_name, kind, "400").inc()
             return _error_response(400, str(e))

@@ -121,6 +121,16 @@ async def migrating_stream(
             )
             if on_migration is not None:
                 on_migration(MIGRATED)
+            # the re-issue is a trace milestone: an instant span under the
+            # request's trace, so a migrated stream's timeline shows WHERE
+            # the worker hop happened — and because the retry runs in this
+            # same context, the new worker's spans join the original trace
+            from ..runtime.tracing import span as _span
+
+            with _span("migration.reissue", attempt=attempts,
+                       generated=len(generated),
+                       error=type(e).__name__):
+                pass
             if not progressed:
                 # no progress since the last attempt: pace the retry so a
                 # cluster-wide incident isn't hammered by every stream

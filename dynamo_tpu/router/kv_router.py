@@ -279,15 +279,28 @@ class KvRouter:
                     f"{self.busy_threshold:.2f}"
                 )
             workers = free
-        overlaps = self.index.find_matches(hashes)
-        if self.approx:
-            a = self.approx.find_matches(hashes)
-            for w, o in a.items():
-                overlaps[w] = max(overlaps.get(w, 0), o)
-        request_blocks = max(len(hashes), 1)
-        decision = self.selector.select(
-            workers, overlaps, request_blocks, self.active
-        )
+        # the scheduling decision as a span: chosen worker + overlap score
+        # join the request's trace, so a badly-routed outlier is visible
+        # on its timeline (reference: kv_router decision tracing)
+        from ..runtime.tracing import span as _span
+
+        with _span("router.schedule") as sp:
+            overlaps = self.index.find_matches(hashes)
+            if self.approx:
+                a = self.approx.find_matches(hashes)
+                for w, o in a.items():
+                    overlaps[w] = max(overlaps.get(w, 0), o)
+            request_blocks = max(len(hashes), 1)
+            decision = self.selector.select(
+                workers, overlaps, request_blocks, self.active
+            )
+            sp.attrs.update(
+                worker=decision.worker_id,
+                dp_rank=unpack_worker(decision.worker_id)[1],
+                overlap_blocks=decision.overlap_blocks,
+                request_blocks=request_blocks,
+                candidates=len(workers),
+            )
         rid = request.get("request_id") or request.get("id") or str(id(request))
         self.active.add_request(
             rid,

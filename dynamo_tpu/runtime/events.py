@@ -1,0 +1,111 @@
+"""Engine step event recorder — a lock-light fixed-size ring buffer.
+
+The spans in `runtime.tracing` answer "where did THIS request's time go";
+this recorder answers "what was the ENGINE doing, step by step" — admit,
+dispatch, rung selection, spec accept, pool alloc/free, disagg handoff —
+at monotonic-ns resolution with near-zero overhead, so a TTFT outlier or
+a chaos-scenario failure can be replayed as a timeline instead of
+inferred from aggregate counters (reference analog: the KV-event
+recorder + mocker step logs, here generalized to every engine decision).
+
+Design constraints:
+- the pump's executor thread records on the device-step hot path, so one
+  `record()` must stay well under 5 µs (tier-1 micro-benchmark in
+  tests/test_step_events.py) — a preallocated list slot write under a
+  plain lock, no dict churn beyond the caller's attr kwargs;
+- `dump()` is wait-free for the writer: it snapshots under the same lock
+  and carries BOTH a wall-clock and a monotonic anchor so offline tools
+  (runtime/timeline.py) can place monotonic event times on the spans'
+  wall-clock axis.
+
+The recorder is always attached to the engine; `DYN_TPU_STEP_EVENTS`
+overrides the ring capacity (0 disables recording entirely — `record`
+short-circuits on one attribute load)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+DEFAULT_CAPACITY = 4096
+
+
+class StepEventRecorder:
+    """Fixed-capacity ring of (t_ns, dur_ns, kind, attrs) tuples."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self.capacity = max(0, int(capacity))
+        self.enabled = self.capacity > 0
+        self._ring: List[Optional[tuple]] = [None] * self.capacity
+        self._n = 0  # total events ever recorded
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_env(cls) -> "StepEventRecorder":
+        from .config import env_int
+
+        return cls(env_int("DYN_TPU_STEP_EVENTS", DEFAULT_CAPACITY))
+
+    @staticmethod
+    def now() -> int:
+        """Monotonic ns — the `t0_ns` anchor for duration events."""
+        return time.monotonic_ns()
+
+    def record(self, kind: str, t0_ns: Optional[int] = None,
+               **attrs: Any) -> None:
+        """Record one event.  With `t0_ns` (a prior `now()`), the event is
+        a duration slice [t0_ns, now]; without, an instant."""
+        if not self.enabled:
+            return
+        t = time.monotonic_ns()
+        if t0_ns is not None:
+            ev = (t0_ns, t - t0_ns, kind, attrs)
+        else:
+            ev = (t, 0, kind, attrs)
+        with self._lock:
+            self._ring[self._n % self.capacity] = ev
+            self._n += 1
+
+    def __len__(self) -> int:
+        return min(self._n, self.capacity)
+
+    @property
+    def total(self) -> int:
+        return self._n
+
+    def snapshot(self) -> List[tuple]:
+        """Events in record order (oldest surviving first)."""
+        if not self.enabled:
+            return []
+        with self._lock:
+            n, ring = self._n, list(self._ring)
+        if n <= self.capacity:
+            return [e for e in ring[:n]]
+        head = n % self.capacity
+        return ring[head:] + ring[:head]
+
+    def dump(self) -> Dict[str, Any]:
+        """JSON-able ring dump with time anchors (the worker debug
+        endpoint's payload, and timeline.py's merge input).
+
+        `wall_ns - mono_ns` converts any event's monotonic time to the
+        wall clock the OTLP spans use."""
+        mono = time.monotonic_ns()
+        wall = time.time_ns()
+        return {
+            "wall_ns": wall,
+            "mono_ns": mono,
+            "capacity": self.capacity,
+            "recorded_total": self._n,
+            "dropped_total": max(0, self._n - self.capacity),
+            "events": [
+                {"t_ns": t, "dur_ns": d, "kind": k, **a}
+                for (t, d, k, a) in self.snapshot()
+            ],
+        }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring = [None] * self.capacity
+            self._n = 0

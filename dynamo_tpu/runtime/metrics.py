@@ -123,6 +123,34 @@ ENGINE_STAT_RENAMES = {
 }
 
 
+class TracingSpanCollector:
+    """`dynamo_tracing_spans_sent_total` / `_dropped_total` from the live
+    span exporter (runtime.tracing) — registered on BOTH the frontend and
+    worker /metrics registries, so a full OTLP push queue (spans silently
+    dropped) is visible as a counter instead of a mystery gap in the
+    trace.  Yields nothing when span export is disabled (absent series,
+    not zeros — the usual Prometheus idiom for an inactive subsystem)."""
+
+    def collect(self):
+        from prometheus_client.core import CounterMetricFamily
+
+        from .tracing import exporter_stats
+
+        try:
+            stats = exporter_stats()
+        except Exception:  # noqa: BLE001 — a scrape must not break /metrics
+            stats = None
+        if stats is None:
+            return
+        for key in ("sent", "dropped"):
+            fam = CounterMetricFamily(
+                f"dynamo_tracing_spans_{key}",
+                f"OTLP spans {key} by this process's exporter",
+            )
+            fam.add_metric([], stats.get(key, 0))
+            yield fam
+
+
 class EngineStatsCollector:
     """Prometheus custom collector over a live engine-stats dict
     (``vars(engine.metrics())`` — ForwardPassMetrics incl. dynamic

@@ -20,12 +20,14 @@ class SystemStatusServer:
         metrics: MetricsScope | None = None,
         health_fn: Callable[[], Awaitable[dict]] | None = None,
         stats_fn: Callable[[], dict] | None = None,
+        events_fn: Callable[[], dict] | None = None,
         host: str = "0.0.0.0",
         port: int = 0,
     ):
         self.metrics = metrics
         self.health_fn = health_fn
         self.stats_fn = stats_fn
+        self.events_fn = events_fn
         self.host = host
         self.port = port
         self._runner: web.AppRunner | None = None
@@ -36,6 +38,7 @@ class SystemStatusServer:
         app.router.add_get("/live", self._live)
         app.router.add_get("/metrics", self._metrics)
         app.router.add_get("/metrics.json", self._metrics_json)
+        app.router.add_get("/events.json", self._events_json)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)
@@ -69,6 +72,15 @@ class SystemStatusServer:
         """Component stats as JSON (engine ForwardPassMetrics incl. KV
         transfer counters on disagg decode workers)."""
         body = self.stats_fn() if self.stats_fn else {}
+        return web.Response(
+            text=json.dumps(body), content_type="application/json"
+        )
+
+    async def _events_json(self, request: web.Request) -> web.Response:
+        """Engine step-event ring dump (runtime.events.StepEventRecorder
+        — the worker debug endpoint `scripts/trace_stack.py` and the
+        timeline merger read; {} when no recorder is wired)."""
+        body = self.events_fn() if self.events_fn else {}
         return web.Response(
             text=json.dumps(body), content_type="application/json"
         )

@@ -110,6 +110,25 @@ class TraceFormatter(logging.Formatter):
 # and tests can assert cross-process trace joins from the file.
 
 _EXPORTER = None
+# guards lazy exporter construction: the engine executor thread
+# (export_span) and the event loop (span.__exit__) race on first use —
+# without the lock the loser's exporter is leaked unclosed
+import threading as _threading  # noqa: E402 — scoped to this guard
+
+_EXPORTER_LOCK = _threading.Lock()
+_ATEXIT_REGISTERED = False
+
+
+def _register_atexit_once() -> None:
+    """One process-wide atexit flush hook, however many times the
+    exporter is closed and re-created (callers hold _EXPORTER_LOCK)."""
+    global _ATEXIT_REGISTERED
+    if _ATEXIT_REGISTERED:
+        return
+    import atexit
+
+    atexit.register(close_exporter)
+    _ATEXIT_REGISTERED = True
 
 
 def _otlp_span(name: str, ctx: TraceContext, parent_span: str,
@@ -148,16 +167,30 @@ def _otlp_envelope(service_name: str, spans: list) -> dict:
 
 class SpanFileExporter:
     def __init__(self, path: str, service_name: str = "dynamo_tpu"):
+        import threading
+
         self.path = path
         self.service_name = service_name
+        self.sent = 0
+        self.dropped = 0
+        # spans export from BOTH the event loop and the engine's executor
+        # thread (per-request milestone spans) — serialize writes so two
+        # threads can't tear one line
+        self._lock = threading.Lock()
         self._f = open(path, "a", buffering=1)
 
     def export(self, name: str, ctx: TraceContext, parent_span: str,
                start_ns: int, end_ns: int, attrs: dict) -> None:
         span = _otlp_span(name, ctx, parent_span, start_ns, end_ns, attrs)
-        self._f.write(
-            json.dumps(_otlp_envelope(self.service_name, [span])) + "\n"
-        )
+        try:
+            # one json.dumps → one line-buffered write: O_APPEND keeps
+            # concurrent processes' lines whole in a shared sink file
+            line = json.dumps(_otlp_envelope(self.service_name, [span]))
+            with self._lock:
+                self._f.write(line + "\n")
+                self.sent += 1
+        except (OSError, ValueError):
+            self.dropped += 1
 
     def close(self) -> None:
         try:
@@ -262,6 +295,25 @@ class SpanHttpExporter:
         self._flush_all(deadline=time.monotonic() + 10.0)
 
 
+def default_service_name() -> str:
+    """DYN_SERVICE_NAME, else a name derived from argv: `python -m
+    dynamo_tpu.worker` runs with argv[0] = .../dynamo_tpu/worker/
+    __main__.py, whose basename alone would label every component
+    "__main__.py" — use the package directory instead."""
+    from .config import env_str
+
+    import os as _os
+
+    name = env_str("DYN_SERVICE_NAME")
+    if name:
+        return name
+    base = _os.path.basename(sys.argv[0] or "")
+    if base in ("__main__.py", ""):
+        pkg = _os.path.basename(_os.path.dirname(sys.argv[0] or ""))
+        return pkg or "dynamo_tpu"
+    return base
+
+
 def get_exporter():
     """DYN_OTEL_ENDPOINT (live OTLP/HTTP push) wins over DYN_OTEL_FILE
     (replayable OTLP/JSON lines); None disables span export."""
@@ -269,21 +321,73 @@ def get_exporter():
     if _EXPORTER is None:
         from .config import env_str
 
-        import os as _os
-
-        service = (env_str("DYN_SERVICE_NAME")
-                   or _os.path.basename(sys.argv[0]) or "dynamo_tpu")
-        endpoint = env_str("DYN_OTEL_ENDPOINT")
-        path = env_str("DYN_OTEL_FILE")
-        if endpoint:
-            import atexit
-
-            _EXPORTER = SpanHttpExporter(endpoint, service_name=service)
-            # short-lived processes must not lose the final flush window
-            atexit.register(_EXPORTER.close)
-        elif path:
-            _EXPORTER = SpanFileExporter(path, service_name=service)
+        with _EXPORTER_LOCK:
+            if _EXPORTER is not None:  # lost the construction race
+                return _EXPORTER
+            service = default_service_name()
+            endpoint = env_str("DYN_OTEL_ENDPOINT")
+            path = env_str("DYN_OTEL_FILE")
+            if endpoint:
+                _EXPORTER = SpanHttpExporter(endpoint, service_name=service)
+                # short-lived processes must not lose the final flush
+                # window; ONE module-level hook (not one per exporter —
+                # close/re-create cycles would pin every dead exporter)
+                _register_atexit_once()
+            elif path:
+                _EXPORTER = SpanFileExporter(path, service_name=service)
     return _EXPORTER
+
+
+def close_exporter() -> None:
+    """Flush + close the process exporter and clear the cache (so a later
+    `get_exporter()` re-reads the env).  Graceful shutdowns call this —
+    relying on atexit alone loses the final flush window on the paths
+    (SIGTERM handlers, test teardowns) that never run atexit hooks, which
+    was exactly the silent-span-loss failure mode."""
+    global _EXPORTER
+    with _EXPORTER_LOCK:
+        exp, _EXPORTER = _EXPORTER, None
+    if exp is not None:
+        try:
+            exp.close()
+        except Exception:  # noqa: BLE001 — shutdown must not raise
+            pass
+
+
+def exporter_stats() -> Optional[dict]:
+    """{"sent": n, "dropped": n} for the ACTIVE exporter (None when span
+    export is off) — surfaced as `dynamo_tracing_spans_sent_total` /
+    `_dropped_total` so a full push queue is visible, not silent."""
+    exp = _EXPORTER
+    if exp is None:
+        return None
+    return {"sent": exp.sent, "dropped": exp.dropped}
+
+
+def wall_ns_from_monotonic(mono_s: float) -> int:
+    """Place a `time.monotonic()` stamp on the wall-clock ns axis OTLP
+    spans use (milestone spans are reconstructed from the engine's
+    monotonic timestamps after the fact)."""
+    return time.time_ns() - (time.monotonic_ns() - int(mono_s * 1e9))
+
+
+def export_span(name: str, parent: Optional[TraceContext], start_ns: int,
+                end_ns: int, **attrs) -> None:
+    """Export one ALREADY-TIMED span as a child of `parent` (wall-clock
+    ns).  The engine's pump thread uses this to emit per-request
+    milestone spans (block-wait / queue-wait / prefill / decode) from
+    timestamps recorded earlier — there is no live contextvar on that
+    thread to wrap with `span()`."""
+    if parent is None:
+        return
+    try:
+        exporter = get_exporter()
+        if exporter is None:
+            return
+        exporter.export(name, parent.child(), parent.span_id,
+                        start_ns, end_ns, attrs)
+    except Exception:  # noqa: BLE001 — tracing must not break serving
+        pass
 
 
 class span:

@@ -270,24 +270,30 @@ class ServiceClient:
         """Send a request; yield response items until the end sentinel.
         Cancelling `context` sends CANCEL (graceful) / KILL to the worker."""
         await gate_async_check("service.call", retryable_exc=ServiceUnavailable)
-        conn = await self._get_conn(address)
-        sid = next(conn.ids)
-        q: asyncio.Queue = asyncio.Queue()
-        conn.streams[sid] = q
-        ctx = context or Context()
+        from ..tracing import span, trace_headers
 
-        from ..tracing import trace_headers
+        # the egress hop gets its own span (the reference's addressed-
+        # router OTEL injection): the wire headers carry THIS span's ids,
+        # so the remote service.handle nests under service.call and the
+        # replayed trace shows the hop.  Scoped to connect+send — stream
+        # consumption time belongs to the caller's span
+        with span("service.call", endpoint=endpoint, address=address):
+            conn = await self._get_conn(address)
+            sid = next(conn.ids)
+            q: asyncio.Queue = asyncio.Queue()
+            conn.streams[sid] = q
+            ctx = context or Context()
 
-        hdr = {"endpoint": endpoint, "rid": ctx.id, **trace_headers()}
-        frame = Frame(K_REQ, sid, hdr, pack(request))
-        async with conn.send_lock:
-            try:
-                conn.writer.write(frame.encode())
-                await conn.writer.drain()
-            except (ConnectionError, RuntimeError) as e:
-                conn.broken = True
-                conn.streams.pop(sid, None)
-                raise ServiceUnavailable(f"send to {address}: {e}") from e
+            hdr = {"endpoint": endpoint, "rid": ctx.id, **trace_headers()}
+            frame = Frame(K_REQ, sid, hdr, pack(request))
+            async with conn.send_lock:
+                try:
+                    conn.writer.write(frame.encode())
+                    await conn.writer.drain()
+                except (ConnectionError, RuntimeError) as e:
+                    conn.broken = True
+                    conn.streams.pop(sid, None)
+                    raise ServiceUnavailable(f"send to {address}: {e}") from e
 
         watcher = asyncio.create_task(self._watch_cancel(conn, sid, ctx))
         finished = False

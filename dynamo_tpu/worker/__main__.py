@@ -408,7 +408,7 @@ async def _run(args) -> None:
         # engine ForwardPassMetrics on every scrape — counters for
         # monotonic fields (incl. the spec_decode draft/accept pair) so
         # rate() is well-typed, gauges for the rest
-        from ..runtime.metrics import EngineStatsCollector
+        from ..runtime.metrics import EngineStatsCollector, TracingSpanCollector
 
         scope = MetricsScope(
             namespace=args.namespace, component=args.component,
@@ -416,11 +416,31 @@ async def _run(args) -> None:
         scope.registry.register(EngineStatsCollector(
             _stats, namespace=args.namespace, component=args.component,
         ))
+        # span-exporter sent/dropped counters (silent span loss -> visible)
+        scope.registry.register(TracingSpanCollector())
+
+        def _events():
+            """Step-event ring dump(s) for /events.json — the engine(s)
+            behind this endpoint, keyed so the timeline merger can place
+            each ring on its own track (dp ranks dump separately)."""
+            inner = engine
+            while not hasattr(inner, "events") and hasattr(inner, "engine"):
+                inner = inner.engine  # unwrap disagg/encode handlers
+            if hasattr(inner, "engines"):  # DpRankEngine
+                return {
+                    f"rank{r}": e.events.dump()
+                    for r, e in enumerate(inner.engines)
+                    if hasattr(e, "events")
+                }
+            if hasattr(inner, "events"):
+                return {"engine": inner.events.dump()}
+            return {}
 
         status = await SystemStatusServer(
             metrics=scope,
             health_fn=lambda: _async_health(health),
             stats_fn=_stats,
+            events_fn=_events,
             port=args.status_port,
         ).start()
         print(f"STATUS http://0.0.0.0:{status.port}", flush=True)
@@ -439,6 +459,11 @@ async def _run(args) -> None:
     await runtime.shutdown()
     if hasattr(engine, "shutdown"):
         await engine.shutdown()
+    # flush + close the span exporter LAST: engine shutdown may still
+    # deliver final deltas whose spans must make the flush
+    from ..runtime.tracing import close_exporter
+
+    close_exporter()
 
 
 async def _async_health(health) -> dict:

@@ -42,10 +42,13 @@ class ProcSet:
         self.env = env
         self.procs = []
 
-    def spawn(self, argv, name):
+    def spawn(self, argv, name, env_extra=None):
+        """`env_extra` overlays per-process variables (e.g. a distinct
+        DYN_SERVICE_NAME per component for span export)."""
         log = os.path.join(self.tmp, f"{name}.log")
+        env = {**self.env, **(env_extra or {})}
         with open(log, "w") as f:
-            p = subprocess.Popen(argv, env=self.env, stdout=f,
+            p = subprocess.Popen(argv, env=env, stdout=f,
                                  stderr=subprocess.STDOUT)
         self.procs.append((p, log))
         return p, log

@@ -39,14 +39,18 @@ def _setup_env() -> None:
     jax.config.update("jax_platforms", "cpu")
 
 
-def run_suite(scenario: str = "", log_dir: str = "") -> list:
-    """Run one named scenario (or all) and return the ScenarioResults."""
+def run_suite(scenario: str = "", log_dir: str = "",
+              timeline_dir: str = "") -> list:
+    """Run one named scenario (or all) and return the ScenarioResults.
+    With `timeline_dir`, each scenario also writes a merged Chrome-trace
+    timeline artifact (its path lands in the result's telemetry)."""
     _setup_env()
     from dynamo_tpu.chaos.scenarios import run_all, run_scenario
 
     if scenario:
-        return [asyncio.run(run_scenario(scenario, log_dir=log_dir))]
-    return asyncio.run(run_all(log_dir=log_dir))
+        return [asyncio.run(run_scenario(scenario, log_dir=log_dir,
+                                         timeline_dir=timeline_dir))]
+    return asyncio.run(run_all(log_dir=log_dir, timeline_dir=timeline_dir))
 
 
 def main(argv=None) -> int:
@@ -58,8 +62,13 @@ def main(argv=None) -> int:
                     help="run just one scenario (default: the whole suite)")
     ap.add_argument("--log-dir", default="",
                     help="directory for per-scenario worker-process logs")
+    ap.add_argument("--timeline-dir",
+                    default=os.environ.get("DYN_TPU_CHAOS_TIMELINE", ""),
+                    help="also write a merged Perfetto/Chrome-trace "
+                         "timeline per scenario into this directory "
+                         "(default: $DYN_TPU_CHAOS_TIMELINE)")
     args = ap.parse_args(argv)
-    results = run_suite(args.scenario, args.log_dir)
+    results = run_suite(args.scenario, args.log_dir, args.timeline_dir)
     failed = 0
     for r in results:
         print(r.to_json(), flush=True)

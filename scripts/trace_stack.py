@@ -1,0 +1,246 @@
+#!/usr/bin/env python
+"""Stand up the stack, drive seeded traffic, emit ONE merged timeline.
+
+    python scripts/trace_stack.py [--out-dir DIR]
+
+Spawns real OS processes — control plane, standalone KV router, a
+prefill worker, a disagg decode worker, the OpenAI frontend — every one
+exporting OTLP spans to a SHARED ``DYN_OTEL_FILE``.  Drives:
+
+1. a short greedy chat completion (local decode path), and
+2. long-prompt completions until one rides the disagg remote-prefill
+   path (frontend → decode worker → router.choose → prefill worker →
+   KV transfer back), so a single trace id crosses four processes;
+
+then pulls the decode/prefill workers' ``/events.json`` step-event ring
+dumps and merges spans + rings into one Chrome-trace JSON that Perfetto
+and chrome://tracing open directly.
+
+Artifacts in ``--out-dir``:
+- ``spans.jsonl``   — the raw shared OTLP/JSON sink
+- ``timeline.json`` — the merged Chrome-trace timeline
+- per-process logs
+
+stdout ends with ONE summary JSON line (exit nonzero unless every check
+holds).  Import-safe: ``from trace_stack import run`` next to
+``scripts/_verify_harness.py`` — tests/test_tracing_e2e.py embeds it.
+"""
+
+import json
+import os
+import sys
+import time
+import urllib.request
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from _verify_harness import ProcSet, free_port, wait_ready  # noqa: E402
+
+
+def _http_json(url, body=None, headers=None, timeout=120):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(body).encode() if body else None,
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _wait_model(base, name, timeout=60):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            models = _http_json(f"{base}/v1/models", timeout=10)
+            if name in [m["id"] for m in models.get("data", [])]:
+                return
+        except OSError:
+            pass
+        time.sleep(0.5)
+    raise TimeoutError(f"model {name} never discovered")
+
+
+def run(out_dir: str) -> dict:
+    """Stand up the stack, drive traffic, merge the timeline; returns the
+    summary dict (`summary["ok"]` is the overall verdict)."""
+    os.makedirs(out_dir, exist_ok=True)
+    spans_path = os.path.join(out_dir, "spans.jsonl")
+    timeline_path = os.path.join(out_dir, "timeline.json")
+    base_env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": ROOT,
+        "PYTHONUNBUFFERED": "1",
+        "DYN_OTEL_FILE": spans_path,
+    }
+    procs = ProcSet(out_dir, base_env)
+    t_start = time.time()
+    try:
+        cp_port = free_port()
+        p, log = procs.spawn(
+            [sys.executable, "-u", "-m", "dynamo_tpu.runtime",
+             "--port", str(cp_port), "--host", "127.0.0.1"],
+            "control", env_extra={"DYN_SERVICE_NAME": "control"})
+        wait_ready(p, log, timeout=60)
+        control = f"127.0.0.1:{cp_port}"
+
+        p, log = procs.spawn(
+            [sys.executable, "-u", "-m", "dynamo_tpu.router",
+             "--control", control, "--component", "router",
+             "--target-component", "prefill"],
+            "router", env_extra={"DYN_SERVICE_NAME": "router"})
+        wait_ready(p, log, timeout=60)
+
+        worker_args = [
+            sys.executable, "-u", "-m", "dynamo_tpu.worker",
+            "--control", control, "--model", "tiny", "--dtype", "float32",
+            "--platform", "cpu", "--page-size", "8", "--num-pages", "128",
+            "--max-prefill-tokens", "64", "--max-model-len", "256",
+        ]
+        pw_status = free_port()
+        pw, pw_log = procs.spawn(
+            [*worker_args, "--disagg-role", "prefill",
+             "--status-port", str(pw_status)],
+            "prefill-worker",
+            env_extra={"DYN_SERVICE_NAME": "worker-prefill"})
+        dw_status = free_port()
+        dw, dw_log = procs.spawn(
+            [*worker_args, "--disagg-role", "decode",
+             "--prefill-router", "router",
+             "--decode-steps", "4", "--decode-block-ladder", "1,2,4",
+             "--status-port", str(dw_status)],
+            "decode-worker",
+            env_extra={"DYN_SERVICE_NAME": "worker-decode"})
+        wait_ready(pw, pw_log, timeout=240)
+        wait_ready(dw, dw_log, timeout=240)
+
+        http_port = free_port()
+        fe, fe_log = procs.spawn(
+            [sys.executable, "-u", "-m", "dynamo_tpu.frontend",
+             "--control", control, "--host", "127.0.0.1",
+             "--port", str(http_port)],
+            "frontend", env_extra={"DYN_SERVICE_NAME": "frontend"})
+        wait_ready(fe, fe_log, timeout=60)
+        base = f"http://127.0.0.1:{http_port}"
+        _wait_model(base, "tiny-chat")
+
+        # 1. short greedy chat — the local decode path, one known trace id
+        short_trace = "traceshort0001"
+        out = _http_json(
+            f"{base}/v1/chat/completions",
+            {"model": "tiny-chat",
+             "messages": [{"role": "user", "content": "hello timeline"}],
+             "max_tokens": 8, "temperature": 0,
+             "nvext": {"ignore_eos": True}},
+            headers={"x-request-id": short_trace},
+        )
+        assert out["usage"]["completion_tokens"] == 8, out
+
+        # 2. long prompts until one actually rides the disagg data plane
+        # (an identical prompt served locally once would be prefix-cached
+        # and kept local forever after — vary it per attempt)
+        disagg_trace = ""
+        for i in range(30):
+            tid = f"tracedisagg{i:04d}"
+            _http_json(
+                f"{base}/v1/chat/completions",
+                {"model": "tiny-chat",
+                 "messages": [{"role": "user",
+                               "content": f"probe {i} " + "count " * 30}],
+                 "max_tokens": 8, "temperature": 0,
+                 "nvext": {"ignore_eos": True}},
+                headers={"x-request-id": tid},
+            )
+            m = _http_json(f"http://127.0.0.1:{dw_status}/metrics.json",
+                           timeout=30)
+            if m.get("kv_transfer_count", 0) >= 1:
+                disagg_trace = tid
+                break
+            time.sleep(0.3)
+        assert disagg_trace, "no request ever rode the disagg data plane"
+
+        ring_dumps = {}
+        for service, port in (("worker-decode", dw_status),
+                              ("worker-prefill", pw_status)):
+            dump = _http_json(f"http://127.0.0.1:{port}/events.json",
+                              timeout=30)
+            for key, d in dump.items():
+                name = (service if key == "engine" else f"{service}-{key}")
+                ring_dumps[name] = d
+    finally:
+        procs.stop()
+
+    # spans flush on worker/frontend SIGTERM shutdown (close_exporter);
+    # merge AFTER teardown so the final deltas' spans are in the file
+    from dynamo_tpu.runtime import timeline as tl
+
+    spans = tl.load_otlp_spans([spans_path])
+    doc = tl.merge_timeline([spans_path], ring_dumps=ring_dumps,
+                            out_path=timeline_path)
+    graph = tl.trace_graph(spans)
+    schema_errors = tl.validate_chrome_trace(doc)
+
+    short = graph.get(short_trace, {})
+    disagg = graph.get(disagg_trace, {})
+    decode_slices = [
+        ev for d in ring_dumps.values() for ev in d.get("events", [])
+        if ev.get("kind") == "decode_block"
+    ]
+    ttft_spans = [
+        sp for sp in spans
+        if sp.get("name") == "engine.prefill"
+        and any(a.get("key") == "prefill_ms"
+                for a in sp.get("attributes", []))
+    ]
+    orphans = [o for g in graph.values() for o in g["orphans"]]
+    summary = {
+        "ok": True,
+        "elapsed_s": round(time.time() - t_start, 1),
+        "services": sorted({sp.get("service") for sp in spans}),
+        "traces": len(graph),
+        "short_trace": {"id": short_trace, **short},
+        "disagg_trace": {"id": disagg_trace, **disagg},
+        "disagg_services": len(disagg.get("services", [])),
+        "decode_block_slices": len(decode_slices),
+        "decode_slices_with_rung": sum(
+            1 for ev in decode_slices if "rung" in ev
+        ),
+        "ttft_attr_spans": len(ttft_spans),
+        "orphan_spans": len(orphans),
+        "schema_errors": len(schema_errors),
+        "timeline": timeline_path,
+        "spans_file": spans_path,
+    }
+    checks = [
+        # one request id == one timeline across >= 3 processes
+        summary["disagg_services"] >= 3,
+        disagg.get("orphans") == [],
+        short.get("spans", 0) >= 3,
+        summary["decode_slices_with_rung"] >= 1,
+        summary["ttft_attr_spans"] >= 1,
+        summary["schema_errors"] == 0,
+        summary["orphan_spans"] == 0,
+    ]
+    summary["ok"] = all(checks)
+    if schema_errors:
+        summary["schema_error_sample"] = schema_errors[:5]
+    return summary
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out-dir", default="traces",
+                    help="artifact directory (spans.jsonl, timeline.json, "
+                         "process logs)")
+    args = ap.parse_args(argv)
+    summary = run(args.out_dir)
+    print(json.dumps(summary), flush=True)
+    return 0 if summary["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

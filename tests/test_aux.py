@@ -256,13 +256,16 @@ async def test_otel_span_file_export(tmp_path, monkeypatch):
         rs = _json.loads(line)["resourceSpans"][0]
         sp = rs["scopeSpans"][0]["spans"][0]
         spans[sp["name"]] = sp
-    assert {"http.chat", "service.handle", "engine.step"} <= set(spans)
+    assert {"http.chat", "service.call", "service.handle",
+            "engine.step"} <= set(spans)
     # every span joined the same trace minted by the frontend
     assert {s["traceId"] for s in spans.values()} == {"otel-e2e"}
     # the replayed file shows the real cross-process hierarchy:
-    # http.chat (root) → service.handle (worker) → engine.step
+    # http.chat (root) → service.call (egress) → service.handle (worker)
+    # → engine.step
     assert "parentSpanId" not in spans["http.chat"]
-    assert spans["service.handle"]["parentSpanId"] == spans["http.chat"]["spanId"]
+    assert spans["service.call"]["parentSpanId"] == spans["http.chat"]["spanId"]
+    assert spans["service.handle"]["parentSpanId"] == spans["service.call"]["spanId"]
     assert spans["engine.step"]["parentSpanId"] == spans["service.handle"]["spanId"]
     assert int(spans["http.chat"]["endTimeUnixNano"]) >= int(
         spans["http.chat"]["startTimeUnixNano"]

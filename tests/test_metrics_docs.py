@@ -1,0 +1,55 @@
+"""Metrics contract: registered metric families must match the reference
+table in docs/observability.md (scripts/check_metrics_docs.py — wired
+here as a tier-1 gate so new metrics can't land undocumented)."""
+
+import os
+import sys
+
+SCRIPTS = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"
+)
+if SCRIPTS not in sys.path:
+    sys.path.insert(0, SCRIPTS)
+
+from check_metrics_docs import (  # noqa: E402
+    check,
+    documented_names,
+    frontend_metric_names,
+    worker_metric_names,
+)
+
+
+def test_no_drift():
+    assert check() == []
+
+
+def test_collectors_enumerate_known_families():
+    f = frontend_metric_names()
+    assert "dynamo_frontend_requests_total" in f
+    assert "dynamo_frontend_ttft_block_wait_seconds" in f
+    assert "dynamo_tracing_spans_sent_total" in f
+    w = worker_metric_names()
+    assert "dynamo_tpu_worker_kv_usage" in w
+    assert "dynamo_tpu_worker_spec_draft_tokens_total" in w
+    assert "dynamo_tpu_worker_kv_transfers_total" in w  # renamed family
+    assert "dynamo_tpu_worker_decode_rung8_dispatches_total" in w
+
+
+def test_drift_detected_both_directions(tmp_path):
+    """Removing a documented family OR documenting a ghost one fails."""
+    doc = documented_names()
+    assert doc, "reference table must parse"
+    trimmed = tmp_path / "observability.md"
+    with open(os.path.join(os.path.dirname(SCRIPTS), "docs",
+                           "observability.md")) as f:
+        text = f.read()
+    trimmed.write_text(
+        text.replace("| `dynamo_frontend_requests_total` | counter "
+                     "| model, kind, status |\n", "")
+        + "\n| `dynamo_ghost_metric_total` | counter | |\n"
+    )
+    errors = check(str(trimmed))
+    assert any("undocumented: dynamo_frontend_requests_total" in e
+               for e in errors)
+    assert any("not registered: dynamo_ghost_metric_total" in e
+               for e in errors)

@@ -1,0 +1,131 @@
+"""Timeline merger: OTLP spans + ring dumps → valid Chrome-trace JSON
+with cross-process flow stitching (runtime/timeline.py)."""
+
+import json
+import time
+
+from dynamo_tpu.runtime import timeline as tl
+
+
+def _otlp_line(service, name, trace, span_id, parent="", start=1000,
+               end=2000, attrs=None):
+    span = {
+        "traceId": trace, "spanId": span_id, "name": name, "kind": 1,
+        "startTimeUnixNano": str(start), "endTimeUnixNano": str(end),
+        "attributes": [
+            {"key": k, "value": {"stringValue": str(v)}}
+            for k, v in (attrs or {}).items()
+        ],
+    }
+    if parent:
+        span["parentSpanId"] = parent
+    return json.dumps({"resourceSpans": [{
+        "resource": {"attributes": [
+            {"key": "service.name", "value": {"stringValue": service}}
+        ]},
+        "scopeSpans": [{"scope": {"name": "t"}, "spans": [span]}],
+    }]})
+
+
+def _write_spans(path, lines):
+    path.write_text("\n".join(lines) + "\n")
+    return str(path)
+
+
+def test_load_tolerates_torn_lines(tmp_path):
+    p = tmp_path / "spans.jsonl"
+    good = _otlp_line("frontend", "http.chat", "t1", "s1")
+    p.write_text(good + "\n" + good[: len(good) // 2])  # torn tail
+    spans = tl.load_otlp_spans([str(p)])
+    assert len(spans) == 1 and spans[0]["service"] == "frontend"
+
+
+def test_merge_produces_valid_chrome_trace_with_flows(tmp_path):
+    spans_file = _write_spans(tmp_path / "s.jsonl", [
+        _otlp_line("frontend", "http.chat", "t1", "a", start=1_000_000,
+                   end=9_000_000),
+        _otlp_line("frontend", "service.call", "t1", "b", parent="a",
+                   start=1_100_000, end=1_500_000),
+        _otlp_line("worker", "service.handle", "t1", "c", parent="b",
+                   start=1_200_000, end=8_000_000),
+        _otlp_line("worker", "engine.prefill", "t1", "d", parent="c",
+                   start=1_300_000, end=2_000_000,
+                   attrs={"prefill_ms": "0.7"}),
+    ])
+    out = tmp_path / "timeline.json"
+    doc = tl.merge_timeline([spans_file], out_path=str(out))
+    assert tl.validate_chrome_trace(doc) == []
+    assert json.loads(out.read_text()) == doc
+    evs = doc["traceEvents"]
+    x = [e for e in evs if e["ph"] == "X"]
+    assert {e["name"] for e in x} == {
+        "http.chat", "service.call", "service.handle", "engine.prefill"}
+    # one pid per service, named by metadata events
+    names = {e["pid"]: e["args"]["name"] for e in evs
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert sorted(names.values()) == ["frontend", "worker"]
+    # the frontend→worker hop got a flow arrow (s on parent, f on child)
+    flows = [e for e in evs if e["ph"] in ("s", "f")]
+    assert {e["ph"] for e in flows} == {"s", "f"}
+    assert len({e["id"] for e in flows}) == 1
+    # span attrs survive into args
+    prefill = next(e for e in x if e["name"] == "engine.prefill")
+    assert prefill["args"]["prefill_ms"] == "0.7"
+    assert prefill["args"]["trace_id"] == "t1"
+
+
+def test_ring_dump_merges_onto_wall_clock(tmp_path):
+    from dynamo_tpu.runtime.events import StepEventRecorder
+
+    spans_file = _write_spans(tmp_path / "s.jsonl", [
+        _otlp_line("worker", "service.handle", "t1", "a",
+                   start=time.time_ns(), end=time.time_ns() + 1_000_000),
+    ])
+    rec = StepEventRecorder(capacity=16)
+    t0 = rec.now()
+    rec.record("decode_block", t0_ns=t0, rung=4, batch=2, chain=1)
+    rec.record("admit", rid="r1", rank=0)
+    doc = tl.merge_timeline([spans_file],
+                            ring_dumps={"worker": rec.dump()})
+    assert tl.validate_chrome_trace(doc) == []
+    ring = [e for e in doc["traceEvents"] if e.get("cat") == "engine"]
+    slices = [e for e in ring if e["ph"] == "X"]
+    instants = [e for e in ring if e["ph"] == "i"]
+    assert slices[0]["name"] == "decode_block"
+    assert slices[0]["args"] == {"rung": 4, "batch": 2, "chain": 1}
+    assert instants[0]["name"] == "admit"
+    # rebased onto the wall-clock axis: within a minute of the span
+    span_ts = next(e["ts"] for e in doc["traceEvents"]
+                   if e.get("name") == "service.handle")
+    assert abs(slices[0]["ts"] - span_ts) < 60e6
+    # the ring track is labelled
+    threads = [e for e in doc["traceEvents"]
+               if e["ph"] == "M" and e["name"] == "thread_name"]
+    assert threads and threads[0]["args"]["name"] == "engine-steps"
+
+
+def test_validate_rejects_malformed():
+    assert tl.validate_chrome_trace([]) != []
+    assert tl.validate_chrome_trace({"traceEvents": "nope"}) != []
+    bad = {"traceEvents": [{"name": "x", "ph": "X", "pid": 1, "tid": 1,
+                            "ts": 0}]}  # X without dur
+    assert any("dur" in e for e in tl.validate_chrome_trace(bad))
+    bad2 = {"traceEvents": [{"name": "x", "ph": "q", "pid": 1, "tid": 1,
+                             "ts": 0}]}
+    assert any("unknown ph" in e for e in tl.validate_chrome_trace(bad2))
+
+
+def test_trace_graph_finds_orphans(tmp_path):
+    spans_file = _write_spans(tmp_path / "s.jsonl", [
+        _otlp_line("frontend", "http.chat", "t1", "a"),
+        _otlp_line("worker", "service.handle", "t1", "b", parent="a"),
+        _otlp_line("worker", "engine.decode", "t1", "x",
+                   parent="missing"),           # orphan
+        _otlp_line("frontend", "http.chat", "t2", "c"),
+    ])
+    graph = tl.trace_graph(tl.load_otlp_spans([spans_file]))
+    assert graph["t1"]["spans"] == 3
+    assert graph["t1"]["services"] == ["frontend", "worker"]
+    assert graph["t1"]["orphans"] == ["engine.decode"]
+    assert graph["t1"]["roots"] == 1
+    assert graph["t2"]["orphans"] == []
