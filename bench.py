@@ -566,6 +566,64 @@ async def spec_decode_phase(cfg, params, prompt_len=128, gen=96, k=4,
     return out
 
 
+async def continuous_phase(cfg, params, prompt_len=128, gen=192, rounds=3):
+    """Device-resident decode loop A/B (ISSUE 6): the r05 serving shape
+    (64-step int8 blocks) with the FIXED 4-block decode chain vs
+    CONTINUOUS chaining (open-ended device-side chaining, on-device stop
+    detection, async double-buffered drain), rounds interleaved within
+    one run so a tunnel phase moves both arms.  Also derives the
+    inter-block HOST gap from the continuous engine's step-event ring
+    (runtime.timeline.decode_host_gaps — ROADMAP target: p50 < 0.1 ms
+    on-chip between consecutive decode blocks)."""
+    from dynamo_tpu.engine import EngineConfig, JaxEngine
+    from dynamo_tpu.runtime.timeline import decode_host_gaps
+
+    pages_per = (prompt_len + gen) // 16 + 2
+
+    def mk(continuous):
+        return JaxEngine(cfg, params, EngineConfig(
+            page_size=16, num_pages=1 + BATCH * pages_per + 16,
+            max_num_seqs=BATCH, max_prefill_tokens=BATCH * prompt_len,
+            prefill_batch_size=BATCH, max_model_len=prompt_len + gen + 16,
+            decode_batch_buckets=[BATCH], chunk_buckets=[prompt_len],
+            decode_steps=64, decode_chain=4, decode_continuous=continuous,
+            enable_prefix_caching=False, quantization="int8",
+            fuse_projections=True,
+        ), eos_token_ids=[])
+
+    chained, cont = mk(False), mk(True)
+    try:
+        (ch_tok, ch_rates, ch_med), (cc_tok, cc_rates, cc_med) = (
+            await interleaved_ab([chained, cont], rounds=rounds,
+                                 gen_tokens=gen))
+        m = cont.metrics()
+        # host-gap measurement on ONE dedicated round with a cleared
+        # ring: the A/B-interleaved rounds leave seconds-long idle
+        # boundaries between the cont engine's blocks (the chained arm
+        # was running), which would masquerade as p99 host gaps
+        cont.events.clear()
+        await run_round(cont, seed_base=12345, gen_tokens=gen)
+        gaps = decode_host_gaps(cont.events.dump(), continuous_only=True)
+        return {
+            "batch": BATCH, "gen": gen,
+            "tok_s_chained": round(ch_tok, 2),
+            "tok_s_continuous": round(cc_tok, 2),
+            "itl_p50_chained_ms": round(ch_med[3] * 1e3, 3),
+            "itl_p50_continuous_ms": round(cc_med[3] * 1e3, 3),
+            "itl_ratio": round(ch_med[3] / max(cc_med[3], 1e-9), 3),
+            "cc_chains": m.decode_cc_chains_total,
+            "cc_blocks": m.decode_cc_blocks_total,
+            "host_gap_ms": gaps,
+            "samples_tok_s": {
+                "chained": [round(r, 1) for r in ch_rates],
+                "continuous": [round(r, 1) for r in cc_rates],
+            },
+        }
+    finally:
+        await chained.shutdown()
+        await cont.shutdown()
+
+
 def phase_breakdown(cfg, params, T=32, B=8, table_w=32):
     """Per-phase decode-step shares measured ON DEVICE (VERDICT r5 item
     4): full forward vs no-lm-head vs matmuls-only scans at the serving
@@ -858,6 +916,12 @@ async def main_async():
     out["spec_decode_1b_int8"] = await spec_decode_phase(cfg, params)
     gc.collect()
 
+    # device-resident decode loop A/B (ISSUE 6): continuous chaining vs
+    # the fixed chain on the same int8 serving shape, same run — plus
+    # the inter-block host-gap percentiles off the step-event timeline
+    out["continuous_decode_1b"] = await continuous_phase(cfg, params)
+    gc.collect()
+
     # disaggregated prefill→decode KV-transfer latency (the missing half
     # of BASELINE.json's metric — VERDICT r5 item 3): a prefill engine
     # exports pages through the real data plane (disagg/transfer.py), a
@@ -1126,6 +1190,7 @@ def _compact_summary(full):
     m1 = full.get("models", {}).get("llama-3.2-1b", {})
     m8 = full.get("models", {}).get("llama-3.1-8b-int8", {})
     spec = full.get("spec_decode_1b_int8", {})
+    cc = full.get("continuous_decode_1b", {})
     phase = full.get("phase_samples_tok_s", {})
     return {
         "headline_bf16_tok_s": full.get("value"),
@@ -1161,6 +1226,13 @@ def _compact_summary(full):
         "spec_itl_ratio": spec.get("itl_ratio"),
         "spec_tokens_per_dispatch": spec.get("tokens_per_dispatch"),
         "spec_acceptance_rate": spec.get("acceptance_rate"),
+        # device-resident decode loop A/B (ISSUE 6): fixed-chain vs
+        # continuous ITL + the inter-block host-gap percentiles
+        "itl_1b_chained_ms": cc.get("itl_p50_chained_ms"),
+        "itl_1b_continuous_ms": cc.get("itl_p50_continuous_ms"),
+        "cc_itl_ratio": cc.get("itl_ratio"),
+        "host_gap_ms_p50": (cc.get("host_gap_ms") or {}).get("p50_ms"),
+        "host_gap_ms_p99": (cc.get("host_gap_ms") or {}).get("p99_ms"),
     }
 
 

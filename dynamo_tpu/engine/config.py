@@ -35,6 +35,23 @@ class EngineConfig:
     # (host RTT) overlaps the next block's compute.  1 = no chaining.
     decode_chain: int = 1
 
+    # device-resident decode loop (docs/device_loop.md): instead of a
+    # FIXED `decode_chain` horizon, keep feeding each decode block's
+    # device-side outputs back as the next block's inputs for as long as
+    # no admission/stop event is pending.  Per-row eos/stop-token and
+    # max-token checks run ON DEVICE (an active-row mask carried through
+    # the scan: finished rows freeze their position/PRNG counter and
+    # write only to the trash page), a drain thread fetches block k
+    # while block k+1 computes, and pages are pre-reserved
+    # `decode_chain` blocks ahead (watermark-respecting) so one page
+    # table serves the rolling horizon.  Token-identical to the
+    # per-step engine (greedy, seeded, penalized, laddered); engages
+    # only on flat single-process engines at the ladder's top rung —
+    # meshed/pp/sp/pooled engines and spec dispatches keep their
+    # existing paths.  Multi-token stop SEQUENCES stay host-detected
+    # and force chain fall-out.
+    decode_continuous: bool = False
+
     # adaptive decode-block sizing ("block ladder"): compile the decode/
     # mixed step at THIS ladder of block sizes instead of only
     # `decode_steps`, and let the scheduler pick the rung per dispatch —
@@ -172,6 +189,18 @@ class EngineConfig:
             self.decode_block_ladder = sorted(
                 set(rungs) | {self.decode_steps}
             )
+        if self.decode_continuous:
+            if self.speculative_ngram_k:
+                raise ValueError(
+                    "decode_continuous does not compose with "
+                    "speculative_ngram_k yet (the draft-verify step has "
+                    "no device-side stop mask)"
+                )
+            if self.decode_chain < 1:
+                raise ValueError(
+                    "decode_continuous requires decode_chain >= 1 (it is "
+                    "the page pre-reservation horizon, in blocks)"
+                )
         if self.speculative_ngram_k and self.speculative_history < 1:
             # tokens[-0:] would silently mean UNBOUNDED history, turning
             # the per-dispatch host lookup into a full-context scan
@@ -201,6 +230,14 @@ class EngineConfig:
         if not self.decode_block_ladder:
             return (self.decode_steps,)
         return tuple(self.decode_block_ladder)
+
+    @property
+    def cc_horizon_blocks(self) -> int:
+        """Blocks of pages the continuous decode loop pre-reserves per
+        table build (>= 2 so the double-buffered drain never outruns the
+        reservation): `decode_chain` keeps its meaning as the lookahead
+        depth, continuous mode just stops treating it as a hard stop."""
+        return max(2, self.decode_chain)
 
     @property
     def usable_pages(self) -> int:

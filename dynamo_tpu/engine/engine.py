@@ -82,10 +82,23 @@ class ForwardPassMetrics:
     ttft_queue_wait_ms_total: float = 0.0
     ttft_prefill_ms_total: float = 0.0
     ttft_attributed_total: int = 0
+    # device-resident decode loop: chains run and blocks dispatched by
+    # the continuous path (blocks/chains >> decode_chain means the open
+    # horizon is actually engaging)
+    decode_cc_blocks_total: int = 0
+    decode_cc_chains_total: int = 0
 
 
 # static top-k width for OpenAI `top_logprobs` responses (API max is 20)
 TOPLP = 20
+
+# materialized-KV HBM cap for the decode BLOCK path (plain and
+# continuous scans read the SAME constant, so the block/per-step
+# crossover can never drift between them; module-level so tests can
+# force the per-step fallback): kg+vg live across the whole step scan
+# (~2*L*B*S*nkv*hd bytes) — past ~2GB the per-step path's
+# layer-at-a-time gathers are the safer footprint
+_BLOCK_KV_BYTE_BUDGET = 2 << 30
 
 
 def _pack_out(out: jax.Array, logp: jax.Array, logits=None) -> jax.Array:
@@ -117,6 +130,43 @@ def _unpack_out(packed: np.ndarray, b: int, with_top: bool = False):
     lps = packed[..., 2 * b + b * TOPLP :]
     return (
         toks, logp,
+        ids.reshape(*packed.shape[:-1], b, TOPLP),
+        lps.reshape(*packed.shape[:-1], b, TOPLP),
+    )
+
+
+def _pack_out_cc(out: jax.Array, logp: jax.Array, act: jax.Array,
+                 logits=None) -> jax.Array:
+    """`_pack_out` plus the device-resident loop's per-row EMITTED flag
+    (1.0 where the row was still active when this step sampled): the
+    drained buffer is then self-describing — the host learns each row's
+    real token count and stop position from the flags instead of
+    re-running per-token stop checks.
+
+    Layout: [tok(B) | logp(B) | act(B) | top_ids(B*TOPLP) | top_lps]."""
+    parts = [jax.lax.bitcast_convert_type(out, jnp.float32), logp,
+             act.astype(jnp.float32)]
+    if logits is not None:
+        ids, lps = top_logprobs(logits, TOPLP)
+        parts.append(jax.lax.bitcast_convert_type(ids, jnp.float32).reshape(-1))
+        parts.append(lps.reshape(-1))
+    return jnp.concatenate(parts, axis=-1)
+
+
+def _unpack_out_cc(packed: np.ndarray, b: int, with_top: bool = False):
+    """Inverse of `_pack_out_cc`; returns (toks, logp, flags, top_ids,
+    top_lps) — `flags` is a bool emitted-mask aligned with toks."""
+    toks = np.ascontiguousarray(packed[..., :b]).view(np.int32)
+    logp = packed[..., b : 2 * b]
+    flags = packed[..., 2 * b : 3 * b] > 0.5
+    if not with_top:
+        return toks, logp, flags, None, None
+    ids = np.ascontiguousarray(
+        packed[..., 3 * b : 3 * b + b * TOPLP]
+    ).view(np.int32)
+    lps = packed[..., 3 * b + b * TOPLP :]
+    return (
+        toks, logp, flags,
         ids.reshape(*packed.shape[:-1], b, TOPLP),
         lps.reshape(*packed.shape[:-1], b, TOPLP),
     )
@@ -404,12 +454,6 @@ def _make_decode_scan(cfg: ModelConfig, n_steps: int, max_valid_pos: int,
         packed = _pack_out(out, logp, logits if with_top else None)
         return out, cts, packed
 
-    # the block path is bounded by the materialized context's HBM cost:
-    # kg+vg live across the whole step scan (~2*L*B*S*nkv*hd bytes) —
-    # past ~2GB (forced-xla meshed engines at very long contexts) the
-    # per-step path's layer-at-a-time gathers are the safer footprint
-    _BLOCK_KV_BYTE_BUDGET = 2 << 30
-
     def block_scan(params, kv, tokens, positions, counters, counts,
                    page_table, samp, seeds, rope_off=None):
         def sample_step(eng, logits, tok_prev, t):
@@ -552,6 +596,162 @@ def _build_decode_step(cfg: ModelConfig, n_steps: int, max_valid_pos: int,
                      samp, seeds):
                 return run(params, kv, tokens, positions, counters, None,
                            page_table, samp, seeds)
+
+    return step
+
+
+def _make_decode_scan_cc(cfg: ModelConfig, n_steps: int, max_valid_pos: int,
+                         penalized: bool, with_top: bool, attn_impl: str,
+                         greedy: bool = False):
+    """The device-resident decode-block body (`_make_decode_scan` with
+    ON-DEVICE stop detection): an active-row mask rides the scan carry —
+    each step a row emits only while active, and the mask latches off at
+    the first stop/eos-token hit or when its token budget (max-token +
+    model-window headroom, computed host-side) runs out.  Frozen rows
+    stop advancing their position and PRNG counter, write KV only to the
+    trash page, and stay inert for every later block of an open-ended
+    chain, so their pool pages may be freed as soon as the stop drains.
+
+    Extra operands vs the plain scan: `act [B]` bool (active at block
+    start), `budget [B]` int32 (tokens the row may still emit), `stops
+    [B, K]` int32 (-1-padded per-row stop/eos ids).  The packed output
+    carries the per-step emitted flags (`_pack_out_cc`); the carries
+    (tok, pos, ctr, act, budget, counts) all return as device arrays so
+    block k+1 consumes block k's outputs with zero host round-trip.
+
+    DRIFT TRIPWIRE: this deliberately forks `_make_decode_scan`'s
+    sample tail / per-step body / block-path gate (the mask threading
+    touches every line, and the meshed variants must stay untouched) —
+    any fix to the plain scan (penalty order, the blk_bytes HBM budget,
+    the pallas `_adapt` gate) MUST be mirrored here, and vice versa; the
+    continuous-vs-per-step equivalence matrix in tests/test_engine.py +
+    tests/test_block_ladder.py is what catches a drift."""
+    from ..models.llama import decode_block_scan
+    from ..ops.paged_attention import _adapt
+
+    def sample_tail(logits, cts, samp, seeds, ctr, act, budget, stops):
+        """Sample + freeze: counters/penalty counts advance only for
+        rows active BEFORE this step; the returned mask governs the
+        NEXT step."""
+        if penalized:
+            logits = apply_penalties(
+                logits, cts, samp.frequency_penalty, samp.presence_penalty)
+        out = sample_tokens_maybe_greedy(logits, samp, seeds, ctr, greedy)
+        actf = act.astype(jnp.float32)
+        ctr = ctr + act.astype(ctr.dtype)
+        if penalized:
+            cts = cts.at[jnp.arange(out.shape[0]), out].add(actf)
+        logp = compute_logprobs(logits, out)
+        packed = _pack_out_cc(out, logp, actf,
+                              logits if with_top else None)
+        hit = (out[:, None] == stops).any(axis=-1)
+        budget = budget - act.astype(budget.dtype)
+        act_next = act & ~hit & (budget > 0)
+        return out, ctr, cts, packed, act_next, budget
+
+    def block_scan(params, kv, tokens, positions, counters, counts, act,
+                   budget, stops, page_table, samp, seeds, rope_off=None):
+        def sample_step(eng, logits, tok_prev, t, act_in):
+            ctr, cts, bud, _ = eng
+            out, ctr, cts, packed, act_next, bud = sample_tail(
+                logits, cts, samp, seeds, ctr, act_in, bud, stops)
+            # act duplicated into the engine carry so the final mask
+            # returns as a chainable device array
+            return (ctr, cts, bud, act_next), out, packed, act_next
+
+        cts0 = counts if penalized else jnp.zeros((), jnp.float32)
+        (ctr, cts, bud, act_out), packed, tok, pos, kv = decode_block_scan(
+            params, cfg, kv, tokens, positions, page_table, n_steps,
+            max_valid_pos, sample_step, (counters, cts0, budget, act),
+            rope_offset=rope_off, active_init=act,
+        )
+        if penalized:
+            return packed, tok, pos, ctr, act_out, bud, cts, kv
+        return packed, tok, pos, ctr, act_out, bud, kv
+
+    def body_common(kv, tok, pos, ctr, cts, act, budget, stops, page_table,
+                    samp, seeds, params, rope_off=None):
+        ok = (pos < max_valid_pos) & act
+        safe_pos = jnp.where(pos < max_valid_pos, pos, 0)
+        # frozen and out-of-window rows write through an all-trash table
+        table = jnp.where(ok[:, None], page_table, 0)
+        logits, kv = forward_decode(
+            params, cfg, kv, tok, safe_pos, table, attn_impl=attn_impl,
+            rope_offset=rope_off,
+        )
+        return (kv,) + sample_tail(logits, cts, samp, seeds, ctr, act,
+                                   budget, stops)
+
+    def scan(params, kv, tokens, positions, counters, counts, act, budget,
+             stops, page_table, samp, seeds, rope_off=None):
+        blk_bytes = (2 * kv.k.shape[0] * page_table.shape[0]
+                     * page_table.shape[1] * kv.k.shape[2]
+                     * kv.k.shape[3] * kv.k.shape[4] * kv.k.dtype.itemsize)
+        if (_adapt(attn_impl, page_table, kv.k.shape[2]) != "pallas"
+                and blk_bytes <= _BLOCK_KV_BYTE_BUDGET):
+            return block_scan(params, kv, tokens, positions, counters,
+                              counts, act, budget, stops, page_table,
+                              samp, seeds, rope_off)
+
+        def body(carry, _):
+            kv, tok, pos, ctr, cts, a, bud = carry
+            kv, out, ctr, cts, packed, a_next, bud = body_common(
+                kv, tok, pos, ctr, cts, a, bud, stops, page_table,
+                samp, seeds, params, rope_off,
+            )
+            return (kv, out, pos + a.astype(pos.dtype), ctr, cts, a_next,
+                    bud), packed
+
+        cts0 = counts if penalized else jnp.zeros((), jnp.float32)
+        (kv, tok, pos, ctr, cts, act, budget), packed = jax.lax.scan(
+            body, (kv, tokens, positions, counters, cts0, act, budget),
+            None, length=n_steps,
+        )
+        if penalized:
+            return packed, tok, pos, ctr, act, budget, cts, kv
+        return packed, tok, pos, ctr, act, budget, kv
+
+    return scan
+
+
+def _build_decode_step_cc(cfg: ModelConfig, n_steps: int, max_valid_pos: int,
+                          *, greedy: bool = False, penalized: bool = False,
+                          with_top: bool = False, attn_impl: str = "xla"):
+    """The continuous-chain decode step (flat single-process engines
+    only): one compiled program per (penalized, with_top, greedy, rung)
+    like the plain variants, with the stop mask / budget carries riding
+    as device arrays so an open-ended chain never rebuilds host inputs."""
+    run = _make_decode_scan_cc(cfg, n_steps, max_valid_pos, penalized,
+                               with_top, attn_impl, greedy)
+    mrope = bool(cfg.mrope_section)
+    if penalized:
+        if mrope:
+            @partial(jax.jit, donate_argnums=(1, 5))
+            def step(params, kv, tokens, positions, counters, counts, act,
+                     budget, stops, page_table, samp, seeds, rope_off):
+                return run(params, kv, tokens, positions, counters, counts,
+                           act, budget, stops, page_table, samp, seeds,
+                           rope_off)
+        else:
+            @partial(jax.jit, donate_argnums=(1, 5))
+            def step(params, kv, tokens, positions, counters, counts, act,
+                     budget, stops, page_table, samp, seeds):
+                return run(params, kv, tokens, positions, counters, counts,
+                           act, budget, stops, page_table, samp, seeds)
+    else:
+        if mrope:
+            @partial(jax.jit, donate_argnums=(1,))
+            def step(params, kv, tokens, positions, counters, act, budget,
+                     stops, page_table, samp, seeds, rope_off):
+                return run(params, kv, tokens, positions, counters, None,
+                           act, budget, stops, page_table, samp, seeds,
+                           rope_off)
+        else:
+            @partial(jax.jit, donate_argnums=(1,))
+            def step(params, kv, tokens, positions, counters, act, budget,
+                     stops, page_table, samp, seeds):
+                return run(params, kv, tokens, positions, counters, None,
+                           act, budget, stops, page_table, samp, seeds)
 
     return step
 
@@ -1302,6 +1502,12 @@ class JaxEngine:
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._pump_task: Optional[asyncio.Task] = None
         self._executor = None  # dedicated device-step thread (see _ensure_pump)
+        # async drain (device-resident decode loop): a second thread that
+        # device_gets + unpacks block k while the step thread dispatches
+        # block k+1 (lazy — only continuous-mode engines start it)
+        self._drain_pool = None
+        self._cc_blocks_total = 0
+        self._cc_chains_total = 0
         self._closed = False
         # adds/aborts are deferred to the pump loop so ALL scheduler/pool
         # mutation happens strictly between device steps, on the pump's
@@ -1586,6 +1792,21 @@ class JaxEngine:
             )
         return self._decode_steps[key]
 
+    def _get_cc_step(self, penalized: bool, with_top: bool,
+                     greedy: bool = False, n_steps: Optional[int] = None):
+        """The continuous-chain decode variant, cached beside the plain
+        rung programs under a "cc" key (flat engines only — `_cc_ok`
+        gates dispatch)."""
+        n_steps = n_steps or self.cfg.decode_steps
+        key = ("cc", penalized, with_top, greedy, n_steps)
+        if key not in self._decode_steps:
+            self._decode_steps[key] = _build_decode_step_cc(
+                self.model_cfg, n_steps, self.cfg.hard_cap,
+                penalized=penalized, with_top=with_top,
+                attn_impl=self._attn_impl, greedy=greedy,
+            )
+        return self._decode_steps[key]
+
     def _get_mixed_step(self, penalized: bool, with_top: bool,
                         greedy: bool = False,
                         n_steps: Optional[int] = None):
@@ -1676,6 +1897,8 @@ class JaxEngine:
             ttft_queue_wait_ms_total=self._ttft_queue_wait_ms_total,
             ttft_prefill_ms_total=self._ttft_prefill_ms_total,
             ttft_attributed_total=self._ttft_attributed_total,
+            decode_cc_blocks_total=self._cc_blocks_total,
+            decode_cc_chains_total=self._cc_chains_total,
         )
         # chosen-rung histogram (block ladder): one dynamic counter attr
         # per rung — bounded by the ladder size, picked up by vars()
@@ -1823,6 +2046,11 @@ class JaxEngine:
                 None, self._executor.shutdown, True
             )
             self._executor = None
+        if self._drain_pool is not None:
+            await asyncio.get_running_loop().run_in_executor(
+                None, self._drain_pool.shutdown, True
+            )
+            self._drain_pool = None
         self._close_blob_channels()
 
     def _close_blob_channels(self) -> None:
@@ -2392,15 +2620,18 @@ class JaxEngine:
                         break  # stop hit mid-block; rest discarded
 
     def _deliver_block(self, seq: Sequence, toks, logps, tids, tlps,
-                       col: int, with_top: bool) -> None:
+                       col: int, with_top: bool,
+                       finish_reason: Optional[str] = None) -> None:
         """One queue item for a whole decode block (fast path: the block
-        was appended without stop checks — none can hit)."""
+        was appended without per-token stop checks — either none can hit,
+        or the device-side mask already cut the block at the stop and
+        `finish_reason` rides the same delta)."""
         queue = self._queues.get(seq.request_id)
         if queue is None:
             return
         out = {
             "token_ids": [int(x) for x in toks],
-            "finish_reason": None,
+            "finish_reason": finish_reason,
         }
         if seq.opts.logprobs:
             out["log_probs"] = [float(x) for x in logps]
@@ -2414,6 +2645,8 @@ class JaxEngine:
             # one-shot TTFT attribution (see _deliver)
             out["ttft"] = seq.ttft_attr
             seq.ttft_attr = None
+        if finish_reason:
+            self._close_decode_span(seq, finish_reason)
         self._post_threadsafe(queue, out)
 
     def _post_threadsafe(self, queue, out) -> None:
@@ -2983,6 +3216,12 @@ class JaxEngine:
         # prompt rides the next mixed dispatch within one short block
         t0_ev = self.events.now()
         T, allow_chain = self.scheduler.select_decode_rung()
+        if allow_chain and self._cc_ok():
+            # device-resident loop: rungs stay the scan lengths — the
+            # ladder's quiet-ramp top rung is where open-ended chaining
+            # engages; short rungs (prompts pending) keep the per-
+            # dispatch path so admission latency is unchanged
+            return self._run_decode_continuous(seqs, T)
         hard_cap = self.cfg.hard_cap
         # decide the chain length upfront and pre-reserve pages for the
         # whole horizon, so ONE page table serves every block: chained
@@ -3079,6 +3318,312 @@ class JaxEngine:
                 pass
             dispatches.append(packed_d)
         return dispatches
+
+    # -- device-resident decode loop (continuous chaining) -------------------- #
+
+    def _cc_ok(self) -> bool:
+        """May decode take the device-resident continuous loop?  Flat
+        single-process engines only: the pooled/pp/sp step layouts and
+        the multihost plan channel keep their existing chained paths
+        (and stay token-identical — the loop is output-invisible)."""
+        return (self.cfg.decode_continuous and self.mesh is None
+                and not self._multihost and self._pp == 1
+                and self._sp == 1 and not self._pooled)
+
+    def _ensure_drain_pool(self):
+        if self._drain_pool is None:
+            import concurrent.futures as _cf
+
+            self._drain_pool = _cf.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="jax-engine-drain"
+            )
+        return self._drain_pool
+
+    def _stop_arrays(self, rows: List[Optional[Sequence]]) -> np.ndarray:
+        """Per-row device stop-token ids ([B, K] int32, -1-padded, K a
+        pow2 bucket): the row's stop_token_ids plus the engine eos set
+        unless ignore_eos.  Multi-token stop SEQUENCES are not here — the
+        host detects those at consume and forces chain fall-out."""
+        sets = []
+        for s in rows:
+            if s is None:
+                sets.append([])
+                continue
+            ids = set(s.opts.stop_token_ids)
+            if not s.opts.ignore_eos:
+                ids.update(self.eos_token_ids)
+            sets.append(sorted(ids))
+        K = max(1, max((len(x) for x in sets), default=1))
+        K = 1 << (K - 1).bit_length()
+        out = np.full((len(rows), K), -1, np.int32)
+        for i, ids in enumerate(sets):
+            out[i, : len(ids)] = ids
+        return out
+
+    def _seq_budget(self, s: Sequence) -> int:
+        """Tokens `s` may still emit before a LENGTH stop — the same
+        bound `check_stop` enforces (max_tokens, model window,
+        page-table horizon).  ONE definition, shared by the device
+        budget operand and the horizon pre-reservation: a drift between
+        the two desyncs the on-device stop mask from the reserved
+        tables."""
+        return max(0, min(
+            s.opts.max_tokens - len(s.output_tokens),
+            self.cfg.max_model_len - s.total_len,
+            self.cfg.hard_cap - s.num_computed,
+        ))
+
+    def _budget_array(self, rows: List[Optional[Sequence]]) -> np.ndarray:
+        """Per-row `_seq_budget` ([B] int32), precomputed so the device
+        can latch length stops without the host in the loop."""
+        out = np.zeros((len(rows),), np.int32)
+        for i, s in enumerate(rows):
+            if s is not None:
+                out[i] = self._seq_budget(s)
+        return out
+
+    def _cc_reserve(self, seqs: List[Sequence], T: int,
+                    inflight_blocks: int = 0) -> int:
+        """Watermark page pre-reservation: grow every running row's
+        pages up to `cc_horizon_blocks` decode blocks ahead WITHOUT
+        preemption and without dipping into the admission watermark,
+        then return how many more whole blocks the resulting tables
+        cover for every row (rows whose remaining budget already fits
+        under their table never constrain).  `inflight_blocks` accounts
+        for dispatched-but-undrained blocks whose tokens the host has
+        not yet folded into num_computed."""
+        ps = self.cfg.page_size
+        hard_cap = self.cfg.hard_cap
+        horizon = self.cfg.cc_horizon_blocks
+        allowance = horizon
+        for s in seqs:
+            if s.status != "running":
+                continue
+            budget = self._seq_budget(s)
+            target = min(s.num_computed + (inflight_blocks + horizon) * T,
+                         s.num_computed + budget, hard_cap)
+            self.scheduler.try_extend_pages(s, target, keep_watermark=True)
+            covered = (min(len(s.pages) * ps, hard_cap) - s.num_computed
+                       - inflight_blocks * T)
+            if budget - inflight_blocks * T > covered:
+                allowance = min(allowance, max(0, covered) // T)
+        return allowance
+
+    def _cc_fall_out(self, seqs: List[Sequence]) -> Optional[str]:
+        """The chain's fall-out signals (None = keep feeding the loop):
+        anything else needing the pump, an ADMISSIBLE waiting prompt
+        (`_admit_check` via `admission_ready`), or any co-scheduled row
+        having stopped (drained stop flags / host stop sequences) — a
+        stop frees capacity and shrinks the batch, so replanning wins."""
+        if self._closed:
+            return "shutdown"
+        if self._pending_adds or self._pending_aborts or self._pending_ops:
+            return "pending_work"
+        if self.scheduler.waiting and self.scheduler.admission_ready():
+            return "admit"
+        if any(s.status != "running" for s in seqs):
+            return "stop"
+        if self.tiered is not None and self.tiered.pending_offloads:
+            return "offload"
+        # only the co-scheduled rows' contexts (O(batch), not O(every
+        # live stream) — this check sits inside the sub-0.1ms-target
+        # inter-block host gap); other streams' graceful stops are
+        # _plan_step's job after fall-out anyway
+        for s in seqs:
+            ctx = self._contexts.get(s.request_id)
+            if ctx is not None and ctx.is_stopped() and not ctx.is_killed():
+                return "cancel"
+        return None
+
+    def _fetch_packed_cc(self, packed_d, Bb: int, with_top: bool):
+        """Drain-thread half of the double buffer: block device_get +
+        numpy unpack off the step thread, so block k's host fetch rides
+        under block k+1's compute.  Scheduler state is NOT touched here
+        — consumption stays on the step thread."""
+        return _unpack_out_cc(
+            np.asarray(jax.device_get(packed_d)), Bb, with_top
+        )
+
+    def _run_decode_continuous(self, seqs: List[Sequence], T: int) -> None:
+        """The device-resident decode inner loop (docs/device_loop.md):
+        an OPEN-ENDED chain of decode blocks whose varying inputs (last
+        token, positions, counters, active mask, budgets, penalty
+        counts) live on device — the host's only per-block work is
+        issuing the next dispatch, handing the previous block to the
+        drain thread, and checking the fall-out signals.  Stops are
+        detected on device (active-row mask), so the host never
+        re-checks per token; pages are pre-reserved `cc_horizon_blocks`
+        ahead so one page table serves the rolling horizon; the chain
+        ends only on a fall-out signal or when every row finishes."""
+        from collections import deque as _deque
+
+        rows = self._decode_rows(seqs)
+        Bb = len(rows)
+        tokens, positions = self._decode_arrays(rows)
+        seeds, counters = self._seed_arrays(rows)
+        penalized = any(s.opts.penalized for s in seqs)
+        with_top = any(s.opts.top_logprobs > 0 for s in seqs)
+        samp = self._samp_arrays(rows)
+        counts = self._counts_array(rows) if penalized else None
+        rope_off = self._rope_array(rows)
+        greedy = self._is_greedy(samp)
+        budget = self._budget_array(rows)
+        active = np.array([s is not None and budget[i] > 0
+                           for i, s in enumerate(rows)])
+        step = self._get_cc_step(penalized, with_top, greedy, T)
+        drain = self._ensure_drain_pool()
+        # _plan_decode reserved decode_advance (>= T) preemptively, so
+        # the first block always fits even when the watermark blocks
+        # further growth
+        allowance = max(1, self._cc_reserve(seqs, T))
+        table_d = self._put(self._table_array(rows), self._bax, None)
+        tok_d = self._put(tokens, self._bax)
+        pos_d = self._put(positions, self._bax)
+        ctr_d = self._put(counters, self._bax)
+        act_d = self._put(active, self._bax)
+        budget_d = self._put(budget, self._bax)
+        stops_d = self._put(self._stop_arrays(rows), self._bax, None)
+        samp_d = self._put_samp(samp)
+        seeds_d = self._put(seeds, self._bax)
+        cts_d = self._put(counts, self._bax, None) if penalized else None
+        rope = ()
+        if self.model_cfg.mrope_section:
+            if rope_off is None:
+                rope_off = np.zeros_like(positions)
+            rope = (self._put(rope_off, self._bax),)
+        inflight: Any = _deque()
+        deferred: List[int] = []
+        self.scheduler.deferred_free = deferred
+        blocks = 0
+        # None until a fall-out signal fires: a chain that dies before
+        # its first check records "error", never a clean reason
+        fallout = None
+        chain_t0 = self.events.now()
+        try:
+            while True:
+                t_iter = self.events.now()
+                if penalized:
+                    (packed_d, tok_d, pos_d, ctr_d, act_d, budget_d,
+                     cts_d, self.kv) = step(
+                        self.params, self.kv, tok_d, pos_d, ctr_d, cts_d,
+                        act_d, budget_d, stops_d, table_d, samp_d, seeds_d,
+                        *rope,
+                    )
+                else:
+                    (packed_d, tok_d, pos_d, ctr_d, act_d, budget_d,
+                     self.kv) = step(
+                        self.params, self.kv, tok_d, pos_d, ctr_d,
+                        act_d, budget_d, stops_d, table_d, samp_d, seeds_d,
+                        *rope,
+                    )
+                try:
+                    packed_d.copy_to_host_async()
+                except Exception:  # noqa: BLE001 — backends may not support it
+                    pass
+                blocks += 1
+                allowance -= 1
+                self._note_dispatch("decode", T, blocks=1)
+                inflight.append(
+                    drain.submit(self._fetch_packed_cc, packed_d, Bb,
+                                 with_top))
+                # double buffer: with two blocks undrained, consume the
+                # older one (its device_get overlapped this dispatch)
+                while len(inflight) >= 2:
+                    self._consume_cc_block(inflight.popleft().result(),
+                                           rows, with_top)
+                fallout = self._cc_fall_out(seqs)
+                # one decode_block slice per ITERATION (dispatch + drain
+                # handoff + fall-out checks): the gap to the next slice
+                # is the host's non-overlapped inter-block time — the
+                # quantity runtime.timeline.decode_host_gaps derives
+                self.events.record("decode_block", t0_ns=t_iter, rung=T,
+                                   batch=len(seqs), chain=blocks,
+                                   continuous=True)
+                if fallout is not None:
+                    break
+                if allowance < 1:
+                    # rolling horizon exhausted: re-reserve and push a
+                    # fresh table (the one host input a long chain ever
+                    # rebuilds, once per cc_horizon_blocks blocks)
+                    allowance = self._cc_reserve(
+                        seqs, T, inflight_blocks=len(inflight))
+                    if allowance < 1:
+                        fallout = "pages"
+                        break
+                    table_d = self._put(self._table_array(rows),
+                                        self._bax, None)
+        finally:
+            err = None
+            while inflight:
+                fut = inflight.popleft()
+                try:
+                    self._consume_cc_block(fut.result(), rows, with_top)
+                except Exception as e:  # noqa: BLE001 — drain the window
+                    # before surfacing (later futures must not leak)
+                    err = err or e
+            self.scheduler.deferred_free = None
+            if deferred:
+                self.pool.free(deferred)
+            self._cc_chains_total += 1
+            self._cc_blocks_total += blocks
+            self.events.record("decode_chain", t0_ns=chain_t0, rung=T,
+                               batch=len(seqs), blocks=blocks,
+                               fallout=fallout or "error")
+            if err is not None:
+                raise err
+
+    def _consume_cc_block(self, fetched, rows: List[Optional[Sequence]],
+                          with_top: bool) -> None:
+        """Account one drained continuous block: the emitted flags say
+        exactly which tokens are real and where each row stopped, so
+        rows without host-only stop SEQUENCES take a batch path — one
+        extend + one stop check + one delivery per block.  A stop
+        detected here was latched ON DEVICE in the same step (the mask
+        froze the row before any later block wrote its pages), so the
+        row's pages free immediately instead of waiting for chain
+        fall-out."""
+        out, logp, flags, tids, tlps = fetched  # [T, B] each
+        for i, s in enumerate(rows):
+            if s is None or s.status != "running":
+                continue
+            emitted = int(flags[:, i].sum())
+            if emitted == 0:
+                continue
+            if s.opts.stop_sequences:
+                # multi-token stops are invisible to the device mask:
+                # per-token host path; a hit finishes the row (pages
+                # deferred — in-flight blocks still write them) and the
+                # finished status trips chain fall-out
+                for t in range(emitted):
+                    s.num_computed += 1
+                    self.scheduler.commit_full_pages(s)
+                    self._append_token(
+                        s, int(out[t, i]), float(logp[t, i]),
+                        _tops_for(s, tids, tlps, (t, i)),
+                    )
+                    if s.status != "running":
+                        break
+                continue
+            first = not s.output_tokens
+            s.num_computed += emitted
+            s.output_tokens.extend(int(x) for x in out[:emitted, i])
+            if first:
+                self._note_first_token(s)
+            self.scheduler.commit_full_pages(s)
+            reason = self.scheduler.check_stop(s, self.eos_token_ids)
+            if reason:
+                # device-latched stop (eos/stop-id via the mask, length
+                # via the budget): no in-flight or future block writes
+                # these pages — free NOW, not at chain fall-out
+                saved = self.scheduler.deferred_free
+                self.scheduler.deferred_free = None
+                try:
+                    self.scheduler.finish(s, reason)
+                finally:
+                    self.scheduler.deferred_free = saved
+            self._deliver_block(s, out[:emitted, i], logp[:emitted, i],
+                                tids, tlps, i, with_top,
+                                finish_reason=reason)
 
     # -- multihost lockstep --------------------------------------------------- #
 
@@ -3861,30 +4406,33 @@ class JaxEngine:
             # one-shot TTFT attribution on the first-token delta
             out["ttft"] = seq.ttft_attr
             seq.ttft_attr = None
-        if finish_reason and seq.trace is not None and (
-            seq.t_first_token is not None
-        ):
-            # close the request's engine timeline: one decode-phase span
-            # (first token → finish) carrying the stream's totals + the
-            # TTFT attribution, so a single slice answers "where did this
-            # request's time go" without cross-referencing
-            from ..runtime.tracing import export_span, wall_ns_from_monotonic
-
-            attrs = {
-                "finish_reason": finish_reason,
-                "output_tokens": len(seq.output_tokens),
-                "preemptions": seq.preemptions,
-            }
-            if seq.spec_draft_tokens:
-                attrs["spec_draft_tokens"] = seq.spec_draft_tokens
-                attrs["spec_accepted_tokens"] = seq.spec_accepted_tokens
-            export_span(
-                "engine.decode", seq.trace,
-                wall_ns_from_monotonic(seq.t_first_token),
-                wall_ns_from_monotonic(time.monotonic()), **attrs,
-            )
+        if finish_reason:
+            self._close_decode_span(seq, finish_reason)
         # may be called from the executor thread — hop back to the loop
         self._post_threadsafe(queue, out)
+
+    def _close_decode_span(self, seq: Sequence, finish_reason: str) -> None:
+        """Close the request's engine timeline: one decode-phase span
+        (first token → finish) carrying the stream's totals + the TTFT
+        attribution, so a single slice answers "where did this request's
+        time go" without cross-referencing."""
+        if seq.trace is None or seq.t_first_token is None:
+            return
+        from ..runtime.tracing import export_span, wall_ns_from_monotonic
+
+        attrs = {
+            "finish_reason": finish_reason,
+            "output_tokens": len(seq.output_tokens),
+            "preemptions": seq.preemptions,
+        }
+        if seq.spec_draft_tokens:
+            attrs["spec_draft_tokens"] = seq.spec_draft_tokens
+            attrs["spec_accepted_tokens"] = seq.spec_accepted_tokens
+        export_span(
+            "engine.decode", seq.trace,
+            wall_ns_from_monotonic(seq.t_first_token),
+            wall_ns_from_monotonic(time.monotonic()), **attrs,
+        )
 
 
 def _tops_for(seq: Sequence, tids, tlps, idx):

@@ -511,17 +511,30 @@ class Scheduler:
                     return False
                 self._preempt(victim)
 
-    def try_extend_pages(self, seq: Sequence, upto_tokens: int) -> bool:
+    def try_extend_pages(self, seq: Sequence, upto_tokens: int,
+                         keep_watermark: bool = False) -> bool:
         """Grow seq's page list WITHOUT preemption (cached-page eviction is
         fine).  Used by decode-chaining, where preempting a running sequence
-        would invalidate tables already captured by in-flight dispatches."""
+        would invalidate tables already captured by in-flight dispatches.
+        `keep_watermark` additionally refuses to dip into the admission
+        reserve — the continuous decode loop's horizon pre-reservation
+        must not starve waiting prompts of the pages `_admit_check`
+        holds back for them."""
         need = seq.pages_needed(upto_tokens, self.cfg.page_size) - len(seq.pages)
         if need <= 0:
             return True
-        if self.pool.available_on(seq.kv_rank) < need:
+        reserve = self._watermark_pages() if keep_watermark else 0
+        if self.pool.available_on(seq.kv_rank) < need + reserve:
             return False
         seq.pages.extend(self.pool.allocate_on(seq.kv_rank, need))
         return True
+
+    def admission_ready(self) -> bool:
+        """Public face of `_head_admissible` (`_admit_check` minus the
+        mutation): True when the head-of-queue prompt could be admitted
+        right now — the continuous decode chain's admission fall-out
+        signal."""
+        return self._head_admissible()
 
     def _pick_victim(self, exclude: Sequence, rank: int = 0) -> Optional[Sequence]:
         """Youngest running sequence on the SAME pool partition (evicting

@@ -863,6 +863,9 @@ def decode_block_scan(
     sample_step,  # (carry, logits, tok_prev, step) -> (carry, tok, ys)
     carry_init,  # engine-side carry (seeds/counters/penalty counts …)
     rope_offset: Optional[jax.Array] = None,  # [B] mrope delta
+    active_init: Optional[jax.Array] = None,  # [B] bool — device-resident
+    # stop mask; switches sample_step to the 4-tuple protocol
+    # (carry, logits, tok_prev, step, act) -> (carry, tok, ys, act_next)
 ) -> Tuple[Any, Any, jax.Array, jax.Array, KVCache]:
     """`n_steps` decode steps with BLOCK-MATERIALIZED KV (r5 perf): the
     pool pages behind the block's table are gathered ONCE, in-block
@@ -873,6 +876,15 @@ def decode_block_scan(
     block run at the ~750 GB/s stream rate.
 
     Returns (carry, ys_stacked, last_tok, positions + n_steps, kv).
+
+    With `active_init` (the device-resident decode loop) the scan also
+    carries a per-row ACTIVE mask: a row whose mask drops (stop token /
+    budget exhausted, decided inside `sample_step`) freezes its position
+    — later steps rope/attend with the frozen position (outputs are
+    host-discarded) and the final scatter routes its writes to the trash
+    page, so a finished row's pool pages are never touched again no
+    matter how long the chain keeps running.  Positions then return as
+    `positions + emitted` per row, not `+ n_steps`.
     DRIFT TRIPWIRE: this is a separate forward path from
     `_layer_decode`/`decode_attention` — any new model feature (bias,
     norm variant, softcap, rope flavor) added there MUST be mirrored
@@ -943,8 +955,14 @@ def decode_block_scan(
         v_top = jnp.repeat(v_self, groups, axis=1).astype(jnp.float32)
         return (out + w_s * v_top).astype(q.dtype)
 
+    masked = active_init is not None
+
     def step(carry, _):
-        eng, tok, pos, t, rk, rv = carry
+        if masked:
+            eng, tok, pos, t, act, rk, rv = carry
+        else:
+            eng, tok, pos, t, rk, rv = carry
+            act = None
         ok = pos < max_valid_pos
         safe_pos = jnp.where(ok, pos, 0)
         rp = safe_pos if rope_offset is None else safe_pos + rope_offset
@@ -984,18 +1002,37 @@ def decode_block_scan(
         rv = jax.lax.dynamic_update_slice(
             rv, vs[:, :, None].astype(rv.dtype), (0, 0, t, 0, 0))
         logits = _lm_logits(params, cfg, x)
+        if masked:
+            # rows whose mask dropped freeze their position: the row
+            # emitted its last token already, so later steps compute
+            # discarded garbage and must not advance KV addressing
+            eng, tok_next, ys, act_next = sample_step(
+                eng, logits, tok, t, act)
+            return (eng, tok_next, pos + act.astype(pos.dtype), t + 1,
+                    act_next, rk, rv), (ys, act)
         eng, tok_next, ys = sample_step(eng, logits, tok, t)
         return (eng, tok_next, pos + 1, t + 1, rk, rv), ys
 
     rk0 = jnp.zeros((L, B, T, nkv, hd), kv.k.dtype)
     rv0 = jnp.zeros((L, B, T, nkv, hd), kv.v.dtype)
-    (eng, tok, pos, _, rk, rv), ys = jax.lax.scan(
-        step, (carry_init, tokens, positions, jnp.int32(0), rk0, rv0),
-        None, length=T)
+    if masked:
+        (eng, tok, pos, _, _, rk, rv), (ys, acts) = jax.lax.scan(
+            step, (carry_init, tokens, positions, jnp.int32(0),
+                   active_init, rk0, rv0),
+            None, length=T)
+    else:
+        (eng, tok, pos, _, rk, rv), ys = jax.lax.scan(
+            step, (carry_init, tokens, positions, jnp.int32(0), rk0, rv0),
+            None, length=T)
 
     # 3. one batched scatter of the whole block's KV into the pool
     tpos = positions[:, None] + jnp.arange(T)[None, :]  # [B, T]
     ok = tpos < max_valid_pos
+    if masked:
+        # a frozen row's emitted prefix is contiguous from its initial
+        # position, so the uniform tpos formula holds exactly where the
+        # per-step mask is true; everything after the stop lands in trash
+        ok &= jnp.swapaxes(acts, 0, 1)
     page_idx = jnp.clip(tpos // page, 0, W - 1)
     page_ids = jnp.take_along_axis(page_table, page_idx, axis=1)
     slot = jnp.where(ok, page_ids * page + tpos % page, 0).reshape(-1)

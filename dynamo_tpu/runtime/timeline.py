@@ -233,6 +233,47 @@ def validate_chrome_trace(doc: Any) -> List[str]:
     return errors
 
 
+def decode_host_gaps(dump: dict, continuous_only: bool = False) -> dict:
+    """Inter-block HOST gap derived from a StepEventRecorder dump's
+    `decode_block` slices: for consecutive slices ordered by start time,
+    gap = start[k+1] - end[k], clamped at zero when the next dispatch
+    was issued before the previous slice closed (the async-drain overlap
+    the device-resident decode loop exists to create).
+
+    This is the ROADMAP's "host gap between consecutive decode blocks"
+    measurement (target < 0.1 ms on-chip): the continuous engine records
+    one `decode_block` slice per loop iteration (dispatch + drain
+    handoff + fall-out checks), so the gaps are exactly the host time
+    the device could have been waiting on Python.  Gaps that span chain
+    boundaries (planning, array building) are included — they are the
+    host-in-the-loop cost the open-ended chain amortizes away.
+
+    Returns {"n", "p50_ms", "p99_ms", "max_ms"} (Nones when fewer than
+    two decode_block events are present).  `continuous_only` restricts
+    to blocks the continuous loop dispatched."""
+    evs = [e for e in dump.get("events", [])
+           if e.get("kind") == "decode_block"
+           and (not continuous_only or e.get("continuous"))]
+    evs.sort(key=lambda e: e.get("t_ns", 0))
+    gaps = sorted(
+        max(0, b.get("t_ns", 0) - (a.get("t_ns", 0) + a.get("dur_ns", 0)))
+        / 1e6
+        for a, b in zip(evs, evs[1:])
+    )
+    if not gaps:
+        return {"n": 0, "p50_ms": None, "p99_ms": None, "max_ms": None}
+
+    def pct(p: float) -> float:
+        return gaps[int(p * (len(gaps) - 1))]
+
+    return {
+        "n": len(gaps),
+        "p50_ms": round(pct(0.50), 4),
+        "p99_ms": round(pct(0.99), 4),
+        "max_ms": round(gaps[-1], 4),
+    }
+
+
 def trace_graph(spans: List[dict]) -> Dict[str, dict]:
     """Per-trace connectivity summary used by tests and trace_stack's
     summary line: {trace_id: {spans, services, roots, orphans}}.

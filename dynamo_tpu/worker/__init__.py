@@ -98,6 +98,12 @@ class DpRankEngine:
             ),
             ttft_prefill_ms_total=sum(m.ttft_prefill_ms_total for m in per),
             ttft_attributed_total=sum(m.ttft_attributed_total for m in per),
+            decode_cc_blocks_total=sum(
+                m.decode_cc_blocks_total for m in per
+            ),
+            decode_cc_chains_total=sum(
+                m.decode_cc_chains_total for m in per
+            ),
         )
         # per-rung dispatch counters are dynamic attrs — sum the union
         # across ranks so the block-ladder histogram survives dp>1

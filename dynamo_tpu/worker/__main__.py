@@ -27,6 +27,21 @@ def _ladder_arg(s: str):
         )
 
 
+def _chain_arg(s: str):
+    """--decode-chain takes an int (fixed chain depth) or the literal
+    `continuous` (device-resident open-ended chaining, DYN-style
+    continuous-mode toggle — docs/device_loop.md)."""
+    if s.strip().lower() == "continuous":
+        return "continuous"
+    try:
+        return int(s)
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"invalid --decode-chain {s!r}: expected an int or "
+            f"'continuous'"
+        )
+
+
 def build_parser() -> argparse.ArgumentParser:
     """The worker's argparse surface, exposed so deployment graphs and
     recipe tests can validate worker argv without starting a worker."""
@@ -68,8 +83,19 @@ def build_parser() -> argparse.ArgumentParser:
                          "stops are applied after the block, so up to N-1 "
                          "tokens past a stop are computed and discarded. "
                          "Raise on remote-attached chips (bench.py sweep)")
-    ap.add_argument("--decode-chain", type=int, default=1,
-                    help="decode dispatches in flight before fetching")
+    ap.add_argument("--decode-chain", type=_chain_arg, default=1,
+                    help="decode dispatches in flight before fetching, "
+                         "or 'continuous' for the device-resident decode "
+                         "loop: open-ended chaining with on-device stop "
+                         "detection and an async drain — the chain only "
+                         "falls back to the host on admission/stop "
+                         "events (docs/device_loop.md).  Equivalent to "
+                         "--decode-continuous with the default horizon")
+    ap.add_argument("--decode-continuous", action="store_true",
+                    help="device-resident decode loop (see "
+                         "--decode-chain continuous); with an integer "
+                         "--decode-chain N, N becomes the page "
+                         "pre-reservation horizon in blocks")
     ap.add_argument("--decode-block-ladder", type=_ladder_arg, default=None,
                     help="adaptive decode-block sizing: comma-separated "
                          "rung sizes (e.g. 1,4,16) compiled alongside "
@@ -190,6 +216,7 @@ def check_args(ap: argparse.ArgumentParser, args) -> None:
                       or args.attention_impl != "auto"
                       or args.decode_steps != 1 or args.decode_chain != 1
                       or args.decode_block_ladder
+                      or getattr(args, "decode_continuous", False)
                       or args.speculative_ngram_k
                       or args.no_prefix_caching or args.vision
                       or args.encode_component):
@@ -215,6 +242,10 @@ def engine_config_from_args(args):
     combinations — the same construction the live worker performs)."""
     from ..engine import EngineConfig
 
+    continuous = (getattr(args, "decode_continuous", False)
+                  or args.decode_chain == "continuous")
+    chain = (args.decode_chain if isinstance(args.decode_chain, int)
+             else 2)  # 'continuous' keyword: default double-buffer horizon
     return EngineConfig(
         page_size=args.page_size,
         num_pages=args.num_pages,
@@ -224,7 +255,8 @@ def engine_config_from_args(args):
         quantization=args.quantization,
         attention_impl=args.attention_impl,
         decode_steps=args.decode_steps,
-        decode_chain=args.decode_chain,
+        decode_chain=chain,
+        decode_continuous=continuous,
         decode_block_ladder=args.decode_block_ladder,
         speculative_ngram_k=args.speculative_ngram_k,
         mixed_prefill_tokens=args.mixed_prefill_tokens,

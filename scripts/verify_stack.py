@@ -310,6 +310,39 @@ def main():
         print(f"OK block-ladder worker: greedy-identical to plain, "
               f"rungs {rungs}, ttft attribution on both /metrics")
 
+        # continuous-decode worker (device-resident decode loop, ISSUE
+        # 6): greedy output must be token-identical to the plain
+        # workers' (open-ended chaining + on-device stop detection is
+        # output-invisible), and a long generation must actually engage
+        # the loop (decode_cc_{chains,blocks}_total on /metrics)
+        cw_status = free_port()
+        spawn([*worker_args, "--model-name", "tiny-cc",
+               "--decode-steps", "8", "--decode-chain", "continuous",
+               "--status-port", str(cw_status)], "cc-worker")
+        deadline = time.time() + 30
+        while True:
+            models = http_json(f"{base}/v1/models")
+            if "tiny-cc" in [m["id"] for m in models["data"]]:
+                break
+            assert time.time() < deadline, models
+            time.sleep(0.5)
+        out = http_json(f"{base}/v1/chat/completions",
+                        {**chat, "model": "tiny-cc"})
+        assert out["choices"][0]["message"]["content"] == text1, out
+        # a longer stream outruns the fused prefill chain, so the
+        # continuous loop itself produces most of the tokens
+        long_chat = {**chat, "model": "tiny-cc", "max_tokens": 48,
+                     "nvext": {"ignore_eos": True}}
+        out = http_json(f"{base}/v1/chat/completions", long_chat)
+        assert out["usage"]["completion_tokens"] == 48, out
+        m = http_json(f"http://127.0.0.1:{cw_status}/metrics.json")
+        assert m.get("decode_cc_chains_total", 0) > 0, m
+        assert m.get("decode_cc_blocks_total", 0) >= m[
+            "decode_cc_chains_total"], m
+        print(f"OK continuous-decode worker: greedy-identical to plain, "
+              f"{m['decode_cc_blocks_total']} blocks over "
+              f"{m['decode_cc_chains_total']} chains")
+
         # kill worker1 → requests keep working on worker2
         w1.send_signal(signal.SIGKILL)
         time.sleep(7)  # > lease TTL
@@ -317,7 +350,7 @@ def main():
         assert out["choices"][0]["message"]["content"] == text1
         models = http_json(f"{base}/v1/models")
         assert set(m["id"] for m in models["data"]) == {
-            "tiny-chat", "tiny-vlm", "tiny-spec", "tiny-ladder"}
+            "tiny-chat", "tiny-vlm", "tiny-spec", "tiny-ladder", "tiny-cc"}
         print("OK survives worker kill")
 
         print("VERIFY PASS")

@@ -321,6 +321,68 @@ async def test_prompt_admitted_within_one_short_rung(setup):
     assert got == want
 
 
+# -- continuous chaining (device-resident decode loop, ISSUE 6) ------------- #
+
+
+async def test_continuous_chain_composes_with_ladder(setup):
+    """The device-resident loop engages at the ladder's top rung only
+    (rungs stay the scan lengths; short rungs keep the per-dispatch
+    path for admission latency) and stays token-identical to the fixed
+    engine under the live policy, greedy AND seeded."""
+    def reqs():
+        out = [req(p, max_tokens=12) for p in PROMPTS]
+        out[2] = req(PROMPTS[2], max_tokens=12, temperature=0.8, seed=31)
+        return out
+
+    cc = make_engine(setup, decode_block_ladder=[1, 2, 4],
+                     decode_chain=2, decode_continuous=True)
+    got = await _staggered(cc, reqs())
+    m = cc.metrics()
+    await cc.shutdown()
+    assert m.decode_cc_chains_total > 0  # the loop actually engaged
+
+    fixed = make_engine(setup, decode_block_ladder=[1, 2, 4],
+                        decode_chain=2)
+    want = await _staggered(fixed, reqs())
+    await fixed.shutdown()
+    assert got == want
+
+
+async def test_continuous_chain_falls_out_on_mid_chain_admission(setup):
+    """ISSUE 6 satellite: a prompt arriving while an open-ended chain is
+    in flight makes the chain FALL OUT (the scheduler's pending-add /
+    `_admit_check` signals) and the prompt rides the next mixed/prefill
+    dispatch instead of waiting for a fixed horizon to drain."""
+    engine = make_engine(setup, decode_continuous=True, decode_chain=2,
+                         fuse_prefill_decode=False,
+                         max_prefill_tokens=32, max_model_len=512,
+                         num_pages=256)
+    engine.dispatch_trace = trace = []
+
+    async def long_decode():
+        return (await collect(
+            engine, req([1, 2, 3], max_tokens=400)))[0]
+
+    task = asyncio.ensure_future(long_decode())
+    # wait until the continuous chain is genuinely in flight
+    while not any(e["kind"] == "decode" for e in trace):
+        await asyncio.sleep(0.005)
+    toks_b, _ = await collect(engine, req(list(range(1, 25)), max_tokens=4))
+    assert len(toks_b) == 4
+    task.cancel()  # generate()'s finally aborts the long stream
+    try:
+        await task
+    except asyncio.CancelledError:
+        pass
+    fallouts = [e[3]["fallout"] for e in engine.events.snapshot()
+                if e[2] == "decode_chain"]
+    # the in-flight chain fell out on the admission-side signal...
+    assert any(f in ("pending_work", "admit") for f in fallouts), fallouts
+    # ...and the prompt rode a prefill-bearing dispatch
+    assert any(e["kind"] in ("mixed", "prefill") for e in trace), trace
+    await engine.shutdown()
+
+
 # -- compile-count tripwire ------------------------------------------------- #
 
 

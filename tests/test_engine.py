@@ -388,6 +388,120 @@ async def test_spec_decode_tokens_per_dispatch(engine_setup):
     assert 0.0 < m.spec_acceptance_rate <= 1.0
 
 
+def make_cc_engine(engine_setup, **over):
+    """A device-resident (continuous-chain) engine: open-ended decode
+    chaining, on-device stop detection, async double-buffered drain."""
+    over.setdefault("decode_steps", 4)
+    over.setdefault("decode_chain", 2)
+    over.setdefault("decode_continuous", True)
+    return make_engine(engine_setup, **over)
+
+
+async def test_continuous_decode_matches_per_step(engine_setup):
+    """ISSUE 6 equivalence matrix: the device-resident decode loop
+    (continuous chaining + on-device stop detection + async drain) must
+    be output-invisible vs the per-step engine — greedy, SEEDED
+    temperature sampling, and penalized rows, concurrent and solo."""
+    prompts = [[1, 2, 3, 4, 5], [9, 8, 7], [3, 3, 3, 3, 3, 3, 3, 3]]
+
+    def reqs():
+        out = [req(p, max_tokens=13) for p in prompts]
+        out[1] = req(prompts[1], max_tokens=13, temperature=0.9)
+        out[1]["sampling_options"]["seed"] = 42
+        out[2] = req(prompts[2], max_tokens=13)
+        out[2]["sampling_options"]["frequency_penalty"] = 1.5
+        return out
+
+    plain = make_engine(engine_setup)
+    want = [await collect(plain, r) for r in reqs()]
+    await plain.shutdown()
+
+    cc = make_cc_engine(engine_setup)
+    got = await asyncio.gather(*[collect(cc, r) for r in reqs()])
+    m = cc.metrics()
+    released = cc.pool.free_pages + cc.pool.evictable_pages
+    await cc.shutdown()
+    assert list(got) == want
+    # the continuous path actually engaged (chains + per-chain blocks)
+    assert m.decode_cc_chains_total > 0
+    assert m.decode_cc_blocks_total >= m.decode_cc_chains_total
+    assert released == cc.pool.num_pages - 1
+
+
+async def test_continuous_decode_device_stop_detection(engine_setup):
+    """A stop token inside an open-ended chain is latched ON DEVICE:
+    the stream ends exactly at the stop with the right reason, the
+    finished row's pages free without waiting for chain fall-out, and
+    host-only stop SEQUENCES still work (they force fall-out)."""
+    cc = make_cc_engine(engine_setup)
+    probe, _ = await collect(cc, req([5, 6, 7], max_tokens=20))
+
+    r = req([5, 6, 7], max_tokens=20)
+    r["stop_conditions"]["stop_token_ids"] = [probe[2]]
+    toks, reason = await collect(cc, r)
+    assert toks == probe[:3] and reason == "stop"
+
+    r = req([5, 6, 7], max_tokens=20)
+    r["stop_conditions"]["stop_sequences"] = [[probe[2], probe[3]]]
+    toks, reason = await collect(cc, r)
+    assert toks == probe[:4] and reason == "stop"
+    # a host-detected stop fell the chain out; device-detected stops
+    # free early — either way the pool fully drains
+    for _ in range(100):
+        if (cc.pool.free_pages + cc.pool.evictable_pages
+                == cc.pool.num_pages - 1):
+            break
+        await asyncio.sleep(0.05)
+    assert cc.pool.free_pages + cc.pool.evictable_pages == \
+        cc.pool.num_pages - 1
+    fallouts = [e[3]["fallout"] for e in cc.events.snapshot()
+                if e[2] == "decode_chain"]
+    assert fallouts and set(fallouts) <= {
+        "stop", "pending_work", "admit"}, fallouts
+    await cc.shutdown()
+
+
+async def test_continuous_decode_per_step_fallback_path(engine_setup):
+    """The continuous loop's per-step scan fallback (Pallas / giant-KV
+    engines that cannot materialize the block) stays token-identical:
+    force it by zeroing the block-KV byte budget."""
+    import dynamo_tpu.engine.engine as eng_mod
+
+    plain = make_engine(engine_setup)
+    want = [await collect(plain, req([1, 2, 3, 4, 5], max_tokens=13))]
+    await plain.shutdown()
+
+    saved = eng_mod._BLOCK_KV_BYTE_BUDGET
+    eng_mod._BLOCK_KV_BYTE_BUDGET = 0
+    try:
+        cc = make_cc_engine(engine_setup)
+        got = [await collect(cc, req([1, 2, 3, 4, 5], max_tokens=13))]
+        assert cc.metrics().decode_cc_blocks_total > 0
+        await cc.shutdown()
+    finally:
+        eng_mod._BLOCK_KV_BYTE_BUDGET = saved
+    assert got == want
+
+
+async def test_continuous_decode_top_logprobs(engine_setup):
+    """top-logprobs ride the continuous packed layout (flags slot
+    between logp and the top-TOPLP block)."""
+    cc = make_cc_engine(engine_setup)
+    r = req([1, 2, 3], max_tokens=6)
+    r["sampling_options"]["logprobs"] = True
+    r["sampling_options"]["top_logprobs"] = 3
+    n_toks = n_tops = 0
+    async for out in cc.generate(r):
+        n_toks += len(out["token_ids"])
+        for tops in out.get("top_logprobs", []):
+            assert len(tops) == 3
+            lps = [lp for _, lp in tops]
+            assert lps == sorted(lps, reverse=True)
+            n_tops += 1
+    await cc.shutdown()
+    assert n_toks == 6 and n_tops == 6
+
+
 async def test_fused_prefill_decode_matches_unfused():
     """The fused prefill→decode dispatch (first decode chain fed by the
     prefill's device-side sampled token) must be output-invisible:

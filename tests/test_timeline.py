@@ -129,3 +129,91 @@ def test_trace_graph_finds_orphans(tmp_path):
     assert graph["t1"]["orphans"] == ["engine.decode"]
     assert graph["t1"]["roots"] == 1
     assert graph["t2"]["orphans"] == []
+
+
+# -- inter-block host-gap derivation (ISSUE 6 tripwire) ---------------------- #
+
+
+def _gap_dump(blocks):
+    """A StepEventRecorder-dump shape from (t_ns, dur_ns) decode blocks."""
+    return {
+        "wall_ns": 0, "mono_ns": 0,
+        "events": [{"t_ns": t, "dur_ns": d, "kind": "decode_block",
+                    "rung": 8, "batch": 4, "chain": i + 1,
+                    "continuous": True}
+                   for i, (t, d) in enumerate(blocks)],
+    }
+
+
+def test_decode_host_gaps_basic():
+    # three blocks: gaps of 1ms and 3ms between consecutive slices
+    g = tl.decode_host_gaps(_gap_dump([
+        (0, 5_000_000), (6_000_000, 5_000_000), (14_000_000, 5_000_000),
+    ]))
+    assert g["n"] == 2
+    assert g["p50_ms"] == 1.0 and g["max_ms"] == 3.0
+    # percentiles are monotone by construction
+    assert g["p50_ms"] <= g["p99_ms"] <= g["max_ms"]
+
+
+def test_decode_host_gaps_clamps_async_overlap():
+    """Blocks issued before the previous slice closed (the async-drain
+    overlap) clamp to zero instead of going negative."""
+    g = tl.decode_host_gaps(_gap_dump([
+        (0, 10_000_000), (5_000_000, 10_000_000),
+    ]))
+    assert g == {"n": 1, "p50_ms": 0.0, "p99_ms": 0.0, "max_ms": 0.0}
+
+
+def test_decode_host_gaps_empty_and_filtering():
+    assert tl.decode_host_gaps({"events": []})["n"] == 0
+    dump = _gap_dump([(0, 1_000), (2_000, 1_000)])
+    dump["events"][0]["continuous"] = False
+    assert tl.decode_host_gaps(dump, continuous_only=True)["n"] == 0
+    assert tl.decode_host_gaps(dump)["n"] == 1
+
+
+async def test_host_gap_measured_from_continuous_engine():
+    """The CPU half of the ISSUE 6 acceptance: a continuous-chain
+    engine's step-event ring yields a computable, monotone host-gap
+    measurement (the on-chip < 0.1 ms threshold is a bench rider —
+    CPU asserts existence and sanity under a generous bound)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine import EngineConfig, JaxEngine
+    from dynamo_tpu.models import init_params, tiny_config
+
+    cfg = tiny_config()
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    engine = JaxEngine(
+        cfg, params,
+        EngineConfig(page_size=8, num_pages=64, max_num_seqs=2,
+                     max_prefill_tokens=64, max_model_len=128,
+                     decode_steps=4, decode_chain=2,
+                     decode_continuous=True, fuse_prefill_decode=False),
+        eos_token_ids=[], kv_dtype=jnp.float32,
+    )
+    try:
+        out = []
+        async for d in engine.generate({
+            "token_ids": [1, 2, 3],
+            "sampling_options": {"temperature": 0.0},
+            "stop_conditions": {"max_tokens": 24, "ignore_eos": True},
+        }):
+            assert d.get("finish_reason") != "error", d
+            out.extend(d.get("token_ids", []))
+        assert len(out) == 24
+        dump = engine.events.dump()
+        gaps = tl.decode_host_gaps(dump, continuous_only=True)
+        # ≥ 6 continuous blocks → ≥ 5 gaps: the measurement EXISTS
+        assert gaps["n"] >= 2, dump["events"][-10:]
+        assert gaps["p50_ms"] <= gaps["p99_ms"] <= gaps["max_ms"]
+        # generous CPU bound — catches wiring bugs (e.g. per-chain
+        # instead of per-block events), not chip-grade latency
+        assert gaps["p50_ms"] < 1000.0
+        chains = [e for e in dump["events"] if e["kind"] == "decode_chain"]
+        assert chains and all("fallout" in e and "blocks" in e
+                              for e in chains)
+    finally:
+        await engine.shutdown()
