@@ -121,13 +121,43 @@ async def interleaved_ab(engines, rounds=3, gen_tokens=SUSTAINED_GEN):
 async def _goodput_pass(engine, *, rates, n_req, prompt_len, gen, slo,
                         min_fraction, rep):
     """One rate-ladder pass: sweep Poisson offered rates until the SLO
-    breaks; returns (sweep_points, knee_rate)."""
+    breaks; returns (sweep_points, knee_rate).
+
+    Each rate point ALSO runs through a live frontend SLO window
+    (frontend/slo.py — the exact accounting the serving fleet exposes on
+    /metrics and /fleet.json) and asserts the live slo_met/goodput agree
+    with this offline computation; both land in BENCH_full.json."""
+    from dynamo_tpu.frontend.slo import SLOAccountant, SLOTargets
+
     sweep, knee, broken = [], None, False
     for i, rate in enumerate(rates):
+        live_acc = SLOAccountant(window_s=1800.0, slots=60)
+        # set_targets, NOT the constructor default: the default passes
+        # through SLOTargets.from_env, and a fleet-wide DYN_TPU_SLO_*
+        # override would silently diverge the live predicate from the
+        # offline `slo` dict this pass scores against
+        live_acc.set_targets("bench", SLOTargets(
+            ttft_ms=slo["ttft_ms"], itl_ms=slo["itl_ms"]))
         g = await poisson_goodput(
             engine, n_req=n_req, rate_rps=rate, prompt_len=prompt_len,
             gen=gen, slo=slo, seed=17 + 31 * rep + i,
+            accountant=live_acc,
         )
+        live = live_acc.snapshot()["bench"]
+        # identical request log + identical SLO predicate → the MET
+        # fraction must match exactly; the rates may differ only by the
+        # covered-duration offset (the first arrival's Poisson wait,
+        # ~1/(n_req·rate) of the phase)
+        assert abs((live["slo_met"] if live["slo_met"] is not None
+                    else -1.0) - g[4]) < 1e-6, (live["slo_met"], g[4])
+        if g[0] > 0:
+            # the acceptance bar: live within 5% of offline (the window
+            # is anchored at phase t0, so agreement is near-exact)
+            drift = abs(live["goodput_tok_s"] - g[0]) / g[0]
+            assert drift < 0.05, (
+                f"live window goodput {live['goodput_tok_s']:.1f} vs "
+                f"offline {g[0]:.1f} ({drift:.1%} apart)"
+            )
         sweep.append({
             "rate_rps": rate,
             "goodput_tok_s": round(g[0], 2),
@@ -135,6 +165,13 @@ async def _goodput_pass(engine, *, rates, n_req, prompt_len, gen, slo,
             "ttft_p50_ms": round(g[2], 1),
             "itl_p99_ms": round(g[3], 2),
             "slo_met_fraction": round(g[4], 3),
+            "live_window": {
+                "slo_met": live["slo_met"],
+                "goodput_tok_s": round(live["goodput_tok_s"], 2),
+                "attained_tok_s": round(live["attained_tok_s"], 2),
+                "ttft_p50_ms": live["ttft"]["p50_ms"],
+                "itl_p99_ms": live["itl"]["p99_ms"],
+            },
         })
         if g[4] >= min_fraction and not broken:
             # knee = top of the CONTIGUOUS passing prefix
@@ -219,14 +256,26 @@ def _knee_summary(passes, rates, n_req, min_fraction, slo):
 
 
 async def poisson_goodput(engine, *, n_req, rate_rps, prompt_len, gen,
-                          slo, seed=17):
+                          slo, seed=17, accountant=None):
     """Poisson arrivals; returns (goodput_tok_s, attained_tok_s,
-    ttft_p50_ms, itl_p99_ms, slo_met_fraction)."""
+    ttft_p50_ms, itl_p99_ms, slo_met_fraction).
+
+    With `accountant` (a frontend SLOAccountant), every request ALSO
+    flows through the live sliding-window path — the cross-check that
+    the serving fleet's /metrics numbers and this offline computation
+    are the same definitions (`_goodput_pass` asserts agreement)."""
     rng = random.Random(seed)
     waits, acc = [], 0.0
     for _ in range(n_req):
         acc += rng.expovariate(rate_rps)
         waits.append(acc)
+
+    if accountant is not None:
+        # anchor the live window at phase t0: its covered duration must
+        # be the same interval the offline goodput divides by, not
+        # offset by the first arrival's Poisson wait (an Exp(rate) tail
+        # that would otherwise flake the cross-check ~e^-(0.1·n_req))
+        accountant.window("bench").mark()
 
     async def one(i):
         await asyncio.sleep(waits[i])
@@ -237,6 +286,8 @@ async def poisson_goodput(engine, *, n_req, rate_rps, prompt_len, gen,
         }
         n = 0
         t_submit = time.perf_counter()
+        if accountant is not None:
+            accountant.observe_start("bench")
         t_first = t_last = None
         async for out in engine.generate(req):
             if out["token_ids"]:
@@ -247,6 +298,9 @@ async def poisson_goodput(engine, *, n_req, rate_rps, prompt_len, gen,
         ttft_ms = (t_first - t_submit) * 1e3 if t_first else float("inf")
         itl_ms = ((t_last - t_first) / max(n - 1, 1) * 1e3
                   if t_first else float("inf"))
+        if accountant is not None:
+            accountant.observe("bench", ttft_ms, itl_ms, n,
+                               prompt_tokens=prompt_len)
         return n, ttft_ms, itl_ms
 
     t0 = time.perf_counter()

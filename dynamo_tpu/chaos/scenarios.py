@@ -22,6 +22,11 @@ re-convergence) plus scenario-specific telemetry:
    request path dead) so ONLY the through-the-request-path health check
    catches it; the worker publishes unhealthy, self-evicts, streams migrate,
    and the controller respawns a healthy replica.
+6. ``telemetry_staleness``     — SIGKILL a worker mid-wave AND partition the
+   frontend's control plane; the fleet telemetry aggregator marks the
+   affected capacity snapshots stale (never wrong-but-fresh-looking),
+   retains the dead worker's last snapshot as stale, and recovers to fresh
+   snapshots after the heal.
 
 Graph scenarios run MockEngine workers (the real scheduler + page pool with
 a simulated device step) slowed via ``--mock-speedup`` so faults land
@@ -346,12 +351,151 @@ def disagg_handoff_drop() -> Scenario:
     )
 
 
+# --------------------------------------------------------------------------- #
+# Scenario 6: telemetry staleness under kill + partition (custom — the
+# fleet aggregator must observe the fault WHILE traffic runs, so the
+# scenario owns the stack instead of riding ScenarioRunner's fixed flow)
+# --------------------------------------------------------------------------- #
+
+
+async def _run_telemetry_staleness() -> ScenarioResult:
+    """Kill a worker mid-wave AND partition the frontend's control plane:
+    the fleet aggregator must mark the affected capacity snapshots STALE
+    (never serve wrong-but-fresh-looking data), retain the dead worker's
+    last snapshot as stale instead of dropping it, and recover to fresh
+    snapshots from both live workers after the heal — with zero
+    client-visible errors and streams identical to the unfaulted wave."""
+    from ..planner.telemetry import FleetTelemetryWatcher
+    from .runner import ChaosStack, _counter_total
+
+    traffic = TrafficSpec(requests=4, max_tokens=32, seed_base=1600)
+    plan = FaultPlan(seed=16, faults=[
+        # kill first, partition later in the same wave: migration off the
+        # dead replica needs live discovery (a kill INSIDE a partition
+        # window exhausts the retry budget against the stale instance
+        # list — that failure mode belongs to the overload/retry PRs)
+        FaultSpec(kind=KILL_REPLICA, component="backend", after_tokens=8),
+        FaultSpec(kind=PARTITION, target="local", point="control.call",
+                  after_tokens=40, duration_s=2.0),
+    ])
+    stack = ChaosStack(GRAPH_TWO_REPLICAS,
+                       env={**_FAST_LEASE,
+                            "DYN_TPU_TELEMETRY_INTERVAL": "0.3"})
+    result = ScenarioResult(name="telemetry_staleness", passed=False,
+                            streams=traffic.requests)
+    watcher = monitor_task = None
+    saw_stale = {"during_fault": False}
+    try:
+        await stack.start()
+        await stack.wait_model(traffic.model, 2)
+        watcher = await FleetTelemetryWatcher(
+            stack.front_rt, namespace=NAMESPACE, default_interval=0.3,
+            # the scenario asserts the dead worker's snapshot is
+            # RETAINED-stale after heal; the default 120s retention
+            # could prune it first on a slow CI box
+            retention_s=600.0,
+        ).start()
+        await watcher.wait_synced()
+
+        async def wait_fresh(n, timeout=60.0):
+            deadline = asyncio.get_running_loop().time() + timeout
+            while True:
+                snap = watcher.sample()
+                if len(snap.fresh_workers()) >= n:
+                    return snap
+                if asyncio.get_running_loop().time() > deadline:
+                    ages = {k: w.get("age_s")
+                            for k, w in snap.workers.items()}
+                    raise AssertionError(
+                        f"never saw {n} fresh worker snapshot(s): {ages}")
+                await asyncio.sleep(0.1)
+
+        await wait_fresh(2)
+        baseline = await stack.drive(traffic)
+        for out in baseline:
+            assert not out.errors and out.finish == "length", out
+
+        async def monitor():
+            while True:
+                snap = watcher.snapshot()
+                if any(w.get("stale") for w in snap.workers.values()):
+                    saw_stale["during_fault"] = True
+                await asyncio.sleep(0.1)
+
+        monitor_task = asyncio.create_task(monitor())
+        outcomes = await stack.drive(traffic, plan=plan)
+        result.client_errors = sum(len(o.errors) for o in outcomes)
+        result.stream_mismatches = sum(
+            1 for b, o in zip(baseline, outcomes) if b.text != o.text)
+        assert result.client_errors == 0, (
+            [o.errors for o in outcomes if o.errors])
+        assert result.stream_mismatches == 0
+
+        # the kill + partition MUST surface as staleness — a short wave
+        # can end before the publish deadline (2.5 × interval) elapses,
+        # so poll past it rather than asserting at wave end (the dead
+        # worker can never publish again, so this converges)
+        stale_deadline = asyncio.get_running_loop().time() + 15.0
+        while not saw_stale["during_fault"]:
+            if any(w.get("stale")
+                   for w in watcher.snapshot().workers.values()):
+                saw_stale["during_fault"] = True
+                break
+            assert asyncio.get_running_loop().time() < stale_deadline, (
+                "no capacity snapshot was ever marked stale after the "
+                "kill + partition")
+            await asyncio.sleep(0.1)
+
+        # heal: the operator respawns the victim; both live workers
+        # publish fresh again, and the dead worker's LAST snapshot stays
+        # visible — marked stale, not silently dropped
+        result.converge_s = await stack.wait_converged(
+            model=traffic.model, instances=2)
+        snap = await wait_fresh(2)
+        stale_retained = [k for k, w in snap.workers.items()
+                          if w.get("stale")]
+        assert stale_retained, (
+            "the killed worker's snapshot was dropped instead of "
+            "retained as stale")
+        result.migrations_total = _counter_total(stack.metrics.migrations)
+        result.telemetry = {
+            "fresh_workers": len(snap.fresh_workers()),
+            "stale_retained": len(stale_retained),
+            "saw_stale_during_fault": True,
+        }
+        result.passed = True
+    except (AssertionError, TimeoutError, asyncio.TimeoutError) as e:
+        # asyncio.TimeoutError is NOT builtins.TimeoutError on py3.10 —
+        # wait_for timeouts must land in result.failure, not escape
+        result.failure = str(e) or repr(e)
+    finally:
+        if monitor_task:
+            monitor_task.cancel()
+            await asyncio.gather(monitor_task, return_exceptions=True)
+        if watcher:
+            await watcher.stop()
+        await stack.stop()
+    return result
+
+
+def telemetry_staleness() -> Scenario:
+    return Scenario(
+        name="telemetry_staleness",
+        description="worker kill + control-plane partition under live "
+                    "traffic; the fleet aggregator surfaces staleness "
+                    "and recovers after heal",
+        graph="", traffic=TrafficSpec(), plan=FaultPlan(),
+        custom=_run_telemetry_staleness,
+    )
+
+
 SCENARIOS = {
     "worker_kill_midstream": worker_kill_midstream,
     "multinode_rank_death": multinode_rank_death,
     "control_plane_partition": control_plane_partition,
     "disagg_handoff_drop": disagg_handoff_drop,
     "wedged_engine_eviction": wedged_engine_eviction,
+    "telemetry_staleness": telemetry_staleness,
 }
 
 

@@ -87,6 +87,12 @@ class ForwardPassMetrics:
     # horizon is actually engaging)
     decode_cc_blocks_total: int = 0
     decode_cc_chains_total: int = 0
+    # fleet telemetry capacity signals: running-batch occupancy of the
+    # FULLEST rank (one full rank blocks admission, so max not mean
+    # across dp ranks) and pages still available above the admission
+    # watermark (summed across ranks — aggregate headroom is capacity)
+    batch_occupancy: float = 0.0
+    kv_watermark_headroom_pages: int = 0
 
 
 # static top-k width for OpenAI `top_logprobs` responses (API max is 20)
@@ -1899,6 +1905,11 @@ class JaxEngine:
             ttft_attributed_total=self._ttft_attributed_total,
             decode_cc_blocks_total=self._cc_blocks_total,
             decode_cc_chains_total=self._cc_chains_total,
+            batch_occupancy=running / max(self.cfg.max_num_seqs, 1),
+            kv_watermark_headroom_pages=max(
+                0, self.pool.available_pages
+                - self.scheduler._watermark_pages() * self.pool.ranks  # noqa: SLF001
+            ),
         )
         # chosen-rung histogram (block ladder): one dynamic counter attr
         # per rung — bounded by the ladder size, picked up by vars()

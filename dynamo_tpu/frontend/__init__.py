@@ -9,6 +9,7 @@ from .service import (
     ModelWatcher,
     register_llm,
 )
+from .slo import SLOAccountant, SLOTargets
 
 __all__ = [
     "FrontendMetrics",
@@ -17,5 +18,7 @@ __all__ = [
     "ModelEntry",
     "ModelManager",
     "ModelWatcher",
+    "SLOAccountant",
+    "SLOTargets",
     "register_llm",
 ]

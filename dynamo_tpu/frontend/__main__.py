@@ -101,6 +101,21 @@ async def _run(args) -> None:
         kv_chooser_factory=kv_factory, metrics=metrics,
     ).start()
     health_watcher = await HealthWatcher(runtime, metrics).start()
+    # fleet telemetry plane: publish this frontend's live SLO windows
+    # under /telemetry/{ns}/frontend/{lease}, and watch the whole prefix
+    # so /fleet.json serves the joined fleet view + online knees
+    from ..planner.telemetry import FleetTelemetryWatcher
+    from ..runtime.metrics import TelemetryPublisher
+
+    telemetry = TelemetryPublisher(
+        runtime,
+        lambda: {"kind": "frontend", "models": metrics.slo.snapshot()},
+        namespace=args.namespace, component="frontend",
+    ).start()
+    fleet = await FleetTelemetryWatcher(
+        runtime, namespace=args.namespace,
+    ).start()
+    fleet.start_sampling(telemetry.interval_s)
     enabled = (
         {r.strip() for r in args.routes.split(",") if r.strip()}
         if args.routes else None
@@ -108,7 +123,7 @@ async def _run(args) -> None:
     http = await HttpService(
         manager, host=args.host, port=args.port, metrics=metrics,
         tls_cert=args.tls_cert, tls_key=args.tls_key,
-        enabled_routes=enabled,
+        enabled_routes=enabled, fleet=fleet,
     ).start()
     # self-register for inference gateways (lease-scoped, like worker
     # instance discovery): deploy/gateway.py watches this key space
@@ -147,6 +162,8 @@ async def _run(args) -> None:
     if kserve:
         await kserve.stop()
     await http.stop()
+    await fleet.stop()
+    await telemetry.stop()
     await health_watcher.stop()
     await watcher.stop()
     if chaos_injector:

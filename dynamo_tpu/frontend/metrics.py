@@ -138,6 +138,13 @@ class FrontendMetrics:
         from ..runtime.metrics import TracingSpanCollector
 
         self.registry.register(TracingSpanCollector())
+        # live SLO window (frontend/slo.py): per-request goodput/slo_met
+        # accounting with bench.py's definitions, exposed as gauges at
+        # scrape time and published to the fleet telemetry plane
+        from .slo import SLOAccountant, SLOWindowCollector
+
+        self.slo = SLOAccountant()
+        self.registry.register(SLOWindowCollector(self.slo))
 
     def observe_migration(self, model: str, event: str) -> None:
         """Account one migrating_stream event ('migrated'/'exhausted')."""

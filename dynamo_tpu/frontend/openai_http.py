@@ -107,10 +107,13 @@ class HttpService:
     def __init__(self, manager: ModelManager, host: str = "0.0.0.0",
                  port: int = 8000, metrics: Optional[FrontendMetrics] = None,
                  audit=None, tls_cert: str = "", tls_key: str = "",
-                 enabled_routes: Optional[set] = None):
+                 enabled_routes: Optional[set] = None, fleet=None):
         from ..llm.audit import AuditBus
 
         self.manager = manager
+        # optional planner.telemetry.FleetTelemetryWatcher: /fleet.json
+        # then joins worker capacity snapshots to the local SLO windows
+        self.fleet = fleet
         self.host = host
         self.port = port
         # TLS (reference service_v2.rs:222): both paths or neither
@@ -156,6 +159,7 @@ class HttpService:
             web.get("/health", self.health),
             web.get("/live", self.live),
             web.get("/metrics", self.prometheus),
+            web.get("/fleet.json", self.fleet_json),
             web.get("/openapi.json", self.openapi),
             web.post("/clear_kv_blocks", self.clear_kv_blocks),
         ]
@@ -214,6 +218,7 @@ class HttpService:
             ("/health", "aggregate health"),
             ("/live", "liveness"),
             ("/metrics", "Prometheus exposition"),
+            ("/fleet.json", "live SLO windows + fleet capacity snapshots"),
             ("/openapi.json", "this document"),
         ]:
             paths[path] = {"get": {
@@ -238,6 +243,23 @@ class HttpService:
             body=self.metrics.exposition(),
             content_type="text/plain",
         )
+
+    async def fleet_json(self, request: web.Request) -> web.Response:
+        """Debug surface for the live telemetry plane: this frontend's
+        per-model SLO windows (same definitions bench.py computes
+        offline) plus, when a fleet watcher is attached, the joined
+        worker capacity snapshots and online knee estimates
+        (docs/observability.md documents the schema)."""
+        body = {
+            "ts": time.time(),
+            "models": self.metrics.slo.snapshot(),
+        }
+        if self.fleet is not None:
+            try:
+                body["fleet"] = self.fleet.snapshot().to_dict()
+            except Exception as e:  # noqa: BLE001 — debug surface
+                body["fleet"] = {"error": repr(e)}
+        return web.json_response(body)
 
     async def list_models(self, request: web.Request) -> web.Response:
         now = int(time.time())
@@ -466,6 +488,7 @@ class HttpService:
         streaming = bool(body.get("stream", False))
         if self.audit is not None:
             self.audit.request(rid, model_name, kind, body)
+        self.metrics.slo.observe_start(model_name)
         self.metrics.inflight.labels(model_name).inc()
         try:
             if streaming:
@@ -477,6 +500,18 @@ class HttpService:
             )
         finally:
             self.metrics.inflight.labels(model_name).dec()
+
+    def _observe_slo_failure(self, model_name, preprocessed,
+                             output_tokens=0):
+        """Score a FAILED/abandoned request into the live SLO window:
+        never SLO-met (infinite latency), delivered tokens attained-only.
+        The requests clients saw fail are the ones that must drag
+        slo_met down during incidents — shared by every error path so
+        the failure scoring can't drift between them."""
+        self.metrics.slo.observe(
+            model_name, float("inf"), float("inf"), output_tokens,
+            prompt_tokens=len(preprocessed.get("token_ids") or []),
+        )
 
     def _choice_requests(self, preprocessed, n):
         """n independent engine requests; explicit seeds offset per choice
@@ -508,6 +543,7 @@ class HttpService:
         created = int(time.time())
         first = True
         ntokens = 0
+        t_first = t_last_tok = None
         last_t = t0
         status = "200"
         spec_seen: list = [None] * n  # last cumulative spec stats per choice
@@ -566,6 +602,13 @@ class HttpService:
                 else:
                     self.metrics.itl.labels(model_name).observe(now - last_t)
                 last_t = now
+                if out.get("token_ids"):
+                    # SLO scoring keys off TOKEN-bearing deltas only —
+                    # bench's definition; a token-less finish/role delta
+                    # must not make a zero-token stream look served
+                    t_last_tok = now
+                    if t_first is None:
+                        t_first = now
                 ntokens += len(out.get("token_ids", []))
                 if out.get("spec"):  # cumulative: the last delta seen
                     spec_seen[i] = out["spec"]  # carries the totals
@@ -595,6 +638,7 @@ class HttpService:
             logger.info("client disconnected; killing %d choice(s)", n)
             for ctx in contexts:
                 ctx.kill()
+            self._observe_slo_failure(model_name, preprocessed, ntokens)
             if self.audit is not None:
                 self.audit.response(rid, model_name, kind, "disconnected")
             raise
@@ -604,6 +648,25 @@ class HttpService:
         self.metrics.requests.labels(model_name, kind, status).inc()
         self.metrics.output_tokens.labels(model_name).inc(ntokens)
         self.metrics.duration.labels(model_name).observe(time.monotonic() - t0)
+        # live SLO window: the whole HTTP request is one accounting unit
+        # (bench.poisson_goodput's per-request TTFT + mean-ITL predicate).
+        # A stream the client saw FAIL can never be SLO-met — score it at
+        # infinite latency so incidents show up as a slo_met drop, while
+        # its delivered tokens still count as attained (not goodput).
+        # n>1: choices stream concurrently, so per-STREAM ITL is the
+        # span over one choice's share of the tokens — dividing by the
+        # total would dilute a breach by ~n
+        inf = float("inf")
+        errored = status != "200" or t_first is None
+        self.metrics.slo.observe(
+            model_name,
+            ttft_ms=inf if errored else (t_first - t0) * 1e3,
+            itl_ms=(inf if errored
+                    else (t_last_tok - t_first)
+                    / max(ntokens / max(n, 1) - 1, 1) * 1e3),
+            output_tokens=ntokens,
+            prompt_tokens=len(preprocessed.get("token_ids") or []),
+        )
         for spec in spec_seen:
             if spec:  # a stop string may cut the stream before the
                 self.metrics.observe_spec(model_name, spec)  # final delta
@@ -657,6 +720,16 @@ class HttpService:
         ]
         try:
             results = await asyncio.gather(*tasks)
+        except asyncio.CancelledError:
+            # unary client disconnect: same invariant as streaming
+            for ctx in contexts:
+                ctx.kill()
+            for t in tasks:
+                t.cancel()
+            self._observe_slo_failure(model_name, preprocessed)
+            if self.audit is not None:
+                self.audit.response(rid, model_name, kind, "disconnected")
+            raise
         except (ServiceUnavailable, RemoteStreamError) as e:
             # one choice failed: stop its siblings instead of letting them
             # decode unattended to max_tokens
@@ -667,12 +740,14 @@ class HttpService:
             await asyncio.gather(*tasks, return_exceptions=True)
             status = "503" if isinstance(e, ServiceUnavailable) else "502"
             self.metrics.requests.labels(model_name, kind, status).inc()
+            self._observe_slo_failure(model_name, preprocessed)
             if self.audit is not None:
                 self.audit.response(rid, model_name, kind, status)
             return _error_response(int(status), str(e))
         for r in results:
             if r.get("error"):
                 self.metrics.requests.labels(model_name, kind, "500").inc()
+                self._observe_slo_failure(model_name, preprocessed)
                 if self.audit is not None:
                     self.audit.response(rid, model_name, kind, "500")
                 return _error_response(500, r["error"])
@@ -735,6 +810,25 @@ class HttpService:
             "choices": choices,
             "usage": usage,
         }
+        # live SLO window: unary delivery has no observable per-token
+        # timing, so TTFT comes from the engine's attribution when it
+        # rode the stream and the remainder amortizes as per-STREAM ITL
+        # (choices run concurrently — divide by one choice's share of
+        # the tokens, same as the streaming path)
+        dur_ms = (time.monotonic() - t0) * 1e3
+        ttft_attr = next((r["ttft"] for r in results if r.get("ttft")), None)
+        ttft_ms = (sum(v for v in ttft_attr.values()
+                       if isinstance(v, (int, float)))
+                   if ttft_attr else dur_ms)
+        self.metrics.slo.observe(
+            model_name,
+            ttft_ms=min(ttft_ms, dur_ms),
+            itl_ms=(max(dur_ms - ttft_ms, 0.0)
+                    / max(token_count / max(n, 1) - 1, 1)
+                    if token_count else float("inf")),
+            output_tokens=token_count,
+            prompt_tokens=prompt_tokens,
+        )
         self.metrics.requests.labels(model_name, kind, "200").inc()
         self.metrics.output_tokens.labels(model_name).inc(token_count)
         self.metrics.duration.labels(model_name).observe(time.monotonic() - t0)

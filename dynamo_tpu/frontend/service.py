@@ -199,6 +199,12 @@ class ModelWatcher:
             return  # prefill-only / encode-only workers are not
             # client-facing models (their generate surface speaks the
             # internal disagg protocol, not completions)
+        if self.metrics is not None and getattr(self.metrics, "slo", None):
+            # card-carried SLO targets (env overrides win inside
+            # from_card) drive this model's live window scoring
+            from .slo import SLOTargets
+
+            self.metrics.slo.set_targets(mdc.name, SLOTargets.from_card(mdc))
         entry = self.manager.get(mdc.name)
         if entry is None:
             tokenizer = self._load_tokenizer(mdc)

@@ -1,0 +1,379 @@
+"""Live per-request SLO accounting — the frontend half of the fleet
+telemetry plane.
+
+bench.py computes slo_met / goodput OFFLINE from per-request TTFT and
+mean ITL; this module computes the SAME definitions live, per model, over
+a sliding window, so bench's offline numbers and the serving fleet's
+`/metrics` + `/fleet.json` surfaces are cross-checkable (bench asserts
+agreement after every goodput phase):
+
+- a request MEETS its SLO iff ``ttft_ms <= slo.ttft_ms`` and its mean
+  inter-token latency ``itl_ms <= slo.itl_ms`` (bench.poisson_goodput's
+  `ok` predicate);
+- ``goodput`` counts only tokens from SLO-met requests; ``attained``
+  counts all tokens; both divide by the covered window duration.
+
+Accounting must ride the streaming hot path, so the aggregator is
+lock-light and allocation-free per request: fixed log-bucket histograms
+(one int-list increment per observation) inside a ring of N-second
+sub-windows that rotate in place.  The acceptance micro-bench pins
+``observe()`` under 20 µs/request (tests/test_slo_window.py).
+
+SLO targets ride the ModelDeploymentCard (``slo_ttft_ms``/``slo_itl_ms``,
+set by the worker CLI) and can be overridden fleet-wide at the frontend
+via ``DYN_TPU_SLO_TTFT_MS`` / ``DYN_TPU_SLO_ITL_MS``.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+__all__ = [
+    "LogBucketHistogram",
+    "SLOAccountant",
+    "SLOTargets",
+    "SLOWindowCollector",
+    "SlidingWindow",
+]
+
+# default SLO class when neither the model card nor the environment says
+# otherwise (interactive chat at tunnel latency — bench.py's SLO_8B shape)
+DEFAULT_TTFT_MS = 2000.0
+DEFAULT_ITL_MS = 100.0
+
+
+@dataclass(frozen=True)
+class SLOTargets:
+    """Per-model latency targets the live window scores against."""
+
+    ttft_ms: float = DEFAULT_TTFT_MS
+    itl_ms: float = DEFAULT_ITL_MS
+
+    @staticmethod
+    def from_env(base: "SLOTargets" = None) -> "SLOTargets":
+        """Environment overrides win over `base` (card / defaults); a
+        typo'd knob is logged and ignored WITHOUT dropping the other
+        (each parses independently, lenient so the frontend boots)."""
+        from ..runtime.config import env_float_lenient
+
+        base = base or SLOTargets()
+        return SLOTargets(
+            ttft_ms=env_float_lenient("DYN_TPU_SLO_TTFT_MS", base.ttft_ms),
+            itl_ms=env_float_lenient("DYN_TPU_SLO_ITL_MS", base.itl_ms),
+        )
+
+    @staticmethod
+    def from_card(mdc) -> "SLOTargets":
+        """Card-carried targets, then env overrides on top."""
+        return SLOTargets.from_env(SLOTargets(
+            ttft_ms=float(getattr(mdc, "slo_ttft_ms", 0) or DEFAULT_TTFT_MS),
+            itl_ms=float(getattr(mdc, "slo_itl_ms", 0) or DEFAULT_ITL_MS),
+        ))
+
+    def met(self, ttft_ms: float, itl_ms: float) -> bool:
+        return ttft_ms <= self.ttft_ms and itl_ms <= self.itl_ms
+
+
+# log-bucket geometry: quarter-powers of two from 1 µs to ~4.7 hours (ms
+# domain), 136 buckets — the same fixed-cost layout for TTFT and ITL so
+# sub-window merges are a single elementwise add
+_LO_MS = 1e-3
+_RATIO_LOG = math.log(2.0) / 4.0
+_NBUCKETS = 136
+_LOG_LO = math.log(_LO_MS)
+
+
+class LogBucketHistogram:
+    """Fixed log-spaced latency histogram (milliseconds).
+
+    O(1) record (one `math.log` + one list increment), mergeable by
+    elementwise count addition, percentile answered at the bucket's
+    geometric midpoint — so any quantile is exact to within half a bucket
+    ratio (~±9%), which the oracle test pins."""
+
+    __slots__ = ("counts", "n", "n_finite", "total_ms")
+
+    def __init__(self):
+        self.counts: List[int] = [0] * _NBUCKETS
+        self.n = 0
+        self.n_finite = 0
+        self.total_ms = 0.0
+
+    def record(self, v_ms: float) -> None:
+        if not v_ms > 0.0:  # 0, negative, NaN → first bucket
+            idx = 0
+        elif v_ms == float("inf"):
+            idx = _NBUCKETS - 1
+        else:
+            idx = int((math.log(v_ms) - _LOG_LO) / _RATIO_LOG)
+            if idx < 0:
+                idx = 0
+            elif idx >= _NBUCKETS:
+                idx = _NBUCKETS - 1
+        self.counts[idx] += 1
+        self.n += 1
+        if v_ms == v_ms and v_ms != float("inf") and v_ms > 0:
+            self.n_finite += 1
+            self.total_ms += v_ms
+
+    def merge(self, other: "LogBucketHistogram") -> None:
+        for i, c in enumerate(other.counts):
+            self.counts[i] += c
+        self.n += other.n
+        self.n_finite += other.n_finite
+        self.total_ms += other.total_ms
+
+    @staticmethod
+    def bucket_mid_ms(idx: int) -> float:
+        return math.exp(_LOG_LO + (idx + 0.5) * _RATIO_LOG)
+
+    def percentile(self, p: float) -> Optional[float]:
+        """p in [0, 1] → bucket geometric midpoint (None when empty)."""
+        if self.n == 0:
+            return None
+        rank = max(1, math.ceil(p * self.n))
+        seen = 0
+        for i, c in enumerate(self.counts):
+            seen += c
+            if seen >= rank:
+                return self.bucket_mid_ms(i)
+        return self.bucket_mid_ms(_NBUCKETS - 1)
+
+    def mean(self) -> Optional[float]:
+        """Mean over FINITE observations only — errored requests record
+        at inf and must not drag the mean toward zero."""
+        return self.total_ms / self.n_finite if self.n_finite else None
+
+
+class _Slot:
+    """One sub-window of the ring."""
+
+    __slots__ = ("epoch", "started", "completed", "slo_ok", "tokens",
+                 "tokens_ok", "prompt_tokens", "t_first", "ttft", "itl")
+
+    def __init__(self):
+        self.reset(-1)
+
+    def reset(self, epoch: int) -> None:
+        self.epoch = epoch
+        self.started = 0
+        self.completed = 0
+        self.slo_ok = 0
+        self.tokens = 0
+        self.tokens_ok = 0
+        self.prompt_tokens = 0
+        self.t_first: Optional[float] = None
+        self.ttft = LogBucketHistogram()
+        self.itl = LogBucketHistogram()
+
+
+class SlidingWindow:
+    """Ring of ``slots`` sub-windows each covering ``window_s/slots``
+    seconds; rotation is an in-place slot reset, so recording never
+    allocates and never scans.  Single-writer (the event loop thread) —
+    no lock on the hot path."""
+
+    def __init__(self, window_s: float = 60.0, slots: int = 12):
+        if slots < 2:
+            raise ValueError("SlidingWindow needs at least 2 slots")
+        self.window_s = float(window_s)
+        self.sub_s = self.window_s / slots
+        self._ring = [_Slot() for _ in range(slots)]
+
+    def _slot(self, now: float) -> _Slot:
+        epoch = int(now / self.sub_s)
+        slot = self._ring[epoch % len(self._ring)]
+        if slot.epoch != epoch:
+            slot.reset(epoch)
+        return slot
+
+    def mark(self, now: Optional[float] = None) -> None:
+        """Anchor the covered-duration start without recording anything
+        — bench pins the live window to its phase t0 so the two goodput
+        denominators are the same interval, not offset by the first
+        Poisson arrival wait."""
+        now = time.monotonic() if now is None else now
+        slot = self._slot(now)
+        if slot.t_first is None:
+            slot.t_first = now
+
+    def record_start(self, now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        slot = self._slot(now)
+        slot.started += 1
+        if slot.t_first is None:
+            slot.t_first = now
+
+    def record(self, ttft_ms: float, itl_ms: float, output_tokens: int,
+               slo_ok: bool, prompt_tokens: int = 0,
+               now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        slot = self._slot(now)
+        if slot.t_first is None:
+            slot.t_first = now
+        slot.completed += 1
+        slot.tokens += output_tokens
+        slot.prompt_tokens += prompt_tokens
+        if slo_ok:
+            slot.slo_ok += 1
+            slot.tokens_ok += output_tokens
+        slot.ttft.record(ttft_ms)
+        slot.itl.record(itl_ms)
+
+    def snapshot(self, now: Optional[float] = None) -> dict:
+        """Merge the still-valid slots into one window summary.  Rates
+        divide by the COVERED duration (first record in the window →
+        now), so a short burst doesn't get diluted by empty slots."""
+        now = time.monotonic() if now is None else now
+        cur = int(now / self.sub_s)
+        lo = cur - len(self._ring) + 1
+        ttft, itl = LogBucketHistogram(), LogBucketHistogram()
+        started = completed = ok = tokens = tokens_ok = ptokens = 0
+        t_first = None
+        for slot in self._ring:
+            if not (lo <= slot.epoch <= cur):
+                continue
+            started += slot.started
+            completed += slot.completed
+            ok += slot.slo_ok
+            tokens += slot.tokens
+            tokens_ok += slot.tokens_ok
+            ptokens += slot.prompt_tokens
+            ttft.merge(slot.ttft)
+            itl.merge(slot.itl)
+            if slot.t_first is not None:
+                t_first = (slot.t_first if t_first is None
+                           else min(t_first, slot.t_first))
+        duration = max(now - t_first, 1e-6) if t_first is not None else 0.0
+
+        def dist(h: LogBucketHistogram) -> dict:
+            return {
+                "p50_ms": h.percentile(0.50),
+                "p95_ms": h.percentile(0.95),
+                "p99_ms": h.percentile(0.99),
+                "mean_ms": h.mean(),
+            }
+
+        return {
+            "window_s": round(duration, 3),
+            "requests_started": started,
+            "requests_completed": completed,
+            "slo_met": (ok / completed) if completed else None,
+            "goodput_tok_s": (tokens_ok / duration) if duration else 0.0,
+            "attained_tok_s": (tokens / duration) if duration else 0.0,
+            "prompt_tok_s": (ptokens / duration) if duration else 0.0,
+            "offered_rps": (started / duration) if duration else 0.0,
+            "completed_rps": (completed / duration) if duration else 0.0,
+            "ttft": dist(ttft),
+            "itl": dist(itl),
+        }
+
+
+class SLOAccountant:
+    """Per-model SLO targets + sliding windows; the one object the
+    frontend streams account into and every telemetry surface reads
+    (`/metrics` via SLOWindowCollector, `/fleet.json`, the telemetry
+    publisher)."""
+
+    def __init__(self, window_s: float = 60.0, slots: int = 12,
+                 default: Optional[SLOTargets] = None):
+        self.window_s = window_s
+        self.slots = slots
+        self.default = SLOTargets.from_env(default)
+        self.targets: Dict[str, SLOTargets] = {}
+        self.windows: Dict[str, SlidingWindow] = {}
+
+    def set_targets(self, model: str, targets: SLOTargets) -> None:
+        self.targets[model] = targets
+
+    def targets_for(self, model: str) -> SLOTargets:
+        return self.targets.get(model, self.default)
+
+    def window(self, model: str) -> SlidingWindow:
+        win = self.windows.get(model)
+        if win is None:
+            win = self.windows[model] = SlidingWindow(self.window_s,
+                                                      self.slots)
+        return win
+
+    def observe_start(self, model: str, now: Optional[float] = None) -> None:
+        self.window(model).record_start(now)
+
+    def observe(self, model: str, ttft_ms: float, itl_ms: float,
+                output_tokens: int, prompt_tokens: int = 0,
+                now: Optional[float] = None) -> bool:
+        """Account one COMPLETED request; returns whether it met its SLO
+        (bench.poisson_goodput's predicate, applied live)."""
+        ok = self.targets_for(model).met(ttft_ms, itl_ms)
+        self.window(model).record(ttft_ms, itl_ms, output_tokens, ok,
+                                  prompt_tokens, now)
+        return ok
+
+    def snapshot(self, now: Optional[float] = None) -> Dict[str, dict]:
+        out = {}
+        for model, win in self.windows.items():
+            slo = self.targets_for(model)
+            out[model] = {
+                **win.snapshot(now),
+                "slo": {"ttft_ms": slo.ttft_ms, "itl_ms": slo.itl_ms},
+            }
+        return out
+
+
+class SLOWindowCollector:
+    """Prometheus custom collector over a live SLOAccountant: the window
+    summaries become gauges at scrape time (no double bookkeeping with
+    the request-path accounting).  Families are always yielded (with no
+    samples before traffic) so the docs contract sees them."""
+
+    _QUANTS = (("p50_ms", "0.5"), ("p95_ms", "0.95"), ("p99_ms", "0.99"))
+
+    def __init__(self, accountant: SLOAccountant):
+        self.accountant = accountant
+
+    def collect(self):
+        from prometheus_client.core import GaugeMetricFamily
+
+        slo_met = GaugeMetricFamily(
+            "dynamo_frontend_slo_met_ratio",
+            "Fraction of windowed requests meeting their TTFT+ITL SLO",
+            labels=["model"])
+        goodput = GaugeMetricFamily(
+            "dynamo_frontend_goodput_tokens_per_second",
+            "Windowed output tok/s from SLO-met requests",
+            labels=["model"])
+        attained = GaugeMetricFamily(
+            "dynamo_frontend_attained_tokens_per_second",
+            "Windowed output tok/s from all requests",
+            labels=["model"])
+        offered = GaugeMetricFamily(
+            "dynamo_frontend_offered_requests_per_second",
+            "Windowed request arrival rate",
+            labels=["model"])
+        ttft = GaugeMetricFamily(
+            "dynamo_frontend_window_ttft_seconds",
+            "Windowed TTFT quantiles (live log-bucket window)",
+            labels=["model", "quantile"])
+        itl = GaugeMetricFamily(
+            "dynamo_frontend_window_itl_seconds",
+            "Windowed mean-ITL quantiles (live log-bucket window)",
+            labels=["model", "quantile"])
+        try:
+            snap = self.accountant.snapshot()
+        except Exception:  # noqa: BLE001 — a scrape must not break /metrics
+            snap = {}
+        for model, s in snap.items():
+            if s["slo_met"] is not None:
+                slo_met.add_metric([model], s["slo_met"])
+            goodput.add_metric([model], s["goodput_tok_s"])
+            attained.add_metric([model], s["attained_tok_s"])
+            offered.add_metric([model], s["offered_rps"])
+            for key, q in self._QUANTS:
+                if s["ttft"][key] is not None:
+                    ttft.add_metric([model, q], s["ttft"][key] / 1e3)
+                if s["itl"][key] is not None:
+                    itl.add_metric([model, q], s["itl"][key] / 1e3)
+        return [slo_met, goodput, attained, offered, ttft, itl]

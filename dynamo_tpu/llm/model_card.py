@@ -43,6 +43,12 @@ class ModelDeploymentCard:
     # incident and retries immediately).  0 disables the backoff.
     migration_backoff_ms: int = 50
     migration_backoff_max_ms: int = 2000
+    # latency SLO class for this model (frontend/slo.py live windows +
+    # the planner's knee estimation score against these; worker CLI
+    # --slo-ttft-ms/--slo-itl-ms set them, DYN_TPU_SLO_* env overrides
+    # win at the frontend; 0 = use the frontend default class)
+    slo_ttft_ms: float = 0.0
+    slo_itl_ms: float = 0.0
     # tokenization (None → frontend loads from checkpoint_path)
     checkpoint_path: Optional[str] = None
     tokenizer_json: Optional[str] = None  # inline tokenizer.json contents

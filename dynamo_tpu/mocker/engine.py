@@ -122,6 +122,11 @@ class MockEngine:
             kv_usage=self.pool.usage(),
             kv_total_pages=self.cfg.usable_pages,
             num_requests_total=self._requests_total,
+            batch_occupancy=running / max(self.cfg.max_num_seqs, 1),
+            kv_watermark_headroom_pages=max(
+                0, self.pool.available_pages
+                - self.scheduler._watermark_pages()  # noqa: SLF001
+            ),
         )
 
     def clear_kv_blocks(self) -> int:

@@ -9,10 +9,19 @@ from .load_predictor import (
     make_predictor,
 )
 from .perf_model import PerfProfile, synthetic_profile
+from .telemetry import (
+    FleetSnapshot,
+    FleetTelemetryWatcher,
+    KneeEstimator,
+    TelemetryConnector,
+)
 
 __all__ = [
     "ARPredictor",
     "ConstantPredictor",
+    "FleetSnapshot",
+    "FleetTelemetryWatcher",
+    "KneeEstimator",
     "LoadSample",
     "LocalProcessConnector",
     "MovingAveragePredictor",
@@ -20,6 +29,7 @@ __all__ = [
     "Planner",
     "PlannerConfig",
     "SLO",
+    "TelemetryConnector",
     "VirtualConnector",
     "make_predictor",
     "synthetic_profile",

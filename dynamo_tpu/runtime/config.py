@@ -51,6 +51,22 @@ def env_float(name: str, default: float) -> float:
         raise ValueError(f"{name} must be a number, got {raw!r}") from None
 
 
+def env_float_lenient(name: str, default: float) -> float:
+    """env_float that logs and falls back instead of raising — for
+    tuning knobs (telemetry cadence, SLO targets) where a typo'd value
+    must not take the process down at startup."""
+    try:
+        return env_float(name, default)
+    except ValueError:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "ignoring %s=%r (not a number); using %s",
+            name, os.environ.get(name), default,
+        )
+        return default
+
+
 def env_bool(name: str, default: bool = False) -> bool:
     raw = os.environ.get(name)
     if raw is None or raw == "":

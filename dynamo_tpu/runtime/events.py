@@ -39,6 +39,10 @@ class StepEventRecorder:
         self.enabled = self.capacity > 0
         self._ring: List[Optional[tuple]] = [None] * self.capacity
         self._n = 0  # total events ever recorded
+        # per-kind lifetime counts (survive ring wrap + clear, like _n):
+        # lets periodic consumers (telemetry's host-gap stat) skip the
+        # full ring dump unless the kind they care about actually moved
+        self.kind_totals: Dict[str, int] = {}
         self._lock = threading.Lock()
 
     @classmethod
@@ -66,6 +70,7 @@ class StepEventRecorder:
         with self._lock:
             self._ring[self._n % self.capacity] = ev
             self._n += 1
+            self.kind_totals[kind] = self.kind_totals.get(kind, 0) + 1
 
     def __len__(self) -> int:
         return min(self._n, self.capacity)

@@ -151,6 +151,117 @@ class TracingSpanCollector:
             yield fam
 
 
+TELEMETRY_ROOT = "/telemetry"
+
+
+class TelemetryPublisher:
+    """Periodic compact telemetry snapshots into the control-plane KV,
+    lease-scoped under ``/telemetry/{ns}/{component}/{id}`` — the data
+    the planner's FleetTelemetryWatcher joins into FleetSnapshots.
+
+    Workers publish capacity snapshots (queue depth, batch occupancy,
+    page-pool utilization + watermark headroom, per-rung dispatch RATES
+    derived here from the ``*_total`` counters, spec acceptance, decode
+    host-gap p50); frontends publish their per-model SLO windows.  Each
+    payload carries ``ts``/``seq``/``interval_s`` so consumers can mark
+    a snapshot STALE when its publisher misses a deadline instead of
+    serving wrong-but-fresh-looking data.  Publish failures (partitions)
+    are logged and retried next tick; the lease scope means a dead
+    publisher's key disappears with its process."""
+
+    def __init__(self, runtime, snapshot_fn, namespace: str = "dynamo",
+                 component: str = "backend", ident=None,
+                 interval_s: float | None = None):
+        from .config import env_float_lenient
+
+        self.runtime = runtime
+        self.snapshot_fn = snapshot_fn
+        self.namespace = namespace
+        self.component = component
+        self.ident = ident
+        self.interval_s = (
+            interval_s if interval_s is not None
+            else env_float_lenient("DYN_TPU_TELEMETRY_INTERVAL", 2.0)
+        )
+        self._task = None
+        self._prev: dict | None = None
+        self._prev_t = 0.0
+        self._seq = 0
+
+    @property
+    def key(self) -> str:
+        # resolve the lease-derived ident ONCE and pin it: after a
+        # partition the runtime re-grants primary_lease and re-publishes
+        # every leased key by NAME — a key that tracked the live lease
+        # id would fork (old name re-published as a frozen phantom
+        # worker, new name written alongside).  The pinned name stays
+        # one continuous series held by whatever lease is current.
+        if self.ident is None:
+            self.ident = self.runtime.primary_lease
+        return (f"{TELEMETRY_ROOT}/{self.namespace}/{self.component}/"
+                f"{self.ident}")
+
+    def start(self) -> "TelemetryPublisher":
+        import asyncio
+
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+        return self
+
+    async def stop(self) -> None:
+        import asyncio
+
+        if self._task:
+            self._task.cancel()
+            await asyncio.gather(self._task, return_exceptions=True)
+
+    async def _loop(self) -> None:
+        import asyncio
+        import logging
+
+        log = logging.getLogger(__name__)
+        while True:
+            try:
+                await self.publish_once()
+            except asyncio.CancelledError:
+                return
+            except Exception as e:  # noqa: BLE001 — keep publishing
+                log.warning("telemetry publish failed for %s: %s",
+                            self.key, e)
+            await asyncio.sleep(self.interval_s)
+
+    async def publish_once(self) -> dict:
+        """Build + publish one snapshot (also the test hook)."""
+        import time
+
+        from .transport.wire import pack
+
+        snap = dict(self.snapshot_fn() or {})
+        now = time.monotonic()
+        if self._prev is not None and now > self._prev_t:
+            dt = now - self._prev_t
+            rates = {}
+            for k, v in snap.items():
+                if (k.endswith("_total")
+                        and isinstance(v, (int, float))
+                        and isinstance(self._prev.get(k), (int, float))):
+                    rates[k[:-len("_total")] + "_per_s"] = round(
+                        max(0.0, (v - self._prev[k]) / dt), 4)
+            snap["rates"] = rates
+        self._prev = {k: v for k, v in snap.items()
+                      if isinstance(v, (int, float))}
+        self._prev_t = now
+        self._seq += 1
+        payload = {
+            "ts": time.time(),
+            "seq": self._seq,
+            "interval_s": self.interval_s,
+            "component": self.component,
+            **snap,
+        }
+        await self.runtime.put_leased(self.key, pack(payload))
+        return payload
+
+
 class EngineStatsCollector:
     """Prometheus custom collector over a live engine-stats dict
     (``vars(engine.metrics())`` — ForwardPassMetrics incl. dynamic

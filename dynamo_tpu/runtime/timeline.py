@@ -159,6 +159,29 @@ def ring_to_chrome(dump: dict, service: str,
     return events
 
 
+def counters_to_chrome(samples: Iterable[dict], service: str,
+                       pids: Dict[str, int]) -> List[dict]:
+    """Fleet telemetry snapshots → Perfetto COUNTER tracks (`ph: "C"`)
+    on the service's process: each sample is ``{"ts": wall_seconds,
+    "values": {name: number}}`` (FleetTelemetryWatcher.counter_samples()
+    emits exactly this), and each named value renders as its own counter
+    track — so a goodput dip lines up visually with the rung/host-gap
+    slices that explain it."""
+    pid = pids.setdefault(service, len(pids) + 1)
+    events: List[dict] = []
+    for sample in samples:
+        ts_us = float(sample.get("ts", 0)) * 1e6
+        for name, value in (sample.get("values") or {}).items():
+            if not isinstance(value, (int, float)):
+                continue
+            events.append({
+                "name": name, "ph": "C", "pid": pid, "tid": 0,
+                "ts": ts_us, "cat": "telemetry",
+                "args": {"value": float(value)},
+            })
+    return events
+
+
 def _metadata(pids: Dict[str, int], ring_services: Iterable[str]) -> List[dict]:
     out = []
     for service, pid in pids.items():
@@ -172,14 +195,20 @@ def _metadata(pids: Dict[str, int], ring_services: Iterable[str]) -> List[dict]:
 
 def merge_timeline(otlp_paths: Iterable[str],
                    ring_dumps: Optional[Dict[str, dict]] = None,
-                   out_path: Optional[str] = None) -> dict:
+                   out_path: Optional[str] = None,
+                   counter_dumps: Optional[Dict[str, List[dict]]] = None
+                   ) -> dict:
     """Build the merged Chrome-trace document; write it when `out_path`
-    is given.  `ring_dumps` maps service name → recorder dump."""
+    is given.  `ring_dumps` maps service name → recorder dump;
+    `counter_dumps` maps service name → telemetry counter samples
+    (counters_to_chrome input)."""
     spans = load_otlp_spans(otlp_paths)
     events, pids = spans_to_chrome(spans)
     ring_dumps = ring_dumps or {}
     for service, dump in ring_dumps.items():
         events.extend(ring_to_chrome(dump, service, pids))
+    for service, samples in (counter_dumps or {}).items():
+        events.extend(counters_to_chrome(samples, service, pids))
     doc = {
         "traceEvents": _metadata(pids, ring_dumps) + events,
         "displayTimeUnit": "ms",

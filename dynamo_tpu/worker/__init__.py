@@ -104,6 +104,14 @@ class DpRankEngine:
             decode_cc_chains_total=sum(
                 m.decode_cc_chains_total for m in per
             ),
+            # capacity gauges: occupancy of the FULLEST rank (admission
+            # pins sequences to a rank, so the max is the binding
+            # signal, same reasoning as kv_usage) and aggregate
+            # watermark headroom (pages are capacity — they sum)
+            batch_occupancy=max(m.batch_occupancy for m in per),
+            kv_watermark_headroom_pages=sum(
+                m.kv_watermark_headroom_pages for m in per
+            ),
         )
         # per-rung dispatch counters are dynamic attrs — sum the union
         # across ranks so the block-ladder histogram survives dp>1

@@ -153,6 +153,15 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--status-port", type=int, default=0,
                     help="system status server port (0 = ephemeral, "
                          "-1 = disabled); serves /health /live /metrics")
+    ap.add_argument("--slo-ttft-ms", type=float, default=0.0,
+                    help="TTFT SLO target carried on the model card "
+                         "(frontend live windows + planner knee "
+                         "estimation score against it; 0 = frontend "
+                         "default class, DYN_TPU_SLO_TTFT_MS overrides)")
+    ap.add_argument("--slo-itl-ms", type=float, default=0.0,
+                    help="mean-ITL SLO target carried on the model card "
+                         "(0 = frontend default class, "
+                         "DYN_TPU_SLO_ITL_MS overrides)")
     # serving mesh: dp*tp*sp devices (all local devices by default); on a
     # multihost group this spans the GLOBAL device set
     ap.add_argument("--dp", type=int, default=1, help="data-parallel degree")
@@ -476,12 +485,66 @@ async def _run(args) -> None:
             port=args.status_port,
         ).start()
         print(f"STATUS http://0.0.0.0:{status.port}", flush=True)
+    # capacity snapshots for the fleet telemetry plane: periodic compact
+    # engine state (queue depth, batch occupancy, kv headroom, *_total
+    # counters — the publisher derives per-interval rates — and decode
+    # host-gap p50 when the step-event ring is wired) published
+    # lease-scoped under /telemetry/{ns}/{component}/{lease}; the
+    # planner's FleetTelemetryWatcher joins them with frontend windows
+    from ..runtime.metrics import TelemetryPublisher
+
+    _hg_cache = {"decode_blocks": -1}
+
+    def _capacity_snapshot():
+        try:
+            src = engine
+            while not hasattr(src, "metrics") and hasattr(src, "engine"):
+                src = src.engine  # unwrap offload/handler wrappers
+            m = src.metrics()
+            snap = {k: v for k, v in (m if isinstance(m, dict)
+                                      else vars(m)).items()
+                    if isinstance(v, (int, float))
+                    and not isinstance(v, bool)}
+        except Exception:  # noqa: BLE001
+            return {}
+        snap["model"] = mdc.name
+        snap["disagg_role"] = args.disagg_role
+        snap["queue_depth"] = snap.get("waiting_seqs", 0)
+        try:
+            inner = engine
+            while not hasattr(inner, "events") and hasattr(inner, "engine"):
+                inner = inner.engine
+            events = getattr(inner, "events", None)
+            # dump+sort of a full 4096-event ring is not free on the
+            # serving loop: the per-kind counter gates it, so ticks
+            # under prefill/alloc-only traffic never dump, and nothing
+            # is published while decode is idle (a gap p50 recomputed
+            # from minutes-old decode slices would be wrong-but-fresh-
+            # looking — the staleness design's no-no)
+            n_decode = (events.kind_totals.get("decode_block", 0)
+                        if events is not None else 0)
+            if n_decode and n_decode != _hg_cache["decode_blocks"]:
+                from ..runtime.timeline import decode_host_gaps
+
+                _hg_cache["decode_blocks"] = n_decode
+                gaps = decode_host_gaps(events.dump())
+                if gaps["p50_ms"] is not None:
+                    snap["decode_host_gap_p50_ms"] = gaps["p50_ms"]
+        except Exception:  # noqa: BLE001 — the gap stat is best-effort
+            pass
+        return snap
+
+    telemetry = TelemetryPublisher(
+        runtime, _capacity_snapshot,
+        namespace=args.namespace, component=args.component,
+    ).start()
     print(f"READY worker {mdc.name}", flush=True)
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGINT, signal.SIGTERM):
         loop.add_signal_handler(sig, stop.set)
     await stop.wait()
+    await telemetry.stop()
     if status:
         await status.stop()
     if health:
@@ -536,6 +599,8 @@ def _build_engine(args):
             disagg_role=args.disagg_role,
             reasoning_parser=args.reasoning_parser,
             tool_call_parser=args.tool_call_parser,
+            slo_ttft_ms=args.slo_ttft_ms,
+            slo_itl_ms=args.slo_itl_ms,
         )
         return engine, mdc
 
@@ -672,6 +737,8 @@ def _build_engine(args):
         disagg_role=args.disagg_role,
         reasoning_parser=args.reasoning_parser,
         tool_call_parser=args.tool_call_parser,
+        slo_ttft_ms=args.slo_ttft_ms,
+        slo_itl_ms=args.slo_itl_ms,
         **mm_fields,
     )
     return engine, mdc

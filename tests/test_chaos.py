@@ -72,6 +72,19 @@ async def test_scenario_disagg_handoff_drop(tmp_path):
 
 
 @pytest.mark.timeout(240)
+async def test_scenario_telemetry_staleness(tmp_path):
+    """Kill a worker mid-wave and partition the control plane: the fleet
+    telemetry aggregator marks that instance's capacity snapshot stale
+    (never serves wrong-but-fresh-looking data), retains the dead
+    worker's last snapshot as stale, and recovers to fresh snapshots
+    from both live workers after the heal — zero client errors."""
+    result = await _run("telemetry_staleness", tmp_path)
+    assert result.telemetry.get("saw_stale_during_fault") is True
+    assert result.telemetry.get("fresh_workers") == 2
+    assert result.telemetry.get("stale_retained", 0) >= 1
+
+
+@pytest.mark.timeout(240)
 async def test_scenario_wedged_engine_eviction(tmp_path):
     """A wedged engine (alive process, dead request path) is caught only
     by the health check, publishes unhealthy, self-evicts; streams

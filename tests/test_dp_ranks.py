@@ -83,6 +83,62 @@ async def test_dp_rank_engine_dispatch():
     await dp.shutdown()
 
 
+async def test_dp_rank_capacity_gauges_aggregate_on_metrics_exposition():
+    """Regression (ISSUE 7 satellite): the fleet-telemetry capacity
+    gauges must aggregate across dp ranks — headroom SUMS (pages are
+    capacity), occupancy takes the MAX (the fullest rank blocks
+    admission) — and ride the worker /metrics exposition the same way
+    the decode_cc_*_total counters do."""
+    from prometheus_client import CollectorRegistry, generate_latest
+
+    from dynamo_tpu.runtime.metrics import EngineStatsCollector
+
+    import asyncio
+
+    cfg, engines = _engines(2)
+    dp = DpRankEngine(engines)
+    try:
+        # hold pages on ONE rank so headroom diverges across ranks, and
+        # catch a request IN FLIGHT on that rank so occupancy does too
+        held = engines[1].pool.allocate(6)
+        task = asyncio.ensure_future(
+            _gen(dp, [1, 2, 3, 4, 5], dp_rank=1, max_tokens=48))
+        deadline = asyncio.get_running_loop().time() + 20.0
+        while engines[1].metrics().active_seqs == 0:
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.005)
+        per = [e.metrics() for e in engines]
+        agg = dp.metrics()
+        assert agg.kv_watermark_headroom_pages == sum(
+            m.kv_watermark_headroom_pages for m in per
+        )
+        assert (per[1].kv_watermark_headroom_pages
+                < per[0].kv_watermark_headroom_pages), per
+        assert per[0].batch_occupancy == 0.0
+        assert per[1].batch_occupancy > 0.0
+        assert agg.batch_occupancy == max(m.batch_occupancy for m in per)
+
+        # ... and the exposition path (EngineStatsCollector over the
+        # aggregated stats dict) exports them as worker gauges
+        reg = CollectorRegistry()
+        reg.register(EngineStatsCollector(
+            lambda: {k: v for k, v in vars(agg).items()
+                     if isinstance(v, (int, float))}))
+        body = generate_latest(reg).decode()
+        line = next(l for l in body.splitlines()
+                    if l.startswith("dynamo_tpu_worker_kv_watermark_"
+                                    "headroom_pages"))
+        assert float(line.rsplit(" ", 1)[1]) == float(
+            agg.kv_watermark_headroom_pages)
+        occ = next(l for l in body.splitlines()
+                   if l.startswith("dynamo_tpu_worker_batch_occupancy"))
+        assert float(occ.rsplit(" ", 1)[1]) == agg.batch_occupancy
+        await task
+        engines[1].pool.free(held)
+    finally:
+        await dp.shutdown()
+
+
 async def test_dp_rank_routing_e2e():
     """Full path: a 2-rank worker publishes per-rank KV events; the KV
     router indexes them under packed keys and repeats of a prompt stick

@@ -1,0 +1,194 @@
+"""Live SLO accounting (frontend/slo.py): log-bucket histogram vs a
+brute-force percentile oracle, sliding-window rotation, SLO targets +
+env overrides, and the acceptance micro-bench pinning per-request
+accounting under 20 µs (it rides the streaming hot path)."""
+
+import math
+import random
+import time
+
+import numpy as np
+
+from dynamo_tpu.frontend.slo import (
+    LogBucketHistogram,
+    SLOAccountant,
+    SLOTargets,
+    SlidingWindow,
+)
+
+# half-bucket geometric error bound of the quarter-power-of-two layout
+_BUCKET_RATIO = 2 ** 0.25
+
+
+def test_log_bucket_histogram_vs_oracle():
+    """Every quantile must land within one bucket ratio of the exact
+    (numpy) percentile, across distributions with very different tails."""
+    rng = random.Random(7)
+    cases = [
+        [rng.lognormvariate(2.0, 1.0) for _ in range(4000)],
+        [rng.uniform(0.5, 500.0) for _ in range(4000)],
+        [rng.expovariate(0.01) + 0.1 for _ in range(4000)],
+    ]
+    for vals in cases:
+        h = LogBucketHistogram()
+        for v in vals:
+            h.record(v)
+        assert h.n == len(vals)
+        for p in (0.10, 0.50, 0.90, 0.95, 0.99):
+            est = h.percentile(p)
+            ref = float(np.percentile(vals, p * 100))
+            assert ref / _BUCKET_RATIO <= est <= ref * _BUCKET_RATIO, (
+                f"p{p}: est {est} vs oracle {ref}"
+            )
+        # mean is exact (tracked outside the buckets)
+        assert abs(h.mean() - np.mean(vals)) < 1e-6
+
+
+def test_log_bucket_boundaries_and_degenerate_values():
+    h = LogBucketHistogram()
+    for v in (0.0, -1.0, float("nan"), 1e-9):
+        h.record(v)  # all land in the first bucket, never throw
+    assert h.counts[0] == 4
+    h.record(float("inf"))  # unserved request (no first token)
+    assert h.counts[-1] == 1
+    # a value exactly on a bucket edge reports within one ratio of itself
+    edge = math.exp(math.log(1e-3) + 40 * (math.log(2) / 4))
+    h2 = LogBucketHistogram()
+    h2.record(edge)
+    assert edge / _BUCKET_RATIO <= h2.percentile(0.5) <= edge * _BUCKET_RATIO
+    # merge is count addition
+    h2.merge(h2)
+    assert h2.n == 2
+    # mean is over FINITE records only: errored requests (inf) must not
+    # drag it toward zero
+    h3 = LogBucketHistogram()
+    h3.record(100.0)
+    h3.record(100.0)
+    h3.record(float("inf"))
+    assert h3.mean() == 100.0 and h3.n == 3
+
+
+def test_sliding_window_rotation():
+    """Records age out after window_s; a rotated slot is reset in place
+    (stale epochs can never leak into a snapshot)."""
+    win = SlidingWindow(window_s=10.0, slots=5)  # 2s sub-windows
+    t0 = 1000.0
+    win.record_start(now=t0)
+    win.record(ttft_ms=50, itl_ms=5, output_tokens=10, slo_ok=True,
+               now=t0 + 0.5)
+    s = win.snapshot(now=t0 + 1.0)
+    assert s["requests_completed"] == 1 and s["requests_started"] == 1
+    # still inside the window
+    s = win.snapshot(now=t0 + 9.0)
+    assert s["requests_completed"] == 1
+    # past the window: everything aged out
+    s = win.snapshot(now=t0 + 11.0)
+    assert s["requests_completed"] == 0 and s["requests_started"] == 0
+    assert s["slo_met"] is None and s["goodput_tok_s"] == 0.0
+    # a new record after full rotation starts clean (the ring slot that
+    # held the old epoch was reset, not accumulated into)
+    win.record(ttft_ms=70, itl_ms=7, output_tokens=4, slo_ok=False,
+               now=t0 + 12.0)
+    s = win.snapshot(now=t0 + 12.5)
+    assert s["requests_completed"] == 1 and s["slo_met"] == 0.0
+    assert s["ttft"]["p50_ms"] is not None
+
+
+def test_window_rates_use_covered_duration():
+    """A 2-second burst inside a 60-second window divides by ~2 s, not
+    60 — otherwise live goodput could never match bench's offline
+    number for the same run."""
+    win = SlidingWindow(window_s=60.0, slots=12)
+    t0 = 5000.0
+    for i in range(20):
+        now = t0 + i * 0.1
+        win.record_start(now=now)
+        win.record(ttft_ms=10, itl_ms=2, output_tokens=16, slo_ok=True,
+                   now=now)
+    s = win.snapshot(now=t0 + 2.0)
+    assert abs(s["goodput_tok_s"] - 20 * 16 / 2.0) / (20 * 16 / 2.0) < 0.05
+    assert abs(s["offered_rps"] - 10.0) < 1.0
+
+
+def test_accountant_slo_scoring_and_env_override(monkeypatch):
+    acc = SLOAccountant(default=SLOTargets(ttft_ms=100.0, itl_ms=10.0))
+    t = 100.0
+    assert acc.observe("m", ttft_ms=50, itl_ms=5, output_tokens=8, now=t)
+    assert not acc.observe("m", ttft_ms=500, itl_ms=5, output_tokens=8,
+                           now=t)  # ttft breach
+    assert not acc.observe("m", ttft_ms=50, itl_ms=50, output_tokens=8,
+                           now=t)  # itl breach
+    snap = acc.snapshot(now=t + 0.1)["m"]
+    assert abs(snap["slo_met"] - 1 / 3) < 1e-9
+    assert snap["slo"] == {"ttft_ms": 100.0, "itl_ms": 10.0}
+    # per-model card targets
+    acc.set_targets("m2", SLOTargets(ttft_ms=1000.0, itl_ms=100.0))
+    assert acc.observe("m2", ttft_ms=500, itl_ms=5, output_tokens=8, now=t)
+    # env override beats card targets (from_card applies from_env on top)
+    monkeypatch.setenv("DYN_TPU_SLO_TTFT_MS", "10")
+
+    class Card:
+        slo_ttft_ms = 800.0
+        slo_itl_ms = 25.0
+
+    targets = SLOTargets.from_card(Card())
+    assert targets.ttft_ms == 10.0 and targets.itl_ms == 25.0
+    # a typo'd override is ignored WITHOUT discarding the other knob
+    monkeypatch.setenv("DYN_TPU_SLO_TTFT_MS", "2000ms")
+    monkeypatch.setenv("DYN_TPU_SLO_ITL_MS", "50")
+    targets = SLOTargets.from_card(Card())
+    assert targets.ttft_ms == 800.0  # card value kept, typo dropped
+    assert targets.itl_ms == 50.0    # valid override still applied
+
+
+def test_accountant_matches_bench_offline_computation():
+    """The live window and bench.poisson_goodput's offline math are the
+    SAME definitions: replaying a request log through both must agree."""
+    rng = random.Random(3)
+    slo = SLOTargets(ttft_ms=200.0, itl_ms=20.0)
+    acc = SLOAccountant(default=slo)
+    t0 = 50.0
+    log = []
+    now = t0
+    for i in range(60):
+        now += rng.expovariate(20.0)
+        ttft = rng.uniform(20, 400)
+        itl = rng.uniform(2, 40)
+        toks = rng.randrange(8, 40)
+        log.append((now, ttft, itl, toks))
+        acc.observe_start("bench", now=now)
+        acc.observe("bench", ttft_ms=ttft, itl_ms=itl, output_tokens=toks,
+                    now=now)
+    t_end = now
+    dt = t_end - log[0][0]
+    ok = [(n, tt, it, tk) for n, tt, it, tk in log
+          if tt <= slo.ttft_ms and it <= slo.itl_ms]
+    offline_goodput = sum(tk for *_, tk in ok) / dt
+    offline_attained = sum(tk for *_, tk in log) / dt
+    offline_met = len(ok) / len(log)
+    live = acc.snapshot(now=t_end)["bench"]
+    assert abs(live["slo_met"] - offline_met) < 1e-9
+    assert abs(live["goodput_tok_s"] - offline_goodput) / offline_goodput < 0.05
+    assert (abs(live["attained_tok_s"] - offline_attained)
+            / offline_attained < 0.05)
+
+
+def test_observe_under_20us_per_request():
+    """The acceptance micro-benchmark: per-request SLO accounting must
+    cost < 20 µs (it runs once per request on the streaming path)."""
+    acc = SLOAccountant()
+    rng = random.Random(11)
+    samples = [(rng.uniform(1, 2000), rng.uniform(0.5, 80),
+                rng.randrange(1, 200)) for _ in range(512)]
+    # warm the window + interpreter caches off the clock
+    for ttft, itl, toks in samples[:64]:
+        acc.observe_start("bench")
+        acc.observe("bench", ttft, itl, toks, prompt_tokens=128)
+    n = 20_000
+    t0 = time.perf_counter()
+    for i in range(n):
+        ttft, itl, toks = samples[i % len(samples)]
+        acc.observe_start("bench")
+        acc.observe("bench", ttft, itl, toks, prompt_tokens=128)
+    per_request = (time.perf_counter() - t0) / n
+    assert per_request < 20e-6, f"{per_request * 1e6:.2f}µs/request"

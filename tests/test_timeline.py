@@ -145,6 +145,58 @@ def _gap_dump(blocks):
     }
 
 
+def test_counter_tracks_merge_and_validate(tmp_path):
+    """Fleet telemetry samples render as Perfetto COUNTER tracks (`ph:
+    "C"`) on their service's process, on the same wall-clock axis as the
+    spans — so a goodput dip lines up with the slices that explain it."""
+    samples = [
+        {"ts": 100.0, "values": {"mock-model.goodput_tok_s": 120.0,
+                                 "backend/1.queue_depth": 2}},
+        {"ts": 100.5, "values": {"mock-model.goodput_tok_s": 80.0,
+                                 "backend/1.queue_depth": 5,
+                                 "bogus": "not-a-number"}},
+    ]
+    out = str(tmp_path / "fleet.json")
+    doc = tl.merge_timeline([], counter_dumps={"fleet": samples},
+                            out_path=out)
+    assert tl.validate_chrome_trace(doc) == []
+    counters = [e for e in doc["traceEvents"] if e.get("ph") == "C"]
+    assert len(counters) == 4  # non-numeric values are skipped
+    names = {e["name"] for e in counters}
+    assert names == {"mock-model.goodput_tok_s", "backend/1.queue_depth"}
+    # wall seconds → chrome µs, values ride in args
+    good = sorted((e for e in counters
+                   if e["name"] == "mock-model.goodput_tok_s"),
+                  key=lambda e: e["ts"])
+    assert good[0]["ts"] == 100.0 * 1e6 and good[1]["ts"] == 100.5 * 1e6
+    assert good[0]["args"]["value"] == 120.0
+    # one process per service, shared with span/ring merging
+    pids = {e["pid"] for e in counters}
+    assert len(pids) == 1
+    with open(out) as f:
+        assert json.load(f)["traceEvents"]
+
+
+def test_counter_tracks_share_service_pids_with_spans(tmp_path):
+    """A service that exported spans AND counters renders both under ONE
+    process in the merged document."""
+    path = _write_spans(tmp_path / "spans.jsonl", [
+        _otlp_line("fleet", "http.chat", "a" * 32, "b" * 16,
+                   start=1_000_000_000, end=2_000_000_000),
+    ])
+    doc = tl.merge_timeline(
+        [path],
+        counter_dumps={"fleet": [{"ts": 1.5,
+                                  "values": {"goodput": 9.0}}]},
+    )
+    assert tl.validate_chrome_trace(doc) == []
+    span_pid = next(e["pid"] for e in doc["traceEvents"]
+                    if e.get("cat") == "span")
+    counter_pid = next(e["pid"] for e in doc["traceEvents"]
+                       if e.get("ph") == "C")
+    assert span_pid == counter_pid
+
+
 def test_decode_host_gaps_basic():
     # three blocks: gaps of 1ms and 3ms between consecutive slices
     g = tl.decode_host_gaps(_gap_dump([
