@@ -678,6 +678,175 @@ async def continuous_phase(cfg, params, prompt_len=128, gen=192, rounds=3):
         await cont.shutdown()
 
 
+async def kvbm_zipf_phase(cfg, params, *, tenants=512, sys_len=384,
+                          user_len=64, gen=48, n_req=96, rate_rps=6.0,
+                          zipf_a=1.1, rounds=2, slo=SLO_1B):
+    """Zipf-distributed multi-tenant prefix workload (ISSUE 8): `tenants`
+    distinct system prompts whose popularity follows a Zipf law, each
+    request = tenant system prefix + fresh user suffix, Poisson arrivals.
+    The HBM page pool holds only ~32 tenants' prefixes BY DESIGN (the hot
+    prefix set dwarfs HBM — the millions-of-users regime), so the
+    offload arm keeps evicted prefixes in the DRAM tier and onboards
+    them at admission while the no-offload arm re-prefills cold.
+
+    Waves interleave offload-off/on within one run (same arrival seeds)
+    so a tunnel phase moves both arms; reports per-arm goodput under the
+    1B SLO, per-tier hit counters from the engine's own KVBM metrics,
+    and the warm-prefix TTFT ladder (cold vs HBM-hit vs DRAM-hit — the
+    acceptance ratios: DRAM ≤ 2× HBM, cold ≥ 5× DRAM)."""
+    from dynamo_tpu.engine import EngineConfig, JaxEngine
+    from dynamo_tpu.kvbm import HostBlockPool, TieredKvCache
+
+    page = 16
+    prompt_len = sys_len + user_len
+    pages_per = (prompt_len + gen) // page + 2
+    hot_tenants = 32  # HBM-resident tenant budget
+
+    def mk(offload):
+        tiered = (TieredKvCache(HostBlockPool(capacity_bytes=8 << 30))
+                  if offload else None)
+        return JaxEngine(cfg, params, EngineConfig(
+            page_size=page,
+            num_pages=1 + hot_tenants * (sys_len // page) + 16 * pages_per,
+            max_num_seqs=16,
+            max_prefill_tokens=2 * prompt_len, prefill_batch_size=2,
+            max_model_len=prompt_len + gen + 16,
+            decode_batch_buckets=[16], chunk_buckets=[prompt_len],
+            decode_steps=32, decode_chain=2,
+            mixed_prefill_tokens=2 * prompt_len,
+            enable_prefix_caching=True, quantization="int8",
+            fuse_projections=True,
+        ), eos_token_ids=[], tiered=tiered)
+
+    def tenant_sys(t):
+        return [((t * 131 + j * 7) % 997) + 1 for j in range(sys_len)]
+
+    def zipf_schedule(seed):
+        rng = random.Random(seed)
+        weights = [1.0 / (r + 1) ** zipf_a for r in range(tenants)]
+        acc, reqs = 0.0, []
+        for i in range(n_req):
+            acc += rng.expovariate(rate_rps)
+            t = rng.choices(range(tenants), weights=weights)[0]
+            user = [((i * 31 + j * 3) % 997) + 1 for j in range(user_len)]
+            reqs.append((acc, tenant_sys(t) + user))
+        return reqs
+
+    async def wave(engine, seed):
+        reqs = zipf_schedule(seed)
+
+        async def one(at, tokens):
+            await asyncio.sleep(at)
+            r = {"token_ids": tokens,
+                 "sampling_options": {"temperature": 0.0},
+                 "stop_conditions": {"max_tokens": gen, "ignore_eos": True}}
+            n, t_first, t_last = 0, None, None
+            t_submit = time.perf_counter()
+            async for out in engine.generate(r):
+                if out["token_ids"]:
+                    t_last = time.perf_counter()
+                    if t_first is None:
+                        t_first = t_last
+                    n += len(out["token_ids"])
+            ttft = (t_first - t_submit) * 1e3 if t_first else float("inf")
+            itl = ((t_last - t_first) / max(n - 1, 1) * 1e3
+                   if t_first else float("inf"))
+            return n, ttft, itl
+
+        t0 = time.perf_counter()
+        results = await asyncio.gather(*[one(a, p) for a, p in reqs])
+        dt = time.perf_counter() - t0
+        ok = [r for r in results
+              if r[1] <= slo["ttft_ms"] and r[2] <= slo["itl_ms"]]
+        return (sum(r[0] for r in ok) / dt,
+                sum(r[0] for r in results) / dt,
+                sorted(r[1] for r in results)[len(results) // 2])
+
+    async def drain(tiered):
+        deadline = time.perf_counter() + 30
+        while tiered.offload_backlog and time.perf_counter() < deadline:
+            await asyncio.sleep(0.05)
+
+    e_off, e_on = mk(False), mk(True)
+    try:
+        # warm programs off the clock (prefill/mixed/decode + import)
+        for e in (e_off, e_on):
+            await wave(e, seed=1)
+        await drain(e_on.tiered)
+        m0 = e_on.metrics()
+        goodput = {"no_offload": [], "offload": []}
+        attained = {"no_offload": [], "offload": []}
+        ttft = {"no_offload": [], "offload": []}
+        for r in range(rounds):
+            for name, e in (("no_offload", e_off), ("offload", e_on)):
+                g, a, t = await wave(e, seed=100 + 7 * r)
+                goodput[name].append(g)
+                attained[name].append(a)
+                ttft[name].append(t)
+        m1 = e_on.metrics()
+
+        def med(xs):
+            return sorted(xs)[len(xs) // 2]
+
+        # warm-prefix TTFT ladder on the offload engine: one fresh tenant
+        async def one_ttft(tokens):
+            r = {"token_ids": tokens,
+                 "sampling_options": {"temperature": 0.0},
+                 "stop_conditions": {"max_tokens": 2, "ignore_eos": True}}
+            t0 = time.perf_counter()
+            first = None
+            async for out in e_on.generate(r):
+                if out["token_ids"] and first is None:
+                    first = time.perf_counter() - t0
+            # token-less stream (engine error + recovery) scores inf like
+            # the goodput phases' one() — never crash the bench run
+            return float("inf") if first is None else first * 1e3
+
+        cold, hbm, dram = [], [], []
+        for i in range(3):
+            probe = tenant_sys(tenants + 7 + i) + [7] * user_len
+            e_on.clear_kv_blocks()
+            cold.append(await one_ttft(probe))
+            hbm.append(await one_ttft(probe))
+            await drain(e_on.tiered)
+            e_on.clear_kv_blocks()  # only copy left is DRAM-tier
+            dram.append(await one_ttft(probe))
+
+        gp_on, gp_off = med(goodput["offload"]), med(goodput["no_offload"])
+        stats = {k: getattr(m1, k, 0) - getattr(m0, k, 0) for k in (
+            "kvbm_offload_total", "kvbm_onboard_total", "kvbm_evict_total",
+            "kvbm_host_hits_total", "kvbm_host_misses_total")}
+        looked_up = (stats["kvbm_host_hits_total"]
+                     + stats["kvbm_host_misses_total"])
+        return {
+            "tenants": tenants, "sys_len": sys_len, "gen": gen,
+            "rate_rps": rate_rps, "zipf_a": zipf_a, "n_req": n_req,
+            "goodput_tok_s": {"offload": round(gp_on, 2),
+                              "no_offload": round(gp_off, 2)},
+            "goodput_ratio": round(gp_on / max(gp_off, 1e-9), 3),
+            "attained_tok_s": {
+                "offload": round(med(attained["offload"]), 2),
+                "no_offload": round(med(attained["no_offload"]), 2)},
+            "ttft_p50_ms": {
+                "offload": round(med(ttft["offload"]), 1),
+                "no_offload": round(med(ttft["no_offload"]), 1)},
+            "tier_hits": {**{k: int(v) for k, v in stats.items()},
+                          "host_hit_rate": round(
+                              stats["kvbm_host_hits_total"]
+                              / max(looked_up, 1), 3)},
+            "ttft_ladder_ms": {
+                "cold": round(med(cold), 1),
+                "hbm_hit": round(med(hbm), 1),
+                "dram_hit": round(med(dram), 1),
+                "dram_vs_hbm": round(med(dram) / max(med(hbm), 1e-9), 3),
+                "cold_vs_dram": round(med(cold) / max(med(dram), 1e-9), 3),
+            },
+        }
+    finally:
+        await e_off.shutdown()
+        await e_on.shutdown()
+
+
 def phase_breakdown(cfg, params, T=32, B=8, table_w=32):
     """Per-phase decode-step shares measured ON DEVICE (VERDICT r5 item
     4): full forward vs no-lm-head vs matmuls-only scans at the serving
@@ -976,6 +1145,13 @@ async def main_async():
     out["continuous_decode_1b"] = await continuous_phase(cfg, params)
     gc.collect()
 
+    # KVBM multi-tier A/B (ISSUE 8): Zipf multi-tenant prefix workload
+    # where the hot prefix set dwarfs HBM — offload-on keeps evicted
+    # prefixes in the DRAM tier (onboard at admission) vs cold re-prefill;
+    # plus the warm-prefix TTFT ladder (cold / HBM-hit / DRAM-hit)
+    out["kvbm_zipf"] = await kvbm_zipf_phase(cfg, params)
+    gc.collect()
+
     # disaggregated prefill→decode KV-transfer latency (the missing half
     # of BASELINE.json's metric — VERDICT r5 item 3): a prefill engine
     # exports pages through the real data plane (disagg/transfer.py), a
@@ -1245,6 +1421,7 @@ def _compact_summary(full):
     m8 = full.get("models", {}).get("llama-3.1-8b-int8", {})
     spec = full.get("spec_decode_1b_int8", {})
     cc = full.get("continuous_decode_1b", {})
+    kz = full.get("kvbm_zipf", {})
     phase = full.get("phase_samples_tok_s", {})
     return {
         "headline_bf16_tok_s": full.get("value"),
@@ -1287,6 +1464,19 @@ def _compact_summary(full):
         "cc_itl_ratio": cc.get("itl_ratio"),
         "host_gap_ms_p50": (cc.get("host_gap_ms") or {}).get("p50_ms"),
         "host_gap_ms_p99": (cc.get("host_gap_ms") or {}).get("p99_ms"),
+        # KVBM Zipf multi-tenant prefix A/B (ISSUE 8): aggregate goodput
+        # offload-on vs no-offload + the warm-prefix TTFT tier ladder
+        "kvbm_zipf_goodput_ratio": kz.get("goodput_ratio"),
+        "kvbm_zipf_goodput_offload_tok_s": (kz.get("goodput_tok_s") or {})
+        .get("offload"),
+        "kvbm_zipf_goodput_no_offload_tok_s": (kz.get("goodput_tok_s") or {})
+        .get("no_offload"),
+        "kvbm_ttft_dram_vs_hbm": (kz.get("ttft_ladder_ms") or {})
+        .get("dram_vs_hbm"),
+        "kvbm_ttft_cold_vs_dram": (kz.get("ttft_ladder_ms") or {})
+        .get("cold_vs_dram"),
+        "kvbm_host_hit_rate": (kz.get("tier_hits") or {})
+        .get("host_hit_rate"),
     }
 
 

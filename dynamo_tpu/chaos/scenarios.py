@@ -27,6 +27,11 @@ re-convergence) plus scenario-specific telemetry:
    affected capacity snapshots stale (never wrong-but-fresh-looking),
    retains the dead worker's last snapshot as stale, and recovers to fresh
    snapshots after the heal.
+7. ``kvbm_eviction_race``      — concurrent KVBM offload/onboard/evict under
+   load on small device+host tiers sharing one disk root, plus a writer
+   SIGKILLed mid-offload and planted torn-block debris; zero client-visible
+   errors, streams identical to the no-tier oracle (onboarded blocks
+   re-verify against recompute), and no tier corruption survives a read.
 
 Graph scenarios run MockEngine workers (the real scheduler + page pool with
 a simulated device step) slowed via ``--mock-speedup`` so faults land
@@ -489,6 +494,190 @@ def telemetry_staleness() -> Scenario:
     )
 
 
+# --------------------------------------------------------------------------- #
+# Scenario 7: KVBM eviction race + mid-offload kill (custom — in-process
+# real engines; the tier races live inside one process's thread set, and
+# the kill victim is the shared-disk writer, not a serving replica)
+# --------------------------------------------------------------------------- #
+
+
+async def _run_kvbm_eviction_race() -> ScenarioResult:
+    """Concurrent offload/onboard/evict under load, a writer SIGKILLed
+    mid-offload into the shared disk tier, and planted torn-block debris:
+    zero client-visible errors, every stream identical to the no-tier
+    oracle (tier-onboarded blocks re-verify against recompute), and no
+    corruption survives in the tier (torn reads drop the entry; a killed
+    atomic writer leaves only ignored tmp debris)."""
+    import os
+    import signal
+    import subprocess
+    import sys
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..engine import EngineConfig, JaxEngine
+    from ..kvbm import DiskTier, HostBlockPool, TieredKvCache
+    from ..models import init_params, tiny_config
+    from ..tokens import compute_block_hash_for_seq
+
+    cfg = tiny_config()
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    root = tempfile.mkdtemp(prefix="kvbm-chaos-")
+
+    def make_engine(num_pages, tiered=None):
+        return JaxEngine(
+            cfg, params,
+            EngineConfig(page_size=8, num_pages=num_pages, max_num_seqs=8,
+                         max_prefill_tokens=64, max_model_len=256),
+            eos_token_ids=[], kv_dtype=jnp.float32, tiered=tiered,
+        )
+
+    def make_tiered():
+        # ~4-block host pool: every offload wave churns LRU demotions to
+        # the SHARED disk root while onboarding promotes back up
+        return TieredKvCache(HostBlockPool(capacity_bytes=8 << 10),
+                             DiskTier(root))
+
+    def req(tokens):
+        return {"token_ids": tokens,
+                "sampling_options": {"temperature": 0.0},
+                "stop_conditions": {"max_tokens": 6, "ignore_eos": True}}
+
+    async def collect(gen):
+        toks, errors = [], []
+        async for d in gen:
+            if d.get("finish_reason") == "error":
+                errors.append(d.get("error", "engine error"))
+            toks.extend(d.get("token_ids", []))
+        return toks, errors
+
+    vocab = cfg.vocab_size
+    # four streams over two shared 40-token prefixes: prefix reuse makes
+    # offload dedup + onboard + device prefix hits all race at once
+    prefixes = [[(s * j + s) % vocab or 1 for j in range(1, 41)]
+                for s in (3, 7)]
+    prompts = [pre + [(11 * j + i) % vocab or 1 for j in range(1, 17)]
+               for i, pre in enumerate(prefixes * 2)]
+    result = ScenarioResult(name="kvbm_eviction_race", passed=False,
+                            streams=len(prompts))
+
+    async def drive(engine):
+        outs = await asyncio.gather(
+            *[collect(engine.generate(req(p))) for p in prompts])
+        return [t for t, _ in outs], [e for _, e in outs for e in e]
+
+    # oracle shares the tiered engines' EXACT shapes (incl. pool size) so
+    # one process-wide jit cache serves all three engine lifetimes
+    oracle = make_engine(num_pages=24)
+    want, errs = await drive(oracle)
+    await oracle.shutdown()
+    assert not errs, errs
+
+    engine_a = engine_b = None
+    try:
+        # phase 1: worker A under load on a TIGHT pool (23 usable pages for four
+        # 7..8-page streams → constant device eviction + preemption) with
+        # offload/demotion churning underneath
+        ta = make_tiered()
+        engine_a = make_engine(num_pages=24, tiered=ta)
+        got, errs = await drive(engine_a)
+        result.client_errors += len(errs)
+        result.stream_mismatches += sum(
+            1 for g, w in zip(got, want) if g != w)
+        assert not errs and got == want, "faulted wave diverged on A"
+        deadline = asyncio.get_running_loop().time() + 15
+        while ta.offload_backlog:
+            assert asyncio.get_running_loop().time() < deadline, "no drain"
+            await asyncio.sleep(0.05)
+        await engine_a.shutdown()
+        engine_a = None
+        assert len(ta.disk) > 0, "no demotion reached the shared tier"
+
+        # phase 2: a peer worker is SIGKILLed MID-OFFLOAD into the shared
+        # root (the atomic writer leaves only tmp debris)...
+        writer = subprocess.Popen(
+            [sys.executable, "-c", (
+                "import sys, time; import numpy as np;"
+                "sys.path.insert(0, %r);"
+                "from dynamo_tpu.kvbm.disk import DiskTier;"  # jax-free
+                "d = DiskTier(%r);"
+                "k = np.ones((2, 8, 2, 4), np.float32);"
+                "[(d.put(0x5150000 + i, None, k, k), time.sleep(0.001))"
+                " for i in range(100000)]"
+            ) % (os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))), root)],
+        )
+        # wait for PROOF the writer reached its write loop before killing
+        # it (package import alone takes seconds on the 2-CPU box — a
+        # fixed sleep kills mid-import and the mid-offload-kill phase
+        # silently tests nothing)
+        deadline = asyncio.get_running_loop().time() + 60
+        while not any(n.startswith("000000000515") for n in os.listdir(root)):
+            assert asyncio.get_running_loop().time() < deadline, \
+                "writer never started writing"
+            await asyncio.sleep(0.05)
+        writer.send_signal(signal.SIGKILL)
+        writer.wait()
+        assert any(n.startswith("000000000515") for n in os.listdir(root)), \
+            "writer progress vanished"
+        # ...and pre-atomic torn debris lands on one of the REAL prompt
+        # block hashes (what a non-atomic writer's SIGKILL would leave)
+        torn_hash = compute_block_hash_for_seq(prompts[0], 8)[1]
+        with open(os.path.join(root, f"{torn_hash:016x}.npz"), "wb") as f:
+            f.write(b"PK\x03\x04 torn mid-copy by SIGKILL")
+
+        # phase 3: worker B (fresh process-equivalent: own host pool, same
+        # shared disk) onboards the warm set while its own offloads and
+        # LRU demotions race — streams must re-verify against recompute
+        tb = make_tiered()
+        engine_b = make_engine(num_pages=24, tiered=tb)
+        got, errs = await drive(engine_b)
+        result.client_errors += len(errs)
+        result.stream_mismatches += sum(
+            1 for g, w in zip(got, want) if g != w)
+        assert not errs and got == want, "onboarded wave diverged on B"
+        assert tb.onboarded_blocks > 0, "B never onboarded from the tier"
+        # no corruption survives: the torn entry was dropped on read (or
+        # overwritten by a fresh atomic put), never onboarded as garbage
+        torn_path = os.path.join(root, f"{torn_hash:016x}.npz")
+        if os.path.exists(torn_path):
+            with open(torn_path, "rb") as f:
+                assert f.read(32) != b"PK\x03\x04 torn mid-copy by SIGKILL"
+        result.converge_s = 0.0  # no operator in the loop
+        result.telemetry = {
+            "a_offloaded": ta.offloaded_blocks,
+            "a_evicted": ta.host.evicted,
+            "b_onboarded": tb.onboarded_blocks,
+            "disk_blocks": len(tb.disk),
+            "tmp_debris_ignored": sum(
+                1 for n in os.listdir(root) if n.startswith(".tmp-")),
+        }
+        result.passed = True
+    except AssertionError as e:
+        result.failure = str(e) or repr(e)
+    finally:
+        for eng in (engine_a, engine_b):
+            if eng is not None:
+                await eng.shutdown()
+        import shutil
+
+        shutil.rmtree(root, ignore_errors=True)  # demoted .npz + debris
+    return result
+
+
+def kvbm_eviction_race() -> Scenario:
+    return Scenario(
+        name="kvbm_eviction_race",
+        description="concurrent KVBM offload/onboard/evict under load + "
+                    "mid-offload SIGKILL and torn-block debris in the "
+                    "shared tier; streams re-verify against recompute",
+        graph="", traffic=TrafficSpec(), plan=FaultPlan(),
+        custom=_run_kvbm_eviction_race,
+    )
+
+
 SCENARIOS = {
     "worker_kill_midstream": worker_kill_midstream,
     "multinode_rank_death": multinode_rank_death,
@@ -496,6 +685,7 @@ SCENARIOS = {
     "disagg_handoff_drop": disagg_handoff_drop,
     "wedged_engine_eviction": wedged_engine_eviction,
     "telemetry_staleness": telemetry_staleness,
+    "kvbm_eviction_race": kvbm_eviction_race,
 }
 
 

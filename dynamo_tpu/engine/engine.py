@@ -1578,17 +1578,44 @@ class JaxEngine:
         the admitting sequence's pool rank)."""
         self.tiered = connector
         self.add_event_sink(connector.on_event)
-        # onboarding runs inside admission (pump loop thread, between
-        # steps) — blocking device work, small and batched
-        self.scheduler.onboard_fn = (
-            lambda hashes, rank=0: connector.onboard(self, hashes, rank=rank)
-        )
 
-    def export_cached_blocks(self, hashes):
-        """SYNC device->host export of committed blocks (pump/executor
-        thread only — never concurrent with a step).  Returns
-        (resolved_hashes, k, v) with k/v shaped [L, n, page, kv, hd];
-        hashes no longer cached are skipped."""
+        # onboarding runs inside admission (pump loop thread, between
+        # steps) — blocking device work, small and batched.  The wrapper
+        # leaves the scheduler's watermark reserve untouched (onboarding
+        # must not eat the pages `_admit_check` holds back for decode
+        # growth), exports a `kvbm.onboard` span under the admitting
+        # request's trace, and lands a ring event on the step timeline.
+        def _onboard(hashes, rank=0):
+            t0 = time.time_ns()
+            ring_t0 = (self.events.now() if self.events is not None
+                       else None)
+            pages = connector.onboard(
+                self, hashes, rank=rank,
+                headroom=self.scheduler._watermark_pages() + 1,  # noqa: SLF001
+            )
+            if pages:
+                from ..runtime.tracing import export_span
+
+                export_span(
+                    "kvbm.onboard",
+                    getattr(self.scheduler, "onboard_trace", None),
+                    t0, time.time_ns(),
+                    blocks=len(pages), missed=len(hashes), rank=rank,
+                )
+                if self.events is not None:
+                    self.events.record("kvbm_onboard", t0_ns=ring_t0,
+                                       n=len(pages), rank=rank)
+            return pages
+
+        self.scheduler.onboard_fn = _onboard
+
+    def export_cached_blocks_device(self, hashes):
+        """Device half of the offload export (pump/executor thread only —
+        the jitted gather must never race a step's donated KV buffers).
+        Returns per-rank chunks ``[(hashes, k_dev, v_dev)]`` WITHOUT
+        fetching: the outputs are fresh device buffers, so the blocking
+        ``device_get`` can run on the KVBM drain thread concurrently
+        with later steps.  Hashes no longer cached are skipped."""
         resolved, pages = [], []
         for h in hashes:
             page = self.pool.cached_page(h)
@@ -1596,25 +1623,38 @@ class JaxEngine:
                 resolved.append(h)
                 pages.append(page)
         if not pages:
-            return [], None, None
+            return []
         if self._pooled:
             # a batch of cached hashes may span pool ranks; the export
-            # jit masks to ONE rank per call — group and stitch
+            # jit masks to ONE rank per call — group into chunks
             by_rank: Dict[int, List[tuple]] = {}
             for h, p in zip(resolved, pages):
                 by_rank.setdefault(self.pool.rank_of(p), []).append((h, p))
-            out_h, ks, vs = [], [], []
+            chunks = []
             for items in by_rank.values():
                 pg = [p for _, p in items]
                 k, v = self._export_dev(pg)
-                ks.append(np.asarray(jax.device_get(k))[:, : len(pg)])
-                vs.append(np.asarray(jax.device_get(v))[:, : len(pg)])
-                out_h.extend(h for h, _ in items)
-            return out_h, np.concatenate(ks, 1), np.concatenate(vs, 1)
+                chunks.append(([h for h, _ in items], k, v))
+            return chunks
         k, v = self._export_dev(pages)
-        k = np.asarray(jax.device_get(k))[:, : len(pages)]
-        v = np.asarray(jax.device_get(v))[:, : len(pages)]
-        return resolved, k, v
+        return [(resolved, k, v)]
+
+    def export_cached_blocks(self, hashes):
+        """SYNC device->host export of committed blocks (pump/executor
+        thread only — never concurrent with a step).  Returns
+        (resolved_hashes, k, v) with k/v shaped [L, n, page, kv, hd];
+        hashes no longer cached are skipped."""
+        chunks = self.export_cached_blocks_device(hashes)
+        if not chunks:
+            return [], None, None
+        out_h, ks, vs = [], [], []
+        for hs, k, v in chunks:
+            out_h.extend(hs)
+            ks.append(np.asarray(jax.device_get(k))[:, : len(hs)])
+            vs.append(np.asarray(jax.device_get(v))[:, : len(hs)])
+        if len(ks) == 1:
+            return out_h, ks[0], vs[0]
+        return out_h, np.concatenate(ks, 1), np.concatenate(vs, 1)
 
     def import_committed_blocks(self, blocks, rank: Optional[int] = None
                                 ) -> List[int]:
@@ -1920,12 +1960,24 @@ class JaxEngine:
             m.kv_usage_aggregate = self.pool.usage()
         if self.tiered is not None:
             # KVBM tier stats ride the same snapshot (dynamic attrs are
-            # picked up by vars() consumers: /metrics.json, Prometheus)
-            m.kvbm_host_blocks = len(self.tiered.host)
-            m.kvbm_pending_offloads = self.tiered.pending_offloads
-            m.kvbm_onboarded_blocks_total = self.tiered.onboarded_blocks
-            if self.tiered.disk is not None:
-                m.kvbm_disk_blocks = len(self.tiered.disk)
+            # picked up by vars() consumers: /metrics.json, Prometheus,
+            # the TelemetryPublisher capacity snapshots)
+            t = self.tiered
+            m.kvbm_host_blocks = len(t.host)
+            m.kvbm_pending_offloads = t.pending_offloads
+            m.kvbm_inflight_offloads = t.inflight_offloads
+            m.kvbm_offload_total = t.offloaded_blocks
+            m.kvbm_onboard_total = t.onboarded_blocks
+            m.kvbm_evict_total = t.host.evicted
+            m.kvbm_host_hits_total = t.host.hits
+            m.kvbm_host_misses_total = t.host.misses
+            m.kvbm_host_bytes = t.host.bytes_used
+            m.kvbm_host_capacity_bytes = t.host.capacity_bytes
+            if t.disk is not None:
+                m.kvbm_disk_blocks = len(t.disk)
+                m.kvbm_disk_hits_total = t.disk.hits
+                m.kvbm_disk_misses_total = t.disk.misses
+                m.kvbm_disk_bytes = t.disk.bytes_used
         return m
 
     def clear_kv_blocks(self) -> int:
@@ -2062,6 +2114,15 @@ class JaxEngine:
                 None, self._drain_pool.shutdown, True
             )
             self._drain_pool = None
+        if self.tiered is not None:
+            # join the kvbm-offload drain thread: no tier write (host
+            # insert, demotion disk put) outlives shutdown(), and the
+            # executor thread doesn't leak per engine lifecycle.  The
+            # pump has exited, so nothing submits anymore; a tier shared
+            # with a later engine reopens its drain lazily on submit.
+            await asyncio.get_running_loop().run_in_executor(
+                None, self.tiered.close
+            )
         self._close_blob_channels()
 
     def _close_blob_channels(self) -> None:
@@ -2134,6 +2195,21 @@ class JaxEngine:
             if plan.kind == "idle":
                 if not (self.scheduler.has_work or self._pending_adds
                         or self._pending_aborts):
+                    if self.tiered is not None \
+                            and self.tiered.pending_offloads:
+                        # only offload work remains: keep pumping batches,
+                        # but with a real sleep — when the dispatch is
+                        # backpressured (drain thread busy) a sleep(0)
+                        # loop would spin the step thread hot
+                        await asyncio.sleep(0.002)
+                        continue
+                    # shutdown() may have set _closed (and _wake) while this
+                    # iteration was suspended in an executor await — e.g. the
+                    # offload pump dispatch; clearing _wake here would eat
+                    # that wakeup and park forever against a gather()ing
+                    # shutdown
+                    if self._closed:
+                        break
                     self._wake.clear()
                     await self._wake.wait()
                 else:

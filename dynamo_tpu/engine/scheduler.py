@@ -159,8 +159,11 @@ class Scheduler:
         # chained dispatches referencing them are still in flight
         self.deferred_free: Optional[List[int]] = None
         # optional multi-tier onboarding hook (KVBM): called with the hash
-        # run missed by the device cache, returns onboarded page ids
+        # run missed by the device cache, returns onboarded page ids.
+        # `onboard_trace` carries the admitting request's TraceContext
+        # across the hook call (set/cleared by _apply_prefix_cache)
         self.onboard_fn = None
+        self.onboard_trace = None
         # block-ladder ramp position: 0 = shortest rung.  Reset whenever
         # prompts are pending; climbs one rung per quiet dispatch so the
         # engine eases back into full blocks instead of jumping (a burst
@@ -281,10 +284,19 @@ class Scheduler:
         if self.onboard_fn is not None and len(hit_pages) < len(hashes):
             # onboard() returns pages already holding this sequence's
             # ref, allocated on the sequence's pool rank (a sequence's
-            # pages must share one partition)
-            hit_pages.extend(
-                self.onboard_fn(hashes[len(hit_pages):], seq.kv_rank)
-            )
+            # pages must share one partition).  The admitting request's
+            # trace rides an attribute (not the hook signature, which
+            # tests spy on) so the engine can export a kvbm.onboard span
+            # under it.
+            self.onboard_trace = seq.trace
+            try:
+                hit_pages.extend(
+                    self.onboard_fn(hashes[len(hit_pages):], seq.kv_rank)
+                )
+            finally:
+                # a raising hook must not leave the dead request's trace
+                # attached — the next admission's span would join it
+                self.onboard_trace = None
         if hit_pages:
             seq.pages = list(hit_pages)
             seq.num_cached = len(hit_pages) * ps

@@ -7,6 +7,7 @@ from .distributed import KvbmConfig, KvbmLeader, KvbmWorker
 from .host_pool import HostBlock, HostBlockPool
 from .offload import TieredKvCache
 from .remote import ObjectStoreTier
+from .summary import TierSummaryPublisher, summary_key, summary_prefix
 
 __all__ = [
     "DiskTier",
@@ -17,4 +18,7 @@ __all__ = [
     "KvbmWorker",
     "ObjectStoreTier",
     "TieredKvCache",
+    "TierSummaryPublisher",
+    "summary_key",
+    "summary_prefix",
 ]

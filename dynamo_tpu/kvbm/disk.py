@@ -1,12 +1,21 @@
 """Local-disk KV block tier (G3) — one .npz per block hash, byte-capped LRU
 (the reference's DiskTransferManager + NVMe tier,
-/root/reference/lib/llm/src/block_manager/offload.rs)."""
+/root/reference/lib/llm/src/block_manager/offload.rs).
+
+Writes are ATOMIC (tmp file + rename): the tier directory is shared
+across worker processes, and a worker SIGKILLed mid-offload must never
+leave a torn .npz that another worker could onboard — a half-written
+block either doesn't exist under its final name, or is complete.  Reads
+treat any undecodable file as a miss and drop it (crash debris from
+pre-atomic writers or torn copies on non-POSIX filesystems)."""
 
 from __future__ import annotations
 
 import os
 import threading
+import time
 from collections import OrderedDict
+from itertools import islice
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -19,10 +28,30 @@ class DiskTier:
         os.makedirs(root, exist_ok=True)
         self._lock = threading.Lock()
         self._index: "OrderedDict[int, int]" = OrderedDict()  # hash → nbytes
+        # hashes whose bytes THIS process wrote or read back successfully.
+        # Startup-scan / _discover entries stay unverified: they may be
+        # pre-atomic torn debris under a valid final name, so put() must
+        # overwrite them (os.replace is atomic) rather than dedup against
+        # them, and the offload drain must not skip the host insert on
+        # their account — otherwise valid KV offered for the hash is
+        # dropped from BOTH lower tiers.
+        self._verified: set = set()
         self._bytes = 0
         self.hits = 0
         self.misses = 0
         for name in os.listdir(root):
+            if name.startswith(".tmp-"):
+                # SIGKILL-orphaned write debris: invisible to the index
+                # and the byte cap, so it would otherwise accumulate
+                # forever.  Age-gated so a LIVE writer's in-progress tmp
+                # (savez takes well under a minute) is never swept.
+                p = os.path.join(root, name)
+                try:
+                    if time.time() - os.path.getmtime(p) > 60:
+                        os.remove(p)
+                except OSError:
+                    pass
+                continue
             if name.endswith(".npz"):
                 try:
                     h = int(name[:-4], 16)
@@ -38,23 +67,41 @@ class DiskTier:
     def put(self, block_hash: int, parent_hash: Optional[int],
             k: np.ndarray, v: np.ndarray) -> None:
         with self._lock:
-            if block_hash in self._index:
+            if block_hash in self._index and block_hash in self._verified:
                 self._index.move_to_end(block_hash)
                 return
             path = self._path(block_hash)
-            # hashes are u64; sentinel 2^64-1 = "no parent"
-            np.savez(
-                path, k=k, v=v,
-                parent=np.uint64(
-                    parent_hash if parent_hash is not None else (1 << 64) - 1
-                ),
+            # atomic publish: savez to a private tmp name, then rename —
+            # a SIGKILL mid-write leaves only the tmp file, which no
+            # reader ever resolves (hashes are u64; sentinel 2^64-1 =
+            # "no parent")
+            tmp = os.path.join(
+                self.root, f".tmp-{os.getpid()}-{block_hash:016x}.npz"
             )
+            try:
+                np.savez(
+                    tmp, k=k, v=v,
+                    parent=np.uint64(
+                        parent_hash if parent_hash is not None
+                        else (1 << 64) - 1
+                    ),
+                )
+                os.replace(tmp, path)
+            except Exception:  # any savez failure must not leak the tmp
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+                raise
             sz = os.path.getsize(path)
+            self._bytes -= self._index.get(block_hash, 0)  # debris replaced
             self._index[block_hash] = sz
+            self._verified.add(block_hash)
             self._bytes += sz
             while self._bytes > self.capacity_bytes and len(self._index) > 1:
                 old, old_sz = self._index.popitem(last=False)
                 self._bytes -= old_sz
+                self._verified.discard(old)
                 try:
                     os.remove(self._path(old))
                 except OSError:
@@ -78,14 +125,39 @@ class DiskTier:
                 self.misses += 1
                 return None
             self._index.move_to_end(block_hash)
+        path = self._path(block_hash)
+        torn_stat = None
         try:
-            with np.load(self._path(block_hash)) as z:
-                self.hits += 1
-                return z["k"], z["v"]
-        except (OSError, KeyError):
+            torn_stat = os.stat(path)
+            with np.load(path) as z:
+                # materialize BEFORE counting the hit: a valid zip that
+                # lacks the arrays (foreign debris) raises KeyError here
+                # and must count as one miss, not a hit AND a miss
+                k, v = z["k"], z["v"]
+            self.hits += 1
+            with self._lock:
+                self._verified.add(block_hash)
+            return k, v
+        except Exception:  # noqa: BLE001 — torn/corrupt file = miss
+            # undecodable blocks (zipfile.BadZipFile from a torn copy,
+            # missing keys, truncation) are dropped from the tier so the
+            # next lookup recomputes instead of re-reading debris.  The
+            # remove happens under the lock AND only if the file is still
+            # the one we failed to read (inode+mtime): the directory is
+            # shared across processes, and a concurrent put() may have
+            # atomically re-published a VALID block at this path since.
             with self._lock:
                 sz = self._index.pop(block_hash, 0)
                 self._bytes -= sz
+                self._verified.discard(block_hash)
+                try:
+                    st = os.stat(path)
+                    if (torn_stat is not None
+                            and (st.st_ino, st.st_mtime_ns)
+                            == (torn_stat.st_ino, torn_stat.st_mtime_ns)):
+                        os.remove(path)
+                except OSError:
+                    pass
             self.misses += 1
             return None
 
@@ -93,5 +165,26 @@ class DiskTier:
         with self._lock:
             return block_hash in self._index or self._discover(block_hash)
 
+    def has_verified(self, block_hash: int) -> bool:
+        """True only for entries whose bytes this process wrote or read
+        back successfully — the offload drain's dedup signal.  Discovered
+        entries (startup scan / peer writes) stay unverified until a read
+        proves them, so possible torn debris under a valid name never
+        causes valid offloaded KV to be skipped."""
+        with self._lock:
+            return block_hash in self._verified and block_hash in self._index
+
     def __len__(self) -> int:
         return len(self._index)
+
+    @property
+    def bytes_used(self) -> int:
+        return self._bytes
+
+    def summary(self, max_hashes: int = 8192) -> List[int]:
+        """Indexed block hashes, most-recently-used first, capped — the
+        worker's published prefix-summary view of this tier."""
+        with self._lock:
+            # O(max_hashes), not O(index): the publisher calls this every
+            # tick and the drain thread's demotion writes contend the lock
+            return list(islice(reversed(self._index), max_hashes))

@@ -14,6 +14,7 @@ import threading
 import time
 from collections import OrderedDict
 from dataclasses import dataclass
+from itertools import islice
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -103,3 +104,11 @@ class HostBlockPool:
     @property
     def bytes_used(self) -> int:
         return self._bytes
+
+    def summary(self, max_hashes: int = 8192) -> List[int]:
+        """Resident block hashes, most-recently-used first, capped — the
+        worker's published prefix-summary view of this tier."""
+        with self._lock:
+            # O(max_hashes), not O(pool): called every publisher tick
+            # under the same lock the offload drain thread inserts with
+            return list(islice(reversed(self._blocks), max_hashes))

@@ -6,11 +6,16 @@ Reference: /root/reference/lib/llm/src/block_manager/offload.rs:86
 kernel, G2→G3 via DiskTransferManager, onboarding on schedule-time cache
 miss).  TPU design differences:
 
-- G1→G2 copies are jitted gathers + device_get, batched per engine step
-  (the pump drains the offload queue between steps, so copies never race
-  the donated KV buffers);
+- the offload pump is SPLIT across two threads so the device-step thread
+  never blocks on a host copy: the step thread (between steps, so the
+  gather never races donated KV buffers) only dispatches the batched
+  jitted gather and hands the resulting device arrays to a dedicated
+  ``kvbm-offload`` drain thread, which performs the blocking
+  ``device_get`` + host-pool insert (and any LRU demotion disk writes)
+  off the scheduler's critical path;
 - demotion G2→G3 happens on host-LRU eviction (write-back, not
-  write-through);
+  write-through) — on whichever thread inserted into the host pool, i.e.
+  the drain thread for offloads and the planning thread for promotions;
 - onboarding runs inside admission: after the device prefix-cache lookup,
   the remaining hash run is looked up host-first then disk (promoting to
   host), imported into freshly-allocated device pages, and committed so
@@ -21,6 +26,7 @@ from __future__ import annotations
 
 import logging
 import threading
+from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional, Sequence, Tuple
 
 from .disk import DiskTier
@@ -38,7 +44,17 @@ class TieredKvCache:
         self.max_offload_batch = max_offload_batch
         self._pending: List[Tuple[int, Optional[int]]] = []  # (hash, parent)
         self._lock = threading.Lock()
+        # hashes whose device→host copy is in flight on the drain thread
+        # (gather dispatched, device_get/host insert not yet done) — they
+        # must not be re-exported by the next pump tick
+        self._inflight: set[int] = set()
+        # ONE drain thread: host inserts stay ordered, and demotion disk
+        # writes serialize instead of thrashing a shared tier directory
+        self._drain = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="kvbm-offload"
+        )
         self.onboarded_blocks = 0
+        self.offloaded_blocks = 0
         if disk is not None or remote is not None:
             host.on_evict = self._demote
 
@@ -62,29 +78,138 @@ class TieredKvCache:
                 self._pending.append((h, parent))
                 parent = h
 
-    # -- offload pump (called by the engine between steps) ------------------- #
+    # -- offload pump (engine step thread, between steps) --------------------- #
 
     def pump_offloads(self, engine) -> int:
-        """Copy queued blocks device→host. Returns blocks offloaded."""
+        """Dispatch one batch of queued device→host copies.  Runs on the
+        engine's step/executor thread strictly BETWEEN device steps (the
+        jitted gather must never race a step's donated KV buffers), but
+        only *dispatches* the gather — the blocking ``device_get`` and
+        the host-pool insert complete asynchronously on the
+        ``kvbm-offload`` drain thread.  Returns blocks dispatched."""
         with self._lock:
+            # backpressure: each dispatched chunk pins fresh device
+            # export buffers until its device_get completes — with the
+            # drain thread stuck in slow demotion writes, unbounded
+            # dispatch would fill HBM with export buffers.  Cap in-flight
+            # at 2 batches and let the pump retry next tick.
+            if len(self._inflight) >= 2 * self.max_offload_batch:
+                return 0
             batch = self._pending[: self.max_offload_batch]
             self._pending = self._pending[self.max_offload_batch:]
-        todo = [
-            (h, p) for h, p in batch
-            if h not in self.host
-            and (self.disk is None or h not in self.disk)
-            and (self.remote is None or h not in self.remote)
-        ]
+            # step-thread dedup is IN-MEMORY only (inflight set + host
+            # dict): disk/remote membership involves stat/network
+            # syscalls, so those checks run on the drain thread before
+            # the host insert instead — the worst case is a wasted async
+            # gather dispatch, never a blocked step thread.  The batch
+            # moves from _pending to _inflight INSIDE one locked section:
+            # offload_backlog must never transiently read 0 while a
+            # dispatch is being prepared, or drain barriers exit early
+            todo = [
+                (h, p) for h, p in batch
+                if h not in self._inflight and h not in self.host
+            ]
+            self._inflight.update(h for h, _ in todo)
+        if not todo:
+            return 0
         parents = dict(todo)
-        resolved, k, v = engine.export_cached_blocks([h for h, _ in todo])
-        for i, h in enumerate(resolved):
-            self.host.put(h, parents[h], k[:, i].copy(), v[:, i].copy())
-        return len(resolved)
+        try:
+            # device half: the jitted gather dispatches asynchronously;
+            # the returned chunks are FRESH output buffers, so fetching
+            # them from another thread cannot race later steps' donated KV
+            chunks = engine.export_cached_blocks_device(
+                [h for h, _ in todo])
+        except BaseException:
+            with self._lock:
+                self._inflight.difference_update(h for h, _ in todo)
+            raise
+        resolved = {h for hs, _, _ in chunks for h in hs}
+        stale = [h for h, _ in todo if h not in resolved]
+        if stale:  # no longer device-cached — nothing will drain them
+            with self._lock:
+                self._inflight.difference_update(stale)
+        n = len(resolved)
+        if not n:
+            return 0
+        try:
+            self._drain.submit(self._complete_offload, chunks, parents,
+                               engine)
+        except RuntimeError:
+            # close()d by a previous owner's shutdown and re-attached to a
+            # new engine: reopen the drain lazily
+            self._drain = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="kvbm-offload"
+            )
+            self._drain.submit(self._complete_offload, chunks, parents,
+                               engine)
+        return n
+
+    def _complete_offload(self, chunks, parents, engine) -> None:
+        """Drain-thread half: blocking device→host fetch + host insert
+        (and, via the host pool's on_evict, any G2→G3 demotion writes)."""
+        try:
+            import jax
+            import numpy as np
+
+            from ..runtime.tracing import span as _span
+
+            events = getattr(engine, "events", None)
+            for hashes, k_dev, v_dev in chunks:
+                t0 = events.now() if events is not None else None
+                with _span("kvbm.offload", blocks=len(hashes)):
+                    k = np.asarray(jax.device_get(k_dev))[:, : len(hashes)]
+                    v = np.asarray(jax.device_get(v_dev))[:, : len(hashes)]
+                    for i, h in enumerate(hashes):
+                        # lower-tier dedup lives HERE (not the step
+                        # thread): membership may stat a shared dir or
+                        # hit the network.  Disk dedup trusts only
+                        # VERIFIED entries — a discovered-but-unread
+                        # file may be torn debris, and skipping the host
+                        # insert on its account would drop valid KV from
+                        # both lower tiers
+                        if ((self.disk is not None
+                             and self.disk.has_verified(h))
+                                or (self.remote is not None
+                                    and h in self.remote)):
+                            continue
+                        self.host.put(h, parents.get(h), k[:, i].copy(),
+                                      v[:, i].copy())
+                        self.offloaded_blocks += 1
+                if events is not None:
+                    events.record("kvbm_offload", t0_ns=t0, n=len(hashes))
+        except Exception:  # noqa: BLE001 — offload is best-effort
+            logger.exception("kvbm offload drain failed")
+        finally:
+            with self._lock:
+                for hashes, _, _ in chunks:
+                    self._inflight.difference_update(hashes)
 
     @property
     def pending_offloads(self) -> int:
+        """Queued blocks still needing a device-side gather (step-thread
+        work) — the engine's chain fall-out / pump gating signal."""
         with self._lock:
             return len(self._pending)
+
+    @property
+    def inflight_offloads(self) -> int:
+        """Blocks whose gather is dispatched but whose host copy hasn't
+        completed on the drain thread yet."""
+        with self._lock:
+            return len(self._inflight)
+
+    @property
+    def offload_backlog(self) -> int:
+        """pending + in-flight — zero means every queued block has landed
+        in a host/disk tier (what tests and drain barriers wait on)."""
+        with self._lock:
+            return len(self._pending) + len(self._inflight)
+
+    def close(self) -> None:
+        """Join the drain thread (no tier write outlives the caller) and
+        release it.  A tier re-attached to a later engine reopens the
+        drain lazily on the next pump dispatch."""
+        self._drain.shutdown(wait=True)
 
     # -- onboarding (admission path) ----------------------------------------- #
 
@@ -109,18 +234,32 @@ class TieredKvCache:
             out.append(blk)
         return out
 
-    def onboard(self, engine, hashes: Sequence[int],
-                rank: int = 0) -> List[int]:
+    def onboard(self, engine, hashes: Sequence[int], rank: int = 0,
+                headroom: Optional[int] = None) -> List[int]:
         """Import the leading cached run into device pages ON the given
         pool rank (the admitting sequence's partition — all its pages
         must share one rank); returns page ids committed to the device
-        prefix cache."""
+        prefix cache.  ``headroom`` pages are left free on the rank
+        (callers pass the admission watermark so onboarding never eats
+        the reserve `_admit_check` holds back for decode growth)."""
         run = self.lookup_run(hashes)
-        # leave headroom: don't onboard into the rank's last free pages
-        run = run[: max(0, engine.pool.available_on(rank) - 2)]
+        free = max(0, engine.pool.available_on(rank)
+                   - (2 if headroom is None else headroom))
+        run = run[:free]
         pages = engine.import_committed_blocks(
             [(b.block_hash, b.parent_hash, b.k, b.v) for b in run],
             rank=rank,
         )
         self.onboarded_blocks += len(pages)
         return pages
+
+    # -- router-facing tier summary ------------------------------------------- #
+
+    def summary(self, max_hashes: int = 8192) -> dict:
+        """Per-tier block-hash lists for the worker's published prefix
+        summary (most-recent first, capped) — what the router's global
+        index scores tier overlap against."""
+        host = self.host.summary(max_hashes)
+        disk = (self.disk.summary(max_hashes)
+                if self.disk is not None else [])
+        return {"host": host, "disk": disk}

@@ -62,6 +62,8 @@ class KvRouter:
     ):
         self.runtime = runtime
         self.client = client
+        self.namespace = namespace
+        self.component = component
         self.block_size = block_size
         self.salt = salt
         from .publisher import KV_WIRE_VERSION
@@ -72,6 +74,11 @@ class KvRouter:
         self.busy_threshold = busy_threshold
         self.snapshot_threshold = snapshot_threshold
         self.index = RadixIndex()
+        # KVBM global prefix index: worker → blocks resident in its
+        # host/disk tiers, fed by the lease-scoped summary watch (a put
+        # REPLACES the worker's view; lease loss DROPS it — stale tier
+        # data would route requests at an evaporated cache)
+        self.tier_index = RadixIndex()
         self.approx = ApproxKvIndexer() if use_approx else None
         self.active = ActiveSequences()
         self.selector = KvWorkerSelector(overlap_score_weight, temperature)
@@ -89,6 +96,7 @@ class KvRouter:
         self._tasks = [
             loop.create_task(self._event_loop()),
             loop.create_task(self._metrics_loop()),
+            loop.create_task(self._summary_loop()),
         ]
         return self
 
@@ -185,6 +193,59 @@ class KvRouter:
         elif kind == "cleared":
             self.index.clear_worker(wid)
 
+    async def _summary_loop(self) -> None:
+        """Watch the KVBM tier summaries for this component into
+        `tier_index` (kvbm/summary.py).  Puts replace the worker's tier
+        view; deletes and forgets — a summary key vanishing with its
+        lease — drop the worker from the index immediately, so the
+        overlap score can never send a request chasing cache state whose
+        owner is gone."""
+        from ..kvbm.summary import summary_prefix
+        from ..runtime.transport.control_plane import watch_resilient
+
+        prefix = summary_prefix(self.namespace, self.component)
+        while True:
+            try:
+                async for ev in watch_resilient(self.runtime.control, prefix,
+                                                "kvbm-summary"):
+                    if ev.type == "put":
+                        try:
+                            payload = unpack(ev.value)
+                            wid = int(ev.key[len(prefix):])
+                        except (ValueError, TypeError, KeyError):
+                            continue
+                        if not isinstance(payload, dict):
+                            continue
+                        try:
+                            self._apply_summary(wid, payload)
+                        except (TypeError, ValueError):
+                            # malformed field (version skew/corruption)
+                            # must drop the EVENT, not kill the watch —
+                            # a dead loop retains every worker's tier
+                            # view stale forever
+                            logger.warning(
+                                "malformed kvbm summary from worker %d "
+                                "dropped", wid)
+                    elif ev.type in ("delete", "forget"):
+                        try:
+                            wid = int(ev.key[len(prefix):])
+                        except ValueError:
+                            continue
+                        self.tier_index.remove_worker(wid)
+            except asyncio.CancelledError:
+                return
+            except (ConnectionError, RuntimeError) as e:
+                logger.warning("kvbm summary watch failed: %s", e)
+                await asyncio.sleep(0.5)
+
+    def _apply_summary(self, wid: int, payload: dict) -> None:
+        hashes = list(payload.get("host") or []) + list(
+            payload.get("disk") or []
+        )
+        self.tier_index.remove_worker(wid)
+        if hashes:
+            self.tier_index.apply_stored(wid, hashes)
+
     async def _metrics_loop(self) -> None:
         while True:
             try:
@@ -229,6 +290,7 @@ class KvRouter:
             if unpack_worker(key)[0] not in live_inst:
                 del self.worker_states[key]
                 self.index.remove_worker(key)
+                self.tier_index.remove_worker(key)
                 self.active.remove_worker(key)
                 if self.approx:
                     self.approx.remove_worker(key)
@@ -290,14 +352,20 @@ class KvRouter:
                 a = self.approx.find_matches(hashes)
                 for w, o in a.items():
                     overlaps[w] = max(overlaps.get(w, 0), o)
+            # KVBM tier overlap: leading runs resident in workers'
+            # host/disk tiers (fed by the lease-scoped summary watch) —
+            # the global, not-just-device half of the overlap score
+            tier_overlaps = self.tier_index.find_matches(hashes)
             request_blocks = max(len(hashes), 1)
             decision = self.selector.select(
-                workers, overlaps, request_blocks, self.active
+                workers, overlaps, request_blocks, self.active,
+                tier_overlaps=tier_overlaps,
             )
             sp.attrs.update(
                 worker=decision.worker_id,
                 dp_rank=unpack_worker(decision.worker_id)[1],
                 overlap_blocks=decision.overlap_blocks,
+                tier_overlap_blocks=decision.tier_overlap_blocks,
                 request_blocks=request_blocks,
                 candidates=len(workers),
             )
@@ -305,7 +373,12 @@ class KvRouter:
         self.active.add_request(
             rid,
             decision.worker_id,
-            prefill_blocks=request_blocks - decision.overlap_blocks,
+            # tier-resolvable blocks onboard instead of prefilling — the
+            # pending-prefill load estimate should not count them
+            prefill_blocks=max(
+                0, request_blocks - decision.overlap_blocks
+                - decision.tier_overlap_blocks,
+            ),
             decode_blocks=request_blocks,
         )
         if self.approx:

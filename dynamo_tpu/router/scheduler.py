@@ -51,6 +51,9 @@ class SchedulingDecision:
     worker_id: int
     overlap_blocks: int
     costs: Dict[int, float] = field(default_factory=dict)
+    # leading blocks resolvable from the chosen worker's HOST/DISK tiers
+    # beyond its device overlap (KVBM onboarding instead of prefill)
+    tier_overlap_blocks: int = 0
 
 
 class WorkerSelector(Protocol):
@@ -60,24 +63,47 @@ class WorkerSelector(Protocol):
         overlaps: Dict[int, int],
         request_blocks: int,
         active: ActiveSequences,
+        tier_overlaps: Optional[Dict[int, int]] = None,
     ) -> SchedulingDecision: ...
 
 
 class KvWorkerSelector:
-    """The default cost-based selector."""
+    """The default cost-based selector.
+
+    With KVBM tier summaries (`tier_overlaps`), a worker whose host/disk
+    tier holds a leading run of the request's blocks avoids prefilling
+    them too — it onboards at `onboard_cost_weight` of a prefilled
+    block's cost (device→host copies are cheap next to recompute but not
+    free), so the effective prefill estimate becomes::
+
+        effective_overlap = max(device_overlap, tier_overlap)
+        prefill_cost = (request_blocks - effective_overlap)
+                     + onboard_cost_weight * max(0, tier - device)
+    """
 
     def __init__(self, overlap_score_weight: float = 1.0,
-                 temperature: float = 0.0, rng: Optional[random.Random] = None):
+                 temperature: float = 0.0, rng: Optional[random.Random] = None,
+                 onboard_cost_weight: float = 0.25):
         self.overlap_score_weight = overlap_score_weight
         self.temperature = temperature
+        self.onboard_cost_weight = onboard_cost_weight
         self._rng = rng or random.Random(0x5EED)
 
-    def select(self, workers, overlaps, request_blocks, active):
+    def select(self, workers, overlaps, request_blocks, active,
+               tier_overlaps=None):
+        tier_overlaps = tier_overlaps or {}
         costs: Dict[int, float] = {}
+        eff: Dict[int, float] = {}
         for wid, st in workers.items():
             overlap = overlaps.get(wid, 0)
+            tier = tier_overlaps.get(wid, 0)
+            effective = max(overlap, tier)
+            onboard = max(0, tier - overlap)
+            eff[wid] = effective
             pending_prefill, resident_decode = active.load(wid)
-            prefill = (request_blocks - overlap) + pending_prefill
+            prefill = ((request_blocks - effective)
+                       + self.onboard_cost_weight * onboard
+                       + pending_prefill)
             decode = resident_decode + request_blocks
             # worker-published load joins the estimate: pool-wide usage
             # scales the decode pressure (full workers get costlier)
@@ -86,10 +112,11 @@ class KvWorkerSelector:
         if not costs:
             raise RuntimeError("no workers to select from")
         if self.temperature <= 0:
-            # deterministic: min cost, ties → highest overlap then lowest id
+            # deterministic: min cost, ties → highest effective overlap
+            # (device beats tier at equal depth via cost) then lowest id
             wid = min(
                 costs,
-                key=lambda w: (costs[w], -overlaps.get(w, 0), w),
+                key=lambda w: (costs[w], -eff.get(w, 0), w),
             )
         else:
             wids = list(costs)
@@ -105,4 +132,9 @@ class KvWorkerSelector:
                 if r <= acc:
                     wid = w
                     break
-        return SchedulingDecision(wid, overlaps.get(wid, 0), costs)
+        return SchedulingDecision(
+            wid, overlaps.get(wid, 0), costs,
+            tier_overlap_blocks=max(
+                0, tier_overlaps.get(wid, 0) - overlaps.get(wid, 0)
+            ),
+        )

@@ -154,7 +154,8 @@ class ServedEndpoint:
     async def deregister(self) -> None:
         """Remove from discovery (stop receiving new requests)."""
         # stop any attached publishers / data-plane servers first
-        for attr in ("kv_publisher", "metrics_publisher", "transfer_source"):
+        for attr in ("kv_publisher", "metrics_publisher", "transfer_source",
+                     "tier_summary_publisher"):
             svc = getattr(self, attr, None)
             for one in (svc if isinstance(svc, list) else [svc]):
                 if one is not None:

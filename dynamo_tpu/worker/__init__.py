@@ -225,6 +225,21 @@ async def serve_engine(
         ).start()
         served.kv_publisher = kv_pub
         served.metrics_publisher = metrics_pub
+    # KVBM fleet-wide prefix reuse: a worker with KV tiers attached also
+    # publishes its host/disk tier summary (lease-scoped) so routers can
+    # score overlap against blocks that left this worker's device cache
+    tiered_src = engine
+    while (getattr(tiered_src, "tiered", None) is None
+           and hasattr(tiered_src, "engine")):
+        tiered_src = tiered_src.engine  # unwrap disagg/encode handlers
+    if publish_kv_events and getattr(tiered_src, "tiered", None) is not None:
+        from ..kvbm.summary import TierSummaryPublisher
+        from ..router.worker_key import pack_worker
+
+        served.tier_summary_publisher = TierSummaryPublisher(
+            runtime, tiered_src.tiered, namespace, component,
+            worker_id=pack_worker(wid, 0),
+        ).start()
     ranks = engine.dp_ranks if isinstance(engine, DpRankEngine) else 1
     inner = engine.engines[0] if isinstance(engine, DpRankEngine) else engine
     # unwrap handler/offload wrappers (DisaggDecodeHandler, EncodeOffload

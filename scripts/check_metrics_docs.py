@@ -77,8 +77,18 @@ def representative_engine_stats() -> dict:
     # KVBM tiers (engine.metrics() with a connector attached)
     stats["kvbm_host_blocks"] = 0
     stats["kvbm_pending_offloads"] = 0
-    stats["kvbm_onboarded_blocks_total"] = 0
+    stats["kvbm_inflight_offloads"] = 0
     stats["kvbm_disk_blocks"] = 0
+    stats["kvbm_offload_total"] = 0
+    stats["kvbm_onboard_total"] = 0
+    stats["kvbm_evict_total"] = 0
+    stats["kvbm_host_hits_total"] = 0
+    stats["kvbm_host_misses_total"] = 0
+    stats["kvbm_disk_hits_total"] = 0
+    stats["kvbm_disk_misses_total"] = 0
+    stats["kvbm_host_bytes"] = 0
+    stats["kvbm_host_capacity_bytes"] = 0
+    stats["kvbm_disk_bytes"] = 0
     # DisaggDecodeHandler.metrics() riders
     stats["kv_transfer_count"] = 0
     stats["kv_transfer_ms_total"] = 0.0

@@ -85,6 +85,20 @@ async def test_scenario_telemetry_staleness(tmp_path):
 
 
 @pytest.mark.timeout(240)
+async def test_scenario_kvbm_eviction_race(tmp_path):
+    """Concurrent KVBM offload/onboard/evict under load on small
+    device+host tiers sharing one disk root, a writer SIGKILLed
+    mid-offload, and planted torn-block debris on a real prompt hash:
+    zero client-visible errors, streams identical to the no-tier oracle
+    (tier-onboarded blocks re-verify against recompute), corruption
+    never survives a read."""
+    result = await _run("kvbm_eviction_race", tmp_path)
+    assert result.telemetry.get("a_offloaded", 0) > 0
+    assert result.telemetry.get("b_onboarded", 0) > 0
+    assert result.telemetry.get("disk_blocks", 0) > 0
+
+
+@pytest.mark.timeout(240)
 async def test_scenario_wedged_engine_eviction(tmp_path):
     """A wedged engine (alive process, dead request path) is caught only
     by the health check, publishes unhealthy, self-evicts; streams

@@ -353,3 +353,107 @@ async def test_busy_shed_returns_503_through_http():
         await front_rt.shutdown(graceful=False)
         await rt.shutdown(graceful=False)
         await control.stop()
+
+
+# -- KVBM tier summaries: global (host/disk-tier) cache awareness ------------ #
+
+
+def test_selector_tier_overlap_prefers_host_tier_worker():
+    """ISSUE 8 acceptance: the overlap score must prefer a worker whose
+    HOST-tier cache holds the prefix over a cold worker — and an
+    equal-depth device run must still beat a tier run (onboard cost)."""
+    sel = KvWorkerSelector()
+    workers = {1: WorkerState(1), 2: WorkerState(2)}
+    active = ActiveSequences()
+    # no device overlap anywhere; worker 1's host tier holds 6 of 8
+    d = sel.select(workers, {}, 8, active, tier_overlaps={1: 6})
+    assert d.worker_id == 1
+    assert d.tier_overlap_blocks == 6 and d.overlap_blocks == 0
+    # equal-depth device residency beats tier residency
+    d = sel.select(workers, {2: 6}, 8, active, tier_overlaps={1: 6})
+    assert d.worker_id == 2 and d.tier_overlap_blocks == 0
+    # a much deeper tier run beats a shallow device run
+    d = sel.select(workers, {2: 2}, 8, active, tier_overlaps={1: 7})
+    assert d.worker_id == 1 and d.tier_overlap_blocks == 7
+
+
+def test_router_tier_summary_replace_and_drop():
+    """Tier-summary semantics on the router's index: a put REPLACES the
+    worker's prior view (LRU evictions disappear), and lease loss drops
+    the worker entirely — stale tier data must never route a request at
+    an evaporated cache."""
+    router = KvRouter(None, "dynamo", "backend", None)
+    h = compute_block_hash_for_seq(list(range(64)), 16)  # 4 blocks
+    router._apply_summary(5, {"host": h[:3], "disk": h[3:]})
+    assert router.tier_index.find_matches(h) == {5: 4}
+    router._apply_summary(5, {"host": h[:2], "disk": []})
+    assert router.tier_index.find_matches(h) == {5: 2}  # replaced, not merged
+    router.tier_index.remove_worker(5)  # what the delete/forget path runs
+    assert router.tier_index.find_matches(h) == {}
+
+
+async def test_tier_summary_routes_to_host_tier_worker_and_drops_on_lease_loss():
+    """End to end over the control plane: a published tier summary pulls
+    the next warm-prefix request to that worker; deleting the summary key
+    (what lease expiry does) removes it from the router's global index."""
+    from dynamo_tpu.kvbm.summary import summary_key
+    from dynamo_tpu.router.worker_key import pack_worker
+    from dynamo_tpu.runtime.transport.wire import pack
+
+    stack = await start_fleet(2)
+    control, runtimes, engines, front, client, router = stack
+    try:
+        instances = sorted(s.instance_id for s in client.instances())
+        target = instances[0]
+        pw = pack_worker(target, 0)
+        prompt = list(range(100, 196))  # 6 blocks
+        hashes = compute_block_hash_for_seq(prompt, 16)
+        key = summary_key("dynamo", "backend", pw)
+        await runtimes[0].control.put(key, pack({
+            "worker_id": pw, "seq": 1, "host": hashes, "disk": [],
+        }))
+        deadline = asyncio.get_running_loop().time() + 5
+        while not router.tier_index.find_matches(hashes):
+            assert asyncio.get_running_loop().time() < deadline, "no summary"
+            await asyncio.sleep(0.05)
+        # warm-prefix request → the host-tier holder wins over cold peers
+        chosen = await router.choose(req(prompt, rid="t1"))
+        assert unpack_worker(chosen)[0] == target
+        router.mark_finished("t1")
+        # lease loss (modeled by the key's deletion) → dropped immediately
+        await runtimes[0].control.delete(key)
+        deadline = asyncio.get_running_loop().time() + 5
+        while router.tier_index.find_matches(hashes):
+            assert asyncio.get_running_loop().time() < deadline, "not dropped"
+            await asyncio.sleep(0.05)
+    finally:
+        await stop_fleet(*stack)
+
+
+async def test_tier_summary_publisher_dedups_unchanged():
+    """The worker-side publisher writes lease-scoped and skips rewriting
+    an unchanged multi-thousand-hash summary every tick."""
+    import numpy as np
+
+    from dynamo_tpu.kvbm import HostBlockPool, TierSummaryPublisher, TieredKvCache
+    from dynamo_tpu.runtime.transport.wire import unpack as _unpack
+
+    control = await ControlPlaneServer().start()
+    rt = await DistributedRuntime.connect(control.address)
+    try:
+        tiered = TieredKvCache(HostBlockPool(capacity_bytes=1 << 20))
+        pub = TierSummaryPublisher(rt, tiered, "dynamo", "backend",
+                                   worker_id=77)
+        k = np.zeros((1, 2, 1, 2), np.float32)
+        tiered.host.put(0xAB, None, k, k)
+        p1 = await pub.publish_once()
+        assert p1 is not None and p1["host"] == [0xAB]
+        raw = await rt.control.get(pub.key)
+        assert _unpack(raw)["host"] == [0xAB]
+        assert await pub.publish_once() is None  # unchanged → no rewrite
+        tiered.host.put(0xCD, None, k, k)
+        p3 = await pub.publish_once()
+        assert p3 is not None and p3["seq"] == 2 and set(p3["host"]) == {0xCD, 0xAB}
+    finally:
+        await rt.shutdown(graceful=False)
+        await control.stop()

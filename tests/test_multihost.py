@@ -499,7 +499,7 @@ if rank == 0:
         # offload pump exports them (kv_export plans), then a cache
         # clear forces onboarding (kv_import_fetch plans)
         deadline = asyncio.get_running_loop().time() + 10
-        while tiered.pending_offloads or len(tiered.host) == 0:
+        while tiered.offload_backlog or len(tiered.host) == 0:
             assert asyncio.get_running_loop().time() < deadline, "no offload"
             await asyncio.sleep(0.05)
         mh.clear_kv_blocks()

@@ -202,7 +202,7 @@ async def test_pp_kvbm_tiering_offload_onboard(setup, tmp_path):
         prompt = list(range(1, 41))  # 5 full pages
         want = await collect(eng, req(prompt, max_tokens=4))
         deadline = asyncio.get_running_loop().time() + 20
-        while tiered.pending_offloads or len(tiered.host) == 0:
+        while tiered.offload_backlog or len(tiered.host) == 0:
             assert asyncio.get_running_loop().time() < deadline, "no offload"
             await asyncio.sleep(0.05)
         assert len(tiered.host) >= 5
