@@ -1,0 +1,32 @@
+"""Concurrency contract checking (ISSUE 10).
+
+The reference system leans on Rust's compiler to keep its distributed
+glue race-free; this JAX reproduction reimplements the same
+step-thread / drain-thread / event-loop architecture in Python, where
+nothing checks those invariants.  This package turns the repo's
+implicit concurrency contracts into machine-checked ones:
+
+- ``contracts``: the thread-affinity registry (``@affine("step")`` …)
+  and the ``make_lock``/``make_rlock``/``make_condition`` factories —
+  zero-cost no-ops in production, checked under ``DYN_TPU_CHECKS=1``
+  (affinity asserts) / ``DYN_TPU_LOCKCHECK=1`` (runtime lock-order +
+  hold-time + affinity recording);
+- ``lint``: the AST-based static pass enforcing the guarded-by /
+  blocking-call / thread-hygiene / exception-handling rules
+  (CLI: ``scripts/lint_concurrency.py``);
+- ``lockcheck``: the dynamic detector behind the checked lock
+  factories — lock-acquisition-order graph with cycle reporting,
+  per-lock hold-time p99, blocking-call-while-holding events.
+
+The thread model and lock inventory these tools enforce are documented
+in docs/concurrency.md.
+"""
+
+from .contracts import (  # noqa: F401
+    affine,
+    current_role,
+    make_condition,
+    make_lock,
+    make_rlock,
+    register_thread_role,
+)

@@ -1,0 +1,239 @@
+"""Thread-affinity contracts + checked lock factories.
+
+The repo's thread model (docs/concurrency.md) gives every system thread
+a ROLE:
+
+- ``step``  — the engine's dedicated device-step executor
+  (``jax-engine-step``): device dispatch, scheduler-state reads during
+  a running step, offload gather dispatch;
+- ``drain`` — the blocking device→host side (``jax-engine-drain`` for
+  the continuous-decode double buffer, ``kvbm-offload`` for the KVBM
+  drain): ``device_get`` + host-tier inserts live here so they never
+  stretch the decode host gap;
+- ``loop``  — any thread currently running an asyncio event loop:
+  transport handlers, scheduler planning between steps
+  (``_plan_step``), admission-time onboarding, SLO accounting.
+
+``@affine(*roles)`` declares the roles a function may run under.  In
+production it is a ZERO-COST no-op: the decorator returns the function
+object unchanged (decided once at decoration time), so the decode hot
+path pays nothing.  Under ``DYN_TPU_CHECKS=1`` a violation raises
+``AffinityError`` at the call site; under ``DYN_TPU_LOCKCHECK=1``
+violations are RECORDED (``affinity_violations()``) so a full test run
+completes and reports, instead of dying on the first mismatch.
+
+Threads the role map doesn't know (pytest's main thread driving a
+component synchronously, user threads) have no role and are exempt:
+the contract constrains the system's own threads from wandering across
+roles, not test harnesses from calling things directly.
+
+Checked locks: modules create their locks through ``make_lock(name)``
+(`make_rlock`/`make_condition` likewise).  Production gets a plain
+``threading.Lock`` back — zero wrapper cost.  Under
+``DYN_TPU_LOCKCHECK=1`` the factory returns a ``lockcheck.TrackedLock``
+that feeds the global acquisition-order graph, hold-time stats, and
+the held-lock dump the wedge watchdog prints.
+
+The ``# guarded-by: <lock>`` comment convention (enforced statically by
+``analysis.lint``) lives next to the attribute's assignment::
+
+    self._pending = []   # guarded-by: _lock
+
+meaning every read/write of ``self._pending`` outside ``__init__`` must
+sit inside ``with self._lock:`` within the class.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+import os
+import threading
+from typing import Callable, Dict, List, Optional
+
+__all__ = [
+    "AffinityError",
+    "affine",
+    "affinity_violations",
+    "checks_mode",
+    "clear_affinity_violations",
+    "current_role",
+    "make_condition",
+    "make_lock",
+    "make_rlock",
+    "register_thread_role",
+]
+
+# thread-name prefix → role.  Executors name their threads
+# "<prefix>_<n>", so prefix matching covers them.
+THREAD_NAME_ROLES: Dict[str, str] = {
+    "jax-engine-step": "step",
+    "jax-engine-drain": "drain",
+    "kvbm-offload": "drain",
+}
+
+# mode decided ONCE at import: "off" (production), "raise"
+# (DYN_TPU_CHECKS=1 — fail fast at the violating call), or "record"
+# (DYN_TPU_LOCKCHECK=1 — collect, report at session end).  DYN_TPU_CHECKS
+# wins when both are set.
+def _mode_from_env() -> str:
+    if os.environ.get("DYN_TPU_CHECKS", "") not in ("", "0"):
+        return "raise"
+    if os.environ.get("DYN_TPU_LOCKCHECK", "") not in ("", "0"):
+        return "record"
+    return "off"
+
+
+_MODE = _mode_from_env()
+
+_tls = threading.local()
+
+_VIOLATIONS_LOCK = threading.Lock()
+_MAX_VIOLATIONS = 1024
+# deduped {(func, expected, actual): count} — guarded-by: _VIOLATIONS_LOCK
+_violations: Dict[tuple, dict] = {}
+
+
+class AffinityError(AssertionError):
+    """A function ran on a thread whose role its @affine contract
+    excludes."""
+
+
+def checks_mode() -> str:
+    """"off" | "raise" | "record" — what the decorators compiled to."""
+    return _MODE
+
+
+def register_thread_role(role: str) -> None:
+    """Explicitly tag the CURRENT thread with a role (overrides the
+    name-prefix map) — for threads whose names the map doesn't know."""
+    _tls.role = role
+
+
+def current_role() -> Optional[str]:
+    """The current thread's role, or None for unmanaged threads.
+
+    Resolution order: explicit ``register_thread_role`` tag → thread
+    name prefix → "loop" when an asyncio event loop is running in this
+    thread → None."""
+    role = getattr(_tls, "role", None)
+    if role is not None:
+        return role
+    name = threading.current_thread().name
+    for prefix, r in THREAD_NAME_ROLES.items():
+        if name.startswith(prefix):
+            return r
+    try:
+        asyncio.get_running_loop()
+        return "loop"
+    except RuntimeError:
+        return None
+
+
+def _record_violation(func_name: str, expected: tuple, actual: str) -> None:
+    key = (func_name, expected, actual)
+    with _VIOLATIONS_LOCK:
+        v = _violations.get(key)
+        if v is not None:
+            v["count"] += 1
+            return
+        if len(_violations) >= _MAX_VIOLATIONS:
+            return
+        _violations[key] = {
+            "func": func_name,
+            "expected": list(expected),
+            "actual": actual,
+            "thread": threading.current_thread().name,
+            "count": 1,
+        }
+
+
+def affinity_violations() -> List[dict]:
+    """Recorded violations (record mode) — what the lockcheck session
+    report asserts empty."""
+    with _VIOLATIONS_LOCK:
+        return [dict(v) for v in _violations.values()]
+
+
+def clear_affinity_violations() -> None:
+    with _VIOLATIONS_LOCK:
+        _violations.clear()
+
+
+def _check(func_name: str, roles: tuple) -> None:
+    actual = current_role()
+    if actual is None or actual in roles:
+        return
+    if _MODE == "raise":
+        raise AffinityError(
+            f"{func_name} is @affine{roles} but ran on a "
+            f"{actual!r}-role thread "
+            f"({threading.current_thread().name})"
+        )
+    _record_violation(func_name, roles, actual)
+
+
+def affine(*roles: str) -> Callable:
+    """Declare the thread roles a function may run under.
+
+    Zero-cost when checks are off: the decorator returns the function
+    unchanged.  Checked builds wrap with a role assertion (async
+    functions are checked inside the coroutine, where it actually
+    runs)."""
+    if not roles:
+        raise ValueError("affine() needs at least one role")
+
+    def deco(fn):
+        if _MODE == "off":
+            return fn
+        qual = getattr(fn, "__qualname__", getattr(fn, "__name__", str(fn)))
+        if asyncio.iscoroutinefunction(fn):
+            @functools.wraps(fn)
+            async def awrapper(*args, **kwargs):
+                _check(qual, roles)
+                return await fn(*args, **kwargs)
+            awrapper.__affine_roles__ = roles
+            return awrapper
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            _check(qual, roles)
+            return fn(*args, **kwargs)
+        wrapper.__affine_roles__ = roles
+        return wrapper
+
+    return deco
+
+
+# -- checked lock factories --------------------------------------------------- #
+
+def make_lock(name: str) -> "threading.Lock":
+    """A named lock: plain ``threading.Lock`` in production,
+    ``lockcheck.TrackedLock`` under DYN_TPU_LOCKCHECK=1.  ``name`` is
+    the lock CLASS for order tracking (lockdep-style): all instances
+    created under one name share a node in the acquisition-order
+    graph, so an ABBA inversion between two *classes* of lock is
+    reported even when the two runs touched different instances."""
+    if _MODE != "record":
+        return threading.Lock()
+    from . import lockcheck
+
+    return lockcheck.TrackedLock(name)
+
+
+def make_rlock(name: str):
+    if _MODE != "record":
+        return threading.RLock()
+    from . import lockcheck
+
+    return lockcheck.TrackedLock(name, reentrant=True)
+
+
+def make_condition(name: str):
+    """A Condition over a tracked lock (checked builds) or a plain
+    ``threading.Condition``."""
+    if _MODE != "record":
+        return threading.Condition()
+    from . import lockcheck
+
+    return threading.Condition(lockcheck.TrackedLock(name, reentrant=True))

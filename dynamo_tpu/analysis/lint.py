@@ -1,0 +1,537 @@
+"""AST-based concurrency lint for the dynamo_tpu package.
+
+Static enforcement of the contracts in ``analysis.contracts`` /
+docs/concurrency.md.  Findings are ERRORS — the tier-1 gate
+(tests/test_analysis.py, CLI ``scripts/lint_concurrency.py``) requires
+a clean run over ``dynamo_tpu/``.
+
+Rules
+-----
+
+``guarded-by``
+    An attribute annotated ``self._x = ...  # guarded-by: _lock`` may
+    only be read or written inside ``with self._lock:`` within its
+    class.  ``__init__`` is exempt (no concurrency before the object
+    escapes), as are methods named ``*_locked`` (the documented
+    convention for helpers whose CALLER holds the lock — the caller's
+    with-block is where the rule is checked).
+
+``blocking-under-lock``
+    No blocking call inside a held-lock region: ``jax.device_get`` /
+    ``block_until_ready``, ``time.sleep``, file I/O (``open``, the
+    mutating/stat-ing ``os.*`` calls, ``np.savez``/``np.load``), socket
+    I/O (``sendall``/``recv``/``accept``), ``urlopen``, ``.result()``,
+    ``.join()``.  One level of intra-module call resolution: calling a
+    same-module function/method that directly contains a blocking call
+    is also a finding.
+
+``blocking-in-async``
+    The same blocking set inside ``async def`` bodies (awaited calls
+    excluded) — a blocking call on the event loop stalls every
+    connection and the engine pump.  Same one-level call resolution.
+
+``thread-hygiene``
+    Every ``threading.Thread(...)`` carries an explicit ``name=`` and
+    an explicit ``daemon=`` — anonymous threads make wedge stack dumps
+    unreadable, and implicit ``daemon`` inherits from the spawner.
+
+``bare-except`` / ``swallowed-exception``
+    No bare ``except:`` anywhere; no broad handler (``Exception`` /
+    ``BaseException`` / bare) whose body is only ``pass`` — a thread
+    run loop that swallows its own death leaves a silently-missing
+    thread, the hardest wedge to diagnose.
+
+Allowlist: a finding is suppressed by a justification comment on the
+flagged line or the line above::
+
+    # lint: allow(blocking-in-async): asyncio.Task.result() after wait
+    out = get.result()
+
+The justification text is mandatory — ``allow(rule):`` with nothing
+after the colon does not parse and suppresses nothing.  ``lint_paths``
+returns the used allowlist entries alongside the findings so the CLI
+can print what is being tolerated and why.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+__all__ = [
+    "Finding",
+    "RULES",
+    "iter_python_files",
+    "lint_paths",
+    "lint_source",
+]
+
+RULES = (
+    "guarded-by",
+    "blocking-under-lock",
+    "blocking-in-async",
+    "thread-hygiene",
+    "bare-except",
+    "swallowed-exception",
+)
+
+
+@dataclasses.dataclass
+class Finding:
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+@dataclasses.dataclass
+class AllowEntry:
+    path: str
+    line: int
+    rule: str
+    reason: str
+
+
+_ALLOW_RE = re.compile(r"#\s*lint:\s*allow\(([a-z-]+)\)\s*:\s*(\S.*)")
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
+
+# os.* calls that hit the filesystem (attribute access on `os`)
+_OS_FS_CALLS = {
+    "replace", "remove", "rename", "unlink", "stat", "makedirs",
+    "mkdir", "listdir", "scandir", "rmdir", "fsync",
+}
+# attribute calls that block regardless of receiver
+_BLOCKING_ATTRS = {
+    "device_get": "jax.device_get",
+    "block_until_ready": "block_until_ready",
+    "sendall": "socket sendall",
+    "recv": "socket recv",
+    "recvfrom": "socket recvfrom",
+    "accept": "socket accept",
+    "urlopen": "urlopen",
+    "savez": "np.savez (file write)",
+    "savez_compressed": "np.savez_compressed (file write)",
+    "getsize": "os.path.getsize",
+}
+_NUMERIC = (int, float)
+
+
+def _attr_chain(node: ast.AST) -> str:
+    """Dotted text of a Name/Attribute chain ('' when not a chain)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _blocking_desc(call: ast.Call) -> Optional[str]:
+    """Why this Call blocks, or None."""
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        if fn.id == "open":
+            return "open() (file I/O)"
+        if fn.id == "urlopen":
+            return "urlopen"
+        return None
+    if not isinstance(fn, ast.Attribute):
+        return None
+    attr = fn.attr
+    recv = _attr_chain(fn.value)
+    if attr == "sleep" and recv in ("time", "_time"):
+        return "time.sleep"
+    if attr == "load" and recv in ("np", "numpy"):
+        return "np.load (file read)"
+    if attr in _OS_FS_CALLS and recv in ("os", "_os"):
+        return f"os.{attr} (file I/O)"
+    if attr == "result":
+        return ".result() (future wait)"
+    if attr == "join":
+        # str.join / os.path.join false-positive filters: skip
+        # os.path receivers and single non-numeric-positional calls
+        # (an iterable argument means string join, a bare timeout
+        # number means thread join)
+        if recv.endswith("path"):
+            return None
+        if (
+            len(call.args) == 1
+            and not call.keywords
+            and not (
+                isinstance(call.args[0], ast.Constant)
+                and isinstance(call.args[0].value, _NUMERIC)
+            )
+        ):
+            return None
+        if isinstance(fn.value, ast.Constant):
+            return None
+        return ".join() (thread wait)"
+    if attr in _BLOCKING_ATTRS:
+        if attr == "getsize" and not recv.endswith("path"):
+            return None
+        return _BLOCKING_ATTRS[attr]
+    return None
+
+
+def _is_lock_ctor(node: ast.AST) -> bool:
+    """Does this expression construct a lock/rlock/condition?"""
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    name = fn.id if isinstance(fn, ast.Name) else (
+        fn.attr if isinstance(fn, ast.Attribute) else "")
+    return name.lstrip("_") in (
+        "Lock", "RLock", "Condition",
+        "make_lock", "make_rlock", "make_condition",
+    )
+
+
+def _allow_map(src: str) -> Dict[int, Dict[str, str]]:
+    """line → {rule: reason}; an allow comment covers its own line and
+    the next one (trailing comment, or comment-only line above)."""
+    out: Dict[int, Dict[str, str]] = {}
+    for i, line in enumerate(src.splitlines(), start=1):
+        m = _ALLOW_RE.search(line)
+        if m:
+            rule, reason = m.group(1), m.group(2).strip()
+            for ln in (i, i + 1):
+                out.setdefault(ln, {})[rule] = reason
+    return out
+
+
+class _ModuleIndex:
+    """Per-module tables the checking pass consumes: lock names,
+    guarded attributes, and one-level blocking summaries."""
+
+    def __init__(self, tree: ast.Module, src_lines: List[str]):
+        self.module_locks: Set[str] = set()
+        # class → {attr: lock_name}
+        self.guarded: Dict[str, Dict[str, str]] = {}
+        # class → lock attr names
+        self.class_locks: Dict[str, Set[str]] = {}
+        # (class|'', func) → (desc, lineno) of first direct blocking call
+        self.blocking_fns: Dict[Tuple[str, str], Tuple[str, int]] = {}
+        self._src_lines = src_lines
+        self._index(tree)
+
+    def _guard_comment(self, node: ast.stmt) -> Optional[str]:
+        for ln in range(node.lineno, (node.end_lineno or node.lineno) + 1):
+            if ln <= len(self._src_lines):
+                m = _GUARDED_RE.search(self._src_lines[ln - 1])
+                if m:
+                    return m.group(1)
+        # or a comment-only line directly above the assignment
+        if node.lineno >= 2:
+            above = self._src_lines[node.lineno - 2].strip()
+            if above.startswith("#"):
+                m = _GUARDED_RE.search(above)
+                if m:
+                    return m.group(1)
+        return None
+
+    def _index(self, tree: ast.Module) -> None:
+        for stmt in tree.body:
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                           else [stmt.target])
+                if stmt.value is not None and _is_lock_ctor(stmt.value):
+                    for t in targets:
+                        if isinstance(t, ast.Name):
+                            self.module_locks.add(t.id)
+            elif isinstance(stmt, ast.ClassDef):
+                self._index_class(stmt)
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._summarize(("", stmt.name), stmt)
+
+    def _index_class(self, cls: ast.ClassDef) -> None:
+        guarded: Dict[str, str] = {}
+        locks: Set[str] = set()
+        for fn in cls.body:
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            self._summarize((cls.name, fn.name), fn)
+            for stmt in ast.walk(fn):
+                if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                    continue
+                targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                           else [stmt.target])
+                for t in targets:
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        if stmt.value is not None and _is_lock_ctor(stmt.value):
+                            locks.add(t.attr)
+                        g = self._guard_comment(stmt)
+                        if g:
+                            guarded[t.attr] = g
+        # every lock a guard names is a lock even if constructed
+        # indirectly (e.g. passed into __init__)
+        locks.update(guarded.values())
+        self.guarded[cls.name] = guarded
+        self.class_locks[cls.name] = locks
+
+    def _summarize(self, key: Tuple[str, str], fn: ast.AST) -> None:
+        # async targets don't run their body at call time — the coroutine
+        # executes on the loop, where blocking-in-async checks it directly
+        if isinstance(fn, ast.AsyncFunctionDef):
+            return
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                desc = _blocking_desc(node)
+                if desc:
+                    self.blocking_fns[key] = (desc, node.lineno)
+                    return
+
+
+class _Checker(ast.NodeVisitor):
+    """Walks one function body with lock/async context, emitting
+    findings."""
+
+    def __init__(self, linter: "_Linter", class_name: str,
+                 func_name: str, is_async: bool):
+        self.linter = linter
+        self.idx = linter.idx
+        self.class_name = class_name
+        self.func_name = func_name
+        self.is_async = is_async
+        self.lock_stack: List[str] = []
+        self._awaited: Set[int] = set()
+        self.guard_exempt = (
+            func_name == "__init__" or func_name.endswith("_locked")
+        )
+
+    # -- context tracking ----------------------------------------------------- #
+
+    def _lock_name_of(self, expr: ast.AST) -> Optional[str]:
+        text = _attr_chain(expr)
+        if not text:
+            return None
+        if text in self.idx.module_locks:
+            return text
+        if text.startswith("self."):
+            attr = text[5:]
+            if attr in self.idx.class_locks.get(self.class_name, ()):
+                return attr
+        return None
+
+    def visit_With(self, node: ast.With) -> None:
+        names = [n for n in
+                 (self._lock_name_of(i.context_expr) for i in node.items)
+                 if n]
+        self.lock_stack.extend(names)
+        for stmt in node.body:
+            self.visit(stmt)
+        if names:
+            del self.lock_stack[-len(names):]
+        for i in node.items:
+            self.visit(i.context_expr)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        # nested def: runs later, not under this lock / in this coroutine
+        self.linter.check_function(self.class_name, node, is_async=False)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self.linter.check_function(self.class_name, node, is_async=True)
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        pass  # deferred execution, same reasoning as nested defs
+
+    def visit_Await(self, node: ast.Await) -> None:
+        if isinstance(node.value, ast.Call):
+            self._awaited.add(id(node.value))
+        self.generic_visit(node)
+
+    # -- rules ----------------------------------------------------------------- #
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if (not self.guard_exempt
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            lock = self.idx.guarded.get(self.class_name, {}).get(node.attr)
+            if lock and lock not in self.lock_stack:
+                self.linter.emit(
+                    "guarded-by", node.lineno,
+                    f"{self.class_name}.{node.attr} is guarded by "
+                    f"'{lock}' but accessed outside 'with self.{lock}:' "
+                    f"(in {self.func_name})",
+                )
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        self._check_thread_ctor(node)
+        desc = _blocking_desc(node)
+        awaited = id(node) in self._awaited
+        if desc:
+            self._flag_blocking(node.lineno, desc, awaited)
+        elif not awaited:
+            self._check_call_graph(node)
+        self.generic_visit(node)
+
+    def _flag_blocking(self, line: int, desc: str, awaited: bool,
+                       via: str = "") -> None:
+        where = f" (via {via})" if via else ""
+        if self.lock_stack:
+            self.linter.emit(
+                "blocking-under-lock", line,
+                f"blocking call {desc}{where} while holding "
+                f"'{self.lock_stack[-1]}' (in {self.func_name})",
+            )
+        if self.is_async and not awaited:
+            self.linter.emit(
+                "blocking-in-async", line,
+                f"blocking call {desc}{where} on the event loop "
+                f"(in async {self.func_name})",
+            )
+
+    def _check_call_graph(self, node: ast.Call) -> None:
+        """One-level resolution: self.m() / m() whose same-module target
+        directly blocks."""
+        if not (self.lock_stack or self.is_async):
+            return
+        fn = node.func
+        key = None
+        if (isinstance(fn, ast.Attribute)
+                and isinstance(fn.value, ast.Name) and fn.value.id == "self"):
+            key = (self.class_name, fn.attr)
+        elif isinstance(fn, ast.Name):
+            key = ("", fn.id)
+        if key is None:
+            return
+        hit = self.idx.blocking_fns.get(key)
+        if hit:
+            desc, at = hit
+            name = f"{key[0]}.{key[1]}" if key[0] else key[1]
+            self._flag_blocking(
+                node.lineno, desc, awaited=False,
+                via=f"{name}() at line {at}",
+            )
+
+    def _check_thread_ctor(self, node: ast.Call) -> None:
+        fn = node.func
+        name = fn.id if isinstance(fn, ast.Name) else (
+            fn.attr if isinstance(fn, ast.Attribute) else "")
+        if name != "Thread":
+            return
+        if isinstance(fn, ast.Attribute):
+            recv = _attr_chain(fn.value)
+            if recv not in ("threading", "_threading"):
+                return
+        kw = {k.arg for k in node.keywords}
+        missing = [k for k in ("name", "daemon") if k not in kw]
+        if missing:
+            self.linter.emit(
+                "thread-hygiene", node.lineno,
+                f"threading.Thread without explicit {'/'.join(missing)}= "
+                f"(in {self.func_name})",
+            )
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        broad = node.type is None or (
+            isinstance(node.type, ast.Name)
+            and node.type.id in ("Exception", "BaseException")
+        )
+        only_pass = all(isinstance(s, ast.Pass) for s in node.body)
+        if node.type is None:
+            self.linter.emit(
+                "bare-except", node.lineno,
+                f"bare 'except:' (in {self.func_name})",
+            )
+        elif broad and only_pass:
+            self.linter.emit(
+                "swallowed-exception", node.lineno,
+                f"broad except with pass-only body silently swallows "
+                f"failures (in {self.func_name})",
+            )
+        self.generic_visit(node)
+
+
+class _Linter:
+    def __init__(self, src: str, path: str):
+        self.path = path
+        self.findings: List[Finding] = []
+        self.used_allows: List[AllowEntry] = []
+        self._allow = _allow_map(src)
+        self._lines = src.splitlines()
+        self.tree = ast.parse(src, filename=path)
+        self.idx = _ModuleIndex(self.tree, self._lines)
+
+    def emit(self, rule: str, line: int, message: str) -> None:
+        reason = self._allow.get(line, {}).get(rule)
+        if reason is not None:
+            self.used_allows.append(AllowEntry(self.path, line, rule, reason))
+            return
+        self.findings.append(Finding(self.path, line, rule, message))
+
+    def check_function(self, class_name: str, fn: ast.AST,
+                       is_async: bool) -> None:
+        checker = _Checker(self, class_name, fn.name, is_async)
+        for stmt in fn.body:
+            checker.visit(stmt)
+
+    def run(self) -> None:
+        for stmt in self.tree.body:
+            self._check_stmt(stmt, class_name="")
+
+    def _check_stmt(self, stmt: ast.stmt, class_name: str) -> None:
+        if isinstance(stmt, ast.FunctionDef):
+            self.check_function(class_name, stmt, is_async=False)
+        elif isinstance(stmt, ast.AsyncFunctionDef):
+            self.check_function(class_name, stmt, is_async=True)
+        elif isinstance(stmt, ast.ClassDef):
+            for s in stmt.body:
+                self._check_stmt(s, class_name=stmt.name)
+        else:
+            # module-level statements (import guards, registrations)
+            checker = _Checker(self, class_name, "<module>", is_async=False)
+            checker.visit(stmt)
+
+
+def lint_source(src: str, path: str = "<src>"):
+    """Lint one module's source.  Returns (findings, used_allowlist)."""
+    linter = _Linter(src, path)
+    linter.run()
+    return linter.findings, linter.used_allows
+
+
+def iter_python_files(root: str) -> List[str]:
+    out = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                out.append(os.path.join(dirpath, fn))
+    return sorted(out)
+
+
+def lint_paths(paths):
+    """Lint files and/or package directories.  Returns
+    (findings, used_allowlist) across all of them."""
+    findings: List[Finding] = []
+    allows: List[AllowEntry] = []
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            files.extend(iter_python_files(p))
+        else:
+            files.append(p)
+    for f in files:
+        with open(f) as fh:
+            src = fh.read()
+        try:
+            fnd, alw = lint_source(src, path=f)
+        except SyntaxError as e:
+            findings.append(Finding(f, e.lineno or 0, "parse",
+                                    f"syntax error: {e.msg}"))
+            continue
+        findings.extend(fnd)
+        allows.extend(alw)
+    findings.sort(key=lambda x: (x.path, x.line))
+    return findings, allows

@@ -1,0 +1,385 @@
+"""Runtime lock-order / hold-time / blocking-call detector.
+
+Enabled by ``DYN_TPU_LOCKCHECK=1``: ``contracts.make_lock`` returns a
+``TrackedLock`` instead of a plain ``threading.Lock``, and importing
+this module installs probes around the classic blocking primitives
+(``time.sleep``, ``jax.device_get``).  Everything here is OFF the
+production path — unchecked builds never construct a TrackedLock and
+never import this module.
+
+What it records (lockdep-style, by lock NAME = lock class):
+
+- the global acquisition-order graph: an edge A→B each time a thread
+  acquires a ``B``-named lock while holding an ``A``-named one.  A
+  cycle in that graph is a potential deadlock (the classic ABBA), even
+  when no run has ever actually deadlocked;
+- same-instance re-acquire on a non-reentrant lock (certain deadlock —
+  recorded as a violation *before* the thread wedges, so the wedge
+  forensics dump says why);
+- per-lock-name hold times, reported as p50/p99 + max;
+- blocking-call-while-holding events: a probed blocking primitive
+  invoked while the calling thread holds any tracked lock;
+- per-thread held-lock sets, so the test watchdog's stack dump can say
+  which locks each wedged thread was sitting on.
+
+``report()`` returns the whole picture as one JSON-able dict;
+``assert_clean()`` raises on cycles / self-deadlocks / affinity
+violations (what the tier-1 session gate under DYN_TPU_LOCKCHECK=1
+checks).  Processes that exit outside pytest (chaos scenario workers)
+write a nonclean report into ``$DYN_TPU_LOCKCHECK_DIR`` at exit so the
+parent session can collect them.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+import traceback
+from typing import Dict, List, Optional, Tuple
+
+from . import contracts
+
+__all__ = [
+    "TrackedLock",
+    "assert_clean",
+    "blocking_events",
+    "cycles",
+    "held_locks_by_thread",
+    "hold_time_stats",
+    "install_probes",
+    "report",
+    "reset",
+    "wrap_blocking",
+]
+
+# One plain (untracked!) lock guards every registry below — tracking
+# the tracker would recurse.
+_REG = threading.Lock()
+_edges: Dict[Tuple[str, str], dict] = {}     # guarded-by: _REG
+_holds: Dict[str, List[float]] = {}          # guarded-by: _REG
+_hold_counts: Dict[str, int] = {}            # guarded-by: _REG
+_blocking: List[dict] = []                   # guarded-by: _REG
+_self_deadlocks: List[dict] = []             # guarded-by: _REG
+_held_by_thread: Dict[int, List[str]] = {}   # guarded-by: _REG
+_acquired_total = 0                          # guarded-by: _REG
+
+_MAX_HOLD_SAMPLES = 8192
+_MAX_EVENTS = 256
+
+_tls = threading.local()
+
+
+def _stack(skip: int = 2, limit: int = 6) -> List[str]:
+    frames = traceback.extract_stack()[: -skip]
+    return [f"{f.filename}:{f.lineno} {f.name}" for f in frames[-limit:]]
+
+
+def _held_stack() -> list:
+    st = getattr(_tls, "held", None)
+    if st is None:
+        st = _tls.held = []
+    return st
+
+
+class TrackedLock:
+    """Drop-in for ``threading.Lock``/``RLock`` with order/hold-time
+    bookkeeping.  The fast path (no other lock held) is one thread-local
+    append + one registry update."""
+
+    def __init__(self, name: str, reentrant: bool = False):
+        self.name = name
+        self.reentrant = reentrant
+        self._lock = threading.RLock() if reentrant else threading.Lock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        held = _held_stack()
+        if held:
+            self._note_order(held, blocking)
+        args = (blocking,) if timeout == -1 else (blocking, timeout)
+        ok = self._lock.acquire(*args)
+        if ok:
+            held.append((self, time.perf_counter()))
+            self._publish_held(held)
+        return ok
+
+    def release(self) -> None:
+        held = _held_stack()
+        t_rel = time.perf_counter()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] is self:
+                _, t_acq = held.pop(i)
+                self._sample_hold(t_rel - t_acq)
+                break
+        self._publish_held(held)
+        self._lock.release()
+
+    def __enter__(self) -> "TrackedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        if self.reentrant:
+            # RLock has no locked(); try-acquire probes it
+            got = self._lock.acquire(blocking=False)
+            if got:
+                self._lock.release()
+                return False
+            return True
+        return self._lock.locked()
+
+    # -- bookkeeping ---------------------------------------------------------- #
+
+    def _note_order(self, held: list, blocking: bool) -> None:
+        global _acquired_total
+        names_seen = set()
+        ex = None
+        with _REG:
+            for lk, _ in held:
+                if lk is self and not self.reentrant and blocking:
+                    if len(_self_deadlocks) < _MAX_EVENTS:
+                        _self_deadlocks.append({
+                            "lock": self.name,
+                            "thread": threading.current_thread().name,
+                            "stack": _stack(),
+                        })
+                    continue
+                if lk.name == self.name or lk.name in names_seen:
+                    continue
+                names_seen.add(lk.name)
+                e = _edges.get((lk.name, self.name))
+                if e is None:
+                    _edges[(lk.name, self.name)] = {
+                        "count": 1,
+                        "thread": threading.current_thread().name,
+                        "stack": _stack(),
+                    }
+                else:
+                    e["count"] += 1
+        if ex is not None:
+            raise ex
+
+    def _sample_hold(self, dt: float) -> None:
+        global _acquired_total
+        with _REG:
+            _acquired_total += 1
+            samples = _holds.setdefault(self.name, [])
+            n = _hold_counts.get(self.name, 0)
+            _hold_counts[self.name] = n + 1
+            if len(samples) < _MAX_HOLD_SAMPLES:
+                samples.append(dt)
+            else:
+                # deterministic reservoir-ish overwrite keeps the tail fresh
+                samples[n % _MAX_HOLD_SAMPLES] = dt
+
+    def _publish_held(self, held: list) -> None:
+        ident = threading.current_thread().ident or 0
+        names = [lk.name for lk, _ in held]
+        with _REG:
+            if names:
+                _held_by_thread[ident] = names
+            else:
+                _held_by_thread.pop(ident, None)
+
+
+# -- blocking-call probes ------------------------------------------------------ #
+
+def wrap_blocking(fn, name: str):
+    """Wrap a blocking primitive: calling it while this thread holds any
+    tracked lock records a blocking-under-lock event."""
+    def probed(*args, **kwargs):
+        held = getattr(_tls, "held", None)
+        if held:
+            with _REG:
+                if len(_blocking) < _MAX_EVENTS:
+                    _blocking.append({
+                        "call": name,
+                        "locks": [lk.name for lk, _ in held],
+                        "thread": threading.current_thread().name,
+                        "stack": _stack(),
+                    })
+        return fn(*args, **kwargs)
+
+    probed.__lockcheck_wrapped__ = fn
+    probed.__name__ = getattr(fn, "__name__", name)
+    return probed
+
+
+_probes_installed = False
+
+
+def install_probes() -> None:
+    """Patch the classic blocking primitives with held-lock probes.
+    Idempotent; called on import when lockcheck mode is active."""
+    global _probes_installed
+    if _probes_installed:
+        return
+    _probes_installed = True
+    if not hasattr(time.sleep, "__lockcheck_wrapped__"):
+        time.sleep = wrap_blocking(time.sleep, "time.sleep")
+    try:
+        import jax
+
+        if not hasattr(jax.device_get, "__lockcheck_wrapped__"):
+            jax.device_get = wrap_blocking(jax.device_get, "jax.device_get")
+    except Exception:  # lint: allow(swallowed-exception): probing is optional; jax may be absent
+        pass
+
+
+# -- reporting ------------------------------------------------------------------ #
+
+def cycles() -> List[List[str]]:
+    """Simple cycles in the lock-order graph (each reported once, as the
+    rotation starting at its smallest node)."""
+    with _REG:
+        adj: Dict[str, set] = {}
+        for (a, b) in _edges:
+            adj.setdefault(a, set()).add(b)
+    found = set()
+    out: List[List[str]] = []
+
+    def dfs(start: str, node: str, path: List[str], seen: set) -> None:
+        for nxt in sorted(adj.get(node, ())):
+            if nxt == start:
+                cyc = path[:]
+                i = cyc.index(min(cyc))
+                key = tuple(cyc[i:] + cyc[:i])
+                if key not in found:
+                    found.add(key)
+                    out.append(list(key))
+            elif nxt not in seen and nxt > start:
+                # only explore nodes > start: every cycle is found from
+                # its smallest member exactly once
+                seen.add(nxt)
+                dfs(start, nxt, path + [nxt], seen)
+                seen.discard(nxt)
+
+    for n in sorted(adj):
+        dfs(n, n, [n], {n})
+    return out
+
+
+def hold_time_stats() -> Dict[str, dict]:
+    with _REG:
+        snap = {k: list(v) for k, v in _holds.items()}
+        counts = dict(_hold_counts)
+    out = {}
+    for name, samples in snap.items():
+        if not samples:
+            continue
+        s = sorted(samples)
+        out[name] = {
+            "acquisitions": counts.get(name, len(s)),
+            "p50_us": round(s[len(s) // 2] * 1e6, 2),
+            "p99_us": round(s[min(len(s) - 1, int(len(s) * 0.99))] * 1e6, 2),
+            "max_us": round(s[-1] * 1e6, 2),
+        }
+    return out
+
+
+def blocking_events() -> List[dict]:
+    with _REG:
+        return [dict(e) for e in _blocking]
+
+
+def held_locks_by_thread() -> Dict[str, List[str]]:
+    """thread name → held tracked-lock names (the watchdog's held-lock
+    dump).  Ident-keyed internally; resolved to names here."""
+    with _REG:
+        snap = dict(_held_by_thread)
+    by_ident = {t.ident: t.name for t in threading.enumerate()}
+    return {
+        by_ident.get(ident, f"ident-{ident}"): names
+        for ident, names in snap.items()
+    }
+
+
+def report() -> dict:
+    with _REG:
+        edges = [
+            {"from": a, "to": b, **info}
+            for (a, b), info in _edges.items()
+        ]
+        blocking = [dict(e) for e in _blocking]
+        self_dl = [dict(e) for e in _self_deadlocks]
+        acquired = _acquired_total
+    return {
+        "enabled": contracts.checks_mode() == "record",
+        "acquired_total": acquired,
+        "edges": edges,
+        "cycles": cycles(),
+        "self_deadlocks": self_dl,
+        "hold_times": hold_time_stats(),
+        "blocking_under_lock": blocking,
+        "affinity_violations": contracts.affinity_violations(),
+    }
+
+
+def assert_clean(rep: Optional[dict] = None) -> None:
+    """Raise AssertionError when the run recorded any lock-order cycle,
+    certain self-deadlock, or thread-affinity violation.  Hold times and
+    blocking events are informational (the static lint owns
+    blocking-under-lock as an error; at runtime third-party callees can
+    trip the probe legitimately)."""
+    rep = rep or report()
+    problems = []
+    for cyc in rep["cycles"]:
+        problems.append(f"lock-order cycle: {' -> '.join(cyc + cyc[:1])}")
+    for sd in rep["self_deadlocks"]:
+        problems.append(
+            f"self-deadlock: {sd['lock']} re-acquired on {sd['thread']}"
+        )
+    for v in rep["affinity_violations"]:
+        problems.append(
+            f"affinity: {v['func']} expected {v['expected']} "
+            f"ran as {v['actual']!r} on {v['thread']} (x{v['count']})"
+        )
+    if problems:
+        raise AssertionError(
+            "lockcheck found {} problem(s):\n  {}".format(
+                len(problems), "\n  ".join(problems)
+            )
+        )
+
+
+def reset() -> None:
+    """Clear every registry (unit tests isolate scenarios with this)."""
+    global _acquired_total
+    with _REG:
+        _edges.clear()
+        _holds.clear()
+        _hold_counts.clear()
+        _blocking.clear()
+        _self_deadlocks.clear()
+        _held_by_thread.clear()
+        _acquired_total = 0
+    contracts.clear_affinity_violations()
+
+
+def _atexit_report() -> None:
+    """Subprocesses under a lockcheck'd session (chaos workers) drop a
+    nonclean report where the parent can find it."""
+    out_dir = os.environ.get("DYN_TPU_LOCKCHECK_DIR", "")
+    if not out_dir:
+        return
+    rep = report()
+    if not (rep["cycles"] or rep["self_deadlocks"]
+            or rep["affinity_violations"]):
+        return
+    try:
+        os.makedirs(out_dir, exist_ok=True)
+        path = os.path.join(out_dir, f"lockcheck-{os.getpid()}.json")
+        with open(path, "w") as f:
+            json.dump(rep, f, indent=1)
+    except OSError:
+        pass
+
+
+if contracts.checks_mode() == "record":
+    install_probes()
+    atexit.register(_atexit_report)

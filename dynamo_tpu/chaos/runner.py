@@ -168,7 +168,9 @@ class ChaosStack:
             self.last_status = status
 
         if self.log_path:
+            # lint: allow(blocking-in-async): chaos harness setup/teardown, not the serving loop
             os.makedirs(os.path.dirname(self.log_path) or ".", exist_ok=True)
+            # lint: allow(blocking-in-async): chaos harness setup/teardown, not the serving loop
             self._log_file = open(self.log_path, "ab")
         self.controller = GraphController(
             self.spec, self.control.address, interval=0.25,
@@ -463,6 +465,7 @@ class ScenarioRunner:
         if self.timeline_dir:
             from ..runtime import tracing
 
+            # lint: allow(blocking-in-async): chaos harness setup/teardown, not the serving loop
             os.makedirs(self.timeline_dir, exist_ok=True)
             spans_path = os.path.join(
                 self.timeline_dir, f"chaos_{s.name}_spans.jsonl"

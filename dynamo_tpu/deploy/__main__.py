@@ -78,6 +78,7 @@ def _verb_main(argv) -> None:
         client = await ControlPlaneClient(args.control).connect()
         try:
             if verb == "apply":
+                # lint: allow(blocking-in-async): one-shot CLI config read
                 with open(args.config) as f:
                     text = f.read()
                 name = args.name or GraphSpec.parse(text).namespace

@@ -75,7 +75,7 @@ class RemoteRouterClient:
                     {"op": "finished", "request_id": request_id}, rid, Context()
                 ):
                     break
-            except Exception:  # noqa: BLE001 — load tracking is advisory
+            except Exception:  # lint: allow(swallowed-exception): load tracking is advisory
                 pass
 
         import asyncio

@@ -427,7 +427,7 @@ class KvTransferClient:
             if task is not None:
                 try:
                     await task
-                except Exception:  # noqa: BLE001 — original error wins
+                except Exception:  # lint: allow(swallowed-exception): original error wins; task settled either way
                     pass
             await self.engine.free_pages(dest_pages)
             await self._release_remote(descriptor)
@@ -515,7 +515,7 @@ class KvTransferClient:
             ))
             await asyncio.wait_for(writer.drain(), timeout=2.0)
             writer.close()
-        except Exception:  # noqa: BLE001 — TTL is the backstop
+        except Exception:  # lint: allow(swallowed-exception): remote TTL is the backstop for a lost release
             pass
 
     async def _fetch_into(self, descriptor, src: KvLayout, dst: KvLayout,

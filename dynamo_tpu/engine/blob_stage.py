@@ -28,6 +28,8 @@ from typing import Dict, Optional, Tuple
 import msgpack
 import numpy as np
 
+from ..analysis import make_lock
+
 logger = logging.getLogger(__name__)
 
 _DEFAULT_TTL = 300.0
@@ -73,8 +75,8 @@ class BlobStage:
         self.port = 0
         self.bytes_staged = 0  # total staged (the would-be broadcast size)
         self.bytes_served = 0  # total actually pulled by followers
-        self._entries: Dict[str, _Entry] = {}
-        self._lock = threading.Lock()
+        self._entries: Dict[str, _Entry] = {}  # guarded-by: _lock
+        self._lock = make_lock("blob_stage._lock")
         self._server: Optional[socketserver.ThreadingTCPServer] = None
 
     # -- lifecycle ----------------------------------------------------------- #

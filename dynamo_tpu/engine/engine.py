@@ -32,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..analysis import affine
 from ..models import KVCache, ModelConfig, forward_decode, forward_prefill
 from ..models.llama import forward_embed
 from ..ops import (
@@ -1609,9 +1610,11 @@ class JaxEngine:
 
         self.scheduler.onboard_fn = _onboard
 
+    @affine("step", "loop")
     def export_cached_blocks_device(self, hashes):
-        """Device half of the offload export (pump/executor thread only —
-        the jitted gather must never race a step's donated KV buffers).
+        """Device half of the offload export (step thread in steady state;
+        the planning loop may call it too, where dispatch ordering keeps it
+        from racing a step's donated KV buffers — never the drain thread).
         Returns per-rank chunks ``[(hashes, k_dev, v_dev)]`` WITHOUT
         fetching: the outputs are fresh device buffers, so the blocking
         ``device_get`` can run on the KVBM drain thread concurrently
@@ -1656,6 +1659,7 @@ class JaxEngine:
             return out_h, ks[0], vs[0]
         return out_h, np.concatenate(ks, 1), np.concatenate(vs, 1)
 
+    @affine("step", "loop")
     def import_committed_blocks(self, blocks, rank: Optional[int] = None
                                 ) -> List[int]:
         """SYNC import of (hash, parent_hash, k, v) blocks into freshly
@@ -2046,6 +2050,7 @@ class JaxEngine:
                 if get not in done:
                     get.cancel()
                     return
+                # lint: allow(blocking-in-async): asyncio.Task already completed by wait(); result() is non-blocking
                 out = get.result()
                 if out is None:
                     return
@@ -2093,7 +2098,7 @@ class JaxEngine:
             self._xprof_done = True
             try:
                 jax.profiler.stop_trace()
-            except Exception:  # noqa: BLE001 — best-effort flush on exit
+            except Exception:  # lint: allow(swallowed-exception): best-effort profiler flush on exit
                 pass
         if self._pump_task:
             await asyncio.gather(self._pump_task, return_exceptions=True)
@@ -2139,6 +2144,7 @@ class JaxEngine:
             client.close()
         self._blob_clients.clear()
 
+    @affine("loop")
     def _plan_step(self) -> StepPlan:
         """Apply deferred scheduler mutations and plan the next step.
 
@@ -2216,6 +2222,7 @@ class JaxEngine:
                     await asyncio.sleep(0)
                 continue
             if not self._xprof_done:
+                # lint: allow(blocking-in-async): one-time profiler capture setup, not steady-state
                 self._xprof_start()
             try:
                 if plan.kind == "prefill":
@@ -2485,6 +2492,7 @@ class JaxEngine:
                 "t": time.monotonic(),
             })
 
+    @affine("step")
     def _run_prefill(self, items: List[PrefillItem]) -> None:
         t0_ev = self.events.now()
         self._note_dispatch("prefill")
@@ -2536,7 +2544,7 @@ class JaxEngine:
         # latency instead of the whole fused chain's
         try:
             packed_d.copy_to_host_async()
-        except Exception:  # noqa: BLE001 — sharded arrays may not support it
+        except Exception:  # lint: allow(swallowed-exception): copy_to_host_async optional; fetch path device_gets anyway
             pass
         # the dispatch is committed: account the computed tokens NOW so a
         # fused decode chain plans from current positions (errors reset
@@ -2747,6 +2755,7 @@ class JaxEngine:
             if not self._loop.is_closed():
                 raise
 
+    @affine("step")
     def _run_mixed(self, plan: StepPlan) -> None:
         """One dispatch: bounded prefill chunk + decode block (the mixed
         plan).  Decode rows' pages were reserved preemptively at planning;
@@ -2857,7 +2866,7 @@ class JaxEngine:
         for a in (p_packed, d_packed):
             try:  # start both host copies; they ride back in fetch order
                 a.copy_to_host_async()
-            except Exception:  # noqa: BLE001 — sharded arrays may not support it
+            except Exception:  # lint: allow(swallowed-exception): copy_to_host_async optional; fetch path device_gets anyway
                 pass
         return p_packed, d_packed
 
@@ -3247,6 +3256,7 @@ class JaxEngine:
         )
         self._spec_dispatch_total += 1
         drafted = accepted = 0
+        live: List[tuple] = []
         for i, s in enumerate(rows):
             if s is None or s.status != "running":
                 continue
@@ -3255,15 +3265,21 @@ class JaxEngine:
             accepted += a
             s.spec_draft_tokens += k
             s.spec_accepted_tokens += a
+            live.append((i, s, a))
+        # totals are published BEFORE any token is appended: _append_token
+        # hands the finishing token to the waiting generator, whose caller
+        # may read metrics() the moment it wakes — the dispatch counter
+        # above and these totals must never be observable half-updated
+        self._spec_draft_total += drafted
+        self._spec_accepted_total += accepted
+        self._spec_window.append((drafted, accepted))
+        for i, s, a in live:
             for t in range(a + 1):
                 s.num_computed += 1
                 self.scheduler.commit_full_pages(s)
                 self._append_token(s, int(out[i, t]), float(logp[i, t]))
                 if s.status != "running":
                     break  # stop hit inside the accepted run; rest discarded
-        self._spec_draft_total += drafted
-        self._spec_accepted_total += accepted
-        self._spec_window.append((drafted, accepted))
         self.events.record("spec_round", t0_ns=t0_ev, k=k,
                            batch=len(seqs), drafted=drafted,
                            accepted=accepted)
@@ -3290,11 +3306,19 @@ class JaxEngine:
         )
         try:  # start the host copy early
             packed_d.copy_to_host_async()
-        except Exception:  # noqa: BLE001 — sharded arrays may not support it
+        except Exception:  # lint: allow(swallowed-exception): copy_to_host_async optional; fetch path device_gets anyway
             pass
         return packed_d
 
+    @affine("step")
     def _run_decode(self, seqs: List[Sequence]) -> None:
+        # the planner (loop thread) pipelines against this executor: a
+        # sequence it scheduled may have stopped during the step that was
+        # in flight, and its pages may already be freed — dispatching such
+        # a row would read recycled KV and skew per-dispatch telemetry
+        seqs = [s for s in seqs if s.status == "running"]
+        if not seqs:
+            return
         if self._spec_ok(seqs):
             return self._run_spec_decode(seqs)
         # block ladder: the scheduler picks this dispatch's block size —
@@ -3401,7 +3425,7 @@ class JaxEngine:
                 )
             try:  # start the host copy early; overlaps later blocks' compute
                 packed_d.copy_to_host_async()
-            except Exception:  # noqa: BLE001 — sharded arrays may not support it
+            except Exception:  # lint: allow(swallowed-exception): copy_to_host_async optional; fetch path device_gets anyway
                 pass
             dispatches.append(packed_d)
         return dispatches
@@ -3522,6 +3546,7 @@ class JaxEngine:
                 return "cancel"
         return None
 
+    @affine("drain")
     def _fetch_packed_cc(self, packed_d, Bb: int, with_top: bool):
         """Drain-thread half of the double buffer: block device_get +
         numpy unpack off the step thread, so block k's host fetch rides
@@ -3531,6 +3556,7 @@ class JaxEngine:
             np.asarray(jax.device_get(packed_d)), Bb, with_top
         )
 
+    @affine("step")
     def _run_decode_continuous(self, seqs: List[Sequence], T: int) -> None:
         """The device-resident decode inner loop (docs/device_loop.md):
         an OPEN-ENDED chain of decode blocks whose varying inputs (last
@@ -3605,7 +3631,7 @@ class JaxEngine:
                     )
                 try:
                     packed_d.copy_to_host_async()
-                except Exception:  # noqa: BLE001 — backends may not support it
+                except Exception:  # lint: allow(swallowed-exception): copy_to_host_async optional; fetch path device_gets anyway
                     pass
                 blocks += 1
                 allowance -= 1
@@ -4373,6 +4399,7 @@ class JaxEngine:
                 if get not in done:
                     get.cancel()
                     return
+                # lint: allow(blocking-in-async): asyncio.Task already completed by wait(); result() is non-blocking
                 out = get.result()
                 if out is None:
                     return

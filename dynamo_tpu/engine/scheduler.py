@@ -26,6 +26,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Deque, List, Optional, Sequence as Seq, Tuple
 
+from ..analysis import affine
 from ..tokens import chain_seed, compute_block_hash_for_seq, next_block_hash
 from .config import EngineConfig
 from .page_pool import NoPagesError, PagePool
@@ -174,12 +175,14 @@ class Scheduler:
         # selections land on the engine step timeline
         self.events = None
 
+    @affine("step", "loop")
     def drain_errored(self) -> List[Sequence]:
         out, self.errored = self.errored, []
         return out
 
     # -- intake -------------------------------------------------------------- #
 
+    @affine("step", "loop")
     def add(self, seq: Sequence) -> None:
         if seq.prompt_len + seq.opts.max_tokens > self.cfg.max_model_len:
             # clamp generation budget to the model window
@@ -188,6 +191,7 @@ class Scheduler:
             seq.t_seen = time.monotonic()
         self.waiting.append(seq)
 
+    @affine("step", "loop")
     def abort(self, request_id: str) -> None:
         for seq in list(self.waiting):
             if seq.request_id == request_id:
@@ -266,6 +270,7 @@ class Scheduler:
             seq._admit_hashes = hashes
         return seq._admit_hashes
 
+    @affine("step", "loop")
     def add_imported(self, seq: Sequence) -> None:
         """Admit a sequence whose KV was injected externally (disagg decode
         side): pages and num_computed are already set; skip prefix cache."""
@@ -330,6 +335,7 @@ class Scheduler:
             not s.prefill_done for s in self.running
         ) or self._head_admissible()
 
+    @affine("step", "loop")
     def select_decode_rung(self) -> Tuple[int, bool]:
         """(n_steps, allow_chain) for the next decode-bearing dispatch
         (pure decode, mixed, or the fused prefill→decode chain).
@@ -381,6 +387,7 @@ class Scheduler:
         idx = min(self._rung_idx, len(ladder) - 1)
         return ladder[idx], idx == len(ladder) - 1
 
+    @affine("step", "loop")
     def commit_decode_rung(self) -> None:
         """Advance the ramp for a dispatch whose rung was taken via
         `peek_decode_rung` (the fused path: its eligibility already
@@ -391,6 +398,7 @@ class Scheduler:
         if len(ladder) > 1:
             self._rung_idx = min(self._rung_idx + 1, len(ladder) - 1)
 
+    @affine("step", "loop")
     def schedule(self) -> StepPlan:
         self._try_admit()
         if not self.running:
@@ -523,6 +531,7 @@ class Scheduler:
                     return False
                 self._preempt(victim)
 
+    @affine("step", "loop")
     def try_extend_pages(self, seq: Sequence, upto_tokens: int,
                          keep_watermark: bool = False) -> bool:
         """Grow seq's page list WITHOUT preemption (cached-page eviction is
@@ -572,6 +581,7 @@ class Scheduler:
 
     # -- completion ---------------------------------------------------------- #
 
+    @affine("step", "loop")
     def commit_full_pages(self, seq: Sequence) -> None:
         """Register newly-filled pages in the prefix cache (emits KV events)."""
         if not self.cfg.enable_prefix_caching:
@@ -597,6 +607,7 @@ class Scheduler:
             self.pool.commit(seq.pages[i], seq.block_hashes[i], parent)
         seq.committed_pages = full
 
+    @affine("step", "loop")
     def check_stop(self, seq: Sequence, eos_token_ids: Seq[int]) -> Optional[str]:
         out = seq.output_tokens
         if not seq.opts.ignore_eos and out and out[-1] in eos_token_ids:
@@ -624,6 +635,7 @@ class Scheduler:
         if seq in self.running:
             self.running.remove(seq)
 
+    @affine("step", "loop")
     def finish(self, seq: Sequence, reason: str) -> None:
         self.commit_full_pages(seq)
         self._finish(seq, reason)

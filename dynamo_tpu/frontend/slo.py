@@ -31,6 +31,8 @@ import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional
 
+from ..analysis import affine
+
 __all__ = [
     "LogBucketHistogram",
     "SLOAccountant",
@@ -190,6 +192,7 @@ class SlidingWindow:
             slot.reset(epoch)
         return slot
 
+    @affine("loop")
     def mark(self, now: Optional[float] = None) -> None:
         """Anchor the covered-duration start without recording anything
         — bench pins the live window to its phase t0 so the two goodput
@@ -200,6 +203,7 @@ class SlidingWindow:
         if slot.t_first is None:
             slot.t_first = now
 
+    @affine("loop")
     def record_start(self, now: Optional[float] = None) -> None:
         now = time.monotonic() if now is None else now
         slot = self._slot(now)
@@ -207,6 +211,7 @@ class SlidingWindow:
         if slot.t_first is None:
             slot.t_first = now
 
+    @affine("loop")
     def record(self, ttft_ms: float, itl_ms: float, output_tokens: int,
                slo_ok: bool, prompt_tokens: int = 0,
                now: Optional[float] = None) -> None:

@@ -20,14 +20,17 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..analysis import make_lock
+
 
 class DiskTier:
     def __init__(self, root: str, capacity_bytes: int = 32 << 30):
         self.root = root
         self.capacity_bytes = capacity_bytes
         os.makedirs(root, exist_ok=True)
-        self._lock = threading.Lock()
-        self._index: "OrderedDict[int, int]" = OrderedDict()  # hash → nbytes
+        self._lock = make_lock("disk._lock")
+        # hash → nbytes  # guarded-by: _lock
+        self._index: "OrderedDict[int, int]" = OrderedDict()
         # hashes whose bytes THIS process wrote or read back successfully.
         # Startup-scan / _discover entries stay unverified: they may be
         # pre-atomic torn debris under a valid final name, so put() must
@@ -35,8 +38,8 @@ class DiskTier:
         # them, and the offload drain must not skip the host insert on
         # their account — otherwise valid KV offered for the hash is
         # dropped from BOTH lower tiers.
-        self._verified: set = set()
-        self._bytes = 0
+        self._verified: set = set()  # guarded-by: _lock
+        self._bytes = 0  # guarded-by: _lock
         self.hits = 0
         self.misses = 0
         for name in os.listdir(root):
@@ -70,30 +73,38 @@ class DiskTier:
             if block_hash in self._index and block_hash in self._verified:
                 self._index.move_to_end(block_hash)
                 return
-            path = self._path(block_hash)
-            # atomic publish: savez to a private tmp name, then rename —
-            # a SIGKILL mid-write leaves only the tmp file, which no
-            # reader ever resolves (hashes are u64; sentinel 2^64-1 =
-            # "no parent")
-            tmp = os.path.join(
-                self.root, f".tmp-{os.getpid()}-{block_hash:016x}.npz"
+        # ALL file I/O happens outside the lock: a multi-MB savez under
+        # _lock stalls every concurrent get()/summary() on the tier (and
+        # the router publisher behind them).  Atomic publish: savez to a
+        # private tmp name, then rename — a SIGKILL mid-write leaves
+        # only the tmp file, which no reader ever resolves (hashes are
+        # u64; sentinel 2^64-1 = "no parent").  The tmp name carries the
+        # thread ident too: with the write outside the lock, two threads
+        # of one process may race the same hash.
+        path = self._path(block_hash)
+        tmp = os.path.join(
+            self.root,
+            f".tmp-{os.getpid()}-{threading.get_ident()}"
+            f"-{block_hash:016x}.npz",
+        )
+        try:
+            np.savez(
+                tmp, k=k, v=v,
+                parent=np.uint64(
+                    parent_hash if parent_hash is not None
+                    else (1 << 64) - 1
+                ),
             )
-            try:
-                np.savez(
-                    tmp, k=k, v=v,
-                    parent=np.uint64(
-                        parent_hash if parent_hash is not None
-                        else (1 << 64) - 1
-                    ),
-                )
-                os.replace(tmp, path)
-            except Exception:  # any savez failure must not leak the tmp
-                try:
-                    os.remove(tmp)
-                except OSError:
-                    pass
-                raise
+            os.replace(tmp, path)
             sz = os.path.getsize(path)
+        except Exception:  # any savez failure must not leak the tmp
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            raise
+        evicted: List[int] = []
+        with self._lock:
             self._bytes -= self._index.get(block_hash, 0)  # debris replaced
             self._index[block_hash] = sz
             self._verified.add(block_hash)
@@ -102,12 +113,22 @@ class DiskTier:
                 old, old_sz = self._index.popitem(last=False)
                 self._bytes -= old_sz
                 self._verified.discard(old)
-                try:
-                    os.remove(self._path(old))
-                except OSError:
-                    pass
+                evicted.append(old)
+        for old in evicted:
+            # unlink outside the lock.  A concurrent put() may have
+            # re-published this hash since eviction chose it; the recheck
+            # narrows that window, and losing the race degrades to one
+            # spurious miss (get() drops the dangling index entry), never
+            # to serving torn bytes.
+            with self._lock:
+                if old in self._index:
+                    continue
+            try:
+                os.remove(self._path(old))
+            except OSError:
+                pass
 
-    def _discover(self, block_hash: int) -> bool:
+    def _discover_locked(self, block_hash: int) -> bool:
         """Index miss → check the filesystem: the tier directory is SHARED
         across workers (distributed KVBM), so another process may have
         written the block after our directory scan. Caller holds the lock."""
@@ -121,7 +142,8 @@ class DiskTier:
 
     def get(self, block_hash: int) -> Optional[Tuple[np.ndarray, np.ndarray]]:
         with self._lock:
-            if block_hash not in self._index and not self._discover(block_hash):
+            # lint: allow(blocking-under-lock): one getsize probe; shared-dir discovery must be atomic with the index insert
+            if block_hash not in self._index and not self._discover_locked(block_hash):
                 self.misses += 1
                 return None
             self._index.move_to_end(block_hash)
@@ -151,10 +173,12 @@ class DiskTier:
                 self._bytes -= sz
                 self._verified.discard(block_hash)
                 try:
+                    # lint: allow(blocking-under-lock): tiny metadata stat; inode+mtime guard must be atomic with the index drop
                     st = os.stat(path)
                     if (torn_stat is not None
                             and (st.st_ino, st.st_mtime_ns)
                             == (torn_stat.st_ino, torn_stat.st_mtime_ns)):
+                        # lint: allow(blocking-under-lock): debris unlink; must not race a concurrent atomic re-publish
                         os.remove(path)
                 except OSError:
                     pass
@@ -163,7 +187,8 @@ class DiskTier:
 
     def __contains__(self, block_hash: int) -> bool:
         with self._lock:
-            return block_hash in self._index or self._discover(block_hash)
+            # lint: allow(blocking-under-lock): one getsize probe; shared-dir discovery must be atomic with the index insert
+            return block_hash in self._index or self._discover_locked(block_hash)
 
     def has_verified(self, block_hash: int) -> bool:
         """True only for entries whose bytes this process wrote or read
@@ -175,11 +200,13 @@ class DiskTier:
             return block_hash in self._verified and block_hash in self._index
 
     def __len__(self) -> int:
-        return len(self._index)
+        with self._lock:
+            return len(self._index)
 
     @property
     def bytes_used(self) -> int:
-        return self._bytes
+        with self._lock:
+            return self._bytes
 
     def summary(self, max_hashes: int = 8192) -> List[int]:
         """Indexed block hashes, most-recently-used first, capped — the

@@ -10,7 +10,6 @@ Capacity-bounded with LRU eviction; lookups refresh recency.
 
 from __future__ import annotations
 
-import threading
 import time
 from collections import OrderedDict
 from dataclasses import dataclass
@@ -18,6 +17,8 @@ from itertools import islice
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+from ..analysis import make_lock
 
 
 @dataclass
@@ -38,9 +39,10 @@ class HostBlockPool:
 
     def __init__(self, capacity_bytes: int = 4 << 30, on_evict=None):
         self.capacity_bytes = capacity_bytes
+        # hash → HostBlock, LRU order  # guarded-by: _lock
         self._blocks: "OrderedDict[int, HostBlock]" = OrderedDict()
-        self._bytes = 0
-        self._lock = threading.Lock()
+        self._bytes = 0  # guarded-by: _lock
+        self._lock = make_lock("host_pool._lock")
         self.on_evict = on_evict  # callback(HostBlock) — demote to next tier
         self.hits = 0
         self.misses = 0
@@ -99,11 +101,13 @@ class HostBlockPool:
             return block_hash in self._blocks
 
     def __len__(self) -> int:
-        return len(self._blocks)
+        with self._lock:
+            return len(self._blocks)
 
     @property
     def bytes_used(self) -> int:
-        return self._bytes
+        with self._lock:
+            return self._bytes
 
     def summary(self, max_hashes: int = 8192) -> List[int]:
         """Resident block hashes, most-recently-used first, capped — the

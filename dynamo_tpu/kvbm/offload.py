@@ -25,10 +25,10 @@ miss).  TPU design differences:
 from __future__ import annotations
 
 import logging
-import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional, Sequence, Tuple
 
+from ..analysis import affine, make_lock
 from .disk import DiskTier
 from .host_pool import HostBlock, HostBlockPool
 
@@ -42,11 +42,12 @@ class TieredKvCache:
         self.disk = disk
         self.remote = remote  # G4: kvbm.remote.ObjectStoreTier (shared)
         self.max_offload_batch = max_offload_batch
-        self._pending: List[Tuple[int, Optional[int]]] = []  # (hash, parent)
-        self._lock = threading.Lock()
+        # (hash, parent) queue  # guarded-by: _lock
+        self._pending: List[Tuple[int, Optional[int]]] = []
+        self._lock = make_lock("kvbm.offload._lock")
         # hashes whose device→host copy is in flight on the drain thread
         # (gather dispatched, device_get/host insert not yet done) — they
-        # must not be re-exported by the next pump tick
+        # must not be re-exported by the next pump tick  # guarded-by: _lock
         self._inflight: set[int] = set()
         # ONE drain thread: host inserts stay ordered, and demotion disk
         # writes serialize instead of thrashing a shared tier directory
@@ -80,6 +81,7 @@ class TieredKvCache:
 
     # -- offload pump (engine step thread, between steps) --------------------- #
 
+    @affine("step")
     def pump_offloads(self, engine) -> int:
         """Dispatch one batch of queued device→host copies.  Runs on the
         engine's step/executor thread strictly BETWEEN device steps (the
@@ -144,6 +146,7 @@ class TieredKvCache:
                                engine)
         return n
 
+    @affine("drain")
     def _complete_offload(self, chunks, parents, engine) -> None:
         """Drain-thread half: blocking device→host fetch + host insert
         (and, via the host pool's on_evict, any G2→G3 demotion writes)."""

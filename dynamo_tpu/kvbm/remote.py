@@ -24,6 +24,8 @@ from typing import Optional, Tuple
 import msgpack
 import numpy as np
 
+from ..analysis import affine
+
 logger = logging.getLogger(__name__)
 
 
@@ -81,6 +83,7 @@ class ObjectStoreTier:
     def _name(block_hash: int) -> str:
         return format(block_hash & (2**64 - 1), "016x")
 
+    @affine("drain", "loop")
     def put(self, block_hash: int, parent_hash: Optional[int],
             k: np.ndarray, v: np.ndarray) -> None:
         blob = msgpack.packb({
@@ -99,6 +102,7 @@ class ObjectStoreTier:
         except Exception as e:  # noqa: BLE001 — G4 is best-effort
             logger.warning("G4 put failed for %x: %r", block_hash, e)
 
+    @affine("drain", "loop")
     def get(self, block_hash: int) -> Optional[Tuple[np.ndarray, np.ndarray]]:
         try:
             blob = self._run(
@@ -118,6 +122,7 @@ class ObjectStoreTier:
             np.frombuffer(d["v"], dtype).reshape(shape),
         )
 
+    @affine("drain", "loop")
     def __contains__(self, block_hash: int) -> bool:
         # containment gates duplicate offloads; a racy false negative just
         # re-uploads an identical blob.  One bucket listing seeds the local

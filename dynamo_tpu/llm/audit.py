@@ -155,7 +155,7 @@ class AuditBus:
         for sink in self.sinks:
             try:
                 sink.close()
-            except Exception:  # noqa: BLE001
+            except Exception:  # lint: allow(swallowed-exception): close every sink even if one fails
                 pass
 
 

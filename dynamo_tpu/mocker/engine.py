@@ -176,6 +176,7 @@ class MockEngine:
                 if get not in done:
                     get.cancel()
                     return
+                # lint: allow(blocking-in-async): asyncio.Task already completed by wait(); result() is non-blocking
                 out = get.result()
                 if out is None:
                     return

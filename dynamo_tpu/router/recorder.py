@@ -116,6 +116,7 @@ def main(argv=None) -> None:
         from ..runtime import DistributedRuntime
 
         runtime = await DistributedRuntime.connect(args.control)
+        # lint: allow(blocking-in-async): one-shot CLI output open
         out = sys.stdout if args.out == "-" else open(args.out, "w")
         rec = KvEventRecorder(runtime, args.namespace, args.component, out)
         try:

@@ -261,6 +261,7 @@ async def _run_batch(manager, args) -> None:
     entry = _pick_entry(manager, args)
     out_path = args.output_file or (args.input_file + ".out")
     n = 0
+    # lint: allow(blocking-in-async): offline batch CLI, not the serving loop
     with open(args.input_file) as fin, open(out_path, "w") as fout:
         for line in fin:
             line = line.strip()

@@ -24,9 +24,10 @@ short-circuits on one attribute load)."""
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Any, Dict, List, Optional
+
+from ..analysis import make_lock
 
 DEFAULT_CAPACITY = 4096
 
@@ -37,13 +38,13 @@ class StepEventRecorder:
     def __init__(self, capacity: int = DEFAULT_CAPACITY):
         self.capacity = max(0, int(capacity))
         self.enabled = self.capacity > 0
-        self._ring: List[Optional[tuple]] = [None] * self.capacity
-        self._n = 0  # total events ever recorded
+        self._ring: List[Optional[tuple]] = [None] * self.capacity  # guarded-by: _lock
+        self._n = 0  # total events ever recorded  # guarded-by: _lock
         # per-kind lifetime counts (survive ring wrap + clear, like _n):
         # lets periodic consumers (telemetry's host-gap stat) skip the
         # full ring dump unless the kind they care about actually moved
         self.kind_totals: Dict[str, int] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("events._lock")
 
     @classmethod
     def from_env(cls) -> "StepEventRecorder":
@@ -73,22 +74,29 @@ class StepEventRecorder:
             self.kind_totals[kind] = self.kind_totals.get(kind, 0) + 1
 
     def __len__(self) -> int:
-        return min(self._n, self.capacity)
+        with self._lock:
+            return min(self._n, self.capacity)
 
     @property
     def total(self) -> int:
-        return self._n
+        with self._lock:
+            return self._n
+
+    def _snap(self) -> tuple:
+        """(recorded_total, events in record order) in ONE lock
+        acquisition, so dump()'s counters agree with its event list."""
+        with self._lock:
+            n, ring = self._n, list(self._ring)
+        if n <= self.capacity:
+            return n, [e for e in ring[:n]]
+        head = n % self.capacity
+        return n, ring[head:] + ring[:head]
 
     def snapshot(self) -> List[tuple]:
         """Events in record order (oldest surviving first)."""
         if not self.enabled:
             return []
-        with self._lock:
-            n, ring = self._n, list(self._ring)
-        if n <= self.capacity:
-            return [e for e in ring[:n]]
-        head = n % self.capacity
-        return ring[head:] + ring[:head]
+        return self._snap()[1]
 
     def dump(self) -> Dict[str, Any]:
         """JSON-able ring dump with time anchors (the worker debug
@@ -98,15 +106,16 @@ class StepEventRecorder:
         wall clock the OTLP spans use."""
         mono = time.monotonic_ns()
         wall = time.time_ns()
+        n, events = self._snap()
         return {
             "wall_ns": wall,
             "mono_ns": mono,
             "capacity": self.capacity,
-            "recorded_total": self._n,
-            "dropped_total": max(0, self._n - self.capacity),
+            "recorded_total": n,
+            "dropped_total": max(0, n - self.capacity),
             "events": [
                 {"t_ns": t, "dur_ns": d, "kind": k, **a}
-                for (t, d, k, a) in self.snapshot()
+                for (t, d, k, a) in events
             ],
         }
 

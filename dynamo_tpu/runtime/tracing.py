@@ -113,9 +113,9 @@ _EXPORTER = None
 # guards lazy exporter construction: the engine executor thread
 # (export_span) and the event loop (span.__exit__) race on first use —
 # without the lock the loser's exporter is leaked unclosed
-import threading as _threading  # noqa: E402 — scoped to this guard
+from ..analysis import make_lock as _make_lock  # noqa: E402 — scoped to this guard
 
-_EXPORTER_LOCK = _threading.Lock()
+_EXPORTER_LOCK = _make_lock("tracing._EXPORTER_LOCK")
 _ATEXIT_REGISTERED = False
 
 
@@ -167,8 +167,6 @@ def _otlp_envelope(service_name: str, spans: list) -> dict:
 
 class SpanFileExporter:
     def __init__(self, path: str, service_name: str = "dynamo_tpu"):
-        import threading
-
         self.path = path
         self.service_name = service_name
         self.sent = 0
@@ -176,7 +174,7 @@ class SpanFileExporter:
         # spans export from BOTH the event loop and the engine's executor
         # thread (per-request milestone spans) — serialize writes so two
         # threads can't tear one line
-        self._lock = threading.Lock()
+        self._lock = _make_lock("tracing.file_exporter._lock")
         self._f = open(path, "a", buffering=1)
 
     def export(self, name: str, ctx: TraceContext, parent_span: str,
@@ -350,7 +348,7 @@ def close_exporter() -> None:
     if exp is not None:
         try:
             exp.close()
-        except Exception:  # noqa: BLE001 — shutdown must not raise
+        except Exception:  # lint: allow(swallowed-exception): exporter shutdown must not raise
             pass
 
 
@@ -386,7 +384,7 @@ def export_span(name: str, parent: Optional[TraceContext], start_ns: int,
             return
         exporter.export(name, parent.child(), parent.span_id,
                         start_ns, end_ns, attrs)
-    except Exception:  # noqa: BLE001 — tracing must not break serving
+    except Exception:  # lint: allow(swallowed-exception): telemetry must never break the request path
         pass
 
 
@@ -424,7 +422,7 @@ class span:
                     self.name, self.ctx, self.parent_span,
                     self._start, time.time_ns(), attrs,
                 )
-        except Exception:  # noqa: BLE001 — tracing must not break serving
+        except Exception:  # lint: allow(swallowed-exception): telemetry must never break the request path
             pass
         finally:
             reset_trace(self._token)

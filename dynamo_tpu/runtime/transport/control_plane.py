@@ -169,7 +169,7 @@ class ControlPlaneServer:
             conn.alive = False
             try:
                 conn.writer.close()
-            except Exception:
+            except OSError:  # close on an already-dead socket
                 pass
         if self._server:
             await self._server.wait_closed()

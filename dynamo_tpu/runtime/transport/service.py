@@ -82,7 +82,7 @@ class ServiceServer:
         for writer in list(self._writers):
             try:
                 writer.close()
-            except Exception:
+            except OSError:  # close on an already-dead socket
                 pass
         if self._server:
             await self._server.wait_closed()
@@ -236,7 +236,7 @@ class ServiceClient:
                     conn.recv_task.cancel()
                 try:
                     conn.writer.close()
-                except Exception:
+                except OSError:  # close on an already-dead socket
                     pass
             host, port = address.rsplit(":", 1)
             try:

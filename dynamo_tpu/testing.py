@@ -44,7 +44,7 @@ async def threaded_control_plane() -> AsyncIterator[str]:
         started.set()
         loop.run_forever()
 
-    t = threading.Thread(target=run, daemon=True)
+    t = threading.Thread(target=run, name="test-control-plane", daemon=True)
     t.start()
     started.wait(10)
     try:
@@ -53,11 +53,13 @@ async def threaded_control_plane() -> AsyncIterator[str]:
         loop = holder["loop"]
         fut = _a.run_coroutine_threadsafe(holder["server"].stop(), loop)
         try:
-            fut.result(5)
-        except Exception:  # noqa: BLE001
+            # bounded waits off the caller's loop: teardown must not
+            # stall other coroutines sharing it
+            await _a.to_thread(fut.result, 5)
+        except Exception:  # lint: allow(swallowed-exception): best-effort test teardown; server may already be gone
             pass
         loop.call_soon_threadsafe(loop.stop)
-        t.join(5)
+        await _a.to_thread(t.join, 5)
 
 
 @contextlib.asynccontextmanager

@@ -327,6 +327,7 @@ async def _run(args) -> None:
 
     # build the engine BEFORE taking a lease: model load / first compile can
     # block for longer than the lease TTL
+    # lint: allow(blocking-in-async): one-time startup before serving; model load dwarfs it
     engine, mdc = _build_engine(args)
     runtime = await DistributedRuntime.connect(args.control)
     if args.kvbm:
@@ -530,7 +531,7 @@ async def _run(args) -> None:
                 gaps = decode_host_gaps(events.dump())
                 if gaps["p50_ms"] is not None:
                     snap["decode_host_gap_p50_ms"] = gaps["p50_ms"]
-        except Exception:  # noqa: BLE001 — the gap stat is best-effort
+        except Exception:  # lint: allow(swallowed-exception): the gap stat is best-effort telemetry
             pass
         return snap
 

@@ -34,9 +34,155 @@ def _assert_cpu_mesh():
     assert jax.default_backend() == "cpu" and len(jax.devices()) == 8
 
 
+# -- wedge forensics ----------------------------------------------------------- #
+#
+# A wedged test (thread stuck in a C call, ABBA deadlock, drain thread
+# waiting on a dead loop) used to surface only as the driver's opaque
+# suite-level kill.  The watchdog arms a per-test soft deadline: on
+# overrun it dumps every thread's stack — and, when DYN_TPU_LOCKCHECK=1,
+# which tracked locks each thread was holding — to the REAL stderr
+# (pytest's capture would eat it), then lets the test keep running so
+# the hard timeout still owns the kill.
+
+_WEDGE_SOFT_DEADLINE = float(os.environ.get("DYN_TPU_WEDGE_TIMEOUT", "570"))
+
+# Dup'd REAL stderr, captured in pytest_configure while capture is
+# suspended: pytest's fd-level capture redirects fd 2 to a temp file
+# during tests, and a wedge dump into a temp file that dies with the
+# killed process is no dump at all.
+_WEDGE_STDERR = None
+
+
+def _wedge_stderr():
+    import sys
+
+    return _WEDGE_STDERR if _WEDGE_STDERR is not None else sys.__stderr__
+
+
+def _dump_wedge_forensics(nodeid: str) -> None:
+    import faulthandler
+
+    err = _wedge_stderr()
+    try:
+        err.write(
+            f"\n=== WEDGE WATCHDOG: {nodeid} still running after "
+            f"{_WEDGE_SOFT_DEADLINE:.0f}s — thread dump follows ===\n"
+        )
+        try:
+            from dynamo_tpu.analysis import contracts, lockcheck
+
+            if contracts.checks_mode() == "record":
+                held = lockcheck.held_locks_by_thread()
+                err.write(f"held tracked locks: {held or '{}'}\n")
+        except Exception:  # noqa: BLE001 — forensics must not mask the dump
+            pass
+        faulthandler.dump_traceback(file=err)
+        err.write("=== end wedge dump ===\n")
+        err.flush()
+    except Exception:  # noqa: BLE001 — a dead stderr must not crash the timer
+        pass
+
+
+@pytest.fixture(autouse=True)
+def _wedge_watchdog(request):
+    if os.environ.get("DYN_TPU_WEDGE_WATCHDOG", "1") in ("", "0"):
+        yield
+        return
+    import faulthandler
+    import threading
+
+    # Python-level timer first: it can resolve held-lock names.  The
+    # faulthandler C watchdog backstops it 30s later — it fires even
+    # when every Python thread is wedged behind the GIL.
+    timer = threading.Timer(
+        _WEDGE_SOFT_DEADLINE, _dump_wedge_forensics, args=(request.node.nodeid,)
+    )
+    timer.name = "wedge-watchdog"
+    timer.daemon = True
+    timer.start()
+    faulthandler.dump_traceback_later(
+        _WEDGE_SOFT_DEADLINE + 30, exit=False, file=_wedge_stderr()
+    )
+    try:
+        yield
+    finally:
+        faulthandler.cancel_dump_traceback_later()
+        timer.cancel()
+
+
+# -- lockcheck session gate ----------------------------------------------------- #
+
+def pytest_sessionstart(session):
+    """Under DYN_TPU_LOCKCHECK=1, give subprocesses (chaos workers) a
+    directory to drop nonclean lockcheck reports into."""
+    try:
+        from dynamo_tpu.analysis import contracts
+    except Exception:  # noqa: BLE001 — collection must survive a broken package
+        return
+    if contracts.checks_mode() != "record":
+        return
+    if not os.environ.get("DYN_TPU_LOCKCHECK_DIR"):
+        import tempfile
+
+        os.environ["DYN_TPU_LOCKCHECK_DIR"] = tempfile.mkdtemp(
+            prefix="dyn-tpu-lockcheck-"
+        )
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """The DYN_TPU_LOCKCHECK=1 acceptance gate: the whole session (chaos
+    subprocesses included) must record zero lock-order cycles, zero
+    certain self-deadlocks, and zero thread-affinity violations."""
+    try:
+        from dynamo_tpu.analysis import contracts, lockcheck
+    except Exception:  # noqa: BLE001 — no gate without the package
+        return
+    if contracts.checks_mode() != "record":
+        return
+    import sys
+
+    rep = lockcheck.report()
+    problems = []
+    try:
+        lockcheck.assert_clean(rep)
+    except AssertionError as e:
+        problems.append(str(e))
+    sub_dir = os.environ.get("DYN_TPU_LOCKCHECK_DIR", "")
+    if sub_dir and os.path.isdir(sub_dir):
+        for name in sorted(os.listdir(sub_dir)):
+            if name.startswith("lockcheck-") and name.endswith(".json"):
+                problems.append(
+                    "nonclean subprocess lockcheck report: "
+                    + os.path.join(sub_dir, name)
+                )
+    print(
+        f"\nlockcheck: {rep['acquired_total']} acquisitions, "
+        f"{len(rep['edges'])} order edges, {len(rep['cycles'])} cycles, "
+        f"{len(rep['self_deadlocks'])} self-deadlocks, "
+        f"{len(rep['affinity_violations'])} affinity violations"
+    )
+    if problems:
+        print("LOCKCHECK GATE FAILED:", file=sys.stderr)
+        for p in problems:
+            print("  " + p, file=sys.stderr)
+        session.exitstatus = 1
+        raise pytest.UsageError(
+            f"lockcheck gate: {len(problems)} problem(s) — see above"
+        )
+
+
 def pytest_configure(config):
     """Build the native C++ libs when a toolchain is present so the
     native-twin tests actually run instead of rotting as skips."""
+    global _WEDGE_STDERR
+    import sys
+
+    try:
+        # capture is suspended during configure, so fd 2 is the real
+        # terminal here — dup it for the wedge watchdog's dumps
+        _WEDGE_STDERR = os.fdopen(os.dup(sys.__stderr__.fileno()), "w")
+    except OSError:
+        _WEDGE_STDERR = None
     config.addinivalue_line(
         "markers",
         "async_timeout(seconds): per-test cap for async tests (default 600)",

@@ -62,7 +62,14 @@ def test_from_env_capacity(monkeypatch):
 
 def test_record_under_5us_per_event():
     """The acceptance micro-benchmark: ring recording with exporters
-    disabled must cost < 5 µs/event (it sits on the decode hot path)."""
+    disabled must cost < 5 µs/event (it sits on the decode hot path).
+    The budget is a claim about the PRODUCTION build: under
+    DYN_TPU_LOCKCHECK/DYN_TPU_CHECKS the ring's lock is a TrackedLock
+    with order/hold-time bookkeeping, so the bound is relaxed to a
+    sanity ceiling there."""
+    from dynamo_tpu.analysis import contracts
+
+    budget = 5e-6 if contracts.checks_mode() == "off" else 100e-6
     rec = StepEventRecorder(capacity=4096)
     n = 20_000
     t0 = time.perf_counter()
@@ -70,7 +77,7 @@ def test_record_under_5us_per_event():
         rec.record("decode_block", rung=8, batch=4, chain=1)
     per_event = (time.perf_counter() - t0) / n
     assert rec.total == n
-    assert per_event < 5e-6, f"{per_event * 1e6:.2f}µs/event"
+    assert per_event < budget, f"{per_event * 1e6:.2f}µs/event"
 
 
 def test_slice_timing_accuracy():
