@@ -16,10 +16,17 @@ implicit concurrency contracts into machine-checked ones:
   (CLI: ``scripts/lint_concurrency.py``);
 - ``lockcheck``: the dynamic detector behind the checked lock
   factories — lock-acquisition-order graph with cycle reporting,
-  per-lock hold-time p99, blocking-call-while-holding events.
+  per-lock hold-time p99, blocking-call-while-holding events;
+- ``jitcheck``: the JAX sibling of ``lint`` — static host-sync /
+  jit-stability / PRNG / donation rules over step-path code
+  (CLI: ``scripts/lint_jax.py``);
+- ``xla_ledger``: the runtime JAX layer — the compile ledger behind
+  ``ledgered_jit`` (every jit cache miss attributed to a
+  (function, signature, rung) tuple, steady-state tripwire) and the
+  thread-role transfer guard under ``DYN_TPU_XFERCHECK=1``.
 
 The thread model and lock inventory these tools enforce are documented
-in docs/concurrency.md.
+in docs/concurrency.md; the JAX contracts in docs/jax_contracts.md.
 """
 
 from .contracts import (  # noqa: F401

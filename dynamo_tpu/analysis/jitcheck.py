@@ -1,0 +1,672 @@
+"""AST-based JAX contract lint for the dynamo_tpu package.
+
+The static sibling of ``lint.py`` (concurrency) for the JAX layer:
+host-sync discipline on the step path, jit-boundary stability, PRNG
+hygiene, donation safety.  Findings are ERRORS — the tier-1 gate
+(tests/test_jitcheck.py, CLI ``scripts/lint_jax.py``) requires a clean
+run over ``dynamo_tpu/``.  Runtime enforcement of the same contracts
+lives in ``xla_ledger.py``; the rule table is docs/jax_contracts.md.
+
+Rules
+-----
+
+``host-sync``
+    An implicit device→host sync on a device value inside
+    ``@affine("step")``/``@affine("drain")``-reachable code:
+    ``.item()``, ``float()``/``int()``/``bool()`` coercion,
+    ``np.asarray``/``np.array``, or truth-testing (``if x:`` /
+    ``while x:`` / ``not x``) a device array.  "Device value" is
+    resolved by taint: names with the repo's ``*_d`` device suffix,
+    values returned by ``jnp.*``/``jax.*`` calls or known-jitted
+    callables, and one-level copies of either.  Reachability is the
+    decorated function plus its direct same-module callees (one
+    level, same resolution as lint.py).
+
+``device-get``
+    An EXPLICIT sync — ``jax.device_get`` / ``.block_until_ready()`` —
+    in ``step``-role-reachable code.  The drain role is the sanctioned
+    home for fetches (not flagged); a step-side fetch needs a
+    justified allow, the same contract DYN_TPU_XFERCHECK=1 enforces at
+    runtime.
+
+``jit-unstable-arg``
+    A Python-order-unstable value passed straight into a known-jitted
+    callable: a set literal / set comprehension / ``set(...)`` call
+    (iteration order varies per process), or a dict literal with
+    non-constant keys (insertion order becomes part of the trace).
+    Each distinct order is a fresh jit cache entry — a silent
+    recompile per variation.
+
+``jit-static-drift``
+    jit signatures that cannot stay cache-stable: ``static_argnums``/
+    ``static_argnames`` computed from a non-literal expression,
+    ``jax.jit`` called inside a ``for``/``while`` body (a fresh cache
+    per iteration), or an immediately-invoked ``jax.jit(f)(...)``
+    whose cache dies with the expression.
+
+``prng-reuse``
+    A PRNG key (a name assigned from ``jax.random.PRNGKey`` /
+    ``split`` / ``fold_in``) consumed by two or more calls without an
+    intervening reassignment — correlated randomness across the two
+    uses.  Pass a key onward exactly once; ``split``/``fold_in`` and
+    reassign for more.
+
+``donated-reuse``
+    A name read after being passed in a donated position
+    (``donate_argnums``) of a same-module jitted callable, without
+    reassignment — the buffer was surrendered to XLA and may already
+    be aliased by the output.
+
+Allowlist: identical convention to ``lint.py`` — a finding is
+suppressed by a justified comment on the flagged line or the line
+above::
+
+    # lint: allow(device-get): prefill result fetch, step owns it by design
+    out = np.asarray(jax.device_get(packed_d))
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from .lint import AllowEntry, Finding, _allow_map, _attr_chain, iter_python_files
+
+__all__ = [
+    "RULES",
+    "lint_paths",
+    "lint_source",
+]
+
+RULES = (
+    "host-sync",
+    "device-get",
+    "jit-unstable-arg",
+    "jit-static-drift",
+    "prng-reuse",
+    "donated-reuse",
+)
+
+_STEP_ROLES = ("step", "drain")
+
+# jnp/jax call-prefixes whose results live on device
+_DEVICE_PREFIXES = ("jnp.", "jax.numpy.", "jax.lax.", "jax.nn.", "lax.")
+# jax.* calls that return HOST values (never taint)
+_HOST_RETURNING = {
+    "jax.device_get", "jax.tree_util.tree_map", "jax.eval_shape",
+}
+_NP_NAMES = ("np", "numpy")
+_PRNG_SOURCES = {"PRNGKey", "split", "fold_in", "key"}
+
+
+def _is_jit_expr(node: ast.AST) -> Optional[ast.Call]:
+    """The jit-wrapping Call when `node` is jax.jit(...)/ledgered_jit(...)
+    or partial(jax.jit, ...)/partial(ledgered_jit, ...), else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    chain = _attr_chain(node.func)
+    tail = chain.rsplit(".", 1)[-1]
+    if chain in ("jax.jit",) or tail in ("ledgered_jit", "_ljit"):
+        return node
+    if tail == "partial" and node.args:
+        inner_chain = _attr_chain(node.args[0])
+        inner_tail = inner_chain.rsplit(".", 1)[-1]
+        if inner_chain == "jax.jit" or inner_tail in ("ledgered_jit", "_ljit"):
+            return node
+    return None
+
+
+def _jit_binds_fn(call: ast.Call) -> bool:
+    """True when the jit expression already closed over its function —
+    so a further call invokes the COMPILED fn (``jax.jit(f)(x)``),
+    vs. ``partial(jax.jit, **kw)(body)`` which merely applies jit."""
+    chain = _attr_chain(call.func)
+    tail = chain.rsplit(".", 1)[-1]
+    if chain == "jax.jit" or tail in ("ledgered_jit", "_ljit"):
+        return bool(call.args)
+    if tail == "partial":
+        return len(call.args) >= 2
+    return False
+
+
+def _literal_argnums(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    """donate_argnums as a tuple of ints when given literally."""
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)) and all(
+                isinstance(e, ast.Constant) and isinstance(e.value, int)
+                for e in v.elts
+            ):
+                return tuple(e.value for e in v.elts)
+            return None
+    return None
+
+
+def _affine_roles(fn: ast.AST) -> Tuple[str, ...]:
+    """step/drain roles from an @affine(...) decorator, if any."""
+    roles: List[str] = []
+    for dec in getattr(fn, "decorator_list", ()):
+        if not isinstance(dec, ast.Call):
+            continue
+        chain = _attr_chain(dec.func)
+        if chain.rsplit(".", 1)[-1] != "affine":
+            continue
+        for a in dec.args:
+            if isinstance(a, ast.Constant) and a.value in _STEP_ROLES:
+                roles.append(a.value)
+    return tuple(roles)
+
+
+class _JaxIndex:
+    """Per-module tables: jitted callables (+ donation map), affine
+    roles, and the one-level call graph used for reachability."""
+
+    def __init__(self, tree: ast.Module):
+        # (class|'', func-or-name) → donate_argnums (or ()) for every
+        # known jit-compiled callable in the module
+        self.jitted: Dict[Tuple[str, str], Tuple[int, ...]] = {}
+        # (class|'', func) → declared step/drain roles
+        self.roles: Dict[Tuple[str, str], Tuple[str, ...]] = {}
+        # caller key → same-module callee keys
+        self.calls: Dict[Tuple[str, str], Set[Tuple[str, str]]] = {}
+        # key → (roles, via-description) after one-level propagation
+        self.reach: Dict[Tuple[str, str], Tuple[Tuple[str, ...], str]] = {}
+        self._index(tree)
+        self._propagate()
+
+    def _index(self, tree: ast.Module) -> None:
+        for stmt in tree.body:
+            if isinstance(stmt, ast.ClassDef):
+                for s in stmt.body:
+                    self._index_stmt(s, stmt.name)
+            else:
+                self._index_stmt(stmt, "")
+
+    def _index_stmt(self, stmt: ast.stmt, cls: str) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._index_fn(stmt, cls)
+        elif isinstance(stmt, ast.Assign):
+            jit = stmt.value is not None and _is_jit_expr(stmt.value)
+            if jit:
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        self.jitted[(cls, t.id)] = _literal_argnums(jit) or ()
+
+    def _index_fn(self, fn: ast.AST, cls: str) -> None:
+        key = (cls, fn.name)
+        roles = _affine_roles(fn)
+        if roles:
+            self.roles[key] = roles
+        for dec in fn.decorator_list:
+            chain = _attr_chain(dec)
+            jit = _is_jit_expr(dec)
+            if chain == "jax.jit" or chain.endswith("ledgered_jit") or jit:
+                self.jitted[key] = (
+                    _literal_argnums(jit) if jit else None
+                ) or ()
+        callees: Set[Tuple[str, str]] = set()
+        for node in ast.walk(fn):
+            # nested defs that jit-wrap an inner function make the inner
+            # name a known jitted callable for this module's checks
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not fn:
+                for dec in node.decorator_list:
+                    jit = _is_jit_expr(dec)
+                    if jit or _attr_chain(dec) == "jax.jit":
+                        self.jitted[("", node.name)] = (
+                            _literal_argnums(jit) if jit else None
+                        ) or ()
+            if isinstance(node, ast.Call):
+                f = node.func
+                if (isinstance(f, ast.Attribute)
+                        and isinstance(f.value, ast.Name)
+                        and f.value.id == "self"):
+                    callees.add((cls, f.attr))
+                elif isinstance(f, ast.Name):
+                    callees.add(("", f.id))
+        self.calls[key] = callees
+
+    def _propagate(self) -> None:
+        for key, roles in self.roles.items():
+            cur = self.reach.get(key)
+            merged = tuple(sorted(set((cur[0] if cur else ()) + roles)))
+            self.reach[key] = (merged, "")
+        # one level: a direct callee of an affine function inherits its
+        # roles (mirrors lint.py's one-level blocking resolution)
+        for caller, roles in self.roles.items():
+            cname = f"{caller[0]}.{caller[1]}" if caller[0] else caller[1]
+            for callee in self.calls.get(caller, ()):
+                if callee in self.roles:
+                    continue  # its own decorator wins
+                prev = self.reach.get(callee)
+                merged = tuple(sorted(set((prev[0] if prev else ()) + roles)))
+                via = prev[1] if prev and prev[1] else f"called from {cname}"
+                self.reach[callee] = (merged, via)
+
+
+class _FnChecker:
+    """Checks one function body: taint-tracked host syncs, jit-arg
+    stability, PRNG linearity, donation liveness.  Statements are
+    walked in source order — good enough for a lint with an allowlist,
+    exact dataflow is out of scope."""
+
+    def __init__(self, linter: "_Linter", cls: str, fn: ast.AST,
+                 roles: Tuple[str, ...], via: str):
+        self.linter = linter
+        self.idx = linter.idx
+        self.cls = cls
+        self.fn = fn
+        self.fname = fn.name
+        self.roles = roles
+        self.via = f" ({via})" if via else ""
+        self.tainted: Set[str] = set()
+        self.keys: Dict[str, int] = {}       # prng key name → uses
+        self.donated: Dict[str, int] = {}    # name → line it was donated at
+        for arg in list(fn.args.args) + list(fn.args.kwonlyargs):
+            if arg.arg.endswith("_d"):
+                self.tainted.add(arg.arg)
+
+    # -- taint -------------------------------------------------------------- #
+
+    def _device_call(self, call: ast.Call) -> bool:
+        chain = _attr_chain(call.func)
+        if not chain or chain in _HOST_RETURNING:
+            return False
+        if chain.startswith(_DEVICE_PREFIXES):
+            return True
+        key = self._callee_key(call)
+        return key is not None and key in self.idx.jitted
+
+    def _callee_key(self, call: ast.Call) -> Optional[Tuple[str, str]]:
+        f = call.func
+        if (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)
+                and f.value.id == "self"):
+            return (self.cls, f.attr)
+        if isinstance(f, ast.Name):
+            return ("", f.id)
+        return None
+
+    def _is_tainted(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted or node.id.endswith("_d")
+        if isinstance(node, ast.Subscript):
+            return self._is_tainted(node.value)
+        if isinstance(node, ast.Attribute):
+            return node.attr.endswith("_d")
+        if isinstance(node, ast.Call):
+            return self._device_call(node)
+        return False
+
+    def _assign_taint(self, targets: List[ast.AST], value: ast.AST) -> None:
+        names: List[str] = []
+        for t in targets:
+            if isinstance(t, ast.Name):
+                names.append(t.id)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                names.extend(e.id for e in t.elts if isinstance(e, ast.Name))
+        # any reassignment revives a donated buffer and retires a key
+        for n in names:
+            self.donated.pop(n, None)
+            self.keys.pop(n, None)
+        taint = self._is_tainted(value)
+        for n in names:
+            if taint:
+                self.tainted.add(n)
+            else:
+                self.tainted.discard(n)
+        self._track_prng_assign(names, value)
+
+    def _track_prng_assign(self, names: List[str], value: ast.AST) -> None:
+        if not isinstance(value, ast.Call):
+            return
+        chain = _attr_chain(value.func)
+        if chain.rsplit(".", 1)[-1] in _PRNG_SOURCES and (
+                "random" in chain or chain.rsplit(".", 1)[-1] == "PRNGKey"):
+            for n in names:
+                self.keys[n] = 0
+
+    # -- driving ------------------------------------------------------------ #
+
+    def check(self) -> None:
+        for stmt in self.fn.body:
+            self._stmt(stmt)
+
+    def _stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # nested defs are checked as their own functions
+        if isinstance(stmt, ast.Assign):
+            self._expr(stmt.value)
+            self._assign_taint(stmt.targets, stmt.value)
+            return
+        if isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self._expr(stmt.value)
+            self._assign_taint([stmt.target], stmt.value)
+            return
+        if isinstance(stmt, ast.AugAssign):
+            self._expr(stmt.value)
+            if isinstance(stmt.target, ast.Name):
+                self._name_read(stmt.target)
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            self._truth_test(stmt.test)
+            self._expr(stmt.test)
+            for s in stmt.body:
+                self._stmt(s)
+            for s in stmt.orelse:
+                self._stmt(s)
+            return
+        if isinstance(stmt, ast.Assert):
+            self._truth_test(stmt.test)
+            self._expr(stmt.test)
+            return
+        if isinstance(stmt, ast.For):
+            self._expr(stmt.iter)
+            self._assign_taint([stmt.target], stmt.iter)
+            for s in stmt.body:
+                self._stmt(s)
+            for s in stmt.orelse:
+                self._stmt(s)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._expr(item.context_expr)
+            for s in stmt.body:
+                self._stmt(s)
+            return
+        if isinstance(stmt, ast.Try):
+            for s in stmt.body:
+                self._stmt(s)
+            for h in stmt.handlers:
+                for s in h.body:
+                    self._stmt(s)
+            for s in stmt.orelse + stmt.finalbody:
+                self._stmt(s)
+            return
+        if isinstance(stmt, ast.Return) and stmt.value is not None:
+            self._expr(stmt.value)
+            return
+        if isinstance(stmt, ast.Expr):
+            self._expr(stmt.value)
+            return
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._expr(child)
+            elif isinstance(child, ast.stmt):
+                self._stmt(child)
+
+    def _expr(self, node: ast.AST) -> None:
+        if isinstance(node, ast.Call):
+            self._call(node)
+            return
+        if isinstance(node, ast.Name):
+            self._name_read(node)
+            return
+        if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not):
+            self._truth_test(node.operand)
+        if isinstance(node, ast.BoolOp):
+            for v in node.values:
+                self._truth_test(v)
+        if isinstance(node, (ast.Lambda, ast.FunctionDef)):
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._expr(child)
+
+    def _name_read(self, node: ast.Name) -> None:
+        if not isinstance(node.ctx, ast.Load):
+            return
+        line = self.donated.get(node.id)
+        if line is not None:
+            self.linter.emit(
+                "donated-reuse", node.lineno,
+                f"'{node.id}' read after being donated at line {line} — "
+                f"the buffer belongs to XLA now (in {self.fname})",
+            )
+            del self.donated[node.id]  # one finding per donation
+
+    def _truth_test(self, test: ast.AST) -> None:
+        if not self._checked:
+            return
+        if isinstance(test, ast.Name) and self._is_tainted(test):
+            self.linter.emit(
+                "host-sync", test.lineno,
+                f"truth-testing device value '{test.id}' forces a "
+                f"host sync{self.via} (in {self.fname})",
+            )
+
+    @property
+    def _checked(self) -> bool:
+        return bool(self.roles)
+
+    def _call(self, node: ast.Call) -> None:
+        chain = _attr_chain(node.func)
+        tail = chain.rsplit(".", 1)[-1]
+
+        # host-sync family (step/drain-reachable code only)
+        if self._checked:
+            if tail == "item" and isinstance(node.func, ast.Attribute) \
+                    and self._is_tainted(node.func.value):
+                self.linter.emit(
+                    "host-sync", node.lineno,
+                    f".item() on a device value syncs the step "
+                    f"thread{self.via} (in {self.fname})",
+                )
+            if isinstance(node.func, ast.Name) \
+                    and node.func.id in ("float", "int", "bool") \
+                    and node.args and self._is_tainted(node.args[0]):
+                self.linter.emit(
+                    "host-sync", node.lineno,
+                    f"{node.func.id}() coercion of a device value syncs "
+                    f"the step thread{self.via} (in {self.fname})",
+                )
+            if tail in ("asarray", "array") and \
+                    chain.rsplit(".", 1)[0] in _NP_NAMES and \
+                    node.args and self._is_tainted(node.args[0]):
+                self.linter.emit(
+                    "host-sync", node.lineno,
+                    f"np.{tail}() on a device value syncs the step "
+                    f"thread{self.via} (in {self.fname})",
+                )
+        if "step" in self.roles:
+            if chain == "jax.device_get" or tail == "block_until_ready":
+                what = ("jax.device_get" if chain == "jax.device_get"
+                        else ".block_until_ready()")
+                self.linter.emit(
+                    "device-get", node.lineno,
+                    f"explicit sync {what} on the step role{self.via} — "
+                    f"fetches belong on the drain side (in {self.fname})",
+                )
+
+        # jit-static-drift on the jit expression itself
+        jit = _is_jit_expr(node)
+        if jit is not None:
+            self._check_jit_kwargs(jit)
+        if (isinstance(node.func, ast.Call) and _is_jit_expr(node.func)
+                and _jit_binds_fn(node.func)):
+            self.linter.emit(
+                "jit-static-drift", node.lineno,
+                f"immediately-invoked jax.jit(f)(...) — the compile "
+                f"cache dies with the expression (in {self.fname})",
+            )
+
+        # argument reads happen BEFORE the call donates anything: passing
+        # a name in the donating position is the donation, not a reuse
+        for a in node.args:
+            self._expr(a)
+        for kw in node.keywords:
+            self._expr(kw.value)
+
+        # jit-unstable-arg / prng / donation on calls INTO jitted fns
+        key = self._callee_key(node)
+        if key is not None and key in self.idx.jitted:
+            self._check_jitted_call(node, key)
+        self._count_key_uses(node, chain, tail)
+
+    def _check_jit_kwargs(self, jit: ast.Call) -> None:
+        for kw in jit.keywords:
+            if kw.arg not in ("static_argnums", "static_argnames"):
+                continue
+            v = kw.value
+            stable = isinstance(v, ast.Constant) or (
+                isinstance(v, (ast.Tuple, ast.List))
+                and all(isinstance(e, ast.Constant) for e in v.elts)
+            )
+            if not stable:
+                self.linter.emit(
+                    "jit-static-drift", jit.lineno,
+                    f"{kw.arg} computed from a non-literal expression — "
+                    f"signature can drift between runs (in {self.fname})",
+                )
+
+    def _check_jitted_call(self, node: ast.Call,
+                           key: Tuple[str, str]) -> None:
+        name = f"{key[0]}.{key[1]}" if key[0] else key[1]
+        for a in list(node.args) + [kw.value for kw in node.keywords]:
+            unstable = None
+            if isinstance(a, (ast.Set, ast.SetComp)):
+                unstable = "a set (iteration order varies)"
+            elif isinstance(a, ast.Call) and _attr_chain(a.func) == "set":
+                unstable = "set(...) (iteration order varies)"
+            elif isinstance(a, ast.Dict) and any(
+                    k is not None and not isinstance(k, ast.Constant)
+                    for k in a.keys):
+                unstable = "a dict with computed keys (ordering traced)"
+            if unstable:
+                self.linter.emit(
+                    "jit-unstable-arg", a.lineno,
+                    f"passing {unstable} into jitted '{name}' — each "
+                    f"ordering is a fresh compile (in {self.fname})",
+                )
+        donate = self.idx.jitted[key]
+        for pos in donate:
+            if pos < len(node.args) and isinstance(node.args[pos], ast.Name):
+                self.donated[node.args[pos].id] = node.lineno
+
+    def _count_key_uses(self, node: ast.Call, chain: str, tail: str) -> None:
+        consuming = not (tail in ("split", "fold_in") and "random" in chain)
+        for a in node.args:
+            if isinstance(a, ast.Name) and a.id in self.keys:
+                if not consuming:
+                    continue
+                self.keys[a.id] += 1
+                if self.keys[a.id] == 2:
+                    self.linter.emit(
+                        "prng-reuse", a.lineno,
+                        f"PRNG key '{a.id}' consumed twice without "
+                        f"split/fold_in — correlated randomness "
+                        f"(in {self.fname})",
+                    )
+
+
+class _LoopJitScanner(ast.NodeVisitor):
+    """Module-wide: jax.jit inside a for/while body (fresh cache per
+    iteration)."""
+
+    def __init__(self, linter: "_Linter"):
+        self.linter = linter
+        self.loop_depth = 0
+
+    def visit_For(self, node: ast.For) -> None:
+        self._loop(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._loop(node)
+
+    def _loop(self, node) -> None:
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        # a def inside a loop resets loop context: jitting inside a
+        # builder that itself caches is the engine's sanctioned pattern
+        saved, self.loop_depth = self.loop_depth, 0
+        self.generic_visit(node)
+        self.loop_depth = saved
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.loop_depth and _is_jit_expr(node):
+            self.linter.emit(
+                "jit-static-drift", node.lineno,
+                "jax.jit inside a loop body — a fresh compile cache "
+                "per iteration",
+            )
+        self.generic_visit(node)
+
+
+class _Linter:
+    def __init__(self, src: str, path: str):
+        self.path = path
+        self.findings: List[Finding] = []
+        self.used_allows: List[AllowEntry] = []
+        self._allow = _allow_map(src)
+        self.tree = ast.parse(src, filename=path)
+        self.idx = _JaxIndex(self.tree)
+
+    def emit(self, rule: str, line: int, message: str) -> None:
+        reason = self._allow.get(line, {}).get(rule)
+        if reason is not None:
+            self.used_allows.append(AllowEntry(self.path, line, rule, reason))
+            return
+        self.findings.append(Finding(self.path, line, rule, message))
+
+    def run(self) -> None:
+        _LoopJitScanner(self).visit(self.tree)
+        for stmt in self.tree.body:
+            if isinstance(stmt, ast.ClassDef):
+                for s in stmt.body:
+                    self._check_fn(s, stmt.name)
+            else:
+                self._check_fn(stmt, "")
+
+    def _check_fn(self, stmt: ast.stmt, cls: str) -> None:
+        if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return
+        key = (cls, stmt.name)
+        roles, via = self.idx.reach.get(key, ((), ""))
+        _FnChecker(self, cls, stmt, roles, via).check()
+        # nested defs (the engine's jit-builder pattern) are checked
+        # with the ENCLOSING function's reachability
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not stmt:
+                _FnChecker(self, cls, node, roles, via).check()
+
+
+def lint_source(src: str, path: str = "<src>"):
+    """Lint one module's source.  Returns (findings, used_allowlist)."""
+    linter = _Linter(src, path)
+    linter.run()
+    return linter.findings, linter.used_allows
+
+
+def lint_paths(paths):
+    """Lint files and/or package directories.  Returns
+    (findings, used_allowlist) across all of them."""
+    findings: List[Finding] = []
+    allows: List[AllowEntry] = []
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            files.extend(iter_python_files(p))
+        else:
+            files.append(p)
+    for f in files:
+        with open(f) as fh:
+            src = fh.read()
+        try:
+            fnd, alw = lint_source(src, path=f)
+        except SyntaxError as e:
+            findings.append(Finding(f, e.lineno or 0, "parse",
+                                    f"syntax error: {e.msg}"))
+            continue
+        findings.extend(fnd)
+        allows.extend(alw)
+    findings.sort(key=lambda x: (x.path, x.line))
+    return findings, allows

@@ -1,0 +1,500 @@
+"""Runtime JAX contracts: compile ledger + thread-role transfer guard.
+
+The static half of the JAX contract checker lives in ``jitcheck.py``;
+this module is the runtime half (docs/jax_contracts.md):
+
+Compile ledger
+--------------
+``ledgered_jit`` is a drop-in ``jax.jit`` replacement the engine's
+step builders use.  It wraps the function in a trace probe BEFORE
+handing it to ``jax.jit``: the probe body executes exactly when jax
+traces (= jit cache miss) and never on a cache hit, so every XLA
+compilation is attributed to a ``(function, arg-signature, tags)``
+tuple with zero hot-path cost — the compiled callable jax caches is
+keyed on the wrapper, and cache hits never re-enter Python.
+
+``steady_scope`` marks a region where ZERO new compilations are
+allowed (the steady-state tripwire): traces recorded inside an active
+scope become ``trips()``, which the pytest session gate
+(tests/conftest.py, next to the lockcheck gate) requires empty.
+``note_decode_block()`` counts decode blocks; with
+``DYN_TPU_XLALEDGER_STEADY=N`` set, the ledger self-arms a persistent
+steady scope after N blocks (after warmup, N decode blocks ⇒ 0 new
+compiles).  ``DYN_TPU_XLALEDGER=0`` disables the probe entirely
+(``ledgered_jit`` degrades to ``jax.jit``).
+
+A ``jax.monitoring`` listener on backend_compile events backstops the
+probe: it counts compilations jax performs OUTSIDE ledgered functions
+(library warmup, test helpers).  Those are unattributed by
+construction — the event carries no function identity — so they feed
+a single global counter, not the per-function ledger.
+
+Transfer guard (``DYN_TPU_XFERCHECK=1``)
+----------------------------------------
+Role threads (``step``/``drain`` per ``contracts.THREAD_NAME_ROLES``)
+must never perform an IMPLICIT device→host sync — ``.item()``,
+``float()``/``int()``/``bool()`` coercion — mid-step; explicit
+``jax.device_get`` is the one sanctioned sync and is wrapped in an
+allow scope.  Unknown threads (pytest main, user code) are exempt.
+
+Coverage is three-layered because the native guard is backend-shaped:
+``jax.transfer_guard_device_to_host("disallow")`` is entered
+persistently on role threads (it is thread-local), which catches
+implicit D2H on real TPU — but is inert on the CPU backend where
+tier-1 runs (arrays are already host-resident).  So the installer also
+patches ``ArrayImpl.item/__float__/__int__/__bool__/__index__`` with a
+role check that raises ``HostSyncError`` on step/drain threads, which
+fires on every backend.  ``np.asarray`` on a device array cannot be
+intercepted from Python (numpy uses the C buffer protocol), so that
+case is covered statically by jitcheck's ``host-sync`` rule plus the
+native guard on TPU.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+
+from . import contracts
+
+__all__ = [
+    "CompileEntry",
+    "HostSyncError",
+    "allow_host_sync",
+    "backend_compiles_total",
+    "compiles_by_fn",
+    "entries",
+    "guard_state",
+    "install_transfer_guard",
+    "last_entry",
+    "ledger_enabled",
+    "ledgered_jit",
+    "note_decode_block",
+    "note_transfer_violation",
+    "reset",
+    "steady_scope",
+    "summary",
+    "thread_role_init",
+    "transfer_violations",
+    "transfer_violations_total",
+    "trips",
+    "xfercheck_enabled",
+]
+
+# Flags read once at import (same convention as contracts._MODE); tests
+# flip the module globals via monkeypatch, not the env.
+_LEDGER_ON = os.environ.get("DYN_TPU_XLALEDGER", "1") not in ("", "0")
+_XFERCHECK = os.environ.get("DYN_TPU_XFERCHECK", "") not in ("", "0")
+# after N decode blocks, self-arm the steady tripwire (0 = never)
+_AUTO_STEADY_BLOCKS = int(os.environ.get("DYN_TPU_XLALEDGER_STEADY", "0") or 0)
+
+# roles whose threads must not implicitly sync (docs/jax_contracts.md)
+_GUARDED_ROLES = ("step", "drain")
+
+_SIG_MAX_CHARS = 200
+
+
+def ledger_enabled() -> bool:
+    return _LEDGER_ON
+
+
+def xfercheck_enabled() -> bool:
+    return _XFERCHECK
+
+
+class HostSyncError(RuntimeError):
+    """An implicit device→host sync ran on a step/drain-role thread."""
+
+
+@dataclasses.dataclass
+class CompileEntry:
+    """One attributed XLA compilation (jit cache miss)."""
+
+    fn: str               # qualname of the traced function
+    signature: str        # aval signature, e.g. "f32[4,64], i32[4]"
+    tags: Dict[str, Any]  # e.g. {"rung": 4}
+    thread: str
+    in_steady: bool       # a steady scope was active → this is a trip
+    scope: str            # the steady scope's label ("" outside)
+
+    def format(self) -> str:
+        tag = f" {self.tags}" if self.tags else ""
+        return f"{self.fn}({self.signature}){tag} [thread={self.thread}]"
+
+
+_LOCK = threading.Lock()
+# all guarded-by: _LOCK
+_entries: List[CompileEntry] = []
+_trips: List[CompileEntry] = []
+_compiles_by_fn: Dict[str, int] = {}
+_decode_blocks = 0
+_auto_steady_armed = False
+_steady_labels: List[str] = []
+_backend_compiles = 0
+_violations: List[dict] = []
+_violations_by_kind: Dict[str, int] = {}
+_MAX_RECORDS = 4096
+
+_tls = threading.local()
+
+# threads that ran thread_role_init: name → guard description
+_guard_threads: Dict[str, str] = {}
+
+
+# -- signature formatting ------------------------------------------------------ #
+
+_DTYPE_SHORT = {
+    "float32": "f32", "float16": "f16", "bfloat16": "bf16",
+    "float64": "f64", "int32": "i32", "int64": "i64", "int16": "i16",
+    "int8": "i8", "uint32": "u32", "uint8": "u8", "bool": "b1",
+}
+
+
+def _fmt_leaf(x: Any) -> str:
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        d = _DTYPE_SHORT.get(str(dtype), str(dtype))
+        return f"{d}[{','.join(str(s) for s in shape)}]"
+    r = repr(x)
+    return r if len(r) <= 24 else r[:21] + "..."
+
+
+def _fmt_signature(args: tuple, kwargs: dict) -> str:
+    parts: List[str] = []
+    try:
+        leaves = jax.tree_util.tree_leaves((args, kwargs))
+        for leaf in leaves:
+            parts.append(_fmt_leaf(leaf))
+            if sum(len(p) + 2 for p in parts) > _SIG_MAX_CHARS:
+                parts.append(f"...+{len(leaves) - len(parts)} more")
+                break
+    except Exception:  # noqa: BLE001 — attribution must never break tracing
+        return "<unformattable>"
+    return ", ".join(parts)
+
+
+# -- ledger recording ---------------------------------------------------------- #
+
+def _record_trace(fn_name: str, signature: str,
+                  tags: Optional[Dict[str, Any]]) -> None:
+    with _LOCK:
+        in_steady = bool(_steady_labels) or _auto_steady_armed
+        scope = (_steady_labels[-1] if _steady_labels
+                 else ("auto-steady" if _auto_steady_armed else ""))
+        e = CompileEntry(
+            fn=fn_name, signature=signature, tags=dict(tags or {}),
+            thread=threading.current_thread().name,
+            in_steady=in_steady, scope=scope,
+        )
+        if len(_entries) < _MAX_RECORDS:
+            _entries.append(e)
+        _compiles_by_fn[fn_name] = _compiles_by_fn.get(fn_name, 0) + 1
+        if in_steady and len(_trips) < _MAX_RECORDS:
+            _trips.append(e)
+
+
+def ledgered_jit(fn: Callable, *, tags: Optional[Dict[str, Any]] = None,
+                 **jit_kwargs) -> Callable:
+    """``jax.jit`` with compile attribution.
+
+    Drop-in at the call sites the engine uses
+    (``partial(ledgered_jit, donate_argnums=...)`` mirrors
+    ``partial(jax.jit, ...)``).  The probe wrapper's body runs only
+    when jax traces ``fn`` — i.e. on a jit cache miss — so recording
+    costs nothing on the steady-state hit path.  Returns plain
+    ``jax.jit(fn)`` when the ledger is disabled, for exact parity.
+    """
+    if not _LEDGER_ON:
+        return jax.jit(fn, **jit_kwargs)
+    import functools
+
+    name = getattr(fn, "__qualname__", getattr(fn, "__name__", repr(fn)))
+
+    @functools.wraps(fn)
+    def probe(*args, **kwargs):
+        _record_trace(name, _fmt_signature(args, kwargs), tags)
+        return fn(*args, **kwargs)
+
+    return jax.jit(probe, **jit_kwargs)
+
+
+@contextlib.contextmanager
+def steady_scope(label: str = "steady"):
+    """Mark a region where any new compilation is a tripwire hit."""
+    with _LOCK:
+        _steady_labels.append(label)
+    try:
+        yield
+    finally:
+        with _LOCK:
+            _steady_labels.remove(label)
+
+
+def note_decode_block(n: int = 1) -> None:
+    """Engine hook: called once per dispatched decode block.  Feeds the
+    DYN_TPU_XLALEDGER_STEADY=N self-arming warmup counter."""
+    global _decode_blocks, _auto_steady_armed
+    if _AUTO_STEADY_BLOCKS <= 0:
+        with _LOCK:
+            _decode_blocks += n
+        return
+    with _LOCK:
+        _decode_blocks += n
+        if not _auto_steady_armed and _decode_blocks >= _AUTO_STEADY_BLOCKS:
+            _auto_steady_armed = True
+
+
+def entries() -> List[CompileEntry]:
+    with _LOCK:
+        return list(_entries)
+
+
+def trips() -> List[CompileEntry]:
+    """Compilations that happened inside a steady scope — the session
+    gate (tests/conftest.py) requires this empty."""
+    with _LOCK:
+        return list(_trips)
+
+
+def last_entry() -> Optional[CompileEntry]:
+    """Most recent attributed compile — the wedge watchdog prints this
+    so a compile storm mid-test is diagnosable post-mortem."""
+    with _LOCK:
+        return _entries[-1] if _entries else None
+
+
+def compiles_by_fn() -> Dict[str, int]:
+    with _LOCK:
+        return dict(_compiles_by_fn)
+
+
+def backend_compiles_total() -> int:
+    """Unattributed backstop: every backend compile jax reported via
+    monitoring, ledgered or not."""
+    with _LOCK:
+        return _backend_compiles
+
+
+def summary() -> dict:
+    with _LOCK:
+        return {
+            "compiles_total": sum(_compiles_by_fn.values()),
+            "by_fn": dict(_compiles_by_fn),
+            "backend_compiles": _backend_compiles,
+            "decode_blocks": _decode_blocks,
+            "trips": [t.format() for t in _trips],
+            "transfer_violations": dict(_violations_by_kind),
+        }
+
+
+def reset() -> None:
+    """Test isolation: drop all recorded state (steady scopes stay)."""
+    global _decode_blocks, _auto_steady_armed, _backend_compiles
+    with _LOCK:
+        _entries.clear()
+        _trips.clear()
+        _compiles_by_fn.clear()
+        _violations.clear()
+        _violations_by_kind.clear()
+        _decode_blocks = 0
+        _auto_steady_armed = False
+        _backend_compiles = 0
+
+
+# -- monitoring backstop ------------------------------------------------------- #
+
+_listener_installed = False
+
+
+def _on_event_duration(event: str, duration: float, **kwargs) -> None:
+    global _backend_compiles
+    if "backend_compile" in event:
+        with _LOCK:
+            _backend_compiles += 1
+
+
+def _install_listener() -> None:
+    global _listener_installed
+    if _listener_installed:
+        return
+    _listener_installed = True
+    try:
+        jax.monitoring.register_event_duration_secs_listener(
+            _on_event_duration
+        )
+    # lint: allow(swallowed-exception): monitoring is a best-effort backstop; the attributed ledger works without it
+    except Exception:  # noqa: BLE001
+        pass
+
+
+if _LEDGER_ON:
+    _install_listener()
+
+
+# -- transfer guard ------------------------------------------------------------ #
+
+def _sync_allowed() -> bool:
+    return getattr(_tls, "allow_depth", 0) > 0
+
+
+@contextlib.contextmanager
+def allow_host_sync(reason: str = ""):
+    """Sanction an explicit device→host sync on a role thread (the
+    drain thread's ``device_get``; any fetch a human signed off on)."""
+    _tls.allow_depth = getattr(_tls, "allow_depth", 0) + 1
+    try:
+        yield
+    finally:
+        _tls.allow_depth -= 1
+
+
+def note_transfer_violation(kind: str, role: str) -> None:
+    with _LOCK:
+        _violations_by_kind[kind] = _violations_by_kind.get(kind, 0) + 1
+        if len(_violations) < _MAX_RECORDS:
+            _violations.append({
+                "kind": kind,
+                "role": role,
+                "thread": threading.current_thread().name,
+            })
+
+
+def transfer_violations() -> List[dict]:
+    with _LOCK:
+        return [dict(v) for v in _violations]
+
+
+def transfer_violations_total() -> Dict[str, int]:
+    with _LOCK:
+        return dict(_violations_by_kind)
+
+
+def _guard_check(kind: str) -> None:
+    """Raise iff the current thread's role forbids implicit D2H."""
+    if not _XFERCHECK:
+        return  # patches may outlive a test's enable; stay inert
+    if _sync_allowed():
+        return
+    role = contracts.current_role()
+    if role not in _GUARDED_ROLES:
+        return
+    note_transfer_violation(kind, role)
+    raise HostSyncError(
+        f"implicit device->host sync ({kind}) on a {role!r}-role thread "
+        f"({threading.current_thread().name}); fetch via jax.device_get "
+        f"on the drain side, or wrap in xla_ledger.allow_host_sync()"
+    )
+
+
+_patched = False
+
+
+def _array_impl_class():
+    try:
+        from jaxlib import xla_extension
+
+        return xla_extension.ArrayImpl
+    except Exception:  # noqa: BLE001 — jaxlib layout varies across versions
+        return None
+
+
+def install_transfer_guard() -> bool:
+    """Idempotently patch ``ArrayImpl``'s implicit-sync dunders with the
+    role check, and wrap ``jax.device_get`` in an allow scope.  Returns
+    True when the patch is in place.  Process-global, but the check
+    itself is role-gated per call, so unknown threads are unaffected.
+
+    ``__array__``/``np.asarray`` is NOT covered here: numpy reads the
+    buffer protocol straight from C.  The static ``host-sync`` lint and
+    the native per-thread transfer guard (TPU) own that case.
+    """
+    global _patched
+    if _patched:
+        return True
+    cls = _array_impl_class()
+    if cls is None:
+        return False
+
+    def guarded(kind: str, orig):
+        def method(self, *a, **kw):
+            _guard_check(kind)
+            return orig(self, *a, **kw)
+        method.__name__ = getattr(orig, "__name__", kind)
+        return method
+
+    for kind, dunder in (
+        ("item", "item"),
+        ("float", "__float__"),
+        ("int", "__int__"),
+        ("bool", "__bool__"),
+        ("index", "__index__"),
+    ):
+        orig = getattr(cls, dunder, None)
+        if orig is not None and not getattr(orig, "_dyn_tpu_guard", False):
+            m = guarded(kind, orig)
+            m._dyn_tpu_guard = True
+            try:
+                setattr(cls, dunder, m)
+            except TypeError:
+                # immutable extension type on this jaxlib — the native
+                # guard + static lint still cover role threads
+                _patched = False
+                return False
+
+    if not getattr(jax.device_get, "_dyn_tpu_guard", False):
+        import functools
+
+        _orig_device_get = jax.device_get
+
+        @functools.wraps(_orig_device_get)
+        def device_get(x):
+            with allow_host_sync("jax.device_get is the sanctioned sync"):
+                return _orig_device_get(x)
+
+        device_get._dyn_tpu_guard = True
+        jax.device_get = device_get
+
+    _patched = True
+    return True
+
+
+def thread_role_init() -> None:
+    """Executor ``initializer=``: on step/drain threads (resolved from
+    the thread name via ``contracts``), enter a PERSISTENT native
+    ``jax.transfer_guard_device_to_host("disallow")`` — thread-local in
+    jax, effective on real TPU — and ensure the Python-level patches
+    (effective on CPU) are installed.  No-op on unknown threads and
+    when DYN_TPU_XFERCHECK is off, so production pays nothing."""
+    if not _XFERCHECK:
+        return
+    role = contracts.current_role()
+    name = threading.current_thread().name
+    if role not in _GUARDED_ROLES:
+        _guard_threads[name] = f"role={role or 'none'} (exempt)"
+        return
+    installed = install_transfer_guard()
+    native = False
+    try:
+        ctx = jax.transfer_guard_device_to_host("disallow")
+        ctx.__enter__()  # deliberately never exited: guard for the
+        _tls.native_guard = ctx  # thread's whole life
+        native = True
+    # lint: allow(swallowed-exception): older jax without the transfer-guard API — the Python patches still cover the thread
+    except Exception:  # noqa: BLE001
+        pass
+    _guard_threads[name] = (
+        f"role={role} d2h=disallow "
+        f"(native={'on' if native else 'off'}, "
+        f"patch={'on' if installed else 'off'})"
+    )
+
+
+def guard_state() -> Dict[str, str]:
+    """Per-thread guard status for the wedge watchdog's forensics dump."""
+    return dict(_guard_threads)

@@ -32,7 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ..analysis import affine
+from ..analysis import affine, xla_ledger
 from ..models import KVCache, ModelConfig, forward_decode, forward_prefill
 from ..models.llama import forward_embed
 from ..ops import (
@@ -48,6 +48,10 @@ from ..tokens import compute_block_hash_for_seq
 from .config import EngineConfig, bucket_for
 from .page_pool import KvEvent, NoPagesError, PagePool
 from .scheduler import PrefillItem, SamplingOptions, Scheduler, Sequence, StepPlan
+
+# jax.jit with compile attribution (analysis/xla_ledger.py): every jit
+# cache miss in the engine lands in the ledger as (fn, signature, rung)
+_ljit = xla_ledger.ledgered_jit
 
 logger = logging.getLogger(__name__)
 
@@ -235,7 +239,7 @@ def _build_prefill_step(cfg: ModelConfig, with_top: bool = False,
     kw = ({"out_shardings": _lockstep_out_shardings(lockstep_mesh, P())}
           if lockstep_mesh is not None else {})
 
-    @partial(jax.jit, donate_argnums=(1,), **kw)
+    @partial(_ljit, donate_argnums=(1,), **kw)
     def step(params, kv, tokens, page_table, prefix_lens, chunk_lens, samp,
              seeds, counters, *mm):
         logits, kv = forward_prefill(
@@ -279,7 +283,7 @@ def _build_prefill_step_sp(cfg: ModelConfig, mesh, with_top: bool = False,
         kw = {}
 
     if pool_axes is None:
-        @partial(jax.jit, donate_argnums=(1,), **kw)
+        @partial(_ljit, donate_argnums=(1,), **kw)
         def step(params, kv, tokens, page_table, prefix_lens, chunk_lens,
                  samp, seeds, counters, *rest):
             mm, (prefix_table,) = rest[:-1], rest[-1:]
@@ -295,7 +299,7 @@ def _build_prefill_step_sp(cfg: ModelConfig, mesh, with_top: bool = False,
             logp = compute_logprobs(logits, out)
             return _pack_out(out, logp, logits if with_top else None), out, kv
     else:
-        @partial(jax.jit, donate_argnums=(1,), **kw)
+        @partial(_ljit, donate_argnums=(1,), **kw)
         def step(params, kv, tokens, page_table, prefix_lens, chunk_lens,
                  samp, seeds, counters, *rest):
             del prefix_lens
@@ -338,7 +342,7 @@ def _build_prefill_step_pp(cfg: ModelConfig, mesh, with_top: bool = False,
 
     kw = _pp_lockstep_kw(mesh, 2, pooled) if lockstep else {}
 
-    @partial(jax.jit, donate_argnums=(1,), **kw)
+    @partial(_ljit, donate_argnums=(1,), **kw)
     def step(params, kv, tokens, page_table, prefix_lens, chunk_lens, samp,
              seeds, counters):
         logits, kv = forward_prefill_pp(
@@ -378,7 +382,7 @@ def _build_decode_step_pp(cfg: ModelConfig, mesh, n_steps: int,
     if penalized:
         kw = _pp_lockstep_kw(mesh, 5, pooled) if lockstep else {}
 
-        @partial(jax.jit, donate_argnums=(1, 5), **kw)
+        @partial(_ljit, donate_argnums=(1, 5), tags={"rung": n_steps}, **kw)
         def step(params, kv, tokens, positions, counters, counts,
                  page_table, samp, seeds):
             toks, logp, tops, counts, kv = forward_decode_pp(
@@ -391,7 +395,7 @@ def _build_decode_step_pp(cfg: ModelConfig, mesh, n_steps: int,
     else:
         kw = _pp_lockstep_kw(mesh, 4, pooled) if lockstep else {}
 
-        @partial(jax.jit, donate_argnums=(1,), **kw)
+        @partial(_ljit, donate_argnums=(1,), tags={"rung": n_steps}, **kw)
         def step(params, kv, tokens, positions, counters, page_table,
                  samp, seeds):
             toks, logp, tops, _, kv = forward_decode_pp(
@@ -414,7 +418,7 @@ def _build_export_fn(replicate_mesh=None):
         rep = NamedSharding(replicate_mesh, P())
         kw["out_shardings"] = (rep, rep)
 
-    @partial(jax.jit, **kw)
+    @partial(_ljit, **kw)
     def export(kv, pages):  # pages [N] int32 → (k,v) [L, N, page, n_kv, hd]
         return kv.k[:, pages], kv.v[:, pages]
 
@@ -422,7 +426,7 @@ def _build_export_fn(replicate_mesh=None):
 
 
 def _build_import_fn():
-    @partial(jax.jit, donate_argnums=(0,))
+    @partial(_ljit, donate_argnums=(0,))
     def imp(kv, k_blob, v_blob, pages):
         # padding rows point at trash page 0 — harmless overwrite
         return type(kv)(
@@ -575,13 +579,13 @@ def _build_decode_step(cfg: ModelConfig, n_steps: int, max_valid_pos: int,
             if lockstep_mesh is not None else {})
 
         if mrope:
-            @partial(jax.jit, donate_argnums=(1, 5), **kw)
+            @partial(_ljit, donate_argnums=(1, 5), tags={"rung": n_steps}, **kw)
             def step(params, kv, tokens, positions, counters, counts,
                      page_table, samp, seeds, rope_off):
                 return run(params, kv, tokens, positions, counters, counts,
                            page_table, samp, seeds, rope_off)
         else:
-            @partial(jax.jit, donate_argnums=(1, 5), **kw)
+            @partial(_ljit, donate_argnums=(1, 5), tags={"rung": n_steps}, **kw)
             def step(params, kv, tokens, positions, counters, counts,
                      page_table, samp, seeds):
                 return run(params, kv, tokens, positions, counters, counts,
@@ -592,13 +596,13 @@ def _build_decode_step(cfg: ModelConfig, n_steps: int, max_valid_pos: int,
             if lockstep_mesh is not None else {})
 
         if mrope:
-            @partial(jax.jit, donate_argnums=(1,), **kw)
+            @partial(_ljit, donate_argnums=(1,), tags={"rung": n_steps}, **kw)
             def step(params, kv, tokens, positions, counters, page_table,
                      samp, seeds, rope_off):
                 return run(params, kv, tokens, positions, counters, None,
                            page_table, samp, seeds, rope_off)
         else:
-            @partial(jax.jit, donate_argnums=(1,), **kw)
+            @partial(_ljit, donate_argnums=(1,), tags={"rung": n_steps}, **kw)
             def step(params, kv, tokens, positions, counters, page_table,
                      samp, seeds):
                 return run(params, kv, tokens, positions, counters, None,
@@ -733,28 +737,28 @@ def _build_decode_step_cc(cfg: ModelConfig, n_steps: int, max_valid_pos: int,
     mrope = bool(cfg.mrope_section)
     if penalized:
         if mrope:
-            @partial(jax.jit, donate_argnums=(1, 5))
+            @partial(_ljit, donate_argnums=(1, 5), tags={"rung": n_steps})
             def step(params, kv, tokens, positions, counters, counts, act,
                      budget, stops, page_table, samp, seeds, rope_off):
                 return run(params, kv, tokens, positions, counters, counts,
                            act, budget, stops, page_table, samp, seeds,
                            rope_off)
         else:
-            @partial(jax.jit, donate_argnums=(1, 5))
+            @partial(_ljit, donate_argnums=(1, 5), tags={"rung": n_steps})
             def step(params, kv, tokens, positions, counters, counts, act,
                      budget, stops, page_table, samp, seeds):
                 return run(params, kv, tokens, positions, counters, counts,
                            act, budget, stops, page_table, samp, seeds)
     else:
         if mrope:
-            @partial(jax.jit, donate_argnums=(1,))
+            @partial(_ljit, donate_argnums=(1,), tags={"rung": n_steps})
             def step(params, kv, tokens, positions, counters, act, budget,
                      stops, page_table, samp, seeds, rope_off):
                 return run(params, kv, tokens, positions, counters, None,
                            act, budget, stops, page_table, samp, seeds,
                            rope_off)
         else:
-            @partial(jax.jit, donate_argnums=(1,))
+            @partial(_ljit, donate_argnums=(1,), tags={"rung": n_steps})
             def step(params, kv, tokens, positions, counters, act, budget,
                      stops, page_table, samp, seeds):
                 return run(params, kv, tokens, positions, counters, None,
@@ -803,13 +807,13 @@ def _build_spec_verify_step(cfg: ModelConfig, *, greedy: bool = False,
         return packed, kv
 
     if mrope:
-        @partial(jax.jit, donate_argnums=(1,), **kw)
+        @partial(_ljit, donate_argnums=(1,), **kw)
         def step(params, kv, tokens, positions, page_table, samp, seeds,
                  counters, rope_off):
             return body(params, kv, tokens, positions, page_table, samp,
                         seeds, counters, rope_off)
     else:
-        @partial(jax.jit, donate_argnums=(1,), **kw)
+        @partial(_ljit, donate_argnums=(1,), **kw)
         def step(params, kv, tokens, positions, page_table, samp, seeds,
                  counters):
             return body(params, kv, tokens, positions, page_table, samp,
@@ -881,7 +885,7 @@ def _build_mixed_step(cfg: ModelConfig, n_steps: int, max_valid_pos: int,
                             with_top, attn_impl, greedy)
     kw = ({"out_shardings": _lockstep_out_shardings(lockstep_mesh, P())}
           if lockstep_mesh is not None else {})
-    return partial(jax.jit, donate_argnums=(1,), **kw)(body)
+    return partial(_ljit, donate_argnums=(1,), tags={"rung": n_steps}, **kw)(body)
 
 
 # -- partitioned-pool (kv_partition) step builders -------------------------- #
@@ -963,7 +967,7 @@ def _build_prefill_step_pooled(cfg: ModelConfig, mesh, pool_axes,
         axis_names=set(pool_axes),
     )
     kw = _lockstep_pooled_kw(mesh, pool_axes, out_specs) if lockstep else {}
-    return partial(jax.jit, donate_argnums=(1,), **kw)(sm)
+    return partial(_ljit, donate_argnums=(1,), **kw)(sm)
 
 
 def _build_decode_step_pooled(cfg: ModelConfig, mesh, pool_axes, n_steps: int,
@@ -1005,7 +1009,7 @@ def _build_decode_step_pooled(cfg: ModelConfig, mesh, pool_axes, n_steps: int,
         axis_names=set(pool_axes),
     )
     kw = _lockstep_pooled_kw(mesh, pool_axes, out_specs) if lockstep else {}
-    step = partial(jax.jit, donate_argnums=donate, **kw)(sm)
+    step = partial(_ljit, donate_argnums=donate, tags={"rung": n_steps}, **kw)(sm)
     if penalized:
         return step
     # present the same call shape as _build_decode_step's plain variant
@@ -1045,7 +1049,7 @@ def _build_mixed_step_pooled(cfg: ModelConfig, mesh, pool_axes, n_steps: int,
     )
     kw = (_lockstep_pooled_kw(mesh, pool_axes, out_specs, n_replicated=2)
           if lockstep else {})
-    return partial(jax.jit, donate_argnums=(1,), **kw)(sm)
+    return partial(_ljit, donate_argnums=(1,), tags={"rung": n_steps}, **kw)(sm)
 
 
 def _build_export_fn_pooled(cfg: ModelConfig, mesh, pool_axes,
@@ -1076,7 +1080,7 @@ def _build_export_fn_pooled(cfg: ModelConfig, mesh, pool_axes,
     if replicate_out:
         rep = NamedSharding(mesh, P())
         kw["out_shardings"] = (rep, rep)
-    return jax.jit(sm, **kw)
+    return _ljit(sm, **kw)
 
 
 def _build_export_fn_pp_pooled(cfg: ModelConfig, mesh,
@@ -1106,7 +1110,7 @@ def _build_export_fn_pp_pooled(cfg: ModelConfig, mesh,
     if replicate_out:
         rep = NamedSharding(mesh, P())
         kw["out_shardings"] = (rep, rep)
-    fn = jax.jit(lambda kv, pages, rank: sm(kv.k, kv.v, pages, rank), **kw)
+    fn = _ljit(lambda kv, pages, rank: sm(kv.k, kv.v, pages, rank), **kw)
     return fn
 
 
@@ -1140,7 +1144,7 @@ def _build_import_fn_pp_pooled(cfg: ModelConfig, mesh,
         out_specs=(kv_in, kv_in), axis_names={"pp", "dp"},
     )
 
-    @partial(jax.jit, donate_argnums=(0,))
+    @partial(_ljit, donate_argnums=(0,))
     def imp(kv, k_blob, v_blob, pages, rank):
         k_new, v_new = sm(kv.k, kv.v, k_blob, v_blob, pages, rank)
         return type(kv)(k_new, v_new)
@@ -1178,7 +1182,7 @@ def _build_import_fn_pooled(cfg: ModelConfig, mesh, pool_axes,
         out_specs=kvspec,
         axis_names=set(pool_axes),
     )
-    return partial(jax.jit, donate_argnums=(0,))(sm)
+    return partial(_ljit, donate_argnums=(0,))(sm)
 
 
 # -- multihost lockstep plan codec ----------------------------------------- #
@@ -2086,7 +2090,8 @@ class JaxEngine:
                 import concurrent.futures as _cf
 
                 self._executor = _cf.ThreadPoolExecutor(
-                    max_workers=1, thread_name_prefix="jax-engine-step"
+                    max_workers=1, thread_name_prefix="jax-engine-step",
+                    initializer=xla_ledger.thread_role_init,
                 )
             self._loop = asyncio.get_running_loop()
             self._pump_task = self._loop.create_task(self._pump())
@@ -2483,6 +2488,7 @@ class JaxEngine:
             self._rung_dispatches[n_steps] = (
                 self._rung_dispatches.get(n_steps, 0) + blocks
             )
+            xla_ledger.note_decode_block(blocks)
         self.events.record("dispatch", step=kind, n_steps=n_steps,
                            blocks=blocks)
         if self.dispatch_trace is not None:
@@ -2564,6 +2570,7 @@ class JaxEngine:
         self.scheduler.deferred_free = deferred
         try:
             out, logp, tids, tlps = self._unpack_rows(
+                # lint: allow(device-get): prefill results are consumed on-step by design — decode, not prefill, is the latency path
                 np.asarray(jax.device_get(packed_d)), B, with_top,
                 blocks=self._prefill_blocks,
             )
@@ -2678,6 +2685,7 @@ class JaxEngine:
         measurable share of serving throughput on real chips."""
         for packed_d in dispatches:
             out, logp, tids, tlps = self._unpack_rows(
+                # lint: allow(device-get): per-block fetch overlaps host consume with the next in-flight block; the cc path drains async
                 np.asarray(jax.device_get(packed_d)), Bb, with_top,
                 blocks=self._decode_blocks,
             )  # [T, B] each
@@ -2819,6 +2827,7 @@ class JaxEngine:
             if it.seq.status == "running":
                 it.seq.num_computed += it.chunk_len
         p_out, p_logp, p_tids, p_tlps = self._unpack_rows(
+            # lint: allow(device-get): mixed-step prefill half, consumed on-step like _run_prefill
             np.asarray(jax.device_get(p_packed_d)), Bp, with_top,
             blocks=self._prefill_blocks,
         )
@@ -3040,7 +3049,8 @@ class JaxEngine:
             for arr, grid in zip(seq.mm_patches, seq.mm_grids):
                 fn = self._encode_fn.get(grid)
                 if fn is None:
-                    fn = jax.jit(
+                    # lint: allow(jit-static-drift): cache keyed by grid in self._encode_fn (LRU 64) — the loop only builds on miss
+                    fn = _ljit(
                         lambda p, px, g=grid: encode_patches(p, vcfg, px, g)
                     )
                     self._encode_fn[grid] = fn
@@ -3049,6 +3059,7 @@ class JaxEngine:
                 else:
                     self._encode_fn.move_to_end(grid)
                 embeds.append(np.asarray(
+                    # lint: allow(device-get): mm encode is prefill-side onboarding; embeds must be host np before chunk packing
                     jax.device_get(fn(vparams, jnp.asarray(arr)))
                 ))
             seq.mm_embeds = embeds
@@ -3057,10 +3068,11 @@ class JaxEngine:
         if self._encode_fn is None:
             from ..models.vision import encode_images
 
-            self._encode_fn = jax.jit(
+            self._encode_fn = _ljit(
                 lambda p, px: encode_images(p, vcfg, px)
             )
         seq.mm_embeds = np.asarray(
+            # lint: allow(device-get): mm encode is prefill-side onboarding; embeds must be host np before chunk packing
             jax.device_get(self._encode_fn(vparams, jnp.asarray(seq.mm_pixels)))
         )
         seq.mm_pixels = None
@@ -3252,6 +3264,7 @@ class JaxEngine:
             rope_off=rope_off,
         )
         out, logp, n_acc = _unpack_spec(
+            # lint: allow(device-get): spec verify needs accept counts on host to commit tokens; one packed fetch per dispatch
             np.asarray(jax.device_get(packed_d)), B, k + 1
         )
         self._spec_dispatch_total += 1
@@ -3446,7 +3459,8 @@ class JaxEngine:
             import concurrent.futures as _cf
 
             self._drain_pool = _cf.ThreadPoolExecutor(
-                max_workers=1, thread_name_prefix="jax-engine-drain"
+                max_workers=1, thread_name_prefix="jax-engine-drain",
+                initializer=xla_ledger.thread_role_init,
             )
         return self._drain_pool
 
@@ -3921,7 +3935,7 @@ class JaxEngine:
             if self._encode_fn is None:
                 from ..models.vision import encode_images
 
-                self._encode_fn = jax.jit(
+                self._encode_fn = _ljit(
                     lambda p, px: encode_images(p, vcfg, px)
                 )
             return np.asarray(jax.device_get(
@@ -3945,7 +3959,7 @@ class JaxEngine:
             cfg = self.model_cfg
             kw = ({"out_shardings": NamedSharding(self.mesh, P())}
                   if self._multihost else {})
-            self._embed_fn = jax.jit(
+            self._embed_fn = _ljit(
                 lambda p, tok, ln: forward_embed(p, cfg, tok, ln), **kw
             )
         out = self._embed_fn(
@@ -4032,8 +4046,8 @@ class JaxEngine:
             padded[: len(pages)] = pages
         if self._multihost:
             if isinstance(kpad, jax.Array):
-                kpad = np.asarray(jax.device_get(kpad))
-                vpad = np.asarray(jax.device_get(vpad))
+                # lint: allow(device-get): lockstep blob staging needs host bytes; one batched fetch for both planes
+                kpad, vpad = map(np.asarray, jax.device_get((kpad, vpad)))
             kpad = np.ascontiguousarray(kpad)
             vpad = np.ascontiguousarray(vpad)
             tid, addr = self._stage_blob(kpad, vpad)

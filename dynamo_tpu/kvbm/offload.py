@@ -28,7 +28,7 @@ import logging
 from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional, Sequence, Tuple
 
-from ..analysis import affine, make_lock
+from ..analysis import affine, make_lock, xla_ledger
 from .disk import DiskTier
 from .host_pool import HostBlock, HostBlockPool
 
@@ -52,7 +52,8 @@ class TieredKvCache:
         # ONE drain thread: host inserts stay ordered, and demotion disk
         # writes serialize instead of thrashing a shared tier directory
         self._drain = ThreadPoolExecutor(
-            max_workers=1, thread_name_prefix="kvbm-offload"
+            max_workers=1, thread_name_prefix="kvbm-offload",
+            initializer=xla_ledger.thread_role_init,
         )
         self.onboarded_blocks = 0
         self.offloaded_blocks = 0
@@ -140,7 +141,8 @@ class TieredKvCache:
             # close()d by a previous owner's shutdown and re-attached to a
             # new engine: reopen the drain lazily
             self._drain = ThreadPoolExecutor(
-                max_workers=1, thread_name_prefix="kvbm-offload"
+                max_workers=1, thread_name_prefix="kvbm-offload",
+                initializer=xla_ledger.thread_role_init,
             )
             self._drain.submit(self._complete_offload, chunks, parents,
                                engine)

@@ -151,6 +151,49 @@ class TracingSpanCollector:
             yield fam
 
 
+class XlaLedgerCollector:
+    """The compile ledger (analysis/xla_ledger.py) on worker /metrics:
+    ``dynamo_tpu_worker_xla_compiles_total{fn}`` — every attributed XLA
+    compilation, labeled by the traced function — and
+    ``dynamo_tpu_worker_xla_transfer_guard_violations_total{kind}`` —
+    implicit device→host syncs a step/drain-role thread attempted under
+    DYN_TPU_XFERCHECK=1.  A compile-count curve that keeps climbing
+    after warmup is the recompile-leak signature the steady-state
+    tripwire pins down in tests; in production this series is the same
+    signal.  Yields nothing when the ledger is disabled (absent series,
+    not zeros)."""
+
+    def collect(self):
+        from prometheus_client.core import CounterMetricFamily
+
+        from ..analysis import xla_ledger
+
+        if not xla_ledger.ledger_enabled():
+            return
+        try:
+            by_fn = xla_ledger.compiles_by_fn()
+            violations = xla_ledger.transfer_violations_total()
+        except Exception:  # noqa: BLE001 — a scrape must not break /metrics
+            return
+        fam = CounterMetricFamily(
+            "dynamo_tpu_worker_xla_compiles",
+            "attributed XLA compilations (jit cache misses) by function",
+            labels=["fn"],
+        )
+        for fn, n in sorted(by_fn.items()):
+            fam.add_metric([fn], n)
+        yield fam
+        vfam = CounterMetricFamily(
+            "dynamo_tpu_worker_xla_transfer_guard_violations",
+            "implicit device-to-host syncs attempted on step/drain-role "
+            "threads (DYN_TPU_XFERCHECK=1)",
+            labels=["kind"],
+        )
+        for kind, n in sorted(violations.items()):
+            vfam.add_metric([kind], n)
+        yield vfam
+
+
 TELEMETRY_ROOT = "/telemetry"
 
 

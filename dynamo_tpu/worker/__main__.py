@@ -450,7 +450,11 @@ async def _run(args) -> None:
         # engine ForwardPassMetrics on every scrape — counters for
         # monotonic fields (incl. the spec_decode draft/accept pair) so
         # rate() is well-typed, gauges for the rest
-        from ..runtime.metrics import EngineStatsCollector, TracingSpanCollector
+        from ..runtime.metrics import (
+            EngineStatsCollector,
+            TracingSpanCollector,
+            XlaLedgerCollector,
+        )
 
         scope = MetricsScope(
             namespace=args.namespace, component=args.component,
@@ -460,6 +464,9 @@ async def _run(args) -> None:
         ))
         # span-exporter sent/dropped counters (silent span loss -> visible)
         scope.registry.register(TracingSpanCollector())
+        # compile ledger: per-function XLA compiles + transfer-guard
+        # violations (a climbing compile curve after warmup = recompile leak)
+        scope.registry.register(XlaLedgerCollector())
 
         def _events():
             """Step-event ring dump(s) for /events.json — the engine(s)

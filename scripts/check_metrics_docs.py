@@ -106,11 +106,17 @@ def worker_metric_names() -> set:
     from dynamo_tpu.runtime.metrics import (
         EngineStatsCollector,
         TracingSpanCollector,
+        XlaLedgerCollector,
     )
 
     stats = representative_engine_stats()
     names = set()
     for fam in EngineStatsCollector(lambda: stats).collect():
+        name = fam.name
+        if fam.type in _COUNTER_SUFFIX:
+            name += "_total"
+        names.add(name)
+    for fam in XlaLedgerCollector().collect():
         name = fam.name
         if fam.type in _COUNTER_SUFFIX:
             name += "_total"

@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""CI gate: the lint rule tables in docs/ must match the RULES tuples
+in the code.
+
+    python scripts/check_rule_docs.py        # exit 1 on drift
+
+Extracts the ``RULES`` tuple from each lint module **purely via AST**
+(no imports, so the check survives a half-broken package) and diffs it
+— both directions — against the ``| Rule | Flags |`` table in that
+lint's document:
+
+- ``dynamo_tpu/analysis/lint.py``     ↔ docs/concurrency.md
+- ``dynamo_tpu/analysis/jitcheck.py`` ↔ docs/jax_contracts.md
+
+A renamed or added rule cannot land undocumented, and the docs cannot
+advertise rules the lints no longer enforce — the same contract
+``check_trace_docs.py`` holds for span/event names.
+
+Import-safe: ``from check_rule_docs import check`` — the tier-1 test
+tests/test_rule_docs.py runs exactly this.
+"""
+
+import ast
+import os
+import re
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+# (lint module, the doc whose rule table describes it)
+PAIRS = (
+    (os.path.join(ROOT, "dynamo_tpu", "analysis", "lint.py"),
+     os.path.join(ROOT, "docs", "concurrency.md")),
+    (os.path.join(ROOT, "dynamo_tpu", "analysis", "jitcheck.py"),
+     os.path.join(ROOT, "docs", "jax_contracts.md")),
+)
+
+
+def rules_in_module(path: str) -> set:
+    """The module's RULES tuple, read from the AST (no import)."""
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    for stmt in tree.body:
+        if not isinstance(stmt, ast.Assign):
+            continue
+        if any(isinstance(t, ast.Name) and t.id == "RULES"
+               for t in stmt.targets):
+            if isinstance(stmt.value, (ast.Tuple, ast.List)):
+                return {
+                    e.value for e in stmt.value.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str)
+                }
+    return set()
+
+
+def rules_in_doc(path: str) -> set:
+    """Backticked rule names from the doc's ``| Rule | Flags |`` table
+    (other tables — thread roles, metrics, guard layers — ignored)."""
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError:
+        return set()
+    out = set()
+    in_table = False
+    for line in text.splitlines():
+        if re.match(r"\|\s*Rule\s*\|", line):
+            in_table = True
+            continue
+        if in_table:
+            m = re.match(r"\|\s*`([a-z-]+)`\s*\|", line)
+            if m:
+                out.add(m.group(1))
+            elif not line.strip().startswith("|"):
+                in_table = False
+    return out
+
+
+def check() -> list:
+    """Returns a list of drift errors (empty = contract holds)."""
+    errors = []
+    for mod, doc in PAIRS:
+        code = rules_in_module(mod)
+        documented = rules_in_doc(doc)
+        mod_rel = os.path.relpath(mod, ROOT)
+        doc_rel = os.path.relpath(doc, ROOT)
+        if not code:
+            errors.append(f"no RULES tuple found in {mod_rel}")
+            continue
+        if not documented:
+            errors.append(f"no '| Rule |' table found in {doc_rel}")
+            continue
+        for r in sorted(code - documented):
+            errors.append(f"{mod_rel}: rule '{r}' undocumented in {doc_rel}")
+        for r in sorted(documented - code):
+            errors.append(f"{doc_rel}: documents rule '{r}' absent from "
+                          f"{mod_rel}")
+    return errors
+
+
+def main() -> int:
+    errors = check()
+    if errors:
+        for e in errors:
+            print(e, file=sys.stderr)
+        print(f"RULE DOC DRIFT ({len(errors)} issue(s))", file=sys.stderr)
+        return 1
+    n = sum(len(rules_in_module(m)) for m, _ in PAIRS)
+    print(f"RULE DOCS OK ({n} rules documented)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Single static-analysis entry point: every lint the repo gates on.
+
+    python scripts/lint_all.py             # exit 1 on any finding
+    python scripts/lint_all.py --json      # machine-readable, both lints
+    python scripts/lint_all.py path [...]  # specific files/dirs
+
+Runs, in order:
+- the concurrency contract lint (scripts/lint_concurrency.py,
+  dynamo_tpu/analysis/lint.py — docs/concurrency.md);
+- the JAX contract lint (scripts/lint_jax.py,
+  dynamo_tpu/analysis/jitcheck.py — docs/jax_contracts.md).
+
+CI and tier-1 invoke this one gate instead of tracking the lint
+inventory by hand; a new lint gets added HERE and nowhere else.
+"""
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+import lint_concurrency  # noqa: E402
+import lint_jax  # noqa: E402
+
+# name → import-safe runner returning (findings, used_allowlist)
+LINTS = (
+    ("concurrency", lint_concurrency.run),
+    ("jax", lint_jax.run),
+)
+
+
+def run(paths=None):
+    """Returns {name: (findings, used_allowlist)} for every lint."""
+    return {name: fn(paths) for name, fn in LINTS}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*", help="files or package dirs "
+                    "(default: dynamo_tpu/)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings + allowlists as JSON")
+    args = ap.parse_args(argv)
+
+    results = run(args.paths or None)
+
+    if args.as_json:
+        print(json.dumps({
+            name: {
+                "findings": [dataclasses.asdict(f) for f in findings],
+                "allowlist": [dataclasses.asdict(a) for a in allows],
+            }
+            for name, (findings, allows) in results.items()
+        }, indent=1))
+        return 1 if any(f for f, _ in results.values()) else 0
+
+    total = 0
+    for name, (findings, allows) in results.items():
+        for f in findings:
+            print(f.format(), file=sys.stderr)
+        total += len(findings)
+        status = f"{len(findings)} finding(s)" if findings else "OK"
+        print(f"{name} lint: {status} ({len(allows)} allows)")
+    if total:
+        print(f"LINT ALL: {total} finding(s)", file=sys.stderr)
+        return 1
+    print("LINT ALL OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -76,6 +76,22 @@ def _dump_wedge_forensics(nodeid: str) -> None:
                 err.write(f"held tracked locks: {held or '{}'}\n")
         except Exception:  # noqa: BLE001 — forensics must not mask the dump
             pass
+        try:
+            # a compile storm mid-test shows up as the last ledger entry;
+            # a wedged role thread shows its transfer-guard state
+            from dynamo_tpu.analysis import xla_ledger
+
+            guards = xla_ledger.guard_state()
+            if guards:
+                err.write(f"transfer-guard state: {guards}\n")
+            last = xla_ledger.last_entry()
+            if last is not None:
+                err.write(
+                    f"last xla compile ({len(xla_ledger.entries())} "
+                    f"total): {last.format()}\n"
+                )
+        except Exception:  # noqa: BLE001 — forensics must not mask the dump
+            pass
         faulthandler.dump_traceback(file=err)
         err.write("=== end wedge dump ===\n")
         err.flush()
@@ -129,10 +145,48 @@ def pytest_sessionstart(session):
         )
 
 
+def _ledger_gate(session) -> None:
+    """The compile-ledger acceptance gate (always on next to lockcheck):
+    the session must end with zero steady-state recompile trips and
+    zero transfer-guard violations.  Tests that deliberately provoke
+    either must ``xla_ledger.reset()`` before returning."""
+    import sys
+
+    try:
+        from dynamo_tpu.analysis import xla_ledger
+    except Exception:  # noqa: BLE001 — no gate without the package
+        return
+    if not xla_ledger.ledger_enabled():
+        return
+    s = xla_ledger.summary()
+    print(
+        f"\nxla ledger: {s['compiles_total']} attributed compiles "
+        f"({s['backend_compiles']} backend), {s['decode_blocks']} decode "
+        f"blocks, {len(s['trips'])} steady-state trips, "
+        f"{sum(s['transfer_violations'].values())} transfer violations"
+    )
+    problems = [f"steady-state recompile: {t}" for t in s["trips"]]
+    problems += [
+        f"transfer-guard violation: {kind} ×{n}"
+        for kind, n in s["transfer_violations"].items()
+    ]
+    if problems:
+        print("XLA LEDGER GATE FAILED:", file=sys.stderr)
+        for p in problems:
+            print("  " + p, file=sys.stderr)
+        session.exitstatus = 1
+        raise pytest.UsageError(
+            f"xla ledger gate: {len(problems)} problem(s) — see above"
+        )
+
+
 def pytest_sessionfinish(session, exitstatus):
     """The DYN_TPU_LOCKCHECK=1 acceptance gate: the whole session (chaos
     subprocesses included) must record zero lock-order cycles, zero
-    certain self-deadlocks, and zero thread-affinity violations."""
+    certain self-deadlocks, and zero thread-affinity violations.
+    The compile-ledger gate (zero steady-state recompiles, zero
+    transfer-guard violations) runs unconditionally alongside it."""
+    _ledger_gate(session)
     try:
         from dynamo_tpu.analysis import contracts, lockcheck
     except Exception:  # noqa: BLE001 — no gate without the package

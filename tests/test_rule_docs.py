@@ -1,0 +1,71 @@
+"""Lint-rule doc contract: the RULES tuples in the two lint modules
+(dynamo_tpu/analysis/lint.py, jitcheck.py) must match the `| Rule |`
+tables in docs/concurrency.md and docs/jax_contracts.md
+(scripts/check_rule_docs.py — wired here as a tier-1 gate so a renamed
+or added rule can't land undocumented)."""
+
+import os
+import sys
+import textwrap
+
+SCRIPTS = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"
+)
+if SCRIPTS not in sys.path:
+    sys.path.insert(0, SCRIPTS)
+
+from check_rule_docs import (  # noqa: E402
+    PAIRS,
+    check,
+    rules_in_doc,
+    rules_in_module,
+)
+
+
+def test_no_drift():
+    assert check() == []
+
+
+def test_rules_extracted_from_both_lints():
+    lint_rules = rules_in_module(PAIRS[0][0])
+    jit_rules = rules_in_module(PAIRS[1][0])
+    assert {"guarded-by", "blocking-under-lock", "bare-except"} <= lint_rules
+    assert {"host-sync", "device-get", "jit-static-drift",
+            "prng-reuse", "donated-reuse", "jit-unstable-arg"} == jit_rules
+
+
+def test_doc_parser_reads_only_rule_tables(tmp_path):
+    doc = tmp_path / "doc.md"
+    doc.write_text(textwrap.dedent("""
+        | Role | Threads |
+        |---|---|
+        | `step` | not a rule |
+
+        | Rule | Flags |
+        |---|---|
+        | `host-sync` | implicit sync |
+        | `device-get` | step-side fetch |
+
+        after the table
+
+        | `ghost-rule` | outside any rule table |
+    """))
+    assert rules_in_doc(str(doc)) == {"host-sync", "device-get"}
+
+
+def test_drift_detected_both_directions(tmp_path):
+    mod = tmp_path / "mod.py"
+    mod.write_text('RULES = ("a-rule", "b-rule")\n')
+    doc = tmp_path / "doc.md"
+    doc.write_text("| Rule | Flags |\n|---|---|\n| `a-rule` | x |\n"
+                   "| `c-rule` | ghost |\n")
+    code = rules_in_module(str(mod))
+    documented = rules_in_doc(str(doc))
+    assert code - documented == {"b-rule"}      # undocumented rule
+    assert documented - code == {"c-rule"}      # documented ghost
+
+
+def test_missing_rules_tuple_is_an_error(tmp_path):
+    mod = tmp_path / "empty.py"
+    mod.write_text("x = 1\n")
+    assert rules_in_module(str(mod)) == set()

@@ -1,0 +1,320 @@
+"""The runtime JAX contracts (dynamo_tpu/analysis/xla_ledger.py): the
+compile ledger attributes every jit cache miss, the steady-state
+tripwire fires with readable attribution, the thread-role transfer
+guard blocks implicit device→host syncs on step/drain threads, and the
+engine holds ZERO steady-state compiles across the rung ladder and the
+continuous-decode chain.
+
+Tests that deliberately provoke trips or violations MUST
+``xla_ledger.reset()`` before returning — the conftest session gate
+requires both empty.
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.analysis import xla_ledger
+
+from test_block_ladder import PROMPTS, collect, make_engine, req, setup  # noqa: F401
+
+pytestmark = pytest.mark.skipif(
+    not xla_ledger.ledger_enabled(),
+    reason="DYN_TPU_XLALEDGER=0: ledger disabled for this run",
+)
+
+
+# -- compile ledger ---------------------------------------------------------- #
+
+
+def test_probe_records_on_miss_not_on_hit():
+    def stepfn(x):
+        return x * 2
+
+    g = xla_ledger.ledgered_jit(stepfn, tags={"rung": 3})
+    name = stepfn.__qualname__
+
+    def count():
+        return xla_ledger.compiles_by_fn().get(name, 0)
+
+    n0 = count()
+    g(jnp.ones((4,), jnp.float32))
+    assert count() == n0 + 1          # miss: traced + recorded
+    g(jnp.zeros((4,), jnp.float32))
+    assert count() == n0 + 1          # same signature: cache hit, no record
+    g(jnp.ones((8,), jnp.float32))
+    assert count() == n0 + 2          # new shape: second compile
+
+    mine = [e for e in xla_ledger.entries() if e.fn == name]
+    assert [e.signature for e in mine[-2:]] == ["f32[4]", "f32[8]"]
+    assert all(e.tags == {"rung": 3} for e in mine)
+    assert xla_ledger.last_entry().fn == name
+
+
+def test_signature_formats_pytrees_and_scalars():
+    def stepfn(tree, n):
+        return tree["a"] + n
+
+    g = xla_ledger.ledgered_jit(stepfn)
+    g({"a": jnp.ones((2, 4), jnp.int32)}, jnp.float32(1.0))
+    e = [x for x in xla_ledger.entries() if x.fn == stepfn.__qualname__][-1]
+    assert "i32[2,4]" in e.signature and "f32[]" in e.signature
+    assert stepfn.__qualname__ in e.format()
+
+
+def test_steady_scope_trip_has_readable_attribution():
+    def coldfn(x):
+        return x + 1
+
+    g = xla_ledger.ledgered_jit(coldfn, tags={"rung": 8})
+    try:
+        with xla_ledger.steady_scope("after-warmup"):
+            g(jnp.ones((3,), jnp.float32))
+        trips = xla_ledger.trips()
+        assert len(trips) == 1
+        t = trips[0]
+        assert t.in_steady and t.scope == "after-warmup"
+        # the attribution a human debugs from: function + arg signature
+        assert "coldfn" in t.format() and "f32[3]" in t.format()
+        assert "rung" in t.format()
+    finally:
+        xla_ledger.reset()  # session gate requires trips empty
+
+
+def test_warm_function_does_not_trip_in_steady_scope():
+    def warmfn(x):
+        return x - 1
+
+    g = xla_ledger.ledgered_jit(warmfn)
+    g(jnp.ones((5,), jnp.float32))  # warm outside the scope
+    before = xla_ledger.trips()
+    with xla_ledger.steady_scope():
+        g(jnp.zeros((5,), jnp.float32))
+    assert xla_ledger.trips() == before
+
+
+def test_disabled_ledger_degrades_to_plain_jit(monkeypatch):
+    monkeypatch.setattr(xla_ledger, "_LEDGER_ON", False)
+
+    def offfn(x):
+        return x * 3
+
+    g = xla_ledger.ledgered_jit(offfn, tags={"rung": 1})
+    out = g(jnp.full((2,), 2.0, jnp.float32))
+    assert np.array_equal(np.asarray(out), [6.0, 6.0])
+    assert offfn.__qualname__ not in xla_ledger.compiles_by_fn()
+
+
+def test_summary_and_reset_roundtrip():
+    def sumfn(x):
+        return x
+
+    xla_ledger.ledgered_jit(sumfn)(jnp.ones((1,)))
+    xla_ledger.note_decode_block(3)
+    s = xla_ledger.summary()
+    assert s["compiles_total"] >= 1 and s["decode_blocks"] >= 3
+    assert set(s) >= {"by_fn", "backend_compiles", "trips",
+                      "transfer_violations"}
+    xla_ledger.reset()
+    s2 = xla_ledger.summary()
+    assert s2["compiles_total"] == 0 and s2["decode_blocks"] == 0
+    assert xla_ledger.entries() == [] and xla_ledger.last_entry() is None
+
+
+# -- transfer guard ---------------------------------------------------------- #
+
+
+def _on_named_thread(name, fn):
+    """Run fn on a thread with the given name; re-raise its exception."""
+    box = {}
+
+    def body():
+        try:
+            box["result"] = fn()
+        except BaseException as e:  # noqa: BLE001 — relayed to the caller
+            box["error"] = e
+
+    t = threading.Thread(target=body, name=name, daemon=True)
+    t.start()
+    t.join(30)
+    assert not t.is_alive(), f"thread {name} wedged"
+    if "error" in box:
+        raise box["error"]
+    return box["result"]
+
+
+@pytest.fixture
+def xfercheck(monkeypatch):
+    monkeypatch.setattr(xla_ledger, "_XFERCHECK", True)
+    if not xla_ledger.install_transfer_guard():
+        pytest.skip("ArrayImpl not patchable on this jaxlib")
+    yield
+    xla_ledger.reset()  # drop any violations so the session gate stays green
+
+
+def test_step_thread_implicit_sync_raises(xfercheck):
+    x = jnp.ones(())
+    with pytest.raises(xla_ledger.HostSyncError, match="step"):
+        _on_named_thread("jax-engine-step_t", lambda: float(x))
+    with pytest.raises(xla_ledger.HostSyncError):
+        _on_named_thread("jax-engine-step_t", x.item)
+    kinds = xla_ledger.transfer_violations_total()
+    assert kinds.get("float", 0) >= 1 and kinds.get("item", 0) >= 1
+    v = xla_ledger.transfer_violations()[0]
+    assert v["role"] == "step" and v["thread"].startswith("jax-engine-step")
+
+
+def test_drain_thread_is_also_guarded(xfercheck):
+    x = jnp.ones(())
+    with pytest.raises(xla_ledger.HostSyncError, match="drain"):
+        _on_named_thread("kvbm-offload_t", lambda: int(x))
+
+
+def test_unknown_thread_is_exempt(xfercheck):
+    x = jnp.ones(())
+    assert _on_named_thread("user-thread", lambda: float(x)) == 1.0
+
+
+def test_allow_scope_sanctions_the_sync(xfercheck):
+    x = jnp.full((), 7.0)
+
+    def body():
+        with xla_ledger.allow_host_sync("test says so"):
+            return float(x)
+
+    assert _on_named_thread("jax-engine-step_t", body) == 7.0
+
+
+def test_device_get_is_the_sanctioned_sync(xfercheck):
+    x = jnp.arange(4)
+    got = _on_named_thread("jax-engine-step_t",
+                           lambda: jax.device_get(x))
+    assert np.array_equal(got, [0, 1, 2, 3])
+
+
+def test_patches_inert_when_xfercheck_off(monkeypatch):
+    # install_transfer_guard() is process-global and may outlive a test
+    # that enabled it; with the flag off the role check must not fire
+    # even on a step-named thread
+    xla_ledger.install_transfer_guard()
+    monkeypatch.setattr(xla_ledger, "_XFERCHECK", False)
+    x = jnp.ones(())
+    assert _on_named_thread("jax-engine-step_t", lambda: float(x)) == 1.0
+
+
+def test_thread_role_init_records_guard_state(xfercheck):
+    _on_named_thread("jax-engine-step_guardinit", xla_ledger.thread_role_init)
+    _on_named_thread("unrelated-pool_t", xla_ledger.thread_role_init)
+    state = xla_ledger.guard_state()
+    assert "d2h=disallow" in state["jax-engine-step_guardinit"]
+    assert "exempt" in state["unrelated-pool_t"]
+
+
+# -- /metrics export --------------------------------------------------------- #
+
+
+def test_xla_ledger_collector_families():
+    from dynamo_tpu.runtime.metrics import XlaLedgerCollector
+
+    def mfn(x):
+        return x
+
+    xla_ledger.ledgered_jit(mfn)(jnp.ones((2,)))
+    xla_ledger.note_transfer_violation("float", "step")
+    try:
+        fams = {f.name: f for f in XlaLedgerCollector().collect()}
+        compiles = fams["dynamo_tpu_worker_xla_compiles"]
+        by_fn = {s.labels["fn"]: s.value for s in compiles.samples
+                 if s.name.endswith("_total")}
+        assert by_fn.get(mfn.__qualname__) == 1
+        viol = fams["dynamo_tpu_worker_xla_transfer_guard_violations"]
+        kinds = {s.labels["kind"]: s.value for s in viol.samples
+                 if s.name.endswith("_total")}
+        assert kinds.get("float") == 1
+    finally:
+        xla_ledger.reset()  # the provoked violation must not reach the gate
+
+
+# -- engine steady-state regression ------------------------------------------ #
+#
+# Warmup must cover every (rung × page-table-width-bucket) pair: the
+# rung ladder's state persists across requests, so the SAME request can
+# reach a rung at a different position — a different width bucket — on
+# its second run.  That is the bounded bucket_for design, not a leak
+# (docs/jax_contracts.md), so steady-state starts after two identical
+# warmup passes.
+
+
+async def test_rung_sweep_zero_steady_state_compiles(setup):  # noqa: F811
+    engine = make_engine(setup, decode_block_ladder=[1, 2, 4])
+    try:
+        r = req([1, 2, 3], max_tokens=12)
+        want, _ = await collect(engine, r)
+        await collect(engine, req([1, 2, 3], max_tokens=12))
+        with xla_ledger.steady_scope("rung-sweep"):
+            got, _ = await collect(engine, req([1, 2, 3], max_tokens=12))
+        bad = xla_ledger.trips()
+        assert bad == [], "\n".join(t.format() for t in bad)
+        assert got == want  # steady run is also token-identical
+    finally:
+        await engine.shutdown()
+        xla_ledger.reset()
+
+
+async def test_continuous_chain_zero_steady_state_compiles(setup):  # noqa: F811
+    engine = make_engine(setup, decode_continuous=True, decode_chain=2)
+    try:
+        r = req(PROMPTS[0], max_tokens=20)
+        await collect(engine, r)
+        await collect(engine, req(PROMPTS[0], max_tokens=20))
+        with xla_ledger.steady_scope("cc-chain"):
+            await collect(engine, req(PROMPTS[0], max_tokens=20))
+        bad = xla_ledger.trips()
+        assert bad == [], "\n".join(t.format() for t in bad)
+        assert engine.metrics().decode_cc_chains_total > 0
+    finally:
+        await engine.shutdown()
+        xla_ledger.reset()
+
+
+def test_decode_blocks_counted_by_engine_hook():
+    n0 = xla_ledger.summary()["decode_blocks"]
+    xla_ledger.note_decode_block(2)
+    assert xla_ledger.summary()["decode_blocks"] == n0 + 2
+
+
+# -- the step-path fix this PR landed (regression) ---------------------------- #
+
+
+async def test_import_dev_fetches_both_planes_in_one_device_get(setup, monkeypatch):  # noqa: F811
+    """PR 12's first-run triage found the multihost import staging two
+    sequential ``jax.device_get`` round-trips (k, then v); the fix
+    batches both planes into ONE fetch.  A revert doubles this count."""
+    engine = make_engine(setup)
+    calls = []
+    real_get = jax.device_get
+
+    def counting_get(x):
+        calls.append(x)
+        return real_get(x)
+
+    try:
+        monkeypatch.setattr(engine, "_multihost", True)
+        monkeypatch.setattr(engine, "_stage_blob",
+                            lambda k, v: ("tid", ("127.0.0.1", 1)))
+        monkeypatch.setattr(engine, "_lockstep_send", lambda msg: None)
+        monkeypatch.setattr(engine, "_import_fetch_replay",
+                            lambda *a, **kw: None)
+        monkeypatch.setattr(jax, "device_get", counting_get)
+        kpad = jnp.ones((2, 4, 8, 1, 2), jnp.float32)
+        vpad = jnp.zeros_like(kpad)
+        engine._import_dev([0, 1], kpad, vpad)
+    finally:
+        monkeypatch.setattr(jax, "device_get", real_get)
+        await engine.shutdown()
+
+    assert len(calls) == 1, f"expected one batched fetch, saw {len(calls)}"
+    assert isinstance(calls[0], tuple) and len(calls[0]) == 2
