@@ -1,0 +1,474 @@
+"""AST-based asyncio & resource lifecycle lint for the dynamo_tpu package.
+
+The static sibling of ``lint.py`` (threads/locks) and ``jitcheck.py``
+(JAX) for the asyncio layer: task ownership, cancellation paths, lock
+discipline across suspension points, and paired acquire/release
+resources.  Findings are ERRORS — the tier-1 gate
+(tests/test_asynccheck.py, CLI ``scripts/lint_async.py``) requires a
+clean run over ``dynamo_tpu/``.  Runtime enforcement of the same
+contracts lives in ``leak_ledger.py``; the rule table is
+docs/async_contracts.md.
+
+Rules
+-----
+
+``orphan-task``
+    The result of ``asyncio.create_task`` / ``ensure_future`` /
+    ``tracked_task`` used as a bare statement — neither stored,
+    awaited, nor given a done-callback.  The task is only weakly
+    referenced by the loop (it can be garbage-collected mid-flight)
+    and any exception it raises is silently dropped at GC time.
+
+``task-no-cancel``
+    A background task stored on ``self`` whose attribute is never
+    cancelled or awaited anywhere in the class — no ``close`` /
+    ``shutdown`` / ``stop`` path reaps it, so it outlives its owner.
+
+``await-in-lock``
+    An ``await`` inside a held *threading* lock (sync ``with`` on a
+    lock in an ``async def``).  The coroutine suspends with the lock
+    held; every other thread contending for it blocks for the full
+    suspension — the asyncio-side complement of lint.py's
+    ``blocking-under-lock``.
+
+``blocking-in-async``
+    A ``subprocess`` child-wait (``run``/``call``/``check_call``/
+    ``check_output``/``communicate``/``wait``) directly inside an
+    ``async def`` body.  Shares its name — and its allow comments —
+    with lint.py's rule, which covers the rest of the blocking set
+    (``time.sleep``, file/socket I/O, ``jax.device_get``); the two
+    passes flag disjoint calls so nothing is reported twice.
+
+``no-timeout-await``
+    Awaiting a control-plane / service / transport call (``.call()``,
+    ``.call_stream()``, ``.direct()``, ``.fetch()``, ``.round_trip()``)
+    with no ``timeout=`` kwarg, outside ``asyncio.wait_for`` and any
+    ``async with asyncio.timeout(...)`` scope — an unbounded wait on a
+    remote peer that a partition turns into a permanent wedge.
+
+``leaked-acquire``
+    A paired-resource acquire in a module with no matching release
+    token anywhere: page-pool ``.allocate(`` with no ``.free(``,
+    ``put_leased(`` with no ``delete_leased(``, or a non-daemon
+    ``threading.Thread`` in a module with no ``.join(``.  Module-level
+    pairing keeps the rule cheap and the false-positive rate near
+    zero; lease-scoped keys that die with their lease get a justified
+    allow.
+
+Allowlist: identical convention to ``lint.py`` — a finding is
+suppressed by a justified comment on the flagged line or the line
+above::
+
+    # lint: allow(orphan-task): self-reaping probe, result latched on state
+    asyncio.create_task(self._probe_once())
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from .lint import (
+    AllowEntry,
+    Finding,
+    _allow_map,
+    _attr_chain,
+    _is_lock_ctor,
+    iter_python_files,
+)
+
+__all__ = [
+    "RULES",
+    "lint_paths",
+    "lint_source",
+]
+
+RULES = (
+    "orphan-task",
+    "task-no-cancel",
+    "await-in-lock",
+    "blocking-in-async",
+    "no-timeout-await",
+    "leaked-acquire",
+)
+
+# call tails that spawn an asyncio task
+_SPAWN_TAILS = {"create_task", "ensure_future", "tracked_task"}
+
+# awaited call tails that cross a process/network boundary
+_RPC_TAILS = {"call", "call_stream", "direct", "fetch", "round_trip"}
+
+# subprocess.* entry points that block until the child exits
+_SUBPROC_TAILS = {"run", "call", "check_call", "check_output", "getoutput"}
+
+
+def _call_tail(call: ast.Call) -> str:
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return ""
+
+
+def _is_spawn(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and _call_tail(node) in _SPAWN_TAILS
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """'x' for an expression that is exactly ``self.x``, else None."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _lockish_name(name: str, known: Set[str]) -> bool:
+    stem = name.lstrip("_")
+    return name in known or stem.endswith(("lock", "cond", "condition", "mutex"))
+
+
+def _subproc_desc(call: ast.Call) -> Optional[str]:
+    fn = call.func
+    if not isinstance(fn, ast.Attribute):
+        return None
+    recv = _attr_chain(fn.value)
+    if recv in ("subprocess", "sp") and fn.attr in _SUBPROC_TAILS:
+        return f"subprocess.{fn.attr} (child wait)"
+    if fn.attr in ("communicate", "wait") and recv.endswith("proc"):
+        return f".{fn.attr}() (child wait)"
+    return None
+
+
+class _ModuleScan:
+    """Per-module tables: lock names (module globals and self attrs),
+    acquire sites, and the release tokens present anywhere in the
+    module (the ``leaked-acquire`` pairing check)."""
+
+    def __init__(self, tree: ast.Module):
+        self.lock_names: Set[str] = set()
+        self.has_free = False
+        self.has_delete_leased = False
+        self.has_join = False
+        # (line, kind) — kind in {"allocate", "put_leased", "thread"}
+        self.acquires: List = []
+        self._scan(tree)
+
+    def _scan(self, tree: ast.Module) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                value = node.value
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                if value is not None and _is_lock_ctor(value):
+                    for t in targets:
+                        if isinstance(t, ast.Name):
+                            self.lock_names.add(t.id)
+                        else:
+                            attr = _self_attr(t)
+                            if attr:
+                                self.lock_names.add(attr)
+            if not isinstance(node, ast.Call):
+                continue
+            tail = _call_tail(node)
+            if tail == "free":
+                self.has_free = True
+            elif tail == "delete_leased":
+                self.has_delete_leased = True
+            elif tail == "join":
+                self.has_join = True
+            if tail == "allocate":
+                self.acquires.append((node.lineno, "allocate"))
+            elif tail == "put_leased":
+                self.acquires.append((node.lineno, "put_leased"))
+            elif tail == "Thread" and not _daemon_true(node):
+                recv = ""
+                if isinstance(node.func, ast.Attribute):
+                    recv = _attr_chain(node.func.value)
+                if recv in ("", "threading", "_threading"):
+                    self.acquires.append((node.lineno, "thread"))
+
+
+def _daemon_true(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+            return bool(kw.value.value)
+    return False
+
+
+class _FnChecker:
+    """Per-function pass: orphan-task everywhere, plus the async-only
+    rules inside ``async def`` bodies.  Does not descend into nested
+    function definitions (each gets its own checker)."""
+
+    def __init__(self, linter: "_Linter", fn: ast.AST):
+        self.linter = linter
+        self.fn = fn
+        self.is_async = isinstance(fn, ast.AsyncFunctionDef)
+        # every Call that is the direct operand of an Await
+        self.awaited: Set[ast.Call] = set()
+        for node in self._walk(fn):
+            if isinstance(node, ast.Await) and isinstance(node.value, ast.Call):
+                self.awaited.add(node.value)
+
+    def _walk(self, root: ast.AST):
+        """ast.walk that stops at nested function/class boundaries."""
+        stack = list(ast.iter_child_nodes(root))
+        while stack:
+            node = stack.pop()
+            yield node
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+
+    def check(self) -> None:
+        self._block(self.fn.body, held_lock=None, timeout_scope=False)
+
+    # -- statement traversal with lock / timeout context ------------
+
+    def _block(self, stmts, held_lock: Optional[str],
+               timeout_scope: bool) -> None:
+        for stmt in stmts:
+            self._stmt(stmt, held_lock, timeout_scope)
+
+    def _stmt(self, stmt: ast.stmt, held_lock: Optional[str],
+              timeout_scope: bool) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return
+        if isinstance(stmt, ast.Expr) and _is_spawn(stmt.value):
+            self.linter.emit(
+                "orphan-task", stmt.lineno,
+                f"{_call_tail(stmt.value)}() result discarded — store it, "
+                "await it, or add a done-callback (the loop holds only a "
+                "weak reference and exceptions vanish at GC)")
+        if isinstance(stmt, ast.With):
+            lock = held_lock
+            for item in stmt.items:
+                name = self._lock_of(item.context_expr)
+                if name:
+                    lock = name
+            self._exprs_in(stmt, held_lock, timeout_scope)
+            self._block(stmt.body, lock, timeout_scope)
+            return
+        if isinstance(stmt, ast.AsyncWith):
+            scope = timeout_scope
+            for item in stmt.items:
+                if isinstance(item.context_expr, ast.Call) and \
+                        _call_tail(item.context_expr) in ("timeout",
+                                                          "timeout_at"):
+                    scope = True
+            self._exprs_in(stmt, held_lock, timeout_scope)
+            self._block(stmt.body, held_lock, scope)
+            return
+        # generic statement: check expressions, then recurse into any
+        # nested statement blocks (if/for/while/try bodies)
+        self._exprs_in(stmt, held_lock, timeout_scope)
+        for field in ("body", "orelse", "finalbody"):
+            self._block(getattr(stmt, field, []) or [],
+                        held_lock, timeout_scope)
+        for handler in getattr(stmt, "handlers", []) or []:
+            self._block(handler.body, held_lock, timeout_scope)
+        for case in getattr(stmt, "cases", []) or []:
+            self._block(case.body, held_lock, timeout_scope)
+
+    def _exprs_in(self, stmt: ast.stmt, held_lock: Optional[str],
+                  timeout_scope: bool) -> None:
+        """Expression-level rules over the statement's own expressions
+        (nested statement bodies are handled by _stmt's recursion)."""
+        for node in self._iter_exprs(stmt):
+            if isinstance(node, ast.Await):
+                if held_lock is not None:
+                    self.linter.emit(
+                        "await-in-lock", node.lineno,
+                        f"await while holding threading lock "
+                        f"'{held_lock}' — the coroutine suspends with "
+                        "the lock held and every contending thread "
+                        "blocks for the full suspension")
+                if isinstance(node.value, ast.Call):
+                    self._check_rpc_await(node.value, timeout_scope)
+            if isinstance(node, ast.Call) and self.is_async \
+                    and node not in self.awaited:
+                desc = _subproc_desc(node)
+                if desc:
+                    self.linter.emit(
+                        "blocking-in-async", node.lineno,
+                        f"blocking call ({desc}) on the event loop — "
+                        "stalls every connection and the engine pump")
+
+    def _iter_exprs(self, stmt: ast.stmt):
+        """Walk the statement's expressions without crossing into
+        nested statement blocks or nested defs."""
+        blocks = set()
+        for field in ("body", "orelse", "finalbody"):
+            for s in getattr(stmt, field, []) or []:
+                if isinstance(s, ast.stmt):
+                    blocks.add(s)
+        for handler in getattr(stmt, "handlers", []) or []:
+            blocks.add(handler)
+        for case in getattr(stmt, "cases", []) or []:
+            blocks.add(case)
+        stack = [c for c in ast.iter_child_nodes(stmt) if c not in blocks]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.stmt, ast.FunctionDef,
+                                 ast.AsyncFunctionDef, ast.Lambda,
+                                 ast.ClassDef, ast.ExceptHandler)):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _lock_of(self, expr: ast.AST) -> Optional[str]:
+        if isinstance(expr, ast.Name) and \
+                _lockish_name(expr.id, self.linter.scan.lock_names):
+            return expr.id
+        attr = _self_attr(expr)
+        if attr and _lockish_name(attr, self.linter.scan.lock_names):
+            return attr
+        return None
+
+    def _check_rpc_await(self, call: ast.Call, timeout_scope: bool) -> None:
+        fn = call.func
+        if not isinstance(fn, ast.Attribute) or fn.attr not in _RPC_TAILS:
+            return
+        if timeout_scope:
+            return
+        if any(kw.arg == "timeout" for kw in call.keywords):
+            return
+        self.linter.emit(
+            "no-timeout-await", call.lineno,
+            f"await .{fn.attr}() with no timeout — wrap in "
+            "asyncio.wait_for / asyncio.timeout or pass timeout= "
+            "(a partition makes this wait forever)")
+
+
+class _ClassChecker:
+    """``task-no-cancel``: tasks assigned to ``self.X`` must be
+    cancelled or awaited somewhere in the same class."""
+
+    def __init__(self, linter: "_Linter", cls: ast.ClassDef):
+        self.linter = linter
+        self.cls = cls
+
+    # a method whose name marks it as a teardown path: a task attribute
+    # merely READ there counts as managed (the common `for t in (self._a,
+    # self._b): t.cancel()` idiom hides the cancel behind a local)
+    _LIFECYCLE = ("close", "shutdown", "stop", "drain", "reap", "exit")
+
+    def check(self) -> None:
+        spawns = {}  # attr -> line of first task assignment
+        cancelled: Set[str] = set()
+        awaited: Set[str] = set()
+        reaped: Set[str] = set()
+        for node in ast.walk(self.cls):
+            if isinstance(node, ast.Assign) and _is_spawn(node.value):
+                for t in node.targets:
+                    attr = _self_attr(t)
+                    if attr:
+                        spawns.setdefault(attr, node.lineno)
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "cancel":
+                attr = _self_attr(node.func.value)
+                if attr:
+                    cancelled.add(attr)
+            if isinstance(node, ast.Await):
+                for sub in ast.walk(node):
+                    attr = _self_attr(sub)
+                    if attr:
+                        awaited.add(attr)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and any(t in node.name for t in self._LIFECYCLE):
+                for sub in ast.walk(node):
+                    attr = _self_attr(sub)
+                    if attr and isinstance(sub.ctx, ast.Load):
+                        reaped.add(attr)
+        for attr, line in sorted(spawns.items(), key=lambda kv: kv[1]):
+            if attr in cancelled or attr in awaited or attr in reaped:
+                continue
+            self.linter.emit(
+                "task-no-cancel", line,
+                f"background task 'self.{attr}' is never cancelled or "
+                "awaited in this class — no close/shutdown/stop path "
+                "reaps it, so it outlives its owner")
+
+
+class _Linter:
+    def __init__(self, src: str, path: str):
+        self.path = path
+        self.findings: List[Finding] = []
+        self.used_allows: List[AllowEntry] = []
+        self._allow = _allow_map(src)
+        self.tree = ast.parse(src, filename=path)
+        self.scan = _ModuleScan(self.tree)
+
+    def emit(self, rule: str, line: int, message: str) -> None:
+        reason = self._allow.get(line, {}).get(rule)
+        if reason is not None:
+            self.used_allows.append(AllowEntry(self.path, line, rule, reason))
+            return
+        self.findings.append(Finding(self.path, line, rule, message))
+
+    def run(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _FnChecker(self, node).check()
+            elif isinstance(node, ast.ClassDef):
+                _ClassChecker(self, node).check()
+        self._check_acquires()
+
+    def _check_acquires(self) -> None:
+        for line, kind in self.scan.acquires:
+            if kind == "allocate" and not self.scan.has_free:
+                self.emit(
+                    "leaked-acquire", line,
+                    "page-pool .allocate() in a module with no .free() — "
+                    "pages leak unless released on every path")
+            elif kind == "put_leased" and not self.scan.has_delete_leased:
+                self.emit(
+                    "leaked-acquire", line,
+                    "put_leased() in a module with no delete_leased() — "
+                    "leased keys accumulate until the lease dies")
+            elif kind == "thread" and not self.scan.has_join:
+                self.emit(
+                    "leaked-acquire", line,
+                    "non-daemon Thread in a module with no .join() — "
+                    "the thread wedges interpreter exit")
+
+
+def lint_source(src: str, path: str = "<src>"):
+    """Lint one module's source.  Returns (findings, used_allowlist)."""
+    linter = _Linter(src, path)
+    linter.run()
+    return linter.findings, linter.used_allows
+
+
+def lint_paths(paths):
+    """Lint files and/or package directories.  Returns
+    (findings, used_allowlist) across all of them."""
+    import os
+
+    findings: List[Finding] = []
+    allows: List[AllowEntry] = []
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            files.extend(iter_python_files(p))
+        else:
+            files.append(p)
+    for f in files:
+        with open(f) as fh:
+            src = fh.read()
+        try:
+            fnd, alw = lint_source(src, path=f)
+        except SyntaxError as e:
+            findings.append(Finding(f, e.lineno or 0, "parse",
+                                    f"syntax error: {e.msg}"))
+            continue
+        findings.extend(fnd)
+        allows.extend(alw)
+    return findings, allows

@@ -1,0 +1,505 @@
+"""Runtime asyncio & resource lifecycle ledger (``DYN_TPU_LEAKCHECK=1``).
+
+The static half of the lifecycle contract checker lives in
+``asynccheck.py``; this module is the runtime half
+(docs/async_contracts.md).  Everything here is a no-op unless
+``DYN_TPU_LEAKCHECK=1`` — production pays one module-global read per
+call site.
+
+Task attribution
+----------------
+``install_loop(loop, owner=...)`` installs a task factory that
+attributes every task created on the loop to (creation site, owner,
+name), plus an exception handler that traps the two asyncio leak
+signals — "Task exception was never retrieved" (a fire-and-forget
+task died and nobody looked) and "Task was destroyed but it is
+pending!" (a task was garbage-collected mid-flight) — as ledger
+records instead of log noise.  ``tracked_task(coro, owner=...)`` is
+the explicit spawn wrapper for code that wants attribution even on an
+uninstalled loop.  ``note_loop_closing(loop)`` classifies any tracked
+task still pending on that loop as an orphan; the test harness calls
+it after its sanctioned straggler-cancel, so only tasks that survive
+BOTH their owner's shutdown and the harness sweep count.
+
+Balance accounts
+----------------
+Paired acquire/release resources feed per-owner accounts:
+
+- ``pages``  — ``check_page_pool(pool, owner)`` at engine shutdown:
+  outstanding page refs with no live sequences are an imbalance.
+- ``leases`` — ``note_lease_put``/``note_lease_delete`` from
+  ``DistributedRuntime``; ``note_owner_closed`` at shutdown credits
+  keys that die with the lease (the system's contract).  An owner
+  that ends the session with keys and no shutdown is the leak.
+- ``threads`` — ``leaked_threads()`` scans live threads for the
+  repo's names (engine executors, drain/offload/blob/audit workers)
+  at gate time; a live one after all owners shut down is unjoined.
+
+``assert_balanced(owner)`` raises at the shutdown site that leaked —
+wired into engine/runtime shutdown so the failure is attributed —
+and the ``pytest_sessionfinish`` gate (tests/conftest.py) fails
+tier-1 on any orphan, swallowed exception, leaked thread, or
+imbalance left at session end.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import threading
+import traceback
+import weakref
+from typing import Any, Dict, List, Optional, Set
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "TaskRecord",
+    "assert_balanced",
+    "check_page_pool",
+    "excuse_new_threads",
+    "imbalances",
+    "install_loop",
+    "leakcheck_enabled",
+    "leaked_threads",
+    "note_lease_delete",
+    "note_lease_put",
+    "note_loop_closing",
+    "note_owner_closed",
+    "note_thread_joined",
+    "note_thread_started",
+    "orphans",
+    "reset",
+    "restore",
+    "snapshot",
+    "summary",
+    "swallowed_exceptions",
+    "tasks_active",
+    "tasks_tracked_total",
+    "tracked_task",
+]
+
+# Flag read once at import (same convention as xla_ledger / contracts);
+# tests flip the module global via monkeypatch, not the env.
+_ON = os.environ.get("DYN_TPU_LEAKCHECK", "") not in ("", "0")
+
+_MAX_RECORDS = 4096
+
+# thread names the repo spawns (lint.py's thread-hygiene rule makes
+# every Thread carry an explicit name, so this list IS the inventory);
+# executor threads get a "_N" suffix, hence prefix matching
+_REPO_THREAD_PREFIXES = (
+    "jax-engine-step", "jax-engine-drain", "kvbm-offload", "kvbm-g4",
+    "blob-stage", "otlp-push", "audit-writer",
+)
+
+
+def leakcheck_enabled() -> bool:
+    return _ON
+
+
+@dataclasses.dataclass
+class TaskRecord:
+    """One attributed asyncio task."""
+
+    site: str                 # creation site, "file.py:123"
+    owner: str                # owning component ("" = unattributed)
+    ref: Any                  # weakref to the task
+
+    def describe(self) -> str:
+        task = self.ref()
+        name = task.get_name() if task is not None else "<collected>"
+        own = f" owner={self.owner}" if self.owner else ""
+        return f"{name} @ {self.site}{own}"
+
+
+_LOCK = threading.Lock()
+# all guarded-by: _LOCK
+_tasks: Dict[int, TaskRecord] = {}   # id(task) → record
+_tasks_total = 0
+_orphans: List[dict] = []
+_swallowed: List[dict] = []
+_imbalance_records: List[dict] = []
+_lease_keys: Dict[str, Set[str]] = {}
+_lease_closed: Set[str] = set()
+_threads_started: Dict[str, int] = {}
+_threads_joined: Dict[str, int] = {}
+# thread idents abandoned by a FAILED test: the failure is already
+# reported, so the session gate must not double-report its debris
+_excused_thread_idents: set = set()
+
+
+# -- task attribution ---------------------------------------------------------- #
+
+def _creation_site() -> str:
+    """Nearest non-asyncio, non-ledger frame of the spawning stack."""
+    for frame in reversed(traceback.extract_stack()):
+        fn = frame.filename.replace("\\", "/")
+        # exact basename: endswith would also skip test_leak_ledger.py
+        if "/asyncio/" in fn or os.path.basename(fn) == "leak_ledger.py":
+            continue
+        return f"{os.path.basename(fn)}:{frame.lineno}"
+    return "<unknown>"
+
+
+def _register(task, owner: str) -> None:
+    global _tasks_total
+    rec = TaskRecord(site=_creation_site(), owner=owner,
+                     ref=weakref.ref(task))
+    with _LOCK:
+        _tasks_total += 1
+        _tasks[id(task)] = rec
+        if len(_tasks) > 4 * _MAX_RECORDS:
+            # bound memory: drop records whose task finished or died
+            for key, r in list(_tasks.items()):
+                t = r.ref()
+                if t is None or t.done():
+                    del _tasks[key]
+
+
+def _record_for(task) -> Optional[TaskRecord]:
+    with _LOCK:
+        return _tasks.get(id(task))
+
+
+def install_loop(loop, owner: str = "") -> None:
+    """Attribute every task created on ``loop`` and trap its leak
+    signals.  Chains to any previously-set exception handler (or the
+    loop default) so nothing is hidden, only recorded."""
+    if not _ON:
+        return
+    import asyncio
+
+    def factory(lp, coro, **kwargs):
+        task = asyncio.Task(coro, loop=lp, **kwargs)
+        _register(task, owner)
+        return task
+
+    prev = loop.get_exception_handler()
+
+    def handler(lp, context):
+        _trap(context)
+        if prev is not None:
+            prev(lp, context)
+        else:
+            lp.default_exception_handler(context)
+
+    loop.set_task_factory(factory)
+    loop.set_exception_handler(handler)
+
+
+def _trap(context: dict) -> None:
+    msg = context.get("message", "") or ""
+    # "never retrieved" is emitted by Future.__del__ and carries the
+    # task under "future"; "destroyed but pending" uses "task"
+    task = context.get("task") or context.get("future")
+    rec = _record_for(task) if task is not None else None
+    site = rec.site if rec else "<untracked>"
+    owner = rec.owner if rec else ""
+    get_name = getattr(task, "get_name", None)
+    name = get_name() if callable(get_name) else ""
+    if "exception was never retrieved" in msg:
+        with _LOCK:
+            if len(_swallowed) < _MAX_RECORDS:
+                _swallowed.append({
+                    "task": name, "site": site, "owner": owner,
+                    "exception": repr(context.get("exception")),
+                })
+    elif "destroyed but it is pending" in msg:
+        with _LOCK:
+            if len(_orphans) < _MAX_RECORDS:
+                _orphans.append({
+                    "task": name, "site": site, "owner": owner,
+                    "state": "destroyed-pending",
+                })
+
+
+def tracked_task(coro, *, owner: str = "", name: Optional[str] = None):
+    """``create_task`` with explicit ownership attribution.  Identical
+    to ``asyncio.create_task`` when leakcheck is off."""
+    import asyncio
+
+    task = asyncio.get_running_loop().create_task(coro, name=name)
+    if _ON:
+        rec = _record_for(task)
+        if rec is not None:
+            rec.owner = owner or rec.owner
+        else:
+            _register(task, owner)
+    return task
+
+
+def note_loop_closing(loop) -> None:
+    """Classify tracked tasks still pending on ``loop`` as orphans.
+    Call after the owner's own shutdown (and, in the test harness,
+    after the sanctioned straggler-cancel): whatever is STILL pending
+    here survived every reaping path it had."""
+    if not _ON:
+        return
+    with _LOCK:
+        records = list(_tasks.items())
+    for key, rec in records:
+        task = rec.ref()
+        if task is None:
+            continue
+        try:
+            if task.get_loop() is not loop:
+                continue
+        except RuntimeError:
+            continue
+        with _LOCK:
+            if not task.done():
+                if len(_orphans) < _MAX_RECORDS:
+                    _orphans.append({
+                        "task": task.get_name(), "site": rec.site,
+                        "owner": rec.owner,
+                        "state": "pending-at-loop-close",
+                    })
+            _tasks.pop(key, None)
+
+
+# -- balance accounts ---------------------------------------------------------- #
+
+def check_page_pool(pool, owner: str) -> int:
+    """Engine-shutdown hook: outstanding page refs at teardown are an
+    imbalance (every sequence is gone; nothing can free them now).
+    Returns the outstanding count, 0 when balanced or off."""
+    if not _ON:
+        return 0
+    outstanding = sum(getattr(pool, "_refs", {}).values())
+    if outstanding:
+        with _LOCK:
+            if len(_imbalance_records) < _MAX_RECORDS:
+                _imbalance_records.append({
+                    "account": "pages", "owner": owner,
+                    "amount": outstanding,
+                    "detail": f"{outstanding} page ref(s) held at "
+                              f"shutdown",
+                })
+    return outstanding
+
+
+def note_lease_put(owner: str, key: str) -> None:
+    if not _ON:
+        return
+    with _LOCK:
+        _lease_keys.setdefault(owner, set()).add(key)
+        _lease_closed.discard(owner)
+
+
+def note_lease_delete(owner: str, key: str) -> None:
+    if not _ON:
+        return
+    with _LOCK:
+        _lease_keys.get(owner, set()).discard(key)
+
+
+def note_owner_closed(owner: str) -> None:
+    """The owner's lease was revoked: remaining leased keys die with it
+    by design (lease-scoped registration) — credit them."""
+    if not _ON:
+        return
+    with _LOCK:
+        _lease_keys.pop(owner, None)
+        _lease_closed.add(owner)
+
+
+def note_thread_started(name: str) -> None:
+    if not _ON:
+        return
+    with _LOCK:
+        _threads_started[name] = _threads_started.get(name, 0) + 1
+
+
+def note_thread_joined(name: str) -> None:
+    if not _ON:
+        return
+    with _LOCK:
+        _threads_joined[name] = _threads_joined.get(name, 0) + 1
+
+
+def excuse_new_threads(before_idents, owner: str = "") -> int:
+    """A test FAILED mid-flight: repo threads it started (alive now, not
+    in ``before_idents``) were abandoned by the failure, which pytest
+    already reports — excuse them so the session gate doesn't
+    double-report the debris.  Returns how many were excused."""
+    if not _ON:
+        return 0
+    n = 0
+    with _LOCK:
+        for t in threading.enumerate():
+            if (t.is_alive() and t.ident not in before_idents
+                    and t.name.startswith(_REPO_THREAD_PREFIXES)):
+                _excused_thread_idents.add(t.ident)
+                n += 1
+    if n:
+        logger.info("leak ledger: excused %d thread(s) abandoned by"
+                    " failed test %s", n, owner or "<unknown>")
+    return n
+
+
+def leaked_threads() -> List[str]:
+    """Live threads with repo-owned names.  At the session gate every
+    engine/runtime has shut down, so any survivor is unjoined — except
+    debris excused by a failed test's wrapper."""
+    out = []
+    for t in threading.enumerate():
+        if t is threading.current_thread() or not t.is_alive():
+            continue
+        if t.ident in _excused_thread_idents:
+            continue
+        if t.name.startswith(_REPO_THREAD_PREFIXES):
+            out.append(t.name)
+    return sorted(out)
+
+
+# -- reporting ----------------------------------------------------------------- #
+
+def tasks_active() -> int:
+    with _LOCK:
+        records = list(_tasks.values())
+    n = 0
+    for rec in records:
+        task = rec.ref()
+        if task is not None and not task.done():
+            n += 1
+    return n
+
+
+def tasks_tracked_total() -> int:
+    with _LOCK:
+        return _tasks_total
+
+
+def orphans() -> List[dict]:
+    with _LOCK:
+        return [dict(o) for o in _orphans]
+
+
+def swallowed_exceptions() -> List[dict]:
+    with _LOCK:
+        return [dict(s) for s in _swallowed]
+
+
+def imbalances(owner: Optional[str] = None) -> Dict[str, int]:
+    """account → outstanding amount (only nonzero accounts listed)."""
+    out: Dict[str, int] = {}
+    with _LOCK:
+        for rec in _imbalance_records:
+            if owner is not None and rec["owner"] != owner:
+                continue
+            out[rec["account"]] = out.get(rec["account"], 0) + rec["amount"]
+        for own, keys in _lease_keys.items():
+            if owner is not None and own != owner:
+                continue
+            if keys and own not in _lease_closed:
+                out["leases"] = out.get("leases", 0) + len(keys)
+        started = sum(_threads_started.values())
+        joined = sum(_threads_joined.values())
+    if owner is None and started > joined:
+        out["threads"] = out.get("threads", 0) + (started - joined)
+    return out
+
+
+def assert_balanced(owner: Optional[str] = None) -> None:
+    """Raise at the shutdown site that leaked (engine/runtime wire this
+    in) so the imbalance is attributed to its owner, not discovered at
+    session end.  No-op when leakcheck is off."""
+    if not _ON:
+        return
+    imb = imbalances(owner)
+    if imb:
+        who = owner or "<all owners>"
+        raise AssertionError(
+            f"leak ledger imbalance at shutdown of {who}: {imb} "
+            f"(records: {[r for r in _imbalance_records if owner is None or r['owner'] == owner]})"
+        )
+
+
+def pending_task_table() -> List[str]:
+    """Wedge-forensics view: every tracked task still pending, with
+    its attribution — what a wedged test was waiting on."""
+    with _LOCK:
+        records = list(_tasks.values())
+    out = []
+    for rec in records:
+        task = rec.ref()
+        if task is not None and not task.done():
+            out.append(rec.describe())
+    return sorted(out)
+
+
+def summary() -> dict:
+    with _LOCK:
+        lease_outstanding = {
+            own: sorted(keys) for own, keys in _lease_keys.items()
+            if keys and own not in _lease_closed
+        }
+    return {
+        "tasks_tracked": tasks_tracked_total(),
+        "tasks_active": tasks_active(),
+        "orphans": orphans(),
+        "swallowed": swallowed_exceptions(),
+        "lease_outstanding": lease_outstanding,
+        "imbalances": imbalances(),
+        "leaked_threads": leaked_threads(),
+    }
+
+
+def reset() -> None:
+    """Test isolation: drop all recorded state."""
+    global _tasks_total
+    with _LOCK:
+        _tasks.clear()
+        _tasks_total = 0
+        _orphans.clear()
+        _swallowed.clear()
+        _imbalance_records.clear()
+        _lease_keys.clear()
+        _lease_closed.clear()
+        _threads_started.clear()
+        _threads_joined.clear()
+        _excused_thread_idents.clear()
+
+
+def snapshot() -> dict:
+    """Copy of all recorded state — pair with ``restore`` so the
+    ledger's own unit tests can reset without erasing what the session
+    gate has accumulated so far."""
+    with _LOCK:
+        return {
+            "tasks": dict(_tasks),
+            "tasks_total": _tasks_total,
+            "orphans": list(_orphans),
+            "swallowed": list(_swallowed),
+            "imbalance": list(_imbalance_records),
+            "lease_keys": {k: set(v) for k, v in _lease_keys.items()},
+            "lease_closed": set(_lease_closed),
+            "threads_started": dict(_threads_started),
+            "threads_joined": dict(_threads_joined),
+            "excused": set(_excused_thread_idents),
+        }
+
+
+def restore(snap: dict) -> None:
+    """Put back state captured by ``snapshot``, discarding anything
+    recorded since."""
+    global _tasks_total
+    with _LOCK:
+        _tasks.clear()
+        _tasks.update(snap["tasks"])
+        _tasks_total = snap["tasks_total"]
+        _orphans[:] = snap["orphans"]
+        _swallowed[:] = snap["swallowed"]
+        _imbalance_records[:] = snap["imbalance"]
+        _lease_keys.clear()
+        _lease_keys.update({k: set(v) for k, v in snap["lease_keys"].items()})
+        _lease_closed.clear()
+        _lease_closed.update(snap["lease_closed"])
+        _threads_started.clear()
+        _threads_started.update(snap["threads_started"])
+        _threads_joined.clear()
+        _threads_joined.update(snap["threads_joined"])
+        _excused_thread_idents.clear()
+        _excused_thread_idents.update(snap["excused"])

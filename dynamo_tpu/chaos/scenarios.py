@@ -428,7 +428,11 @@ async def _run_telemetry_staleness() -> ScenarioResult:
                 await asyncio.sleep(0.1)
 
         monitor_task = asyncio.create_task(monitor())
-        outcomes = await stack.drive(traffic, plan=plan)
+        try:
+            outcomes = await stack.drive(traffic, plan=plan)
+        finally:
+            monitor_task.cancel()
+            await asyncio.gather(monitor_task, return_exceptions=True)
         result.client_errors = sum(len(o.errors) for o in outcomes)
         result.stream_mismatches = sum(
             1 for b, o in zip(baseline, outcomes) if b.text != o.text)

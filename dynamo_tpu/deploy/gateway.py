@@ -57,6 +57,7 @@ async def register_frontend(runtime, port: int, scheme: str = "http") -> str:
     Returns the registration key."""
     key = f"{FRONTEND_ROOT}/{runtime.primary_lease}"
     addr = f"{scheme}://{runtime._advertise_host}:{port}"  # noqa: SLF001
+    # lint: allow(leaked-acquire): lease-scoped registration — lease revoke/expiry deletes the key
     await runtime.put_leased(key, pack({"url": addr}))
     return key
 

@@ -275,7 +275,7 @@ class DisaggDecodeHandler:
             try:
                 with span("disagg.kv_transfer") as tsp:
                     pages, stats = await self.transfer_client.fetch(
-                        result["kv_descriptor"]
+                        result["kv_descriptor"], timeout=30.0
                     )
                     tsp.attrs.update(
                         bytes=stats.bytes, ms=round(stats.ms, 3),

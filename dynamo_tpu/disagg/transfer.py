@@ -140,6 +140,7 @@ class KvTransferSource:
     async def stop(self) -> None:
         if self._reaper:
             self._reaper.cancel()
+            await asyncio.gather(self._reaper, return_exceptions=True)
         if self._server:
             self._server.close()
             await self._server.wait_closed()
@@ -151,6 +152,7 @@ class KvTransferSource:
         (the reference registers NIXL metadata in etcd)."""
         key = f"{LAYOUT_PREFIX}/{namespace}/{component}/{runtime.primary_lease}"
         value = pack({"layout": self.layout.to_dict(), "addr": self.address})
+        # lint: allow(leaked-acquire): lease-scoped registration — lease revoke/expiry deletes the key
         await runtime.put_leased(key, value)
 
     # -- handle lifecycle --------------------------------------------------- #
@@ -373,10 +375,22 @@ class KvTransferClient:
                      else ("host",))
         self.lanes = lanes
 
-    async def fetch(self, descriptor: Dict[str, Any]) -> Tuple[List[int], TransferStats]:
+    async def fetch(self, descriptor: Dict[str, Any],
+                    timeout: Optional[float] = 60.0,
+                    ) -> Tuple[List[int], TransferStats]:
         """Returns (dest page ids holding the prompt KV, stats).  Raises on
         incompatibility or transport failure — callers fall back to local
-        prefill.  Allocated pages are freed on failure."""
+        prefill.  Allocated pages are freed on failure.
+
+        ``timeout`` bounds the whole transfer (a partitioned source must
+        not wedge the caller); on expiry the in-flight lane is cancelled,
+        which runs the same settle-free-release path as any other failure.
+        ``None`` disables the deadline (profiling harnesses)."""
+        if timeout is not None:
+            return await asyncio.wait_for(self._fetch(descriptor), timeout)
+        return await self._fetch(descriptor)
+
+    async def _fetch(self, descriptor: Dict[str, Any]) -> Tuple[List[int], TransferStats]:
         t0 = time.perf_counter()
         src = KvLayout.from_dict(descriptor["layout"])
         dst = self.dest_layout

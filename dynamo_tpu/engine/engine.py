@@ -32,7 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ..analysis import affine, xla_ledger
+from ..analysis import affine, leak_ledger, xla_ledger
 from ..models import KVCache, ModelConfig, forward_decode, forward_prefill
 from ..models.llama import forward_embed
 from ..ops import (
@@ -2107,6 +2107,20 @@ class JaxEngine:
                 pass
         if self._pump_task:
             await asyncio.gather(self._pump_task, return_exceptions=True)
+        # the pump exits the moment _closed is set, so an abort queued
+        # during teardown (generate()'s finally on a cancelled stream)
+        # never reaches the scheduler and its sequence keeps its page
+        # refs forever.  Nothing can step again — reap everything still
+        # scheduled so the pool is balanced before the leak check below.
+        while self._pending_aborts:
+            self.scheduler.abort(self._pending_aborts.pop())
+        for seq in list(self.scheduler.running):
+            self.scheduler.abort(seq.request_id)
+        for seq in list(self.scheduler.waiting):
+            self.scheduler.abort(seq.request_id)
+        if self.scheduler.deferred_free:
+            self.pool.free(self.scheduler.deferred_free)
+            self.scheduler.deferred_free = None
         if self._multihost and self._lockstep_leader:
             # release follower ranks blocked in follower_loop — even when
             # the engine never served a request (no step executor yet)
@@ -2134,6 +2148,10 @@ class JaxEngine:
                 None, self.tiered.close
             )
         self._close_blob_channels()
+        # every sequence is gone: outstanding page refs can never be
+        # freed now — surface the leak at its owner, not session end
+        leak_ledger.check_page_pool(self.pool, f"engine:{id(self):x}")
+        leak_ledger.assert_balanced(f"engine:{id(self):x}")
 
     def _close_blob_channels(self) -> None:
         """Stop the lazily-started blob stage server / fetch clients

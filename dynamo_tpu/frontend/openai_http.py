@@ -28,6 +28,7 @@ from typing import Any, AsyncIterator, Dict, Optional
 
 from aiohttp import web
 
+from ..analysis import leak_ledger
 from ..llm import RequestError
 from ..runtime import Context
 from ..runtime.transport.service import RemoteStreamError, ServiceUnavailable
@@ -185,6 +186,7 @@ class HttpService:
     async def stop(self) -> None:
         if self._runner:
             await self._runner.cleanup()
+        leak_ledger.assert_balanced(f"frontend:{id(self):x}")
 
     # -- handlers ------------------------------------------------------------ #
 
@@ -564,7 +566,8 @@ class HttpService:
                 await queue.put((i, None, None))  # choice drained
 
         tasks = [
-            asyncio.create_task(pump_choice(i, preq, ctx))
+            leak_ledger.tracked_task(pump_choice(i, preq, ctx),
+                                     owner="frontend.stream")
             for i, (preq, ctx) in enumerate(
                 zip(self._choice_requests(preprocessed, n), contexts)
             )
@@ -645,6 +648,9 @@ class HttpService:
         finally:
             for t in tasks:
                 t.cancel()
+            # settle before returning: a cancelled-but-pending pump must
+            # not outlive its request (or the loop, at server shutdown)
+            await asyncio.gather(*tasks, return_exceptions=True)
         self.metrics.requests.labels(model_name, kind, status).inc()
         self.metrics.output_tokens.labels(model_name).inc(ntokens)
         self.metrics.duration.labels(model_name).observe(time.monotonic() - t0)
@@ -713,7 +719,8 @@ class HttpService:
     ) -> web.Response:
         contexts = [Context() for _ in range(n)]
         tasks = [
-            asyncio.ensure_future(self._collect_choice(entry, preq, ctx))
+            leak_ledger.tracked_task(self._collect_choice(entry, preq, ctx),
+                                     owner="frontend.unary")
             for preq, ctx in zip(
                 self._choice_requests(preprocessed, n), contexts
             )

@@ -330,6 +330,7 @@ async def register_llm(
     mdc.component = served_endpoint.instance.component
     mdc.endpoint = served_endpoint.instance.endpoint
     key = mdc.card_path(instance_id)
+    # lint: allow(leaked-acquire): lease-scoped registration — lease revoke/expiry deletes the key
     await runtime.put_leased(key, pack(mdc.to_dict()))
     logger.info("registered model %s at %s", mdc.name, key)
     return key

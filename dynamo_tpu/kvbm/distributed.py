@@ -70,6 +70,7 @@ class KvbmLeader:
 
     async def start(self, timeout: float = 60.0) -> "KvbmLeader":
         c = self.runtime.control
+        # lint: allow(leaked-acquire): lease-scoped registration — lease revoke/expiry deletes the key
         await self.runtime.put_leased(
             f"{PREFIX}/{self.ns}/config", pack(self.config.to_dict())
         )
@@ -93,6 +94,7 @@ class KvbmLeader:
         if len(distinct) != 1:
             raise ValueError(f"kvbm layout mismatch across workers: {layouts}")
         self.members = sorted(layouts)
+        # lint: allow(leaked-acquire): lease-scoped registration — lease revoke/expiry deletes the key
         await self.runtime.put_leased(
             f"{PREFIX}/{self.ns}/ready", pack({"members": self.members})
         )
@@ -125,6 +127,7 @@ class KvbmWorker:
             await asyncio.sleep(0.1)
         # 2. register our layout
         layout = KvLayout.of_engine(self.engine).to_dict()
+        # lint: allow(leaked-acquire): lease-scoped registration — lease revoke/expiry deletes the key
         await self.runtime.put_leased(
             f"{PREFIX}/{self.ns}/workers/{self.worker_id}", pack(layout)
         )

@@ -213,8 +213,11 @@ class TieredKvCache:
     def close(self) -> None:
         """Join the drain thread (no tier write outlives the caller) and
         release it.  A tier re-attached to a later engine reopens the
-        drain lazily on the next pump dispatch."""
+        drain lazily on the next pump dispatch; the G4 loop thread has
+        the same lazy-reopen contract, so it is closed here too."""
         self._drain.shutdown(wait=True)
+        if self.remote is not None:
+            self.remote.close()
 
     # -- onboarding (admission path) ----------------------------------------- #
 

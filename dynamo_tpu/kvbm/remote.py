@@ -24,7 +24,7 @@ from typing import Optional, Tuple
 import msgpack
 import numpy as np
 
-from ..analysis import affine
+from ..analysis import affine, leak_ledger
 
 logger = logging.getLogger(__name__)
 
@@ -42,11 +42,22 @@ class ObjectStoreTier:
         self._known: set[str] = set()
         self._listed = False
         self._started = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._ensure_loop()
+
+    def _ensure_loop(self) -> None:
+        """Start (or restart after close()) the tier's loop thread — the
+        same lazy-reopen contract as TieredKvCache's drain executor, so
+        a tier re-attached to a later engine keeps working."""
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._started.clear()
         self._thread = threading.Thread(
             target=self._loop_main, name="kvbm-g4", daemon=True
         )
         self._thread.start()
-        self._started.wait(timeout)
+        leak_ledger.note_thread_started("kvbm-g4")
+        self._started.wait(self.timeout)
 
     def _loop_main(self) -> None:
         self._loop = asyncio.new_event_loop()
@@ -62,6 +73,8 @@ class ObjectStoreTier:
         return self._client
 
     def _run(self, coro_fn):
+        self._ensure_loop()
+
         async def wrapped():
             client = await self._get_client()
             return await coro_fn(client)
@@ -71,6 +84,9 @@ class ObjectStoreTier:
         )
 
     def close(self) -> None:
+        """Stop the loop and JOIN the thread: no tier I/O outlives the
+        caller, and the kvbm-g4 thread doesn't leak per lifecycle.  A
+        later call re-opens the loop lazily (`_ensure_loop`)."""
         if self._loop is not None:
             if self._client is not None:
                 asyncio.run_coroutine_threadsafe(
@@ -78,6 +94,12 @@ class ObjectStoreTier:
                 ).result(2.0)
                 self._client = None
             self._loop.call_soon_threadsafe(self._loop.stop)
+            self._loop = None
+        if self._thread is not None:
+            self._thread.join(self.timeout)
+            if not self._thread.is_alive():
+                leak_ledger.note_thread_joined("kvbm-g4")
+            self._thread = None
 
     @staticmethod
     def _name(block_hash: int) -> str:

@@ -128,6 +128,7 @@ class TierSummaryPublisher:
             "host": s["host"],
             "disk": s["disk"],
         }
+        # lint: allow(leaked-acquire): lease-scoped telemetry key — lease revoke/expiry deletes it
         await self.runtime.put_leased(self.key, pack(payload))
         self._last_digest = digest
         return payload

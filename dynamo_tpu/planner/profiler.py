@@ -165,7 +165,7 @@ async def sweep_disagg(pre_engine, dec_engine,
                                             transfer_source=source)
         if "kv_descriptor" not in r:
             raise RuntimeError(f"prefill_remote failed: {r}")
-        pages, _stats = await client.fetch(r["kv_descriptor"])
+        pages, _stats = await client.fetch(r["kv_descriptor"], timeout=60.0)
         ttft = time.perf_counter() - t0  # decode-able: KV handed off
 
         async def continue_on_decode():
@@ -209,8 +209,9 @@ async def sweep_disagg(pre_engine, dec_engine,
 
         # prefill role: offered prompt-token rate → TTFT incl. handoff
         t0 = time.perf_counter()
-        await handoff(7, 1)
+        _, cal_cont = await handoff(7, 1)
         serial_s = time.perf_counter() - t0
+        await cal_cont()  # consume: frees the KV imported into the decode role
         capacity = cfg.isl / max(serial_s, 1e-6)
         loads, ttfts = [], []
         for frac in cfg.load_fractions:

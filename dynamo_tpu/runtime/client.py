@@ -86,6 +86,7 @@ class Client:
     async def stop(self) -> None:
         if self._watch_task:
             self._watch_task.cancel()
+            await asyncio.gather(self._watch_task, return_exceptions=True)
 
     # -- routing ------------------------------------------------------------ #
 

@@ -167,6 +167,7 @@ class HealthCheckManager:
             # put_leased (not a bare put): a lease lost to a long partition
             # re-publishes the last health state along with the instance
             # record, instead of the series silently vanishing forever
+            # lint: allow(leaked-acquire): lease-scoped health series — lease revoke/expiry deletes it
             await self.runtime.put_leased(
                 key,
                 pack({

@@ -194,6 +194,58 @@ class XlaLedgerCollector:
         yield vfam
 
 
+class LeakLedgerCollector:
+    """The lifecycle ledger (analysis/leak_ledger.py) on worker
+    /metrics, under DYN_TPU_LEAKCHECK=1:
+    ``dynamo_tpu_worker_tasks_active`` — attributed asyncio tasks
+    currently pending; ``dynamo_tpu_worker_tasks_orphaned_total`` —
+    tasks that died unreaped (pending at loop close, or destroyed
+    pending); ``dynamo_tpu_worker_leak_ledger_imbalance{account}`` —
+    outstanding page refs / leased keys / threads per account.  A
+    tasks_active series that climbs without bound is the fleet-scale
+    slow death the static lint guards against, live.  Yields nothing
+    when leakcheck is disabled (absent series, not zeros)."""
+
+    def collect(self):
+        from prometheus_client.core import (
+            CounterMetricFamily,
+            GaugeMetricFamily,
+        )
+
+        from ..analysis import leak_ledger
+
+        if not leak_ledger.leakcheck_enabled():
+            return
+        try:
+            active = leak_ledger.tasks_active()
+            orphaned = len(leak_ledger.orphans())
+            imb = leak_ledger.imbalances()
+        except Exception:  # noqa: BLE001 — a scrape must not break /metrics
+            return
+        g = GaugeMetricFamily(
+            "dynamo_tpu_worker_tasks_active",
+            "attributed asyncio tasks currently pending",
+        )
+        g.add_metric([], active)
+        yield g
+        c = CounterMetricFamily(
+            "dynamo_tpu_worker_tasks_orphaned",
+            "asyncio tasks that died unreaped (pending at loop close or "
+            "destroyed while pending)",
+        )
+        c.add_metric([], orphaned)
+        yield c
+        ifam = GaugeMetricFamily(
+            "dynamo_tpu_worker_leak_ledger_imbalance",
+            "outstanding acquire/release imbalance per resource account "
+            "(pages, leases, threads)",
+            labels=["account"],
+        )
+        for account, n in sorted(imb.items()):
+            ifam.add_metric([account], n)
+        yield ifam
+
+
 TELEMETRY_ROOT = "/telemetry"
 
 
@@ -301,6 +353,7 @@ class TelemetryPublisher:
             "component": self.component,
             **snap,
         }
+        # lint: allow(leaked-acquire): lease-scoped telemetry key — lease revoke/expiry deletes it
         await self.runtime.put_leased(self.key, pack(payload))
         return payload
 

@@ -16,6 +16,7 @@ import os
 import socket
 from typing import Optional
 
+from ..analysis import leak_ledger
 from .component import Namespace
 from .transport.control_plane import (
     ControlPlaneClient,
@@ -122,14 +123,20 @@ class DistributedRuntime:
 
     # -- lease-scoped state -------------------------------------------------- #
 
+    @property
+    def _ledger_owner(self) -> str:
+        return f"runtime:{id(self):x}"
+
     async def put_leased(self, key: str, value: bytes) -> None:
         """Publish a key under the primary lease AND remember it for
         re-publication after a lease loss."""
         self._leased_keys[key] = value
+        leak_ledger.note_lease_put(self._ledger_owner, key)
         await self.control.put(key, value, lease=self.primary_lease)
 
     async def delete_leased(self, key: str) -> None:
         self._leased_keys.pop(key, None)
+        leak_ledger.note_lease_delete(self._ledger_owner, key)
         await self.control.delete(key)
 
     # -- component tree ----------------------------------------------------- #
@@ -165,6 +172,7 @@ class DistributedRuntime:
             await self.service_server.stop()
         if self._keepalive_task:
             self._keepalive_task.cancel()
+            await asyncio.gather(self._keepalive_task, return_exceptions=True)
         try:
             await self.control.revoke(self.primary_lease)
         except (ConnectionError, RuntimeError):
@@ -173,6 +181,9 @@ class DistributedRuntime:
         await self.control.close()
         if self._embedded_server:
             await self._embedded_server.stop()
+        # the lease is revoked: its keys died with it by design
+        leak_ledger.note_owner_closed(self._ledger_owner)
+        leak_ledger.assert_balanced(self._ledger_owner)
         self._shutdown.set()
 
     async def wait_shutdown(self) -> None:

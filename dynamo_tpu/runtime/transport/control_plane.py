@@ -148,6 +148,9 @@ class ControlPlaneServer:
         self._queue_waiters: dict[str, deque[asyncio.Future]] = defaultdict(deque)
         self._reaper_task: asyncio.Task | None = None
         self._conns: set[_Conn] = set()
+        # strong refs to in-flight op dispatches: the loop only weakly
+        # references tasks, and a dropped dispatch loses its exception
+        self._dispatch_tasks: set[asyncio.Task] = set()
 
     # -- lifecycle ---------------------------------------------------------- #
 
@@ -158,9 +161,21 @@ class ControlPlaneServer:
         logger.info("control plane listening on %s:%d", self.host, self.port)
         return self
 
+    def _reap_dispatch(self, task: asyncio.Task) -> None:
+        self._dispatch_tasks.discard(task)
+        if not task.cancelled() and task.exception() is not None:
+            logger.warning("control-plane dispatch failed: %r",
+                           task.exception())
+
     async def stop(self) -> None:
         if self._reaper_task:
             self._reaper_task.cancel()
+            await asyncio.gather(self._reaper_task, return_exceptions=True)
+        for task in list(self._dispatch_tasks):
+            task.cancel()
+        if self._dispatch_tasks:
+            await asyncio.gather(*self._dispatch_tasks,
+                                 return_exceptions=True)
         if self._server:
             self._server.close()
         # Force-close live connections BEFORE wait_closed: in py3.12
@@ -224,7 +239,9 @@ class ControlPlaneServer:
                 frame = await read_frame(reader)
                 if frame.kind != K_CTRL:
                     continue
-                asyncio.ensure_future(self._dispatch(conn, frame))
+                task = asyncio.ensure_future(self._dispatch(conn, frame))
+                self._dispatch_tasks.add(task)
+                task.add_done_callback(self._reap_dispatch)
         except (asyncio.IncompleteReadError, ConnectionError):
             pass
         finally:
@@ -535,12 +552,14 @@ class ControlPlaneClient:
         self._reader, self._writer = await asyncio.open_connection(host, int(port))
         if self._recv_task is not None:
             self._recv_task.cancel()
+            await asyncio.gather(self._recv_task, return_exceptions=True)
         self._recv_task = asyncio.create_task(self._recv_loop())
 
     async def close(self) -> None:
         self._closed = True
         if self._recv_task:
             self._recv_task.cancel()
+            await asyncio.gather(self._recv_task, return_exceptions=True)
         if self._writer:
             self._writer.close()
 

@@ -321,10 +321,14 @@ def main() -> None:
 
 
 async def _run(args) -> None:
+    from ..analysis import leak_ledger
     from ..llm import ModelDeploymentCard
     from ..runtime import DistributedRuntime
     from . import serve_engine
 
+    # attribute every task on the serving loop (no-op unless
+    # DYN_TPU_LEAKCHECK=1) — feeds the LeakLedgerCollector families
+    leak_ledger.install_loop(asyncio.get_running_loop(), owner="worker")
     # build the engine BEFORE taking a lease: model load / first compile can
     # block for longer than the lease TTL
     # lint: allow(blocking-in-async): one-time startup before serving; model load dwarfs it
@@ -452,6 +456,7 @@ async def _run(args) -> None:
         # rate() is well-typed, gauges for the rest
         from ..runtime.metrics import (
             EngineStatsCollector,
+            LeakLedgerCollector,
             TracingSpanCollector,
             XlaLedgerCollector,
         )
@@ -467,6 +472,9 @@ async def _run(args) -> None:
         # compile ledger: per-function XLA compiles + transfer-guard
         # violations (a climbing compile curve after warmup = recompile leak)
         scope.registry.register(XlaLedgerCollector())
+        # lifecycle ledger: pending/orphaned tasks + resource-account
+        # imbalances (absent unless DYN_TPU_LEAKCHECK=1)
+        scope.registry.register(LeakLedgerCollector())
 
         def _events():
             """Step-event ring dump(s) for /events.json — the engine(s)

@@ -103,8 +103,10 @@ def worker_metric_names() -> set:
     (EngineStatsCollector over the representative stats + the tracing
     span counters)."""
     import dynamo_tpu.runtime.tracing as tracing
+    from dynamo_tpu.analysis import leak_ledger
     from dynamo_tpu.runtime.metrics import (
         EngineStatsCollector,
+        LeakLedgerCollector,
         TracingSpanCollector,
         XlaLedgerCollector,
     )
@@ -121,6 +123,19 @@ def worker_metric_names() -> set:
         if fam.type in _COUNTER_SUFFIX:
             name += "_total"
         names.add(name)
+    # leakcheck is off by default; flip the module flag so the
+    # collector's families surface for the diff (same trick as the
+    # fake tracing exporter below)
+    saved_on = leak_ledger._ON  # noqa: SLF001
+    leak_ledger._ON = True  # noqa: SLF001
+    try:
+        for fam in LeakLedgerCollector().collect():
+            name = fam.name
+            if fam.type in _COUNTER_SUFFIX:
+                name += "_total"
+            names.add(name)
+    finally:
+        leak_ledger._ON = saved_on  # noqa: SLF001
     saved = tracing._EXPORTER  # noqa: SLF001
     tracing._EXPORTER = _FakeExporter()  # noqa: SLF001
     try:

@@ -9,8 +9,9 @@ Extracts the ``RULES`` tuple from each lint module **purely via AST**
 — both directions — against the ``| Rule | Flags |`` table in that
 lint's document:
 
-- ``dynamo_tpu/analysis/lint.py``     ↔ docs/concurrency.md
-- ``dynamo_tpu/analysis/jitcheck.py`` ↔ docs/jax_contracts.md
+- ``dynamo_tpu/analysis/lint.py``        ↔ docs/concurrency.md
+- ``dynamo_tpu/analysis/jitcheck.py``    ↔ docs/jax_contracts.md
+- ``dynamo_tpu/analysis/asynccheck.py``  ↔ docs/async_contracts.md
 
 A renamed or added rule cannot land undocumented, and the docs cannot
 advertise rules the lints no longer enforce — the same contract
@@ -34,6 +35,8 @@ PAIRS = (
      os.path.join(ROOT, "docs", "concurrency.md")),
     (os.path.join(ROOT, "dynamo_tpu", "analysis", "jitcheck.py"),
      os.path.join(ROOT, "docs", "jax_contracts.md")),
+    (os.path.join(ROOT, "dynamo_tpu", "analysis", "asynccheck.py"),
+     os.path.join(ROOT, "docs", "async_contracts.md")),
 )
 
 

@@ -9,7 +9,9 @@ Runs, in order:
 - the concurrency contract lint (scripts/lint_concurrency.py,
   dynamo_tpu/analysis/lint.py — docs/concurrency.md);
 - the JAX contract lint (scripts/lint_jax.py,
-  dynamo_tpu/analysis/jitcheck.py — docs/jax_contracts.md).
+  dynamo_tpu/analysis/jitcheck.py — docs/jax_contracts.md);
+- the asyncio & resource lifecycle lint (scripts/lint_async.py,
+  dynamo_tpu/analysis/asynccheck.py — docs/async_contracts.md).
 
 CI and tier-1 invoke this one gate instead of tracking the lint
 inventory by hand; a new lint gets added HERE and nowhere else.
@@ -23,7 +25,12 @@ import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
+# the sibling lints are imported by bare name: works when run as a
+# script (scripts/ is sys.path[0]) but not when imported as
+# scripts.lint_all — insert our own dir so both spellings resolve
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+import lint_async  # noqa: E402
 import lint_concurrency  # noqa: E402
 import lint_jax  # noqa: E402
 
@@ -31,6 +38,7 @@ import lint_jax  # noqa: E402
 LINTS = (
     ("concurrency", lint_concurrency.run),
     ("jax", lint_jax.run),
+    ("async", lint_async.run),
 )
 
 

@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""CI gate: the asyncio & resource lifecycle lint over dynamo_tpu/.
+
+    python scripts/lint_async.py             # exit 1 on findings
+    python scripts/lint_async.py --json      # machine-readable
+    python scripts/lint_async.py path [...]  # specific files/dirs
+
+Rules (see dynamo_tpu/analysis/asynccheck.py and
+docs/async_contracts.md): orphan-task, task-no-cancel, await-in-lock,
+blocking-in-async, no-timeout-await, leaked-acquire.  A finding is
+suppressed only by a justified ``# lint: allow(<rule>): <why>``
+comment; the allowlist in use is printed so tolerated exceptions stay
+visible.
+
+Import-safe: ``from lint_async import run`` — the tier-1 test
+tests/test_asynccheck.py runs exactly this.
+"""
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+DEFAULT_TARGET = os.path.join(ROOT, "dynamo_tpu")
+
+
+def run(paths=None):
+    """Returns (findings, used_allowlist) over the given paths
+    (default: the whole dynamo_tpu package)."""
+    from dynamo_tpu.analysis import asynccheck
+
+    return asynccheck.lint_paths(paths or [DEFAULT_TARGET])
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*", help="files or package dirs "
+                    "(default: dynamo_tpu/)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings + allowlist as JSON")
+    args = ap.parse_args(argv)
+
+    findings, allows = run(args.paths or None)
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [dataclasses.asdict(f) for f in findings],
+            "allowlist": [dataclasses.asdict(a) for a in allows],
+        }, indent=1))
+        return 1 if findings else 0
+
+    for f in findings:
+        print(f.format(), file=sys.stderr)
+    if allows:
+        print(f"-- allowlist in effect ({len(allows)} entries):")
+        for a in allows:
+            print(f"   {a.path}:{a.line}: allow({a.rule}): {a.reason}")
+    if findings:
+        print(f"ASYNC LINT: {len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    print("ASYNC LINT OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -13,6 +13,7 @@ managers in dynamo_tpu.testing instead of async fixtures.
 import asyncio
 import inspect
 import os
+import threading
 
 # Must be set before jax initializes anywhere in the test process.  NB the
 # axon TPU plugin in this image force-registers itself and ignores the
@@ -90,6 +91,22 @@ def _dump_wedge_forensics(nodeid: str) -> None:
                     f"last xla compile ({len(xla_ledger.entries())} "
                     f"total): {last.format()}\n"
                 )
+        except Exception:  # noqa: BLE001 — forensics must not mask the dump
+            pass
+        try:
+            # what the wedged test was waiting on: every attributed task
+            # still pending, plus the resource-account balances
+            from dynamo_tpu.analysis import leak_ledger
+
+            if leak_ledger.leakcheck_enabled():
+                pending = leak_ledger.pending_task_table()
+                if pending:
+                    err.write(f"pending tasks ({len(pending)}):\n")
+                    for line in pending:
+                        err.write(f"  {line}\n")
+                imb = leak_ledger.imbalances()
+                if imb:
+                    err.write(f"leak-ledger imbalances: {imb}\n")
         except Exception:  # noqa: BLE001 — forensics must not mask the dump
             pass
         faulthandler.dump_traceback(file=err)
@@ -180,12 +197,74 @@ def _ledger_gate(session) -> None:
         )
 
 
+# nodeids of tests that failed — a failed test abandons its resources
+# mid-body (shutdown never runs), and that failure is already reported;
+# the leak gate excuses debris attributed to them instead of
+# double-reporting it
+_failed_nodeids: set = set()
+
+
+def pytest_runtest_logreport(report):
+    if report.failed:
+        _failed_nodeids.add(report.nodeid)
+
+
+def _leak_gate(session) -> None:
+    """The DYN_TPU_LEAKCHECK=1 acceptance gate: the session must end
+    with zero orphaned tasks, zero swallowed task exceptions, zero
+    unjoined repo threads, and balanced page/lease accounts.  Tests
+    that deliberately provoke a leak must ``leak_ledger.reset()``
+    before returning.  Records owned by a FAILED test are excused —
+    the failure itself is the report."""
+    import sys
+
+    try:
+        from dynamo_tpu.analysis import leak_ledger
+    except Exception:  # noqa: BLE001 — no gate without the package
+        return
+    if not leak_ledger.leakcheck_enabled():
+        return
+    s = leak_ledger.summary()
+    imb = s["imbalances"]
+    orphans = [o for o in s["orphans"]
+               if o.get("owner") not in _failed_nodeids]
+    swallowed = [w for w in s["swallowed"]
+                 if w.get("owner") not in _failed_nodeids]
+    excused = ((len(s["orphans"]) - len(orphans))
+               + (len(s["swallowed"]) - len(swallowed)))
+    print(
+        f"\nleak ledger: {s['tasks_tracked']} tasks tracked "
+        f"({s['tasks_active']} active), {len(orphans)} orphaned, "
+        f"{len(swallowed)} swallowed exceptions, "
+        f"{len(s['leaked_threads'])} leaked threads, "
+        f"pages imbalance {imb.get('pages', 0)}, "
+        f"leases outstanding {imb.get('leases', 0)}"
+    )
+    if excused:
+        print(f"leak ledger: {excused} record(s) excused "
+              f"(owned by {len(_failed_nodeids)} failed test(s))")
+    problems = [f"orphaned task: {o}" for o in orphans]
+    problems += [f"swallowed task exception: {w}" for w in swallowed]
+    problems += [f"unjoined thread: {t}" for t in s["leaked_threads"]]
+    problems += [f"account imbalance: {k} = {v}" for k, v in imb.items()]
+    if problems:
+        print("LEAK LEDGER GATE FAILED:", file=sys.stderr)
+        for p in problems:
+            print("  " + p, file=sys.stderr)
+        session.exitstatus = 1
+        raise pytest.UsageError(
+            f"leak ledger gate: {len(problems)} problem(s) — see above"
+        )
+
+
 def pytest_sessionfinish(session, exitstatus):
     """The DYN_TPU_LOCKCHECK=1 acceptance gate: the whole session (chaos
     subprocesses included) must record zero lock-order cycles, zero
     certain self-deadlocks, and zero thread-affinity violations.
     The compile-ledger gate (zero steady-state recompiles, zero
-    transfer-guard violations) runs unconditionally alongside it."""
+    transfer-guard violations) runs unconditionally alongside it; the
+    leak-ledger gate joins them under DYN_TPU_LEAKCHECK=1."""
+    _leak_gate(session)
     _ledger_gate(session)
     try:
         from dynamo_tpu.analysis import contracts, lockcheck
@@ -299,18 +378,53 @@ def pytest_pyfunc_call(pyfuncitem):
             timeout = marker.args[0]
         loop = asyncio.new_event_loop()
         try:
+            from dynamo_tpu.analysis import leak_ledger
+        except Exception:  # noqa: BLE001 — tests must run without the package
+            leak_ledger = None
+        if leak_ledger is not None:
+            # attribute every task the test spawns to its nodeid
+            leak_ledger.install_loop(loop, owner=pyfuncitem.nodeid)
+        threads_before = {t.ident for t in threading.enumerate()}
+        snap = (leak_ledger.snapshot()
+                if leak_ledger is not None and leak_ledger.leakcheck_enabled()
+                else None)
+        ok = False
+        try:
             loop.run_until_complete(
                 asyncio.wait_for(fn(**kwargs), timeout=timeout)
             )
-            # Cancel stragglers (watch loops etc.) so loop.close() is quiet.
-            pending = [t for t in asyncio.all_tasks(loop) if not t.done()]
-            for t in pending:
-                t.cancel()
-            if pending:
-                loop.run_until_complete(
-                    asyncio.gather(*pending, return_exceptions=True)
-                )
+            ok = True
         finally:
+            # Cancel stragglers (watch loops etc.) so loop.close() is
+            # quiet — on FAILURE too, or the abandoned tasks are GC'd
+            # later as destroyed-pending noise blamed on this test.
+            try:
+                pending = [t for t in asyncio.all_tasks(loop)
+                           if not t.done()]
+                for t in pending:
+                    t.cancel()
+                if pending:
+                    loop.run_until_complete(
+                        asyncio.gather(*pending, return_exceptions=True)
+                    )
+            except Exception:  # noqa: BLE001 — best-effort after a failure
+                pass
+            if leak_ledger is not None:
+                if ok:
+                    # anything still pending survived the owner's shutdown
+                    # AND the straggler sweep — a real orphan
+                    leak_ledger.note_loop_closing(loop)
+                else:
+                    # a failed test legitimately abandons its engines
+                    # (pytest skips the rest of the body, shutdown
+                    # included); the failure is the report — roll the
+                    # ledger back to its pre-test state and excuse the
+                    # thread debris instead of double-reporting it at
+                    # the session gate
+                    if snap is not None:
+                        leak_ledger.restore(snap)
+                    leak_ledger.excuse_new_threads(
+                        threads_before, owner=pyfuncitem.nodeid)
             # Join default-executor threads before closing: loop.close()
             # does NOT wait for them, and a leaked worker that later posts
             # call_soon_threadsafe hits "Event loop is closed" and competes

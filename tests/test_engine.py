@@ -126,6 +126,19 @@ async def test_kill_cancels(engine_setup):
     await engine.shutdown()
 
 
+async def test_shutdown_reaps_cancelled_stream_pages(engine_setup):
+    """A stream cancelled right before shutdown queues its abort with the
+    pump, but the pump exits as soon as shutdown() sets _closed — the
+    reap in shutdown() must still run the abort and free the sequence's
+    pages, or the pool leaks refs forever (the leak-ledger page account)."""
+    engine = make_engine(engine_setup)
+    gen = engine.generate(req([1, 2, 3], max_tokens=200))
+    await gen.__anext__()  # sequence admitted, pages allocated
+    await gen.aclose()  # generate()'s finally queues the abort
+    await engine.shutdown()
+    assert sum(engine.pool._refs.values()) == 0
+
+
 async def test_stop_token(engine_setup):
     cfg, params = engine_setup
     engine = make_engine(engine_setup)

@@ -1,0 +1,417 @@
+"""The runtime leak ledger (dynamo_tpu/analysis/leak_ledger.py): task
+attribution on real event loops, the two asyncio leak-signal traps,
+the page/lease/thread balance accounts, off-mode identity, and the
+lifecycle regression fixes the first LEAKCHECK run surfaced (dispatch
+reaping, bounded transfer fetch).  One chaos scenario re-runs with the
+ledger armed to prove a full kill/handoff cycle leaks nothing.
+
+Runtime semantics are documented in docs/async_contracts.md.
+"""
+
+import asyncio
+import gc
+import threading
+
+import pytest
+
+from dynamo_tpu.analysis import leak_ledger
+
+
+@pytest.fixture
+def armed(monkeypatch):
+    """Arm the ledger for one test, isolated from session state — and
+    put the session's accumulated records back afterwards so these unit
+    tests never erase what the session gate has collected so far."""
+    snap = leak_ledger.snapshot()
+    monkeypatch.setattr(leak_ledger, "_ON", True)
+    leak_ledger.reset()
+    yield
+    leak_ledger.restore(snap)
+
+
+# -- task attribution ---------------------------------------------------------- #
+
+
+def test_install_loop_attributes_factory_tasks(armed):
+    seen = {}
+
+    async def main():
+        loop = asyncio.get_running_loop()
+        leak_ledger.install_loop(loop, owner="unit")
+        task = loop.create_task(asyncio.sleep(0))
+        seen["rec"] = leak_ledger._record_for(task)
+        await task
+
+    asyncio.run(main())
+    rec = seen["rec"]
+    assert rec is not None
+    assert rec.owner == "unit"
+    assert rec.site.startswith("test_leak_ledger.py:")
+    assert leak_ledger.tasks_tracked_total() >= 1
+
+
+def test_tracked_task_attributes_without_installed_loop(armed):
+    seen = {}
+
+    async def main():
+        task = leak_ledger.tracked_task(asyncio.sleep(0), owner="frontend.unit")
+        seen["rec"] = leak_ledger._record_for(task)
+        await task
+
+    asyncio.run(main())
+    assert seen["rec"] is not None and seen["rec"].owner == "frontend.unit"
+
+
+def test_swallowed_exception_is_trapped_and_attributed(armed):
+    async def boom():
+        raise RuntimeError("boom-for-ledger")
+
+    async def main():
+        loop = asyncio.get_running_loop()
+        # a quiet prev handler: verifies install_loop chains rather
+        # than replaces, and keeps the expected GC log line out of the
+        # test output
+        chained = []
+        loop.set_exception_handler(lambda lp, ctx: chained.append(1))
+        leak_ledger.install_loop(loop, owner="unit")
+        task = loop.create_task(boom())
+        for _ in range(3):
+            await asyncio.sleep(0)
+        assert task.done()
+        del task  # nobody retrieves the exception
+        gc.collect()
+        assert chained, "prev exception handler was not chained"
+
+    asyncio.run(main())
+    swallowed = leak_ledger.swallowed_exceptions()
+    assert len(swallowed) == 1
+    assert "boom-for-ledger" in swallowed[0]["exception"]
+    assert swallowed[0]["owner"] == "unit"
+    assert swallowed[0]["site"].startswith("test_leak_ledger.py:")
+
+
+def test_retrieved_exception_is_not_a_leak(armed):
+    async def boom():
+        raise RuntimeError("looked-at")
+
+    async def main():
+        loop = asyncio.get_running_loop()
+        leak_ledger.install_loop(loop, owner="unit")
+        task = loop.create_task(boom())
+        with pytest.raises(RuntimeError):
+            await task
+        del task
+        gc.collect()
+
+    asyncio.run(main())
+    assert leak_ledger.swallowed_exceptions() == []
+
+
+def test_pending_at_loop_close_becomes_orphan(armed):
+    async def main():
+        loop = asyncio.get_running_loop()
+        leak_ledger.install_loop(loop, owner="unit")
+        task = loop.create_task(asyncio.sleep(60))
+        await asyncio.sleep(0)
+        leak_ledger.note_loop_closing(loop)
+        task.cancel()
+        await asyncio.gather(task, return_exceptions=True)
+
+    asyncio.run(main())
+    orphans = leak_ledger.orphans()
+    assert len(orphans) == 1
+    assert orphans[0]["state"] == "pending-at-loop-close"
+    assert orphans[0]["owner"] == "unit"
+
+
+def test_completed_tasks_are_not_orphaned_at_loop_close(armed):
+    async def main():
+        loop = asyncio.get_running_loop()
+        leak_ledger.install_loop(loop, owner="unit")
+        await loop.create_task(asyncio.sleep(0))
+        leak_ledger.note_loop_closing(loop)
+
+    asyncio.run(main())
+    assert leak_ledger.orphans() == []
+
+
+def test_destroyed_pending_signal_is_trapped(armed):
+    async def main():
+        loop = asyncio.get_running_loop()
+        chained = []
+        loop.set_exception_handler(lambda lp, ctx: chained.append(1))
+        leak_ledger.install_loop(loop, owner="unit")
+        loop.call_exception_handler(
+            {"message": "Task was destroyed but it is pending!"})
+        assert chained
+
+    asyncio.run(main())
+    orphans = leak_ledger.orphans()
+    assert len(orphans) == 1
+    assert orphans[0]["state"] == "destroyed-pending"
+    assert orphans[0]["site"] == "<untracked>"
+
+
+def test_pending_task_table_describes_live_tasks(armed):
+    async def main():
+        loop = asyncio.get_running_loop()
+        leak_ledger.install_loop(loop, owner="unit")
+        task = loop.create_task(asyncio.sleep(60), name="wedge-probe")
+        await asyncio.sleep(0)
+        table = leak_ledger.pending_task_table()
+        assert any("wedge-probe" in row and "owner=unit" in row
+                   for row in table)
+        task.cancel()
+        await asyncio.gather(task, return_exceptions=True)
+        leak_ledger.note_loop_closing(loop)
+
+    asyncio.run(main())
+
+
+# -- balance accounts ---------------------------------------------------------- #
+
+
+def test_lease_account_balances_with_deletes(armed):
+    leak_ledger.note_lease_put("rt:1", "inst/a")
+    leak_ledger.note_lease_put("rt:1", "inst/b")
+    assert leak_ledger.imbalances("rt:1") == {"leases": 2}
+    leak_ledger.note_lease_delete("rt:1", "inst/a")
+    assert leak_ledger.imbalances("rt:1") == {"leases": 1}
+    leak_ledger.note_lease_delete("rt:1", "inst/b")
+    assert leak_ledger.imbalances("rt:1") == {}
+    leak_ledger.assert_balanced("rt:1")
+
+
+def test_owner_closed_credits_lease_scoped_keys(armed):
+    leak_ledger.note_lease_put("rt:2", "health/x")
+    leak_ledger.note_owner_closed("rt:2")
+    assert leak_ledger.imbalances("rt:2") == {}
+    leak_ledger.assert_balanced("rt:2")
+
+
+def test_assert_balanced_raises_on_outstanding_leases(armed):
+    leak_ledger.note_lease_put("rt:3", "inst/leaked")
+    with pytest.raises(AssertionError, match="rt:3"):
+        leak_ledger.assert_balanced("rt:3")
+
+
+def test_check_page_pool_flags_held_refs(armed):
+    class _Pool:
+        _refs = {3: 2, 9: 1}
+
+    assert leak_ledger.check_page_pool(_Pool(), "engine:t") == 3
+    assert leak_ledger.imbalances("engine:t") == {"pages": 3}
+    with pytest.raises(AssertionError, match="pages"):
+        leak_ledger.assert_balanced("engine:t")
+
+
+def test_check_page_pool_balanced_is_silent(armed):
+    class _Pool:
+        _refs = {}
+
+    assert leak_ledger.check_page_pool(_Pool(), "engine:t") == 0
+    assert leak_ledger.imbalances("engine:t") == {}
+    leak_ledger.assert_balanced("engine:t")
+
+
+def test_leaked_threads_sees_repo_named_thread(armed):
+    release = threading.Event()
+    t = threading.Thread(target=release.wait, name="kvbm-offload_unit")
+    t.start()
+    try:
+        assert "kvbm-offload_unit" in leak_ledger.leaked_threads()
+    finally:
+        release.set()
+        t.join()
+    assert "kvbm-offload_unit" not in leak_ledger.leaked_threads()
+
+
+def test_excuse_new_threads_forgives_failed_test_debris(armed):
+    """The harness excuses repo threads a FAILED test abandoned (its
+    failure is the report) — but only threads started AFTER the
+    snapshot, so debris from passing tests still gates."""
+    pre_release = threading.Event()
+    pre = threading.Thread(target=pre_release.wait, name="kvbm-offload_pre")
+    pre.start()
+    before = {t.ident for t in threading.enumerate()}
+    post_release = threading.Event()
+    post = threading.Thread(target=post_release.wait, name="kvbm-g4_post")
+    post.start()
+    try:
+        assert leak_ledger.excuse_new_threads(before, owner="t::failed") == 1
+        leaked = leak_ledger.leaked_threads()
+        assert "kvbm-g4_post" not in leaked  # excused: born in the failure
+        assert "kvbm-offload_pre" in leaked  # pre-existing: still gates
+    finally:
+        pre_release.set()
+        post_release.set()
+        pre.join()
+        post.join()
+
+
+def test_thread_start_join_counters_feed_imbalance(armed):
+    leak_ledger.note_thread_started("blob-stage")
+    assert leak_ledger.imbalances() == {"threads": 1}
+    leak_ledger.note_thread_joined("blob-stage")
+    assert leak_ledger.imbalances() == {}
+
+
+def test_summary_shape(armed):
+    s = leak_ledger.summary()
+    assert set(s) == {
+        "tasks_tracked", "tasks_active", "orphans", "swallowed",
+        "lease_outstanding", "imbalances", "leaked_threads",
+    }
+
+
+# -- off mode: everything degrades to identity --------------------------------- #
+
+
+def test_off_mode_is_identity(monkeypatch):
+    monkeypatch.setattr(leak_ledger, "_ON", False)
+    leak_ledger.reset()
+
+    async def main():
+        loop = asyncio.get_running_loop()
+        leak_ledger.install_loop(loop, owner="off")
+        assert loop.get_task_factory() is None
+        task = leak_ledger.tracked_task(asyncio.sleep(0), owner="off")
+        assert leak_ledger._record_for(task) is None
+        await task
+        leak_ledger.note_loop_closing(loop)
+
+    asyncio.run(main())
+    leak_ledger.note_lease_put("rt:off", "k")
+    assert leak_ledger.imbalances() == {}
+    leak_ledger.assert_balanced()  # no-op, never raises
+    assert leak_ledger.tasks_tracked_total() == 0
+
+
+# -- metrics collector --------------------------------------------------------- #
+
+
+def test_leak_ledger_collector_families(armed):
+    from dynamo_tpu.runtime.metrics import LeakLedgerCollector
+
+    leak_ledger.note_lease_put("rt:m", "k")
+    fams = {f.name: f for f in LeakLedgerCollector().collect()}
+    assert "dynamo_tpu_worker_tasks_active" in fams
+    assert "dynamo_tpu_worker_tasks_orphaned" in fams
+    imb = fams["dynamo_tpu_worker_leak_ledger_imbalance"]
+    assert any(s.labels.get("account") == "leases" and s.value == 1
+               for s in imb.samples)
+
+
+def test_leak_ledger_collector_absent_when_off(monkeypatch):
+    from dynamo_tpu.runtime.metrics import LeakLedgerCollector
+
+    monkeypatch.setattr(leak_ledger, "_ON", False)
+    assert list(LeakLedgerCollector().collect()) == []
+
+
+# -- lifecycle regressions from the first LEAKCHECK triage --------------------- #
+
+
+async def test_control_plane_reaps_failed_dispatch(caplog):
+    """A dispatch task that dies must be logged and dropped from the
+    strong-ref set — not garbage-collected with its exception unread."""
+    from dynamo_tpu.runtime.transport.control_plane import ControlPlaneServer
+
+    server = ControlPlaneServer()
+
+    async def boom():
+        raise RuntimeError("dispatch-died")
+
+    task = asyncio.ensure_future(boom())
+    server._dispatch_tasks.add(task)
+    task.add_done_callback(server._reap_dispatch)
+    with caplog.at_level("WARNING"):
+        await asyncio.gather(task, return_exceptions=True)
+        await asyncio.sleep(0)  # let the done callback run
+    assert server._dispatch_tasks == set()
+    assert any("dispatch failed" in r.message for r in caplog.records)
+
+
+async def test_control_plane_stop_cancels_inflight_dispatches():
+    from dynamo_tpu.runtime.transport.control_plane import ControlPlaneServer
+
+    server = ControlPlaneServer()
+    task = asyncio.ensure_future(asyncio.sleep(60))
+    server._dispatch_tasks.add(task)
+    task.add_done_callback(server._reap_dispatch)
+    await server.stop()
+    assert task.cancelled()
+    assert server._dispatch_tasks == set()
+
+
+async def test_transfer_fetch_timeout_bounds_a_wedged_source():
+    """fetch() must not await a partitioned source forever: the default
+    deadline cancels the in-flight lane and surfaces TimeoutError."""
+    from dynamo_tpu.disagg.transfer import KvTransferClient
+
+    client = object.__new__(KvTransferClient)  # skip engine-bound init
+
+    async def hang(descriptor):
+        await asyncio.sleep(60)
+
+    client._fetch = hang
+    with pytest.raises(asyncio.TimeoutError):
+        await client.fetch({"layout": {}}, timeout=0.05)
+
+
+async def test_transfer_fetch_timeout_none_disables_deadline():
+    from dynamo_tpu.disagg.transfer import KvTransferClient
+
+    client = object.__new__(KvTransferClient)
+
+    async def quick(descriptor):
+        return [1], None
+
+    client._fetch = quick
+    pages, _ = await client.fetch({"layout": {}}, timeout=None)
+    assert pages == [1]
+
+
+def test_g4_tier_thread_joined_on_close(armed):
+    """First-LEAKCHECK-run triage: TieredKvCache.close() left the G4
+    object-store loop thread running.  close() must join it, and the
+    tier must lazily reopen (same contract as the drain executor)."""
+    from dynamo_tpu.kvbm.host_pool import HostBlockPool
+    from dynamo_tpu.kvbm.offload import TieredKvCache
+    from dynamo_tpu.kvbm.remote import ObjectStoreTier
+
+    remote = ObjectStoreTier("127.0.0.1:1")  # no I/O before _run
+    assert "kvbm-g4" in leak_ledger.leaked_threads()
+    tiered = TieredKvCache(HostBlockPool(capacity_bytes=1 << 16),
+                           remote=remote)
+    tiered.close()
+    assert "kvbm-g4" not in leak_ledger.leaked_threads()
+    assert leak_ledger.imbalances() == {}  # started == joined
+    remote._ensure_loop()  # lazy reopen still works after close
+    assert "kvbm-g4" in leak_ledger.leaked_threads()
+    remote.close()
+    assert "kvbm-g4" not in leak_ledger.leaked_threads()
+
+
+# -- chaos under the armed ledger ---------------------------------------------- #
+
+
+@pytest.mark.chaos
+@pytest.mark.timeout(240)
+async def test_chaos_disagg_handoff_drop_leaks_nothing(armed, tmp_path):
+    """A full prefill→decode handoff cycle with a dropped transfer and
+    local-prefill fallback, with the leak ledger armed: engine shutdown
+    asserts balanced pages, and no task is orphaned or has its
+    exception swallowed anywhere in the scenario."""
+    from dynamo_tpu.chaos.scenarios import run_scenario
+
+    result = await run_scenario("disagg_handoff_drop",
+                                log_dir=str(tmp_path))
+    assert result.passed, result.failure
+    assert leak_ledger.orphans() == []
+    assert leak_ledger.swallowed_exceptions() == []
+    # pages/leases net to zero (thread accounting is session-scoped and
+    # asserted by the pytest_sessionfinish gate, not per-test)
+    imb = {k: v for k, v in leak_ledger.imbalances().items()
+           if k != "threads"}
+    assert imb == {}
