@@ -678,6 +678,136 @@ async def continuous_phase(cfg, params, prompt_len=128, gen=192, rounds=3):
         await cont.shutdown()
 
 
+async def bursty_phase(cfg, params, *, prompt_len=128, gen=1024,
+                       residents=4, bursts=5, burst_n=3,
+                       arrival_prompt=96, arrival_gen=8, quiet_s=1.0,
+                       rounds=2):
+    """Bursty-arrival A/B on the device-resident loop (ISSUE 15):
+    `residents` long decode streams hold a live chain while short-prompt
+    bursts arrive — the UNIFIED arm splices each arrival into the chain
+    as chunk rows (`prefill_chunk_tokens` prompt tokens per block inside
+    the same compiled program), the FALL-OUT arm
+    (`prefill_chunk_tokens=0`) ends the chain and replans per admission.
+
+    Measured per arm, rounds interleaved within one run:
+    - the residents' decode ITL p99 INSIDE burst windows vs quiet
+      windows (the number splicing exists to flatten — admission work
+      that ends the chain lands as resident ITL spikes);
+    - chain fall-outs PER ADMITTED request, split by reason (from the
+      engine's own `decode_cc_fallout_total{reason}` counters)."""
+    from dynamo_tpu.engine import EngineConfig, JaxEngine
+
+    pages_per = (prompt_len + gen) // 16 + 2
+    nseqs = residents + burst_n
+    bucket = 1 << (nseqs - 1).bit_length()
+
+    def mk(chunk_tokens):
+        return JaxEngine(cfg, params, EngineConfig(
+            page_size=16, num_pages=1 + nseqs * pages_per + 16,
+            max_num_seqs=nseqs, max_prefill_tokens=residents * prompt_len,
+            prefill_batch_size=residents, max_model_len=prompt_len + gen + 16,
+            decode_batch_buckets=[bucket],
+            chunk_buckets=[arrival_prompt, prompt_len],
+            decode_steps=64, decode_chain=4, decode_continuous=True,
+            prefill_chunk_tokens=chunk_tokens,
+            enable_prefix_caching=False, quantization="int8",
+            fuse_projections=True,
+        ), eos_token_ids=[])
+
+    def _req(tokens, max_tokens):
+        return {
+            "token_ids": tokens,
+            "sampling_options": {"temperature": 0.0},
+            "stop_conditions": {"max_tokens": max_tokens,
+                                "ignore_eos": True},
+        }
+
+    async def _stream(engine, req, stamps=None):
+        async for out in engine.generate(req):
+            if out["token_ids"] and stamps is not None:
+                stamps.append((time.perf_counter(), len(out["token_ids"])))
+
+    async def _pass(engine, seed_base, *, n_bursts=bursts,
+                    res_gen=gen):
+        m0 = engine.metrics()
+        f0 = dict(m0.decode_cc_fallout_total)
+        stamps = [[] for _ in range(residents)]
+        res = [asyncio.ensure_future(_stream(
+            engine,
+            _req([((i * 7 + j) % 1000) + seed_base
+                  for j in range(prompt_len)], res_gen),
+            stamps[i])) for i in range(residents)]
+        await asyncio.sleep(quiet_s)  # settle into the steady chain
+        windows, admitted = [], 0
+        for b in range(n_bursts):
+            t0 = time.perf_counter()
+            burst = [asyncio.ensure_future(_stream(
+                engine,
+                _req([((b * 31 + j * 13 + k) % 997) + 1
+                      for j in range(arrival_prompt)], arrival_gen)))
+                for k in range(burst_n)]
+            await asyncio.gather(*burst)
+            windows.append((t0, time.perf_counter()))
+            admitted += burst_n
+            await asyncio.sleep(quiet_s)
+        end = time.perf_counter()
+        await asyncio.gather(*res)
+        burst_gaps, quiet_gaps = [], []
+        for per in stamps:
+            for (ta, _ka), (tb, kb) in zip(per, per[1:]):
+                if ta > end:
+                    break  # bursts over: tail gaps classify as nothing
+                g = (tb - ta) / max(kb, 1) * 1e3
+                in_burst = any(ta <= w1 and tb >= w0
+                               for w0, w1 in windows)
+                (burst_gaps if in_burst else quiet_gaps).append(g)
+        f1 = dict(engine.metrics().decode_cc_fallout_total)
+        dfall = {k: v - f0.get(k, 0) for k, v in f1.items()
+                 if v - f0.get(k, 0)}
+        admit_attr = sum(dfall.get(k, 0)
+                         for k in ("admit", "admission", "pending_work"))
+        p99_b = _p99(burst_gaps) if burst_gaps else 0.0
+        p99_q = _p99(quiet_gaps) if quiet_gaps else 0.0
+        return {
+            "itl_p99_burst_ms": round(p99_b, 3),
+            "itl_p99_quiet_ms": round(p99_q, 3),
+            "burst_vs_quiet": round(p99_b / max(p99_q, 1e-9), 3),
+            "gaps_burst": len(burst_gaps), "gaps_quiet": len(quiet_gaps),
+            "admitted": admitted,
+            "fallouts": dfall,
+            "fallout_per_admit": round(
+                sum(dfall.values()) / max(admitted, 1), 3),
+            "admission_fallout_per_admit": round(
+                admit_attr / max(admitted, 1), 3),
+        }
+
+    unified, split = mk(64), mk(0)
+    try:
+        for e in (unified, split):  # compile off the clock, incl. the
+            # chunk-row splice variant (one resident + one burst)
+            await _pass(e, seed_base=0, n_bursts=1, res_gen=96)
+        samples = {"unified": [], "split": []}
+        for r in range(rounds):
+            samples["unified"].append(
+                await _pass(unified, seed_base=5000 + 999 * r))
+            samples["split"].append(
+                await _pass(split, seed_base=5000 + 999 * r))
+        med = {arm: sorted(s, key=lambda p: p["itl_p99_burst_ms"])
+               [len(s) // 2] for arm, s in samples.items()}
+        return {
+            "residents": residents, "bursts": bursts, "burst_n": burst_n,
+            "arrival_prompt": arrival_prompt,
+            "unified": med["unified"], "split": med["split"],
+            "burst_p99_split_vs_unified": round(
+                med["split"]["itl_p99_burst_ms"]
+                / max(med["unified"]["itl_p99_burst_ms"], 1e-9), 3),
+            "samples": samples,
+        }
+    finally:
+        await unified.shutdown()
+        await split.shutdown()
+
+
 async def kvbm_zipf_phase(cfg, params, *, tenants=512, sys_len=384,
                           user_len=64, gen=48, n_req=96, rate_rps=6.0,
                           zipf_a=1.1, rounds=2, slo=SLO_1B):
@@ -1145,6 +1275,13 @@ async def main_async():
     out["continuous_decode_1b"] = await continuous_phase(cfg, params)
     gc.collect()
 
+    # unified serving loop A/B (ISSUE 15): bursty arrivals splice into
+    # the live chain as chunk rows vs falling the chain out per
+    # admission — residents' burst-window vs quiet ITL p99 + chain
+    # fall-outs per admitted request
+    out["bursty_1b"] = await bursty_phase(cfg, params)
+    gc.collect()
+
     # KVBM multi-tier A/B (ISSUE 8): Zipf multi-tenant prefix workload
     # where the hot prefix set dwarfs HBM — offload-on keeps evicted
     # prefixes in the DRAM tier (onboard at admission) vs cold re-prefill;
@@ -1421,6 +1558,7 @@ def _compact_summary(full):
     m8 = full.get("models", {}).get("llama-3.1-8b-int8", {})
     spec = full.get("spec_decode_1b_int8", {})
     cc = full.get("continuous_decode_1b", {})
+    bb = full.get("bursty_1b", {})
     kz = full.get("kvbm_zipf", {})
     phase = full.get("phase_samples_tok_s", {})
     return {
@@ -1464,6 +1602,18 @@ def _compact_summary(full):
         "cc_itl_ratio": cc.get("itl_ratio"),
         "host_gap_ms_p50": (cc.get("host_gap_ms") or {}).get("p50_ms"),
         "host_gap_ms_p99": (cc.get("host_gap_ms") or {}).get("p99_ms"),
+        # unified serving loop A/B (ISSUE 15): burst-window decode ITL
+        # p99 split-vs-unified + fall-outs per admitted arrival
+        "bursty_itl_p99_burst_unified_ms": (bb.get("unified") or {})
+        .get("itl_p99_burst_ms"),
+        "bursty_itl_p99_burst_split_ms": (bb.get("split") or {})
+        .get("itl_p99_burst_ms"),
+        "bursty_burst_p99_split_vs_unified": bb.get(
+            "burst_p99_split_vs_unified"),
+        "bursty_fallout_per_admit_unified": (bb.get("unified") or {})
+        .get("fallout_per_admit"),
+        "bursty_fallout_per_admit_split": (bb.get("split") or {})
+        .get("fallout_per_admit"),
         # KVBM Zipf multi-tenant prefix A/B (ISSUE 8): aggregate goodput
         # offload-on vs no-offload + the warm-prefix TTFT tier ladder
         "kvbm_zipf_goodput_ratio": kz.get("goodput_ratio"),

@@ -99,6 +99,16 @@ class EngineConfig:
     # top-logprobs row, a partitioned pool, a pp/sp mesh, or a row
     # within k+1 tokens of the context cap sends that dispatch down
     # the plain path.
+    # chunked prefill INSIDE the continuous decode chain
+    # (docs/device_loop.md "chunk rows"): token budget per decode block
+    # shared by all chunk rows of that block.  While a chunk row still
+    # has prompt left it feeds one prompt token per scan step (writing
+    # KV, emitting nothing); the step that feeds the LAST prompt token
+    # samples the first output, so admission splices into the running
+    # chain instead of forcing a fall-out.  None → max_prefill_tokens;
+    # 0 disables (admissions fall the chain out, PR 6 behavior)
+    prefill_chunk_tokens: Optional[int] = None
+
     speculative_ngram_k: int = 0
     # drafter match window: the longest trailing m-gram (max_match down
     # to min_match) with an earlier occurrence in the last
@@ -188,6 +198,13 @@ class EngineConfig:
             # normalize: ascending, deduped, decode_steps as the top rung
             self.decode_block_ladder = sorted(
                 set(rungs) | {self.decode_steps}
+            )
+        if self.prefill_chunk_tokens is None:
+            self.prefill_chunk_tokens = self.max_prefill_tokens
+        if self.prefill_chunk_tokens < 0:
+            raise ValueError(
+                "prefill_chunk_tokens must be >= 0, got "
+                f"{self.prefill_chunk_tokens}"
             )
         if self.decode_continuous:
             if self.speculative_ngram_k:

@@ -25,7 +25,7 @@ import logging
 import time
 from dataclasses import dataclass, field
 from functools import partial
-from typing import Any, AsyncIterator, Callable, Dict, List, Optional
+from typing import Any, AsyncIterator, Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -92,6 +92,12 @@ class ForwardPassMetrics:
     # horizon is actually engaging)
     decode_cc_blocks_total: int = 0
     decode_cc_chains_total: int = 0
+    # per-reason chain fall-out counts (dict → labeled counter
+    # decode_cc_fallout_total{reason} on /metrics): "admission" means
+    # the chain ended FOR a waiting prompt (splice impossible or the
+    # watermark reserve refused horizon growth) — distinct from "pages"
+    # (pool genuinely exhausted with nothing waiting)
+    decode_cc_fallout_total: Dict[str, int] = field(default_factory=dict)
     # fleet telemetry capacity signals: running-batch occupancy of the
     # FULLEST rank (one full rank blocks admission, so max not mean
     # across dp ranks) and pages still available above the admission
@@ -630,6 +636,25 @@ def _make_decode_scan_cc(cfg: ModelConfig, n_steps: int, max_valid_pos: int,
     (tok, pos, ctr, act, budget, counts) all return as device arrays so
     block k+1 consumes block k's outputs with zero host round-trip.
 
+    CHUNK ROWS (docs/device_loop.md "chunk rows"): prefill chunks ride
+    the same block as extra operands — `chunk_toks [B, T]` (prompt
+    tokens to feed, row-major from the row's resume point), `chunk_rem
+    [B]` (how many of them this block feeds; 0 = pure decode row) and
+    `chunk_samples [B]` (True when the last fed token completes the
+    prompt, so that step samples the first output).  While a row feeds
+    it is ACTIVE (KV written, position advancing) but emits nothing:
+    its PRNG counter, penalty counts and budget are untouched, so the
+    sampled stream is token-identical to a split prefill+decode.  A row
+    whose chunk runs out mid-prompt goes dormant until the next block's
+    operands feed it again.  `reset [B]` + `init_pos [B]` +
+    `init_budget [B]` splice a NEW request into a slot in-step (a
+    `jnp.where` overlay on the carried pos/ctr/counts/budget), so
+    admission rides the SAME compiled program — zero steady-state
+    compiles.  Within a block, active steps stay a contiguous prefix
+    per row (dormancy only at chunk end, revival only in the prologue),
+    which is what keeps `decode_block_scan`'s uniform KV scatter and
+    ring-attention masks exact.
+
     DRIFT TRIPWIRE: this deliberately forks `_make_decode_scan`'s
     sample tail / per-step body / block-path gate (the mask threading
     touches every line, and the meshed variants must stay untouched) —
@@ -640,40 +665,61 @@ def _make_decode_scan_cc(cfg: ModelConfig, n_steps: int, max_valid_pos: int,
     from ..models.llama import decode_block_scan
     from ..ops.paged_attention import _adapt
 
-    def sample_tail(logits, cts, samp, seeds, ctr, act, budget, stops):
-        """Sample + freeze: counters/penalty counts advance only for
-        rows active BEFORE this step; the returned mask governs the
-        NEXT step."""
+    def sample_tail(logits, cts, samp, seeds, ctr, act, budget, stops,
+                    cidx, chunk_toks, chunk_rem, chunk_samples):
+        """Sample + freeze + feed: counters/penalty counts/budget
+        advance only for rows that EMIT this step (active decode rows,
+        plus a chunk row's prompt-completing step); feeding steps
+        discard the sample and load the next prompt token instead.  The
+        returned mask governs the NEXT step."""
         if penalized:
             logits = apply_penalties(
                 logits, cts, samp.frequency_penalty, samp.presence_penalty)
         out = sample_tokens_maybe_greedy(logits, samp, seeds, ctr, greedy)
-        actf = act.astype(jnp.float32)
-        ctr = ctr + act.astype(ctr.dtype)
+        feeding = cidx < chunk_rem
+        completing = feeding & (cidx + 1 == chunk_rem) & chunk_samples
+        emit = act & (~feeding | completing)
+        emitf = emit.astype(jnp.float32)
+        ctr = ctr + emit.astype(ctr.dtype)
         if penalized:
-            cts = cts.at[jnp.arange(out.shape[0]), out].add(actf)
+            cts = cts.at[jnp.arange(out.shape[0]), out].add(emitf)
         logp = compute_logprobs(logits, out)
-        packed = _pack_out_cc(out, logp, actf,
+        packed = _pack_out_cc(out, logp, emitf,
                               logits if with_top else None)
         hit = (out[:, None] == stops).any(axis=-1)
-        budget = budget - act.astype(budget.dtype)
-        act_next = act & ~hit & (budget > 0)
-        return out, ctr, cts, packed, act_next, budget
+        budget = budget - emit.astype(budget.dtype)
+        cidx_next = cidx + feeding.astype(cidx.dtype)
+        tok_next = jnp.where(
+            cidx_next < chunk_rem,
+            jnp.take_along_axis(
+                chunk_toks,
+                jnp.clip(cidx_next, 0, chunk_toks.shape[1] - 1)[:, None],
+                axis=1)[:, 0],
+            out)
+        # emitting rows follow the stop/budget latch; feeding rows stay
+        # active while prompt tokens remain this block, then go dormant
+        # until the next block's operands feed them again
+        act_next = jnp.where(emit, act & ~hit & (budget > 0),
+                             act & (cidx_next < chunk_rem))
+        return tok_next, ctr, cts, packed, act_next, budget, cidx_next
 
     def block_scan(params, kv, tokens, positions, counters, counts, act,
-                   budget, stops, page_table, samp, seeds, rope_off=None):
+                   budget, stops, page_table, samp, seeds, chunk_toks,
+                   chunk_rem, chunk_samples, rope_off=None):
         def sample_step(eng, logits, tok_prev, t, act_in):
-            ctr, cts, bud, _ = eng
-            out, ctr, cts, packed, act_next, bud = sample_tail(
-                logits, cts, samp, seeds, ctr, act_in, bud, stops)
+            ctr, cts, bud, cidx, _ = eng
+            tok_next, ctr, cts, packed, act_next, bud, cidx = sample_tail(
+                logits, cts, samp, seeds, ctr, act_in, bud, stops,
+                cidx, chunk_toks, chunk_rem, chunk_samples)
             # act duplicated into the engine carry so the final mask
             # returns as a chainable device array
-            return (ctr, cts, bud, act_next), out, packed, act_next
+            return (ctr, cts, bud, cidx, act_next), tok_next, packed, act_next
 
         cts0 = counts if penalized else jnp.zeros((), jnp.float32)
-        (ctr, cts, bud, act_out), packed, tok, pos, kv = decode_block_scan(
+        cidx0 = jnp.zeros_like(chunk_rem)
+        (ctr, cts, bud, _, act_out), packed, tok, pos, kv = decode_block_scan(
             params, cfg, kv, tokens, positions, page_table, n_steps,
-            max_valid_pos, sample_step, (counters, cts0, budget, act),
+            max_valid_pos, sample_step, (counters, cts0, budget, cidx0, act),
             rope_offset=rope_off, active_init=act,
         )
         if penalized:
@@ -681,7 +727,8 @@ def _make_decode_scan_cc(cfg: ModelConfig, n_steps: int, max_valid_pos: int,
         return packed, tok, pos, ctr, act_out, bud, kv
 
     def body_common(kv, tok, pos, ctr, cts, act, budget, stops, page_table,
-                    samp, seeds, params, rope_off=None):
+                    samp, seeds, params, cidx, chunk_toks, chunk_rem,
+                    chunk_samples, rope_off=None):
         ok = (pos < max_valid_pos) & act
         safe_pos = jnp.where(pos < max_valid_pos, pos, 0)
         # frozen and out-of-window rows write through an all-trash table
@@ -691,10 +738,26 @@ def _make_decode_scan_cc(cfg: ModelConfig, n_steps: int, max_valid_pos: int,
             rope_offset=rope_off,
         )
         return (kv,) + sample_tail(logits, cts, samp, seeds, ctr, act,
-                                   budget, stops)
+                                   budget, stops, cidx, chunk_toks,
+                                   chunk_rem, chunk_samples)
 
     def scan(params, kv, tokens, positions, counters, counts, act, budget,
-             stops, page_table, samp, seeds, rope_off=None):
+             stops, page_table, samp, seeds, chunk_toks, chunk_rem,
+             chunk_samples, reset, init_pos, init_budget, rope_off=None):
+        # splice/chunk prologue: spliced rows reset their carried
+        # pos/ctr/counts/budget in-step (a jnp.where overlay, so
+        # admission rides the SAME compiled program), and rows with
+        # prompt tokens to feed this block load their first chunk token
+        # and (re)activate.  Runs before the block/per-step fork so both
+        # paths see identical row state.
+        positions = jnp.where(reset, init_pos, positions)
+        counters = jnp.where(reset, 0, counters)
+        budget = jnp.where(reset, init_budget, budget)
+        if penalized:
+            counts = jnp.where(reset[:, None], 0.0, counts)
+        act = act | (chunk_rem > 0)
+        tokens = jnp.where(chunk_rem > 0, chunk_toks[:, 0], tokens)
+
         blk_bytes = (2 * kv.k.shape[0] * page_table.shape[0]
                      * page_table.shape[1] * kv.k.shape[2]
                      * kv.k.shape[3] * kv.k.shape[4] * kv.k.dtype.itemsize)
@@ -702,20 +765,24 @@ def _make_decode_scan_cc(cfg: ModelConfig, n_steps: int, max_valid_pos: int,
                 and blk_bytes <= _BLOCK_KV_BYTE_BUDGET):
             return block_scan(params, kv, tokens, positions, counters,
                               counts, act, budget, stops, page_table,
-                              samp, seeds, rope_off)
+                              samp, seeds, chunk_toks, chunk_rem,
+                              chunk_samples, rope_off)
 
         def body(carry, _):
-            kv, tok, pos, ctr, cts, a, bud = carry
-            kv, out, ctr, cts, packed, a_next, bud = body_common(
+            kv, tok, pos, ctr, cts, a, bud, cidx = carry
+            kv, tok_next, ctr, cts, packed, a_next, bud, cidx = body_common(
                 kv, tok, pos, ctr, cts, a, bud, stops, page_table,
-                samp, seeds, params, rope_off,
+                samp, seeds, params, cidx, chunk_toks, chunk_rem,
+                chunk_samples, rope_off,
             )
-            return (kv, out, pos + a.astype(pos.dtype), ctr, cts, a_next,
-                    bud), packed
+            return (kv, tok_next, pos + a.astype(pos.dtype), ctr, cts,
+                    a_next, bud, cidx), packed
 
         cts0 = counts if penalized else jnp.zeros((), jnp.float32)
-        (kv, tok, pos, ctr, cts, act, budget), packed = jax.lax.scan(
-            body, (kv, tokens, positions, counters, cts0, act, budget),
+        cidx0 = jnp.zeros_like(chunk_rem)
+        (kv, tok, pos, ctr, cts, act, budget, _), packed = jax.lax.scan(
+            body, (kv, tokens, positions, counters, cts0, act, budget,
+                   cidx0),
             None, length=n_steps,
         )
         if penalized:
@@ -739,30 +806,42 @@ def _build_decode_step_cc(cfg: ModelConfig, n_steps: int, max_valid_pos: int,
         if mrope:
             @partial(_ljit, donate_argnums=(1, 5), tags={"rung": n_steps})
             def step(params, kv, tokens, positions, counters, counts, act,
-                     budget, stops, page_table, samp, seeds, rope_off):
+                     budget, stops, page_table, samp, seeds, chunk_toks,
+                     chunk_rem, chunk_samples, reset, init_pos,
+                     init_budget, rope_off):
                 return run(params, kv, tokens, positions, counters, counts,
                            act, budget, stops, page_table, samp, seeds,
-                           rope_off)
+                           chunk_toks, chunk_rem, chunk_samples, reset,
+                           init_pos, init_budget, rope_off)
         else:
             @partial(_ljit, donate_argnums=(1, 5), tags={"rung": n_steps})
             def step(params, kv, tokens, positions, counters, counts, act,
-                     budget, stops, page_table, samp, seeds):
+                     budget, stops, page_table, samp, seeds, chunk_toks,
+                     chunk_rem, chunk_samples, reset, init_pos,
+                     init_budget):
                 return run(params, kv, tokens, positions, counters, counts,
-                           act, budget, stops, page_table, samp, seeds)
+                           act, budget, stops, page_table, samp, seeds,
+                           chunk_toks, chunk_rem, chunk_samples, reset,
+                           init_pos, init_budget)
     else:
         if mrope:
             @partial(_ljit, donate_argnums=(1,), tags={"rung": n_steps})
             def step(params, kv, tokens, positions, counters, act, budget,
-                     stops, page_table, samp, seeds, rope_off):
+                     stops, page_table, samp, seeds, chunk_toks, chunk_rem,
+                     chunk_samples, reset, init_pos, init_budget, rope_off):
                 return run(params, kv, tokens, positions, counters, None,
                            act, budget, stops, page_table, samp, seeds,
-                           rope_off)
+                           chunk_toks, chunk_rem, chunk_samples, reset,
+                           init_pos, init_budget, rope_off)
         else:
             @partial(_ljit, donate_argnums=(1,), tags={"rung": n_steps})
             def step(params, kv, tokens, positions, counters, act, budget,
-                     stops, page_table, samp, seeds):
+                     stops, page_table, samp, seeds, chunk_toks, chunk_rem,
+                     chunk_samples, reset, init_pos, init_budget):
                 return run(params, kv, tokens, positions, counters, None,
-                           act, budget, stops, page_table, samp, seeds)
+                           act, budget, stops, page_table, samp, seeds,
+                           chunk_toks, chunk_rem, chunk_samples, reset,
+                           init_pos, init_budget)
 
     return step
 
@@ -1519,6 +1598,11 @@ class JaxEngine:
         self._drain_pool = None
         self._cc_blocks_total = 0
         self._cc_chains_total = 0
+        # per-reason chain fall-out counter (decode_cc_fallout_total on
+        # /metrics): single-writer by contract — only the
+        # @affine("step") chain loop mutates it; metrics() snapshots a
+        # dict() copy, so no lock (docs/concurrency.md thread roles)
+        self._cc_fallout_by_reason: Dict[str, int] = {}
         self._closed = False
         # adds/aborts are deferred to the pump loop so ALL scheduler/pool
         # mutation happens strictly between device steps, on the pump's
@@ -1953,6 +2037,7 @@ class JaxEngine:
             ttft_attributed_total=self._ttft_attributed_total,
             decode_cc_blocks_total=self._cc_blocks_total,
             decode_cc_chains_total=self._cc_chains_total,
+            decode_cc_fallout_total=dict(self._cc_fallout_by_reason),
             batch_occupancy=running / max(self.cfg.max_num_seqs, 1),
             kv_watermark_headroom_pages=max(
                 0, self.pool.available_pages
@@ -3509,11 +3594,14 @@ class JaxEngine:
         page-table horizon).  ONE definition, shared by the device
         budget operand and the horizon pre-reservation: a drift between
         the two desyncs the on-device stop mask from the reserved
-        tables."""
+        tables.  For a CHUNK row still mid-prompt (num_computed <
+        prompt_len) emissions begin only after the prompt completes, so
+        the page-table term counts from prompt_len — the exact budget
+        the split engine would compute after its prefill."""
         return max(0, min(
             s.opts.max_tokens - len(s.output_tokens),
             self.cfg.max_model_len - s.total_len,
-            self.cfg.hard_cap - s.num_computed,
+            self.cfg.hard_cap - max(s.num_computed, s.prompt_len),
         ))
 
     def _budget_array(self, rows: List[Optional[Sequence]]) -> np.ndarray:
@@ -3542,27 +3630,40 @@ class JaxEngine:
         for s in seqs:
             if s.status != "running":
                 continue
-            budget = self._seq_budget(s)
+            # chunk rows still owe prompt writes before their first
+            # emission — reserving only against the emission budget
+            # would starve a long prompt whose max_tokens is small
+            remaining = (max(0, s.prompt_len - s.num_computed)
+                         + self._seq_budget(s))
             target = min(s.num_computed + (inflight_blocks + horizon) * T,
-                         s.num_computed + budget, hard_cap)
+                         s.num_computed + remaining, hard_cap)
             self.scheduler.try_extend_pages(s, target, keep_watermark=True)
             covered = (min(len(s.pages) * ps, hard_cap) - s.num_computed
                        - inflight_blocks * T)
-            if budget - inflight_blocks * T > covered:
+            if remaining - inflight_blocks * T > covered:
                 allowance = min(allowance, max(0, covered) // T)
         return allowance
 
-    def _cc_fall_out(self, seqs: List[Sequence]) -> Optional[str]:
+    def _cc_fall_out(self, seqs: List[Sequence],
+                     splice: bool = False) -> Optional[str]:
         """The chain's fall-out signals (None = keep feeding the loop):
         anything else needing the pump, an ADMISSIBLE waiting prompt
         (`_admit_check` via `admission_ready`), or any co-scheduled row
         having stopped (drained stop flags / host stop sequences) — a
-        stop frees capacity and shrinks the batch, so replanning wins."""
+        stop frees capacity and shrinks the batch, so replanning wins.
+        With `splice` (chunked prefill in-chain enabled) plain "add"
+        intake and admissible waiting prompts are NOT fall-outs — the
+        step thread's `_cc_intake` handles both at the next block and
+        falls the chain out itself only when it cannot splice."""
         if self._closed:
             return "shutdown"
-        if self._pending_adds or self._pending_aborts or self._pending_ops:
+        pending_adds = self._pending_adds
+        if splice:
+            pending_adds = [e for e in pending_adds if e[0] != "add"]
+        if pending_adds or self._pending_aborts or self._pending_ops:
             return "pending_work"
-        if self.scheduler.waiting and self.scheduler.admission_ready():
+        if (not splice and self.scheduler.waiting
+                and self.scheduler.admission_ready()):
             return "admit"
         if any(s.status != "running" for s in seqs):
             return "stop"
@@ -3589,6 +3690,113 @@ class JaxEngine:
         )
 
     @affine("step")
+    def _cc_intake(self, rows: List[Optional[Sequence]],
+                   seqs: List[Sequence], penalized: bool, with_top: bool,
+                   greedy: bool) -> Tuple[List[int], Optional[str]]:
+        """Step-thread admission intake for the running chain: drain
+        LEADING plain "add" entries from `_pending_adds` into the
+        scheduler (legal — `Scheduler.add` is @affine("step","loop"),
+        and the pump never plans while the chain's step task runs;
+        non-"add" entries stay for the pump and trip "pending_work"),
+        then splice every admissible waiting prompt into a free padding
+        slot of the current batch bucket.  Returns (spliced slot
+        indices, fall-out reason): "admit" when an admissible prompt
+        exists but cannot ride this chain — no free slot in the bucket,
+        or its sampling needs a different compiled variant (penalized /
+        top-logprobs / greedy are compile-time booleans of the running
+        program) — so the pump re-plans with the right shape."""
+        while (self._pending_adds
+               and self._pending_adds[0][0] == "add"):
+            _, seq = self._pending_adds.pop(0)
+            self.scheduler.add(seq)
+        spliced: List[int] = []
+        while self.scheduler.waiting and self.scheduler.admission_ready():
+            head = self.scheduler.waiting[0]
+            so = head.opts
+            if ((greedy and so.temperature > 0)
+                    or (not penalized and so.penalized)
+                    or (not with_top and so.top_logprobs > 0)):
+                return spliced, "admit"
+            try:
+                slot = rows.index(None)
+            except ValueError:
+                return spliced, "admit"
+            seq = self.scheduler.splice_admit()
+            if seq is None:  # raced an abort / capacity change
+                break
+            rows[slot] = seq
+            seqs.append(seq)
+            spliced.append(slot)
+        return spliced, None
+
+    def _cc_plan_feed(self, rows: List[Optional[Sequence]], T: int,
+                      needs_reset, fed_complete):
+        """Plan this block's chunk-row feeds: every mid-prompt row gets
+        up to T prompt tokens from the shared per-block
+        `prefill_chunk_tokens` budget, clamped to its (watermark-
+        respecting) page coverage.  Fed tokens are committed into
+        `num_computed` AT DISPATCH (the `_run_prefill` contract) —
+        except the prompt-COMPLETING token, whose write is accounted by
+        the first emission's drain exactly like the split engine's
+        prefill→decode handoff (prefill leaves its sampled token's KV
+        to the first decode step).  Rows in `needs_reset` carry their
+        splice reset (init pos/budget) on their first fed block.
+        Returns None on a quiet block (nothing to feed, no reset
+        pending) so the steady path re-puts no host arrays."""
+        ps = self.cfg.page_size
+        hard_cap = self.cfg.hard_cap
+        budget = int(self.cfg.prefill_chunk_tokens)
+        Bb = len(rows)
+        toks = rem = smp = None
+        rst = ipos = ibud = None
+        for i, s in enumerate(rows):
+            if s is None or s.status != "running" or id(s) in fed_complete:
+                continue
+            left = s.prompt_len - s.num_computed
+            if left <= 0 or budget <= 0:
+                continue
+            n = min(T, left, budget)
+            # pages must cover every position this block can write for
+            # the row: fed tokens plus a completing row's same-block
+            # decode tail — one block is at most T writes from here
+            self.scheduler.try_extend_pages(
+                s, min(s.num_computed + T, hard_cap), keep_watermark=True)
+            covered = len(s.pages) * ps - s.num_computed
+            n = min(n, max(0, covered))
+            if n <= 0:
+                continue
+            if toks is None:
+                toks = np.zeros((Bb, T), np.int32)
+                rem = np.zeros((Bb,), np.int32)
+                smp = np.zeros((Bb,), bool)
+                rst = np.zeros((Bb,), bool)
+                ipos = np.zeros((Bb,), np.int32)
+                ibud = np.zeros((Bb,), np.int32)
+            toks[i, :n] = s.prompt[s.num_computed:s.num_computed + n]
+            rem[i] = n
+            completing = n == left
+            smp[i] = completing
+            if i in needs_reset:
+                # first fed block after the splice: reset the slot's
+                # carried pos/ctr/counts/budget in-step
+                rst[i] = True
+                ipos[i] = s.num_computed
+                ibud[i] = self._seq_budget(s)
+                needs_reset.discard(i)
+            budget -= n
+            if completing:
+                # the last prompt token's write rides the first
+                # emission's drain (split-engine prefill handoff);
+                # guard re-feeding it until that drain lands
+                s.num_computed += n - 1
+                fed_complete.add(id(s))
+            else:
+                s.num_computed += n
+        if toks is None:
+            return None
+        return toks, rem, smp, rst, ipos, ibud
+
+    @affine("step")
     def _run_decode_continuous(self, seqs: List[Sequence], T: int) -> None:
         """The device-resident decode inner loop (docs/device_loop.md):
         an OPEN-ENDED chain of decode blocks whose varying inputs (last
@@ -3603,6 +3811,8 @@ class JaxEngine:
         from collections import deque as _deque
 
         rows = self._decode_rows(seqs)
+        seqs = list(seqs)  # chain-local: splices append without
+        # aliasing the caller's plan list
         Bb = len(rows)
         tokens, positions = self._decode_arrays(rows)
         seeds, counters = self._seed_arrays(rows)
@@ -3617,6 +3827,8 @@ class JaxEngine:
                            for i, s in enumerate(rows)])
         step = self._get_cc_step(penalized, with_top, greedy, T)
         drain = self._ensure_drain_pool()
+        splice_on = self.cfg.prefill_chunk_tokens > 0
+        mrope = bool(self.model_cfg.mrope_section)
         # _plan_decode reserved decode_advance (>= T) preemptively, so
         # the first block always fits even when the watermark blocks
         # further growth
@@ -3632,10 +3844,20 @@ class JaxEngine:
         seeds_d = self._put(seeds, self._bax)
         cts_d = self._put(counts, self._bax, None) if penalized else None
         rope = ()
-        if self.model_cfg.mrope_section:
+        if mrope:
             if rope_off is None:
                 rope_off = np.zeros_like(positions)
             rope = (self._put(rope_off, self._bax),)
+        # quiet-block chunk operands, put ONCE and reused: a steady
+        # block ships no fresh host buffer (fresh buffers mid-chain
+        # serialize on remote-attached TPUs)
+        z_toks_d = self._put(np.zeros((Bb, T), np.int32), self._bax, None)
+        z_i32_d = self._put(np.zeros((Bb,), np.int32), self._bax)
+        z_bool_d = self._put(np.zeros((Bb,), bool), self._bax)
+        quiet_chunk = (z_toks_d, z_i32_d, z_bool_d, z_bool_d, z_i32_d,
+                       z_i32_d)
+        needs_reset: set = set()  # guarded-by: step thread (chain-local)
+        fed_complete: set = set()  # guarded-by: step thread (chain-local)
         inflight: Any = _deque()
         deferred: List[int] = []
         self.scheduler.deferred_free = deferred
@@ -3643,23 +3865,72 @@ class JaxEngine:
         # None until a fall-out signal fires: a chain that dies before
         # its first check records "error", never a clean reason
         fallout = None
+        # counted at ENTRY (like the per-dispatch block counter): a
+        # reader polling metrics() mid-chain sees the engaged loop
+        # instead of zero until the teardown drain finishes
+        self._cc_chains_total += 1
         chain_t0 = self.events.now()
         try:
             while True:
+                # -- splice intake + chunk feed (host work BEFORE the
+                # slice's t0, so it lands in the inter-block gap the
+                # timeline attributes to the tagged splice slice) ----- #
+                splice_fall = None
+                spliced: List[int] = []
+                if splice_on:
+                    spliced, splice_fall = self._cc_intake(
+                        rows, seqs, penalized, with_top, greedy)
+                    for i in spliced:
+                        needs_reset.add(i)
+                    if spliced:
+                        # per-row operands now cover the new rows; the
+                        # carried device state is reset in-step by the
+                        # reset overlay on their first fed block
+                        samp_d = self._put_samp(self._samp_arrays(rows))
+                        seeds_d = self._put(
+                            self._seed_arrays(rows)[0], self._bax)
+                        stops_d = self._put(
+                            self._stop_arrays(rows), self._bax, None)
+                        if mrope:
+                            ro = self._rope_array(rows)
+                            if ro is None:
+                                ro = np.zeros_like(positions)
+                            rope = (self._put(ro, self._bax),)
+                feed = (self._cc_plan_feed(rows, T, needs_reset,
+                                           fed_complete)
+                        if splice_on else None)
+                if feed is not None:
+                    toks, rem, smp, rst, ipos, ibud = feed
+                    chunk_ops = (
+                        self._put(toks, self._bax, None),
+                        self._put(rem, self._bax),
+                        self._put(smp, self._bax),
+                        self._put(rst, self._bax),
+                        self._put(ipos, self._bax),
+                        self._put(ibud, self._bax),
+                    )
+                    chunk_rows = int((rem > 0).sum())
+                else:
+                    chunk_ops = quiet_chunk
+                    chunk_rows = 0
+                if spliced or feed is not None:
+                    # splices/feeds may have grown page lists
+                    table_d = self._put(self._table_array(rows),
+                                        self._bax, None)
                 t_iter = self.events.now()
                 if penalized:
                     (packed_d, tok_d, pos_d, ctr_d, act_d, budget_d,
                      cts_d, self.kv) = step(
                         self.params, self.kv, tok_d, pos_d, ctr_d, cts_d,
                         act_d, budget_d, stops_d, table_d, samp_d, seeds_d,
-                        *rope,
+                        *chunk_ops, *rope,
                     )
                 else:
                     (packed_d, tok_d, pos_d, ctr_d, act_d, budget_d,
                      self.kv) = step(
                         self.params, self.kv, tok_d, pos_d, ctr_d,
                         act_d, budget_d, stops_d, table_d, samp_d, seeds_d,
-                        *rope,
+                        *chunk_ops, *rope,
                     )
                 try:
                     packed_d.copy_to_host_async()
@@ -3667,23 +3938,41 @@ class JaxEngine:
                     pass
                 blocks += 1
                 allowance -= 1
+                # live per-dispatch count: a reader polling metrics()
+                # mid-chain (or right after its tokens arrive, before
+                # the chain's trailing blocks drain) sees the blocks
+                # already issued instead of zero
+                self._cc_blocks_total += 1
                 self._note_dispatch("decode", T, blocks=1)
+                # pair every drain future with the rows it was
+                # dispatched against: pre-splice blocks must consume
+                # against the row set that produced them
                 inflight.append(
-                    drain.submit(self._fetch_packed_cc, packed_d, Bb,
-                                 with_top))
+                    (list(rows),
+                     drain.submit(self._fetch_packed_cc, packed_d, Bb,
+                                  with_top)))
                 # double buffer: with two blocks undrained, consume the
                 # older one (its device_get overlapped this dispatch)
                 while len(inflight) >= 2:
-                    self._consume_cc_block(inflight.popleft().result(),
-                                           rows, with_top)
-                fallout = self._cc_fall_out(seqs)
+                    rows_snap, fut = inflight.popleft()
+                    self._consume_cc_block(fut.result(), rows_snap,
+                                           with_top)
+                fallout = splice_fall or self._cc_fall_out(
+                    seqs, splice=splice_on)
                 # one decode_block slice per ITERATION (dispatch + drain
                 # handoff + fall-out checks): the gap to the next slice
                 # is the host's non-overlapped inter-block time — the
-                # quantity runtime.timeline.decode_host_gaps derives
+                # quantity runtime.timeline.decode_host_gaps derives.
+                # Splice/feed iterations are tagged so the timeline can
+                # separate the handshake from true host gaps.
+                attrs = {}
+                if spliced or chunk_rows:
+                    attrs["splice"] = True
+                if chunk_rows:
+                    attrs["chunk_rows"] = chunk_rows
                 self.events.record("decode_block", t0_ns=t_iter, rung=T,
                                    batch=len(seqs), chain=blocks,
-                                   continuous=True)
+                                   continuous=True, **attrs)
                 if fallout is not None:
                     break
                 if allowance < 1:
@@ -3693,27 +3982,33 @@ class JaxEngine:
                     allowance = self._cc_reserve(
                         seqs, T, inflight_blocks=len(inflight))
                     if allowance < 1:
-                        fallout = "pages"
+                        # the watermark reserve held back for waiting
+                        # prompts is what the extension refused for:
+                        # record the trigger, not the symptom
+                        fallout = ("admission" if self.scheduler.waiting
+                                   else "pages")
                         break
                     table_d = self._put(self._table_array(rows),
                                         self._bax, None)
         finally:
             err = None
             while inflight:
-                fut = inflight.popleft()
+                rows_snap, fut = inflight.popleft()
                 try:
-                    self._consume_cc_block(fut.result(), rows, with_top)
+                    self._consume_cc_block(fut.result(), rows_snap,
+                                           with_top)
                 except Exception as e:  # noqa: BLE001 — drain the window
                     # before surfacing (later futures must not leak)
                     err = err or e
             self.scheduler.deferred_free = None
             if deferred:
                 self.pool.free(deferred)
-            self._cc_chains_total += 1
-            self._cc_blocks_total += blocks
+            reason = fallout or "error"
+            self._cc_fallout_by_reason[reason] = (
+                self._cc_fallout_by_reason.get(reason, 0) + 1)
             self.events.record("decode_chain", t0_ns=chain_t0, rung=T,
                                batch=len(seqs), blocks=blocks,
-                               fallout=fallout or "error")
+                               fallout=reason)
             if err is not None:
                 raise err
 
@@ -3731,7 +4026,13 @@ class JaxEngine:
         for i, s in enumerate(rows):
             if s is None or s.status != "running":
                 continue
-            emitted = int(flags[:, i].sum())
+            # the emitted steps are NOT always a block prefix: a chunk
+            # row's feeding steps emit nothing, so a prompt completing
+            # MID-block emits on the tail only (completing step + its
+            # same-block decode steps) — index by the flags, never by
+            # an assumed [0, emitted) range
+            steps = np.nonzero(flags[:, i])[0]
+            emitted = int(steps.size)
             if emitted == 0:
                 continue
             if s.opts.stop_sequences:
@@ -3739,7 +4040,7 @@ class JaxEngine:
                 # per-token host path; a hit finishes the row (pages
                 # deferred — in-flight blocks still write them) and the
                 # finished status trips chain fall-out
-                for t in range(emitted):
+                for t in steps:
                     s.num_computed += 1
                     self.scheduler.commit_full_pages(s)
                     self._append_token(
@@ -3751,7 +4052,7 @@ class JaxEngine:
                 continue
             first = not s.output_tokens
             s.num_computed += emitted
-            s.output_tokens.extend(int(x) for x in out[:emitted, i])
+            s.output_tokens.extend(int(x) for x in out[steps, i])
             if first:
                 self._note_first_token(s)
             self.scheduler.commit_full_pages(s)
@@ -3766,9 +4067,10 @@ class JaxEngine:
                     self.scheduler.finish(s, reason)
                 finally:
                     self.scheduler.deferred_free = saved
-            self._deliver_block(s, out[:emitted, i], logp[:emitted, i],
-                                tids, tlps, i, with_top,
-                                finish_reason=reason)
+            self._deliver_block(s, out[steps, i], logp[steps, i],
+                                tids[steps] if tids is not None else None,
+                                tlps[steps] if tlps is not None else None,
+                                i, with_top, finish_reason=reason)
 
     # -- multihost lockstep --------------------------------------------------- #
 

@@ -253,6 +253,40 @@ class Scheduler:
                     prompt_len=seq.prompt_len, cached=seq.num_cached,
                 )
 
+    @affine("step", "loop")
+    def splice_admit(self) -> Optional[Sequence]:
+        """Admit the head-of-queue prompt WITHOUT the pump: the
+        continuous decode chain's step thread calls this mid-chain so
+        an arriving request becomes a chunk row spliced into the
+        running block (docs/device_loop.md "splice protocol") instead
+        of a chain fall-out.  Exactly `_try_admit`'s per-sequence body
+        — same `_admit_check` capacity gate (watermark-respecting),
+        same prefix-cache application, same admit event (tagged
+        ``spliced``) — so splice admission and pump admission can never
+        diverge.  Returns the admitted sequence, or None when the head
+        is not admissible right now."""
+        if not self._head_admissible():
+            return None
+        seq = self.waiting[0]
+        ok, rank = self._admit_check(seq)
+        if not ok:
+            return None
+        seq.kv_rank = rank
+        self.waiting.popleft()
+        if self.cfg.enable_prefix_caching:
+            self._apply_prefix_cache(seq)
+        seq.status = "running"
+        if seq.t_admitted is None:
+            seq.t_admitted = time.monotonic()
+        self.running.append(seq)
+        if self.events is not None:
+            self.events.record(
+                "admit", rid=seq.request_id, rank=rank,
+                prompt_len=seq.prompt_len, cached=seq.num_cached,
+                spliced=True,
+            )
+        return seq
+
     def _seq_hashes(self, seq: Sequence) -> List[int]:
         """Block-hash chain for admission-time cache scoring (never hits
         the whole-prompt block — its last token must be recomputed).

@@ -385,9 +385,23 @@ class EngineStatsCollector:
         except Exception:  # noqa: BLE001 — a scrape must not take down /metrics
             stats = {}
         for key, value in stats.items():
+            name = f"dynamo_tpu_worker_{ENGINE_STAT_RENAMES.get(key, key)}"
+            if isinstance(value, dict) and key.endswith("_total"):
+                # dict-valued *_total stats export as ONE labeled
+                # counter family, label "reason" (e.g. the continuous
+                # chain's decode_cc_fallout_total{reason} histogram)
+                fam = CounterMetricFamily(
+                    name[: -len("_total")],  # client re-appends
+                    f"engine {key} (live), by reason",
+                    labels=list(self._labels) + ["reason"],
+                )
+                for reason, n in sorted(value.items()):
+                    fam.add_metric(
+                        list(self._labels.values()) + [str(reason)], n)
+                yield fam
+                continue
             if not isinstance(value, (int, float)) or isinstance(value, bool):
                 continue
-            name = f"dynamo_tpu_worker_{ENGINE_STAT_RENAMES.get(key, key)}"
             is_counter = (key in ENGINE_COUNTER_STATS
                           or key.endswith("_total"))
             fam_cls = (CounterMetricFamily if is_counter

@@ -277,30 +277,45 @@ def decode_host_gaps(dump: dict, continuous_only: bool = False) -> dict:
     boundaries (planning, array building) are included — they are the
     host-in-the-loop cost the open-ended chain amortizes away.
 
-    Returns {"n", "p50_ms", "p99_ms", "max_ms"} (Nones when fewer than
-    two decode_block events are present).  `continuous_only` restricts
-    to blocks the continuous loop dispatched."""
+    Splice iterations (a prefill chunk fed / a request spliced into the
+    running chain — the engine tags those slices `splice=True`) do
+    intentional host work before their dispatch, so the gap LEADING
+    INTO a tagged slice is the splice handshake, not an idle stall:
+    those gaps are split out as `splice_n`/`splice_p50_ms`/
+    `splice_p99_ms`/`splice_max_ms`, and the headline p50/p99/max cover
+    only true host gaps.
+
+    Returns {"n", "p50_ms", "p99_ms", "max_ms", "splice_n",
+    "splice_p50_ms", "splice_p99_ms", "splice_max_ms"} (Nones when the
+    corresponding gap set is empty).  `continuous_only` restricts to
+    blocks the continuous loop dispatched."""
     evs = [e for e in dump.get("events", [])
            if e.get("kind") == "decode_block"
            and (not continuous_only or e.get("continuous"))]
     evs.sort(key=lambda e: e.get("t_ns", 0))
-    gaps = sorted(
-        max(0, b.get("t_ns", 0) - (a.get("t_ns", 0) + a.get("dur_ns", 0)))
-        / 1e6
-        for a, b in zip(evs, evs[1:])
-    )
-    if not gaps:
-        return {"n": 0, "p50_ms": None, "p99_ms": None, "max_ms": None}
+    plain = []
+    splice = []
+    for a, b in zip(evs, evs[1:]):
+        gap = max(0, b.get("t_ns", 0)
+                  - (a.get("t_ns", 0) + a.get("dur_ns", 0))) / 1e6
+        # the LATER slice owns the gap before it: its pre-dispatch
+        # host work (splice intake, chunk planning) is what filled it
+        (splice if b.get("splice") else plain).append(gap)
+    plain.sort()
+    splice.sort()
 
-    def pct(p: float) -> float:
-        return gaps[int(p * (len(gaps) - 1))]
+    def stats(gaps, prefix=""):
+        if not gaps:
+            return {f"{prefix}n": 0, f"{prefix}p50_ms": None,
+                    f"{prefix}p99_ms": None, f"{prefix}max_ms": None}
+        return {
+            f"{prefix}n": len(gaps),
+            f"{prefix}p50_ms": round(gaps[int(0.50 * (len(gaps) - 1))], 4),
+            f"{prefix}p99_ms": round(gaps[int(0.99 * (len(gaps) - 1))], 4),
+            f"{prefix}max_ms": round(gaps[-1], 4),
+        }
 
-    return {
-        "n": len(gaps),
-        "p50_ms": round(pct(0.50), 4),
-        "p99_ms": round(pct(0.99), 4),
-        "max_ms": round(gaps[-1], 4),
-    }
+    return {**stats(plain), **stats(splice, "splice_")}
 
 
 def trace_graph(spans: List[dict]) -> Dict[str, dict]:

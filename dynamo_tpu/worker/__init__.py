@@ -104,6 +104,12 @@ class DpRankEngine:
             decode_cc_chains_total=sum(
                 m.decode_cc_chains_total for m in per
             ),
+            # per-reason fall-out dict merges key-wise across ranks
+            decode_cc_fallout_total={
+                r: sum(m.decode_cc_fallout_total.get(r, 0) for m in per)
+                for r in sorted({k for m in per
+                                 for k in m.decode_cc_fallout_total})
+            },
             # capacity gauges: occupancy of the FULLEST rank (admission
             # pins sequences to a rank, so the max is the binding
             # signal, same reasoning as kv_usage) and aggregate

@@ -96,6 +96,14 @@ def build_parser() -> argparse.ArgumentParser:
                          "--decode-chain continuous); with an integer "
                          "--decode-chain N, N becomes the page "
                          "pre-reservation horizon in blocks")
+    ap.add_argument("--prefill-chunk-tokens", type=int, default=None,
+                    help="chunked prefill INSIDE the continuous decode "
+                         "chain: per-block token budget shared by chunk "
+                         "rows, so an admission splices into the running "
+                         "chain instead of falling it out "
+                         "(docs/device_loop.md).  Default: "
+                         "max_prefill_tokens; 0 disables (admissions "
+                         "fall the chain out)")
     ap.add_argument("--decode-block-ladder", type=_ladder_arg, default=None,
                     help="adaptive decode-block sizing: comma-separated "
                          "rung sizes (e.g. 1,4,16) compiled alongside "
@@ -226,6 +234,8 @@ def check_args(ap: argparse.ArgumentParser, args) -> None:
                       or args.decode_steps != 1 or args.decode_chain != 1
                       or args.decode_block_ladder
                       or getattr(args, "decode_continuous", False)
+                      or getattr(args, "prefill_chunk_tokens", None)
+                      is not None
                       or args.speculative_ngram_k
                       or args.no_prefix_caching or args.vision
                       or args.encode_component):
@@ -266,6 +276,7 @@ def engine_config_from_args(args):
         decode_steps=args.decode_steps,
         decode_chain=chain,
         decode_continuous=continuous,
+        prefill_chunk_tokens=getattr(args, "prefill_chunk_tokens", None),
         decode_block_ladder=args.decode_block_ladder,
         speculative_ngram_k=args.speculative_ngram_k,
         mixed_prefill_tokens=args.mixed_prefill_tokens,

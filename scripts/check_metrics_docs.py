@@ -73,6 +73,8 @@ def representative_engine_stats() -> dict:
 
     stats = dict(vars(ForwardPassMetrics()))
     stats["decode_rung8_dispatches_total"] = 0  # block ladder (any rung)
+    # continuous-chain fall-out reasons export as ONE labeled family
+    stats["decode_cc_fallout_total"] = {"admission": 0}
     stats["kv_usage_aggregate"] = 0.0           # ShardedPagePool
     # KVBM tiers (engine.metrics() with a connector attached)
     stats["kvbm_host_blocks"] = 0

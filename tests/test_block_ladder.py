@@ -383,6 +383,59 @@ async def test_continuous_chain_falls_out_on_mid_chain_admission(setup):
     await engine.shutdown()
 
 
+async def test_splice_composes_with_ladder(setup):
+    """ISSUE 15 × ladder composition: chunk rows ride the TOP rung's
+    open-ended chain (the only rung where chaining engages), a batch
+    with a free padding slot splices the arrival instead of falling
+    out, and every stream — greedy and seeded co-residents plus the
+    long-prompt arrival — is byte-identical to the fall-out engine
+    (prefill_chunk_tokens=0) under the same mid-chain admission."""
+    def base_reqs():
+        # long budgets: the chain must still be LIVE (several top-rung
+        # blocks to go) when the arrival lands, or the admission takes
+        # the ordinary between-chains path and nothing splices
+        out = [req(PROMPTS[0], max_tokens=96),
+               req(PROMPTS[3], max_tokens=96, temperature=0.8),
+               req([4, 5, 6], max_tokens=96)]
+        out[1]["sampling_options"]["seed"] = 17
+        return out
+
+    async def drive(engine):
+        top = engine.cfg.block_ladder[-1]
+        engine.dispatch_trace = trace = []
+        futs = [asyncio.ensure_future(collect(engine, r))
+                for r in base_reqs()]
+        # wait for a top-rung decode dispatch: chaining (and therefore
+        # the splice window) only exists there
+        while not any(e["kind"] == "decode" and e["n_steps"] == top
+                      for e in trace):
+            await asyncio.sleep(0.005)
+        late = (await collect(engine, req(PROMPTS[1], max_tokens=6)))[0]
+        rest = [r[0] for r in await asyncio.gather(*futs)]
+        engine.dispatch_trace = None
+        return rest + [late]
+
+    unified = make_engine(setup, decode_block_ladder=[1, 2, 4],
+                          decode_chain=2, decode_continuous=True)
+    got = await drive(unified)
+    ev = unified.events.snapshot()
+    await unified.shutdown()
+    fed = [e[3] for e in ev if e[2] == "decode_block"
+           and e[3].get("chunk_rows", 0) > 0]
+    assert fed, "chunk rows never rode the chain"
+    # chunk blocks ran at the ladder's top rung — rungs stayed the
+    # scan lengths, chunking didn't add a rung
+    top = unified.cfg.block_ladder[-1]
+    assert all(e["rung"] == top for e in fed), fed
+
+    split = make_engine(setup, decode_block_ladder=[1, 2, 4],
+                        decode_chain=2, decode_continuous=True,
+                        prefill_chunk_tokens=0)
+    want = await drive(split)
+    await split.shutdown()
+    assert got == want
+
+
 # -- compile-count tripwire ------------------------------------------------- #
 
 

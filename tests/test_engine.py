@@ -515,6 +515,120 @@ async def test_continuous_decode_top_logprobs(engine_setup):
     assert n_toks == 6 and n_tops == 6
 
 
+async def _drive_mid_chain_arrival(engine, base_reqs, arrival_req):
+    """Start `base_reqs`, wait until a continuous decode dispatch is in
+    flight, then submit `arrival_req`; returns every stream's (tokens,
+    reason) in submission order.  The arrival deterministically lands
+    mid-chain — the splice (unified engine) or fall-out (split engine)
+    path is exercised on every run, not just when timing cooperates."""
+    engine.dispatch_trace = trace = []
+    base = [asyncio.ensure_future(collect(engine, r)) for r in base_reqs]
+    while not any(e["kind"] == "decode" for e in trace):
+        await asyncio.sleep(0.005)
+    late = await collect(engine, arrival_req)
+    out = list(await asyncio.gather(*base))
+    out.append(late)
+    engine.dispatch_trace = None
+    return out
+
+
+def _splice_reqs():
+    """Three co-resident rows covering the device-variant matrix
+    (greedy / seeded temperature / penalized+top-logprobs) plus a
+    long-prompt greedy arrival whose chunked prefill spans several
+    decode blocks AND a page boundary."""
+    prompts = [[1, 2, 3, 4, 5], [9, 8, 7], [3, 3, 3, 3, 3, 3, 3, 3]]
+    out = [req(p, max_tokens=24) for p in prompts]
+    out[1] = req(prompts[1], max_tokens=24, temperature=0.9)
+    out[1]["sampling_options"]["seed"] = 42
+    out[2] = req(prompts[2], max_tokens=24)
+    out[2]["sampling_options"]["frequency_penalty"] = 1.5
+    out[2]["sampling_options"]["logprobs"] = True
+    out[2]["sampling_options"]["top_logprobs"] = 2
+    arrival = req([(5 * j) % 101 + 1 for j in range(24)], max_tokens=8)
+    return out, arrival
+
+
+async def test_chunked_prefill_splice_matches_fallout_engine(engine_setup):
+    """ISSUE 15 tentpole identity: a prompt admitted MID-CHAIN via the
+    chunk-row splice (prefill chunks riding the running decode chain)
+    yields byte-identical streams — for every co-resident row and the
+    admitted request itself — to the fall-out engine
+    (prefill_chunk_tokens=0), which ends the chain and prefills the
+    prompt the PR 6 way.  Greedy, seeded, penalized and top-logprobs
+    rows all share the spliced chain."""
+    base, arrival = _splice_reqs()
+
+    unified = make_cc_engine(engine_setup)
+    got = await _drive_mid_chain_arrival(unified, base, arrival)
+    ev = unified.events.snapshot()
+    m = unified.metrics()
+    released = unified.pool.free_pages + unified.pool.evictable_pages
+    await unified.shutdown()
+
+    # the chunk rows actually rode the chain: splice-tagged decode
+    # blocks with a nonzero chunk-row count...
+    fed = [e[3].get("chunk_rows", 0) for e in ev
+           if e[2] == "decode_block" and e[3].get("splice")]
+    assert fed and max(fed) > 0, [e[3] for e in ev
+                                  if e[2] == "decode_block"]
+    # ...and the admission did NOT end a chain: no admission-side
+    # fall-out reasons (stop/pending_work remain legitimate)
+    assert m.decode_cc_chains_total > 0
+    assert not {"admit", "admission"} & set(m.decode_cc_fallout_total), \
+        m.decode_cc_fallout_total
+    assert released == unified.pool.num_pages - 1
+
+    split = make_cc_engine(engine_setup, prefill_chunk_tokens=0)
+    want = await _drive_mid_chain_arrival(split, base, arrival)
+    m_split = split.metrics()
+    await split.shutdown()
+    # the split engine really took the fall-out path for the arrival
+    assert "admit" in m_split.decode_cc_fallout_total or \
+        "pending_work" in m_split.decode_cc_fallout_total, \
+        m_split.decode_cc_fallout_total
+    assert got == want
+
+
+async def test_chunked_prefill_splice_seeded_arrival(engine_setup):
+    """A SEEDED sampled arrival spliced mid-chain: (a) the co-resident
+    rows — greedy, seeded AND penalized — stay byte-identical to the
+    fall-out engine (the chunk rows' prologue overlay and emit gating
+    never perturb running rows), and (b) the spliced stream itself is
+    reproducible run-to-run: its PRNG stream starts at counter 0 no
+    matter which mid-chain block fed the chunks.  (The spliced row's
+    picks are NOT asserted against the fall-out engine: prefill
+    computes [B,T,D] matmuls where the chunk feed runs T per-step
+    [B,1,D] ones, and the last-ulp logits differences that argmax
+    absorbs can flip a temperature>0 gumbel pick.)"""
+    base, _ = _splice_reqs()
+    arrival = req([(5 * j) % 101 + 1 for j in range(11)], max_tokens=8,
+                  temperature=0.7)
+    arrival["sampling_options"]["seed"] = 1234
+
+    async def run_unified():
+        eng = make_cc_engine(engine_setup)
+        out = await _drive_mid_chain_arrival(eng, base, arrival)
+        ev = eng.events.snapshot()
+        await eng.shutdown()
+        assert any(e[3].get("chunk_rows", 0) > 0 for e in ev
+                   if e[2] == "decode_block"), "splice never engaged"
+        return out
+
+    got = await run_unified()
+    again = await run_unified()
+    assert got == again  # seeded splice is reproducible
+
+    split = make_cc_engine(engine_setup, prefill_chunk_tokens=0)
+    want = await _drive_mid_chain_arrival(split, base, arrival)
+    await split.shutdown()
+    # co-resident rows are bit-identical across the two engines
+    assert got[:3] == want[:3]
+    # the seeded arrival emits the same SHAPE of stream either way
+    assert len(got[3][0]) == len(want[3][0]) == 8
+    assert got[3][1] == want[3][1] == "length"
+
+
 async def test_fused_prefill_decode_matches_unfused():
     """The fused prefill→decode dispatch (first decode chain fed by the
     prefill's device-side sampled token) must be output-invisible:

@@ -1,6 +1,7 @@
 """Timeline merger: OTLP spans + ring dumps → valid Chrome-trace JSON
 with cross-process flow stitching (runtime/timeline.py)."""
 
+import asyncio
 import json
 import time
 
@@ -214,7 +215,9 @@ def test_decode_host_gaps_clamps_async_overlap():
     g = tl.decode_host_gaps(_gap_dump([
         (0, 10_000_000), (5_000_000, 10_000_000),
     ]))
-    assert g == {"n": 1, "p50_ms": 0.0, "p99_ms": 0.0, "max_ms": 0.0}
+    assert g == {"n": 1, "p50_ms": 0.0, "p99_ms": 0.0, "max_ms": 0.0,
+                 "splice_n": 0, "splice_p50_ms": None,
+                 "splice_p99_ms": None, "splice_max_ms": None}
 
 
 def test_decode_host_gaps_empty_and_filtering():
@@ -223,6 +226,36 @@ def test_decode_host_gaps_empty_and_filtering():
     dump["events"][0]["continuous"] = False
     assert tl.decode_host_gaps(dump, continuous_only=True)["n"] == 0
     assert tl.decode_host_gaps(dump)["n"] == 1
+
+
+def test_decode_host_gaps_separates_splice_handshake():
+    """ISSUE 15: the gap leading INTO a splice-tagged slice is the
+    admission/chunk-feed handshake (intentional host work the engine
+    did before that dispatch), not an idle stall — it must ride the
+    splice_* percentiles and stay OUT of the headline host-gap stats,
+    or one splice per chain would dominate p99 and bury regressions
+    in the steady path."""
+    dump = _gap_dump([
+        (0, 5_000_000),             # |--5ms--|
+        (6_000_000, 5_000_000),     #   1ms plain gap
+        (19_000_000, 5_000_000),    #   8ms splice handshake gap
+        (25_000_000, 5_000_000),    #   1ms plain gap
+    ])
+    dump["events"][2]["splice"] = True
+    dump["events"][2]["chunk_rows"] = 1
+    g = tl.decode_host_gaps(dump)
+    # headline stats cover only the two true host gaps
+    assert g["n"] == 2
+    assert g["p50_ms"] == 1.0 and g["max_ms"] == 1.0
+    # the handshake gap is attributed to the tagged LATER slice
+    assert g["splice_n"] == 1
+    assert g["splice_p50_ms"] == g["splice_max_ms"] == 8.0
+    # untagged dumps (fall-out engines, prefill_chunk_tokens=0) keep
+    # the legacy shape: every gap is a plain host gap
+    plain = tl.decode_host_gaps(_gap_dump([
+        (0, 5_000_000), (6_000_000, 5_000_000), (19_000_000, 5_000_000),
+    ]))
+    assert plain["n"] == 2 and plain["splice_n"] == 0
 
 
 async def test_host_gap_measured_from_continuous_engine():
@@ -256,7 +289,14 @@ async def test_host_gap_measured_from_continuous_engine():
             assert d.get("finish_reason") != "error", d
             out.extend(d.get("token_ids", []))
         assert len(out) == 24
-        dump = engine.events.dump()
+        # the chain teardown (trailing in-flight block drain + the
+        # decode_chain event) finishes AFTER the stream's last token
+        # is delivered — poll instead of racing it
+        for _ in range(200):
+            dump = engine.events.dump()
+            if any(e["kind"] == "decode_chain" for e in dump["events"]):
+                break
+            await asyncio.sleep(0.05)
         gaps = tl.decode_host_gaps(dump, continuous_only=True)
         # ≥ 6 continuous blocks → ≥ 5 gaps: the measurement EXISTS
         assert gaps["n"] >= 2, dump["events"][-10:]

@@ -280,6 +280,48 @@ async def test_continuous_chain_zero_steady_state_compiles(setup):  # noqa: F811
         xla_ledger.reset()
 
 
+async def test_splice_admission_zero_steady_state_compiles(setup):  # noqa: F811
+    """ISSUE 15 acceptance: an admission SPLICED into the running chain
+    (chunk rows feeding the prompt through decode blocks) rides the
+    already-compiled chain program — zero steady-state compiles across
+    repeated mid-chain admissions.  Warmup is two identical passes
+    (rung × table-width buckets persist across requests, same rule as
+    the rung sweep above)."""
+    import asyncio
+
+    engine = make_engine(setup, decode_continuous=True, decode_chain=2)
+
+    async def one_pass():
+        engine.dispatch_trace = trace = []
+        # long base budgets keep the chain live across the arrival's
+        # whole chunked admission — the splice must happen mid-chain
+        # even on a warm pass where a block is a few ms
+        base = [asyncio.ensure_future(
+            collect(engine, req(PROMPTS[i], max_tokens=120)))
+            for i in (0, 3)] + [asyncio.ensure_future(
+            collect(engine, req([4, 5, 6], max_tokens=120)))]
+        while not any(e["kind"] == "decode" for e in trace):
+            await asyncio.sleep(0.005)
+        await collect(engine, req(PROMPTS[1], max_tokens=4))
+        await asyncio.gather(*base)
+        engine.dispatch_trace = None
+
+    try:
+        await one_pass()
+        await one_pass()
+        with xla_ledger.steady_scope("cc-splice"):
+            await one_pass()
+        bad = xla_ledger.trips()
+        assert bad == [], "\n".join(t.format() for t in bad)
+        # the steady pass really spliced: chunk rows rode tagged blocks
+        assert any(e[3].get("chunk_rows", 0) > 0
+                   for e in engine.events.snapshot()
+                   if e[2] == "decode_block"), "splice never engaged"
+    finally:
+        await engine.shutdown()
+        xla_ledger.reset()
+
+
 def test_decode_blocks_counted_by_engine_hook():
     n0 = xla_ledger.summary()["decode_blocks"]
     xla_ledger.note_decode_block(2)
