@@ -1117,6 +1117,18 @@ async def main_async():
     from dynamo_tpu.models.config import LLAMA_3_1_8B, LLAMA_3_2_1B
 
     out = {}
+    # frontend egress saturation (docs/frontend_dataplane.md): ramp
+    # concurrent mock SSE streams against the REAL frontend write path
+    # for streams-at-knee + per-delta p99, then A/B the batched
+    # zero-copy writer against the legacy per-delta writer for
+    # CPU-per-token.  Pure asyncio — no device, so it runs before any
+    # model phase and survives a device-phase failure.
+    from dynamo_tpu.frontend.loadgen import frontend_saturation
+
+    out["frontend_saturation"] = await frontend_saturation(
+        log=lambda m: print(m, flush=True)
+    )
+
     cfg = LLAMA_3_2_1B
     params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
     pages_per_seq = (PROMPT_LEN + SUSTAINED_GEN) // 16 + 2
@@ -1560,6 +1572,7 @@ def _compact_summary(full):
     cc = full.get("continuous_decode_1b", {})
     bb = full.get("bursty_1b", {})
     kz = full.get("kvbm_zipf", {})
+    fs = full.get("frontend_saturation", {})
     phase = full.get("phase_samples_tok_s", {})
     return {
         "headline_bf16_tok_s": full.get("value"),
@@ -1627,6 +1640,14 @@ def _compact_summary(full):
         .get("cold_vs_dram"),
         "kvbm_host_hit_rate": (kz.get("tier_hits") or {})
         .get("host_hit_rate"),
+        # frontend egress data plane (ISSUE 16): concurrent-stream knee
+        # + batched-vs-legacy writer CPU-per-token A/B
+        "frontend_streams_at_knee": fs.get("streams_at_knee"),
+        "frontend_delta_p99_ms_at_knee": fs.get("delta_p99_ms_at_knee"),
+        "frontend_cpu_us_per_token": fs.get("cpu_us_per_token"),
+        "frontend_cpu_us_per_token_legacy": fs.get(
+            "cpu_us_per_token_legacy"),
+        "frontend_cpu_per_token_ratio": fs.get("cpu_per_token_ratio"),
     }
 
 

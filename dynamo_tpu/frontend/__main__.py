@@ -48,13 +48,79 @@ def build_parser() -> argparse.ArgumentParser:
                     help="separate system status server port (0 = ephemeral,"
                          " -1 = disabled; the main port already serves "
                          "/health /live /metrics)")
+    ap.add_argument("--shards", type=int, default=1,
+                    help="run N frontend processes sharing a fixed --port "
+                         "via SO_REUSEPORT (per-core sharding; the kernel "
+                         "load-balances accepts and each shard keeps its "
+                         "own lease-scoped registration)")
+    ap.add_argument("--reuse-port", action="store_true",
+                    help="bind with SO_REUSEPORT so several frontend "
+                         "processes can share --port (implied for --shards "
+                         "children)")
+    ap.add_argument("--sse-coalesce", action=argparse.BooleanOptionalAction,
+                    default=None,
+                    help="merge same-choice token deltas into one SSE frame "
+                         "when a connection's write queue backs up "
+                         "(default: DYN_TPU_SSE_COALESCE, else on)")
     ap.add_argument("--log-level", default="")
     ap.add_argument("--log-jsonl", action="store_true", default=None)
     return ap
 
 
+def _shard_argv(argv) -> list:
+    """argv for one --shards child: the --shards flag stripped (children
+    must not recurse) and --reuse-port appended so all N children can
+    bind the same fixed port."""
+    out = []
+    skip = False
+    for a in argv:
+        if skip:
+            skip = False
+            continue
+        if a == "--shards":
+            skip = True
+            continue
+        if a.startswith("--shards="):
+            continue
+        out.append(a)
+    if "--reuse-port" not in out:
+        out.append("--reuse-port")
+    return out
+
+
+def _run_shards(n: int, argv) -> int:
+    """Spawn N identical frontend children on one SO_REUSEPORT address,
+    forward SIGINT/SIGTERM, and wait them all out."""
+    import subprocess
+    import sys
+
+    cmd = [sys.executable, "-m", "dynamo_tpu.frontend"] + _shard_argv(argv)
+    procs = [subprocess.Popen(cmd) for _ in range(n)]
+
+    def _forward(signum, frame):
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, _forward)
+    rcs = [p.wait() for p in procs]
+    bad = [r for r in rcs
+           if r not in (0, -signal.SIGTERM, -signal.SIGINT)]
+    return 1 if bad else 0
+
+
 def main() -> None:
+    import sys
+
     args = build_parser().parse_args()
+    if args.shards > 1:
+        if args.port == 0:
+            raise SystemExit(
+                "--shards requires a fixed --port: the shards share one "
+                "listen address via SO_REUSEPORT"
+            )
+        raise SystemExit(_run_shards(args.shards, sys.argv[1:]))
     from ..runtime.tracing import setup_logging
 
     setup_logging(args.log_level, args.log_jsonl)
@@ -105,26 +171,39 @@ async def _run(args) -> None:
     # under /telemetry/{ns}/frontend/{lease}, and watch the whole prefix
     # so /fleet.json serves the joined fleet view + online knees
     from ..planner.telemetry import FleetTelemetryWatcher
+    from ..runtime.config import env_bool
     from ..runtime.metrics import TelemetryPublisher
 
-    telemetry = TelemetryPublisher(
-        runtime,
-        lambda: {"kind": "frontend", "models": metrics.slo.snapshot()},
-        namespace=args.namespace, component="frontend",
-    ).start()
     fleet = await FleetTelemetryWatcher(
         runtime, namespace=args.namespace,
     ).start()
-    fleet.start_sampling(telemetry.interval_s)
     enabled = (
         {r.strip() for r in args.routes.split(",") if r.strip()}
         if args.routes else None
     )
+    # the library-level coalescing default is OFF (embedding users opt
+    # in); the serving CLI turns it on unless the flag/env says otherwise
+    sse_coalesce = (args.sse_coalesce if args.sse_coalesce is not None
+                    else env_bool("DYN_TPU_SSE_COALESCE", True))
     http = await HttpService(
         manager, host=args.host, port=args.port, metrics=metrics,
         tls_cert=args.tls_cert, tls_key=args.tls_key,
         enabled_routes=enabled, fleet=fleet,
+        reuse_port=args.reuse_port, sse_coalesce=sse_coalesce,
     ).start()
+    # published AFTER http exists: the payload carries the egress
+    # stream count from the service's step-event ring
+    telemetry = TelemetryPublisher(
+        runtime,
+        lambda: {
+            "kind": "frontend",
+            "models": metrics.slo.snapshot(),
+            "egress_streams_total":
+                http.events.totals().get("egress_stream", 0),
+        },
+        namespace=args.namespace, component="frontend",
+    ).start()
+    fleet.start_sampling(telemetry.interval_s)
     # self-register for inference gateways (lease-scoped, like worker
     # instance discovery): deploy/gateway.py watches this key space
     from ..deploy.gateway import register_frontend

@@ -132,6 +132,53 @@ class FrontendMetrics:
             ["endpoint", "instance"],
             registry=self.registry,
         )
+        # egress data plane (frontend/egress.py): per-stream counters
+        # flushed in ONE post-stream batch by observe_egress — nothing
+        # here rides the per-delta delivery path
+        self.egress_frames = Counter(
+            "dynamo_frontend_egress_frames_total",
+            "SSE frames written (coalescing merges deltas into fewer)",
+            ["model"],
+            registry=self.registry,
+        )
+        self.egress_writes = Counter(
+            "dynamo_frontend_egress_writes_total",
+            "resp.write calls (a burst drain sends many frames per write)",
+            ["model"],
+            registry=self.registry,
+        )
+        self.egress_coalesced = Counter(
+            "dynamo_frontend_egress_coalesced_deltas_total",
+            "Token deltas merged into a preceding frame under backpressure",
+            ["model"],
+            registry=self.registry,
+        )
+        self.egress_backpressure = Counter(
+            "dynamo_frontend_egress_backpressure_events_total",
+            "Queue drains that began with deltas already backed up",
+            ["model"],
+            registry=self.registry,
+        )
+        self.egress_cpu = Counter(
+            "dynamo_frontend_egress_cpu_seconds_total",
+            "Frontend CPU spent building + writing SSE frames "
+            "(divide by output tokens for per-token cost)",
+            ["model"],
+            registry=self.registry,
+        )
+        self.egress_bytes = Counter(
+            "dynamo_frontend_egress_bytes_total",
+            "SSE bytes written (frames + keepalive pings)",
+            ["model"],
+            registry=self.registry,
+        )
+        self.egress_queue_depth = Histogram(
+            "dynamo_frontend_egress_queue_depth",
+            "Write-queue backlog observed at each backpressure drain",
+            ["model"],
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512),
+            registry=self.registry,
+        )
         # span-exporter visibility: a full OTLP push queue drops spans —
         # dynamo_tracing_spans_sent_total/_dropped_total make that loss a
         # counter on /metrics instead of a silent trace gap
@@ -145,6 +192,29 @@ class FrontendMetrics:
 
         self.slo = SLOAccountant()
         self.registry.register(SLOWindowCollector(self.slo))
+        # process-level CPU/fd/RSS (runtime/metrics.py): the saturation
+        # story needs frontend CPU per token to be attributable against
+        # whole-process burn from the same scrape
+        from ..runtime.metrics import ProcessStatsCollector
+
+        self.registry.register(ProcessStatsCollector())
+
+    def observe_egress(self, model: str, eg) -> None:
+        """Flush one stream's egress counters (a StreamEgress) — called
+        once per stream from the post-stream accounting block."""
+        self.egress_frames.labels(model).inc(eg.frames)
+        if eg.writes:
+            self.egress_writes.labels(model).inc(eg.writes)
+        if eg.coalesced:
+            self.egress_coalesced.labels(model).inc(eg.coalesced)
+        if eg.backpressure_events:
+            self.egress_backpressure.labels(model).inc(eg.backpressure_events)
+        self.egress_cpu.labels(model).inc(eg.cpu_ns / 1e9)
+        self.egress_bytes.labels(model).inc(eg.bytes_out)
+        if eg.depth_samples:
+            observe = self.egress_queue_depth.labels(model).observe
+            for depth in eg.depth_samples:
+                observe(depth)
 
     def observe_migration(self, model: str, event: str) -> None:
         """Account one migrating_stream event ('migrated'/'exhausted')."""

@@ -31,14 +31,27 @@ from aiohttp import web
 from ..analysis import leak_ledger
 from ..llm import RequestError
 from ..runtime import Context
+from ..runtime.config import env_bool, env_int
+from ..runtime.events import StepEventRecorder
 from ..runtime.transport.service import RemoteStreamError, ServiceUnavailable
+from .egress import CONTENT_SENTINEL, ChunkTemplate, StreamEgress, sse_frame
 from .metrics import FrontendMetrics
 from .service import ModelManager, ModelWatcher
 
 logger = logging.getLogger(__name__)
 
-# idle SSE connections get a comment ping this often (seconds)
+# idle SSE connections get a comment ping this often (seconds), measured
+# from the last bytes actually WRITTEN to the connection (not the last
+# queue item — a token-less drain marker must not reset the timer)
 SSE_KEEPALIVE_S = 10.0
+
+# max queue items drained into one resp.write (bounds frame batch size
+# and keeps a badly backed-up stream from starving its siblings)
+_MAX_BURST = 256
+
+# queue sentinel the rearming keepalive timer drops in when the
+# time-since-last-write deadline passes (never a real delta tuple)
+_KEEPALIVE = object()
 
 
 class _ChoiceParsers:
@@ -108,10 +121,25 @@ class HttpService:
     def __init__(self, manager: ModelManager, host: str = "0.0.0.0",
                  port: int = 8000, metrics: Optional[FrontendMetrics] = None,
                  audit=None, tls_cert: str = "", tls_key: str = "",
-                 enabled_routes: Optional[set] = None, fleet=None):
+                 enabled_routes: Optional[set] = None, fleet=None,
+                 reuse_port: bool = False,
+                 sse_coalesce: Optional[bool] = None,
+                 sse_legacy: Optional[bool] = None,
+                 events: Optional[StepEventRecorder] = None):
         from ..llm.audit import AuditBus
 
         self.manager = manager
+        # egress data plane knobs (frontend/egress.py has the semantics;
+        # explicit args win over the environment)
+        self.reuse_port = reuse_port  # SO_REUSEPORT: per-core sharding
+        self.sse_coalesce = (env_bool("DYN_TPU_SSE_COALESCE")
+                             if sse_coalesce is None else bool(sse_coalesce))
+        self.sse_legacy = (env_bool("DYN_TPU_SSE_LEGACY")
+                           if sse_legacy is None else bool(sse_legacy))
+        self.sse_coalesce_max = env_int("DYN_TPU_SSE_COALESCE_MAX", 64)
+        # per-stream egress summaries land on this ring (kind
+        # "egress_stream"; /events.json dumps it)
+        self.events = events if events is not None else StepEventRecorder.from_env()
         # optional planner.telemetry.FleetTelemetryWatcher: /fleet.json
         # then joins worker capacity snapshots to the local SLO windows
         self.fleet = fleet
@@ -161,6 +189,7 @@ class HttpService:
             web.get("/live", self.live),
             web.get("/metrics", self.prometheus),
             web.get("/fleet.json", self.fleet_json),
+            web.get("/events.json", self.events_json),
             web.get("/openapi.json", self.openapi),
             web.post("/clear_kv_blocks", self.clear_kv_blocks),
         ]
@@ -174,7 +203,8 @@ class HttpService:
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port,
-                           ssl_context=self._ssl)
+                           ssl_context=self._ssl,
+                           reuse_port=self.reuse_port or None)
         await site.start()
         # resolve the real port when 0 was requested
         for s in site._server.sockets:  # noqa: SLF001
@@ -221,6 +251,7 @@ class HttpService:
             ("/live", "liveness"),
             ("/metrics", "Prometheus exposition"),
             ("/fleet.json", "live SLO windows + fleet capacity snapshots"),
+            ("/events.json", "egress step-event ring dump"),
             ("/openapi.json", "this document"),
         ]:
             paths[path] = {"get": {
@@ -239,6 +270,12 @@ class HttpService:
 
     async def openapi(self, request: web.Request) -> web.Response:
         return web.json_response(self._openapi_doc)
+
+    async def events_json(self, request: web.Request) -> web.Response:
+        """Egress step-event ring: one `egress_stream` event per served
+        stream (frames/deltas/coalesced/bytes), same dump schema as the
+        worker's engine ring (docs/observability.md)."""
+        return web.json_response(self.events.dump())
 
     async def prometheus(self, request: web.Request) -> web.Response:
         return web.Response(
@@ -543,10 +580,8 @@ class HttpService:
         )
         await resp.prepare(request)
         created = int(time.time())
-        first = True
         ntokens = 0
         t_first = t_last_tok = None
-        last_t = t0
         status = "200"
         spec_seen: list = [None] * n  # last cumulative spec stats per choice
         contexts = [Context() for _ in range(n)]
@@ -572,70 +607,124 @@ class HttpService:
                 zip(self._choice_requests(preprocessed, n), contexts)
             )
         ]
+        # egress writer (frontend/egress.py): frame building + write
+        # batching live there; this loop does queue drain + IO only.
+        # The legacy arm reproduces the pre-optimization writer (one
+        # dict + json.dumps + resp.write per delta) for A/B benching.
+        eg = StreamEgress(resp, coalesce=self.sse_coalesce,
+                          coalesce_max=self.sse_coalesce_max)
+        legacy = self.sse_legacy
+        max_burst = 1 if legacy else _MAX_BURST
+        templates: dict = {}  # choice index -> ChunkTemplate
+        stamps: list = []     # delta arrival times (batch-observed later)
+        ttft_attrs: list = []  # engine TTFT attributions (ditto)
+
+        def process(item):
+            """One queue item → frames/bookkeeping. No awaits: delivery
+            work happens here; scoring/annotation is deferred to the
+            post-stream accounting block."""
+            nonlocal live, status, ntokens, t_first, t_last_tok
+            i, out, err = item
+            if err is not None:
+                status = "502"
+                eg.add_obj(_sse_error_chunk(rid, str(err)))
+                return
+            if out is None:
+                live -= 1
+                return
+            if out.get("finish_reason") == "error":
+                status = "500"
+                eg.add_obj(_sse_error_chunk(rid, out.get("error",
+                                                         "engine error")))
+                return
+            now = time.monotonic()
+            stamps.append(now)
+            ids = out.get("token_ids")
+            if ids:
+                # SLO scoring keys off TOKEN-bearing deltas only —
+                # bench's definition; a token-less finish/role delta
+                # must not make a zero-token stream look served
+                t_last_tok = now
+                if t_first is None:
+                    t_first = now
+                ntokens += len(ids)
+            spec = out.get("spec")
+            if spec:  # cumulative: the last delta seen carries totals
+                spec_seen[i] = spec
+            attr = out.get("ttft")
+            if attr:  # one-shot, first-token delta only
+                ttft_attrs.append(attr)
+            finish = out.get("finish_reason")
+            if parsers is not None:
+                if finish:
+                    parsed = parsers[i].push_final(out.get("text", ""))
+                else:
+                    parsed = parsers[i].push(out.get("text", ""))
+                delta = parsers[i].delta_fields(parsed)
+                eg.add_obj(_make_chunk(
+                    rid, kind, model_name, created, {**out, "text": ""},
+                    parsers[i].map_finish(finish),
+                    index=i, entry=entry, delta_override=delta,
+                ))
+                return
+            if not legacy and finish is None and not out.get("log_probs"):
+                # fast path: splice the text into the pre-serialized
+                # skeleton — byte-identical to the json.dumps frame
+                text = out.get("text", "")
+                # chat deltas with EMPTY text serialize as `delta: {}`,
+                # a different shape the skeleton can't splice
+                if text or kind != "chat":
+                    tmpl = templates.get(i)
+                    if tmpl is None:
+                        tmpl = templates[i] = ChunkTemplate(_make_chunk(
+                            rid, kind, model_name, created,
+                            {"text": CONTENT_SENTINEL}, None, index=i,
+                        ))
+                    eg.add_fast(tmpl, text)
+                    return
+            eg.add_obj(_make_chunk(rid, kind, model_name, created, out,
+                                   finish, index=i, entry=entry))
+
         live = n
+        # Keepalive keys off time-since-last-WRITE (a steady stream that
+        # stops producing writes still pings on schedule, and proxies
+        # stay open through long prefills — reference: SSE keep-alive
+        # pings, openai.rs).  It's armed as ONE rearming loop.call_later
+        # that drops a sentinel into the queue when the deadline passes:
+        # the drain loop below stays a plain queue.get() with no
+        # per-delta wait_for timer churn on the delivery path.
+        loop = asyncio.get_running_loop()
+        ka_handle = None
+
+        def rearm_keepalive():
+            nonlocal ka_handle
+            wait = SSE_KEEPALIVE_S - (time.monotonic() - eg.last_write)
+            if wait <= 0:
+                queue.put_nowait(_KEEPALIVE)
+                wait = SSE_KEEPALIVE_S
+            ka_handle = loop.call_later(wait, rearm_keepalive)
+
+        ka_handle = loop.call_later(SSE_KEEPALIVE_S, rearm_keepalive)
         try:
             while live:
-                try:
-                    i, out, err = await asyncio.wait_for(
-                        queue.get(), timeout=SSE_KEEPALIVE_S
-                    )
-                except asyncio.TimeoutError:
-                    # comment line keeps idle connections open through
-                    # proxies during long prefills (reference: SSE
-                    # keep-alive pings, http/service/openai.rs)
-                    await resp.write(b": keep-alive\n\n")
+                item = await queue.get()
+                if item is _KEEPALIVE:
+                    if (time.monotonic() - eg.last_write
+                            >= SSE_KEEPALIVE_S):
+                        await eg.ping()
                     continue
-                if err is not None:
-                    status = "502"
-                    chunk = _sse_error_chunk(rid, str(err))
-                    await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
-                    continue
-                if out is None:
-                    live -= 1
-                    continue
-                if out.get("finish_reason") == "error":
-                    status = "500"
-                    chunk = _sse_error_chunk(rid, out.get("error", "engine error"))
-                    await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
-                    continue
-                now = time.monotonic()
-                if first:
-                    self.metrics.ttft.labels(model_name).observe(now - t0)
-                    first = False
-                else:
-                    self.metrics.itl.labels(model_name).observe(now - last_t)
-                last_t = now
-                if out.get("token_ids"):
-                    # SLO scoring keys off TOKEN-bearing deltas only —
-                    # bench's definition; a token-less finish/role delta
-                    # must not make a zero-token stream look served
-                    t_last_tok = now
-                    if t_first is None:
-                        t_first = now
-                ntokens += len(out.get("token_ids", []))
-                if out.get("spec"):  # cumulative: the last delta seen
-                    spec_seen[i] = out["spec"]  # carries the totals
-                if out.get("ttft"):  # one-shot, first-token delta only
-                    self.metrics.observe_ttft_attr(model_name, out["ttft"])
-                finish = out.get("finish_reason")
-                if parsers is not None:
-                    if finish:
-                        parsed = parsers[i].push_final(out.get("text", ""))
-                    else:
-                        parsed = parsers[i].push(out.get("text", ""))
-                    delta = parsers[i].delta_fields(parsed)
-                    out = {**out, "text": ""}
-                    finish = parsers[i].map_finish(finish)
-                    chunk = _make_chunk(
-                        rid, kind, model_name, created, out, finish,
-                        index=i, entry=entry, delta_override=delta,
-                    )
-                else:
-                    chunk = _make_chunk(
-                        rid, kind, model_name, created, out, finish,
-                        index=i, entry=entry,
-                    )
-                await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
+                process(item)
+                depth = queue.qsize()
+                if depth and max_burst > 1:
+                    # the pumps outran the writer: drain the backlog in
+                    # one burst → ONE resp.write (and, when enabled,
+                    # coalesced same-choice frames)
+                    eg.note_backpressure(depth)
+                    for _ in range(min(depth, max_burst - 1)):
+                        it = queue.get_nowait()
+                        if it is not _KEEPALIVE:
+                            process(it)
+                await eg.flush()
             await resp.write(b"data: [DONE]\n\n")
         except (ConnectionResetError, asyncio.CancelledError):
             logger.info("client disconnected; killing %d choice(s)", n)
@@ -646,31 +735,41 @@ class HttpService:
                 self.audit.response(rid, model_name, kind, "disconnected")
             raise
         finally:
+            ka_handle.cancel()
             for t in tasks:
                 t.cancel()
             # settle before returning: a cancelled-but-pending pump must
             # not outlive its request (or the loop, at server shutdown)
             await asyncio.gather(*tasks, return_exceptions=True)
+            # accounting moved OFF the delivery path: per-delta latency
+            # observes, TTFT attribution, egress counters and the ring
+            # event all land here in one post-stream batch (runs on the
+            # disconnect path too, so partial streams still count)
+            if stamps:
+                self.metrics.ttft.labels(model_name).observe(stamps[0] - t0)
+                observe_itl = self.metrics.itl.labels(model_name).observe
+                prev = stamps[0]
+                for t_delta in stamps[1:]:
+                    observe_itl(t_delta - prev)
+                    prev = t_delta
+            for attr in ttft_attrs:
+                self.metrics.observe_ttft_attr(model_name, attr)
+            self.metrics.observe_egress(model_name, eg)
+            self.events.record(
+                "egress_stream", model=model_name, frames=eg.frames,
+                deltas=eg.deltas, coalesced=eg.coalesced,
+                writes=eg.writes, bytes=eg.bytes_out,
+            )
         self.metrics.requests.labels(model_name, kind, status).inc()
         self.metrics.output_tokens.labels(model_name).inc(ntokens)
         self.metrics.duration.labels(model_name).observe(time.monotonic() - t0)
         # live SLO window: the whole HTTP request is one accounting unit
-        # (bench.poisson_goodput's per-request TTFT + mean-ITL predicate).
-        # A stream the client saw FAIL can never be SLO-met — score it at
-        # infinite latency so incidents show up as a slo_met drop, while
-        # its delivered tokens still count as attained (not goodput).
-        # n>1: choices stream concurrently, so per-STREAM ITL is the
-        # span over one choice's share of the tokens — dividing by the
-        # total would dilute a breach by ~n
-        inf = float("inf")
-        errored = status != "200" or t_first is None
-        self.metrics.slo.observe(
-            model_name,
-            ttft_ms=inf if errored else (t_first - t0) * 1e3,
-            itl_ms=(inf if errored
-                    else (t_last_tok - t_first)
-                    / max(ntokens / max(n, 1) - 1, 1) * 1e3),
-            output_tokens=ntokens,
+        # (bench.poisson_goodput's per-request TTFT + mean-ITL predicate,
+        # applied post-hoc in slo.observe_stream — never on the delivery
+        # loop). A stream the client saw FAIL can never be SLO-met.
+        self.metrics.slo.observe_stream(
+            model_name, t0=t0, t_first=t_first, t_last_tok=t_last_tok,
+            ntokens=ntokens, n_choices=n, errored=status != "200",
             prompt_tokens=len(preprocessed.get("token_ids") or []),
         )
         for spec in spec_seen:
@@ -943,6 +1042,16 @@ def _make_chunk(rid, kind, model, created, out, finish_reason, index=0,
 
 def _sse_error_chunk(rid, message):
     return {"id": rid, "error": {"message": message, "type": "internal_error"}}
+
+
+async def _write_sse(resp, obj) -> None:
+    """Serialize + write one SSE object frame directly.
+
+    The single seam for any write site outside the batched StreamEgress
+    path (the two error branches used to carry near-duplicate f-string
+    serializations); both paths produce bytes via egress.sse_frame, so
+    the wire format is defined in exactly one place."""
+    await resp.write(sse_frame(obj))
 
 
 def _error_response(status: int, message: str, code: str = "invalid_request_error"):

@@ -46,6 +46,29 @@ class ModelEntry:
         self.metrics = metrics  # FrontendMetrics (migration counters)
         self.instances: set[int] = set()
         self.kv_chooser = None  # set by the KV router integration (M2)
+        self.engine = None  # in-process AsyncEngine (local() entries)
+
+    @classmethod
+    def local(cls, mdc: ModelDeploymentCard,
+              tokenizer: HuggingFaceTokenizer, engine,
+              metrics=None) -> "ModelEntry":
+        """Transport-free entry over an in-process AsyncEngine: the
+        route IS engine.generate — no control plane, no wire hop.  The
+        egress loadgen/bench saturation harness uses this to drive the
+        REAL frontend write path (and single-process embedders can too);
+        everything above route() — preprocess, postprocess_stream,
+        migration wrapper — is the production pipeline."""
+        entry = cls.__new__(cls)
+        entry.mdc = mdc
+        entry.tokenizer = tokenizer
+        entry.preprocessor = OpenAIPreprocessor(mdc, tokenizer)
+        entry.client = None
+        entry.router_mode = "local"
+        entry.metrics = metrics
+        entry.instances = {0}
+        entry.kv_chooser = None
+        entry.engine = engine
+        return entry
 
     async def route(self, request: Dict[str, Any], context: Context
                     ) -> AsyncIterator[Dict[str, Any]]:
@@ -56,6 +79,10 @@ class ModelEntry:
         (e.g. a text fleet plus a vision worker on `backend/generate`),
         and the endpoint-level round-robin would happily send a request
         for model A to a worker serving only model B."""
+        if self.engine is not None:  # local() entry: no transport
+            async for item in self.engine.generate(request, context):
+                yield item
+            return
         if self.kv_chooser is not None:
             request = {**request, "request_id": context.id}
             # AllWorkersBusy (an Overloaded/ServiceUnavailable) propagates:

@@ -317,6 +317,34 @@ class SLOAccountant:
                                   prompt_tokens, now)
         return ok
 
+    def observe_stream(self, model: str, *, t0: float,
+                       t_first: Optional[float],
+                       t_last_tok: Optional[float], ntokens: int,
+                       n_choices: int, errored: bool,
+                       prompt_tokens: int = 0) -> bool:
+        """Score one streamed HTTP request from its raw timestamps —
+        the post-hoc half of the delivery loop's accounting (the loop
+        only collects monotonic stamps; the TTFT/ITL math happens here,
+        off the write path).
+
+        A stream the client saw fail (or that never produced a token)
+        scores at infinite latency: incidents must drag slo_met down
+        while delivered tokens still count as attained.  n>1 choices
+        stream concurrently, so per-STREAM ITL is the span over ONE
+        choice's share of the tokens — dividing by the total would
+        dilute a breach by ~n."""
+        inf = float("inf")
+        bad = errored or t_first is None
+        return self.observe(
+            model,
+            ttft_ms=inf if bad else (t_first - t0) * 1e3,
+            itl_ms=(inf if bad
+                    else (t_last_tok - t_first)
+                    / max(ntokens / max(n_choices, 1) - 1, 1) * 1e3),
+            output_tokens=ntokens,
+            prompt_tokens=prompt_tokens,
+        )
+
     def snapshot(self, now: Optional[float] = None) -> Dict[str, dict]:
         out = {}
         for model, win in self.windows.items():

@@ -82,6 +82,13 @@ class StepEventRecorder:
         with self._lock:
             return self._n
 
+    def totals(self) -> Dict[str, int]:
+        """Per-kind lifetime counts (survive ring wrap), copied under
+        the lock — the cheap periodic-consumer surface (telemetry
+        publishers) that skips the full ring dump."""
+        with self._lock:
+            return dict(self.kind_totals)
+
     def _snap(self) -> tuple:
         """(recorded_total, events in record order) in ONE lock
         acquisition, so dump()'s counters agree with its event list."""

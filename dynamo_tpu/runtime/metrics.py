@@ -123,6 +123,56 @@ ENGINE_STAT_RENAMES = {
 }
 
 
+class ProcessStatsCollector:
+    """Process-level resource families from /proc/self —
+    ``dynamo_process_cpu_seconds_total`` (utime+stime),
+    ``dynamo_process_open_fds`` and
+    ``dynamo_process_resident_memory_bytes`` — registered on the
+    frontend registry so egress CPU-per-token is attributable against
+    whole-process burn on the same scrape (no psutil dependency; yields
+    nothing on platforms without /proc)."""
+
+    def collect(self):
+        import os
+
+        from prometheus_client.core import (
+            CounterMetricFamily,
+            GaugeMetricFamily,
+        )
+
+        try:
+            with open("/proc/self/stat") as f:
+                stat = f.read()
+            # comm may contain spaces/parens: fields start after the
+            # last ')' (utime/stime are fields 14/15, 1-indexed)
+            fields = stat.rsplit(")", 1)[1].split()
+            ticks = float(os.sysconf("SC_CLK_TCK"))
+            cpu_s = (int(fields[11]) + int(fields[12])) / ticks
+            nfds = len(os.listdir("/proc/self/fd"))
+            with open("/proc/self/statm") as f:
+                rss = int(f.read().split()[1]) * os.sysconf("SC_PAGESIZE")
+        except (OSError, ValueError, IndexError):
+            return
+        cpu = CounterMetricFamily(
+            "dynamo_process_cpu_seconds",
+            "Total user+system CPU consumed by this process",
+        )
+        cpu.add_metric([], cpu_s)
+        yield cpu
+        fds = GaugeMetricFamily(
+            "dynamo_process_open_fds",
+            "Open file descriptors (each SSE connection holds one)",
+        )
+        fds.add_metric([], nfds)
+        yield fds
+        mem = GaugeMetricFamily(
+            "dynamo_process_resident_memory_bytes",
+            "Resident set size",
+        )
+        mem.add_metric([], rss)
+        yield mem
+
+
 class TracingSpanCollector:
     """`dynamo_tracing_spans_sent_total` / `_dropped_total` from the live
     span exporter (runtime.tracing) — registered on BOTH the frontend and
