@@ -1129,6 +1129,16 @@ async def main_async():
         log=lambda m: print(m, flush=True)
     )
 
+    # overload control (docs/overload_control.md): mixed-class Poisson
+    # load at 2x the knee, with vs without priority classes + shedding +
+    # decode preemption — interactive SLO protection and the recovered
+    # attained-vs-goodput gap.  MockEngine (real scheduler), no device.
+    from dynamo_tpu.frontend.overload import overload_phase
+
+    out["overload"] = await overload_phase(
+        log=lambda m: print(m, flush=True)
+    )
+
     cfg = LLAMA_3_2_1B
     params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
     pages_per_seq = (PROMPT_LEN + SUSTAINED_GEN) // 16 + 2
@@ -1573,6 +1583,7 @@ def _compact_summary(full):
     bb = full.get("bursty_1b", {})
     kz = full.get("kvbm_zipf", {})
     fs = full.get("frontend_saturation", {})
+    ov = full.get("overload", {})
     phase = full.get("phase_samples_tok_s", {})
     return {
         "headline_bf16_tok_s": full.get("value"),
@@ -1648,6 +1659,15 @@ def _compact_summary(full):
         "frontend_cpu_us_per_token_legacy": fs.get(
             "cpu_us_per_token_legacy"),
         "frontend_cpu_per_token_ratio": fs.get("cpu_per_token_ratio"),
+        # overload control (ISSUE 18): per-class SLO at 2x knee +
+        # attained-vs-goodput gap recovered by shedding/preemption
+        "overload_interactive_slo_met": ov.get("interactive_slo_met"),
+        "overload_batch_slo_met": ov.get("batch_slo_met"),
+        "overload_gap_cut": ov.get("gap_cut"),
+        "overload_gap_on_tok_s": (ov.get("on") or {}).get("gap_tok_s"),
+        "overload_gap_off_tok_s": (ov.get("off") or {}).get("gap_tok_s"),
+        "overload_batch_shed": ((ov.get("on") or {}).get("classes") or {})
+        .get("batch", {}).get("shed"),
     }
 
 

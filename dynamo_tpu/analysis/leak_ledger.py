@@ -34,6 +34,11 @@ Paired acquire/release resources feed per-owner accounts:
 - ``threads`` — ``leaked_threads()`` scans live threads for the
   repo's names (engine executors, drain/offload/blob/audit workers)
   at gate time; a live one after all owners shut down is unjoined.
+- ``parked_pages`` (and any future paired resource) — the generic
+  ``note_acquire``/``note_release`` balance: preemption park debits,
+  resume/abort/shutdown credit.  A nonzero balance at
+  ``assert_balanced`` is KV pinned in the parking lot with no request
+  left to resume it.
 
 ``assert_balanced(owner)`` raises at the shutdown site that leaked —
 wired into engine/runtime shutdown so the failure is attributed —
@@ -63,9 +68,11 @@ __all__ = [
     "install_loop",
     "leakcheck_enabled",
     "leaked_threads",
+    "note_acquire",
     "note_lease_delete",
     "note_lease_put",
     "note_loop_closing",
+    "note_release",
     "note_owner_closed",
     "note_thread_joined",
     "note_thread_started",
@@ -125,6 +132,8 @@ _lease_keys: Dict[str, Set[str]] = {}
 _lease_closed: Set[str] = set()
 _threads_started: Dict[str, int] = {}
 _threads_joined: Dict[str, int] = {}
+# generic paired-resource balances: (account, owner) → outstanding
+_balances: Dict[tuple, int] = {}
 # thread idents abandoned by a FAILED test: the failure is already
 # reported, so the session gate must not double-report its debris
 _excused_thread_idents: set = set()
@@ -280,6 +289,25 @@ def check_page_pool(pool, owner: str) -> int:
     return outstanding
 
 
+def note_acquire(account: str, owner: str, amount: int = 1) -> None:
+    """Debit a paired-resource account (e.g. ``parked_pages`` when a
+    victim's KV enters the parking lot)."""
+    if not _ON or amount <= 0:
+        return
+    with _LOCK:
+        key = (account, owner)
+        _balances[key] = _balances.get(key, 0) + amount
+
+
+def note_release(account: str, owner: str, amount: int = 1) -> None:
+    """Credit a paired-resource account (resume / abort / shutdown)."""
+    if not _ON or amount <= 0:
+        return
+    with _LOCK:
+        key = (account, owner)
+        _balances[key] = _balances.get(key, 0) - amount
+
+
 def note_lease_put(owner: str, key: str) -> None:
     if not _ON:
         return
@@ -390,6 +418,11 @@ def imbalances(owner: Optional[str] = None) -> Dict[str, int]:
             if owner is not None and rec["owner"] != owner:
                 continue
             out[rec["account"]] = out.get(rec["account"], 0) + rec["amount"]
+        for (account, own), amount in _balances.items():
+            if owner is not None and own != owner:
+                continue
+            if amount:
+                out[account] = out.get(account, 0) + amount
         for own, keys in _lease_keys.items():
             if owner is not None and own != owner:
                 continue
@@ -460,6 +493,7 @@ def reset() -> None:
         _lease_closed.clear()
         _threads_started.clear()
         _threads_joined.clear()
+        _balances.clear()
         _excused_thread_idents.clear()
 
 
@@ -478,6 +512,7 @@ def snapshot() -> dict:
             "lease_closed": set(_lease_closed),
             "threads_started": dict(_threads_started),
             "threads_joined": dict(_threads_joined),
+            "balances": dict(_balances),
             "excused": set(_excused_thread_idents),
         }
 
@@ -501,5 +536,7 @@ def restore(snap: dict) -> None:
         _threads_started.update(snap["threads_started"])
         _threads_joined.clear()
         _threads_joined.update(snap["threads_joined"])
+        _balances.clear()
+        _balances.update(snap.get("balances", {}))
         _excused_thread_idents.clear()
         _excused_thread_idents.update(snap["excused"])

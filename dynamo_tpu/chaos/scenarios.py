@@ -32,6 +32,12 @@ re-convergence) plus scenario-specific telemetry:
    SIGKILLed mid-offload and planted torn-block debris; zero client-visible
    errors, streams identical to the no-tier oracle (onboarded blocks
    re-verify against recompute), and no tier corruption survives a read.
+8. ``preempt_resume_storm``    — overload wave (mixed priority classes, one
+   decode slot per worker) forcing decode preemptions, then a worker
+   SIGKILLed while it holds parked KV; zero client-visible errors, every
+   stream token-identical to the no-preemption oracle (park/resume AND
+   migration resumes), and abort-while-parked / admission sheds leave the
+   parking lot balanced in the leak ledger (docs/overload_control.md).
 
 Graph scenarios run MockEngine workers (the real scheduler + page pool with
 a simulated device step) slowed via ``--mock-speedup`` so faults land
@@ -687,6 +693,323 @@ def kvbm_eviction_race() -> Scenario:
     )
 
 
+# --------------------------------------------------------------------------- #
+# Scenario 8: preempt/resume storm + SIGKILL mid-park (custom — the wave
+# needs per-request priority classes and a kill trigger keyed on BOTH
+# interactive streams decoding concurrently, which is the structural
+# proof the victim replica holds parked KV at kill time)
+# --------------------------------------------------------------------------- #
+
+
+GRAPH_OVERLOAD = f"""
+namespace: {NAMESPACE}
+components:
+  backend:
+    kind: worker
+    replicas: 2
+    args: {{model: tiny, mock: true, platform: cpu, mock-speedup: 0.5,
+           component: backend, max-num-seqs: 1, num-pages: 64,
+           page-size: 8}}
+"""
+
+
+async def _run_preempt_resume_storm() -> ScenarioResult:
+    """Overload wave over 2 one-slot mock workers (the REAL scheduler:
+    class-aware admission + park/resume preemption are production code):
+    four batch streams saturate both decode slots, two interactive
+    streams then arrive and can only produce tokens by PARKING the
+    running batch victims — so the moment both interactive streams are
+    streaming concurrently, every worker holds parked KV, and the
+    SIGKILL lands mid-park by construction.  Invariants: zero
+    client-visible errors, every stream (parked-and-resumed, queued,
+    migrated off the corpse) token-identical to the no-preemption
+    oracle wave, and — in-process — abort-while-parked and admission
+    sheds leave the parking lot's leak-ledger account balanced."""
+    import json as _json
+    import signal
+
+    import aiohttp
+
+    from .runner import ChaosStack, _counter_total
+
+    N_BATCH, N_INT = 4, 2
+    BATCH_TOKENS, INT_TOKENS = 48, 24
+    model = "mock-model"
+    rng = FaultPlan(seed=18).rng()
+    stack = ChaosStack(GRAPH_OVERLOAD, env=dict(_FAST_LEASE))
+    result = ScenarioResult(name="preempt_resume_storm", passed=False,
+                            streams=N_BATCH + N_INT)
+    eng = None
+    inproc_tasks: list = []
+    try:
+        await stack.start()
+        await stack.wait_model(model, 2)
+
+        async def wave(session, *, classes: bool, kill: bool):
+            n = N_BATCH + N_INT
+            chunks = [0] * n
+            done = [False] * n
+            outcomes = [{"text": "", "finish": None, "errors": []}
+                        for _ in range(n)]
+            go_interactive = asyncio.Event()
+            kill_info: dict = {}
+
+            async def one(i, priority, max_tokens, delay=0.0):
+                if delay:
+                    await asyncio.sleep(delay)
+                if priority == "interactive":
+                    # join only once the batch wave is decoding on both
+                    # workers — same release point in both arms
+                    await asyncio.wait_for(go_interactive.wait(), 60.0)
+                body = {
+                    "model": model,
+                    "messages": [{"role": "user",
+                                  "content": f"storm probe {i}"}],
+                    "max_tokens": max_tokens,
+                    "temperature": 0,
+                    "seed": 1800 + i,
+                    "stream": True,
+                    "nvext": {"ignore_eos": True,
+                              **({"priority": priority} if classes
+                                 else {})},
+                }
+                out = outcomes[i]
+                try:
+                    async with session.post(
+                        f"{stack.base_url}/v1/chat/completions", json=body
+                    ) as resp:
+                        if resp.status != 200:
+                            out["errors"].append(
+                                f"http {resp.status}: {await resp.text()}"
+                            )
+                            return
+                        async for raw in resp.content:
+                            line = raw.decode().strip()
+                            if (not line.startswith("data: ")
+                                    or line == "data: [DONE]"):
+                                continue
+                            chunk = _json.loads(line[len("data: "):])
+                            if "error" in chunk:
+                                out["errors"].append(str(chunk["error"]))
+                                continue
+                            if not chunk.get("choices"):
+                                continue
+                            choice = chunk["choices"][0]
+                            out["text"] += (choice.get("delta", {})
+                                            .get("content") or "")
+                            chunks[i] += 1
+                            out["finish"] = (choice.get("finish_reason")
+                                             or out["finish"])
+                except Exception as e:  # noqa: BLE001 — client-visible
+                    out["errors"].append(f"{type(e).__name__}: {e}")
+                finally:
+                    done[i] = True
+
+            async def conduct():
+                # release the interactive latecomers once two batch
+                # streams are visibly decoding (one slot per worker →
+                # both workers busy with batch)
+                while sum(1 for i in range(N_BATCH)
+                          if chunks[i] >= 2) < 2:
+                    await asyncio.sleep(0.01)
+                go_interactive.set()
+                if not kill:
+                    return
+                # mid-park window: with one decode slot per worker, two
+                # CONCURRENTLY streaming interactive requests mean each
+                # worker parked its running batch victim to admit one —
+                # whichever replica dies now dies holding parked KV
+                deadline = asyncio.get_running_loop().time() + 60
+                while not (min(chunks[N_BATCH:]) >= 1
+                           and not any(done[N_BATCH:])):
+                    assert asyncio.get_running_loop().time() < deadline, (
+                        "storm never reached the mid-park kill window "
+                        f"(chunks={chunks}, done={done})"
+                    )
+                    await asyncio.sleep(0.005)
+                procs = stack.controller.actuator._procs.get(  # noqa: SLF001
+                    "backend", [])
+                live = [p for p in procs if p.poll() is None]
+                assert live, "no live replica to kill"
+                victim = live[rng.randrange(len(live))]
+                kill_info.update(
+                    pid=victim.pid,
+                    batch_done_at_kill=sum(done[:N_BATCH]),
+                    interactive_live_at_kill=N_INT - sum(done[N_BATCH:]),
+                )
+                victim.send_signal(signal.SIGKILL)
+
+            tasks = [asyncio.create_task(
+                one(i, "batch", BATCH_TOKENS, delay=0.1 * i))
+                for i in range(N_BATCH)]
+            tasks += [asyncio.create_task(
+                one(N_BATCH + j, "interactive", INT_TOKENS))
+                for j in range(N_INT)]
+            conductor = asyncio.create_task(conduct())
+            try:
+                await asyncio.gather(*tasks)
+            finally:
+                if not conductor.done():
+                    conductor.cancel()
+                await asyncio.gather(conductor, return_exceptions=True)
+            if not conductor.cancelled():
+                # lint: allow(blocking-in-async): task already gathered; result() is non-blocking
+                conductor.result()  # propagate conduct() assertions
+            elif kill:
+                raise AssertionError(
+                    "traffic drained before the mid-park kill fired"
+                )
+            return outcomes, kill_info
+
+        timeout = aiohttp.ClientTimeout(total=90)
+        async with aiohttp.ClientSession(timeout=timeout) as session:
+            # no-preemption oracle: same streams and seeds with no class
+            # declared — single-class FIFO service, nothing preempts
+            oracle, _ = await wave(session, classes=False, kill=False)
+            for out in oracle:
+                assert not out["errors"] and out["finish"] == "length", (
+                    f"oracle wave not clean: {out}"
+                )
+            storm, kill_info = await wave(session, classes=True, kill=True)
+
+        result.client_errors = sum(len(o["errors"]) for o in storm)
+        result.stream_mismatches = sum(
+            1 for b, o in zip(oracle, storm)
+            if (b["text"], "length") != (o["text"], o["finish"])
+        )
+        assert result.client_errors == 0, (
+            [o["errors"] for o in storm if o["errors"]]
+        )
+        assert result.stream_mismatches == 0, [
+            (i, b["text"], o["text"], o["finish"])
+            for i, (b, o) in enumerate(zip(oracle, storm))
+            if (b["text"], "length") != (o["text"], o["finish"])
+        ]
+        # the kill landed mid-park: both interactive streams live (each
+        # worker's slot taken by one ⇒ its batch victim parked), no
+        # batch stream had finished
+        assert kill_info.get("interactive_live_at_kill") == N_INT, kill_info
+        assert kill_info.get("batch_done_at_kill") == 0, kill_info
+        result.converge_s = await stack.wait_converged(
+            model=model, instances=2)
+        result.migrations_total = _counter_total(stack.metrics.migrations)
+        assert result.migrations_total >= 1, (
+            "the mid-park kill missed every live stream"
+        )
+
+        # in-process half: abort-while-parked and an admission shed must
+        # leave the parking lot empty and its leak-ledger account
+        # balanced (no orphaned KV) — asserted on the lot's own books
+        # and by the shutdown assert_balanced gate under leakcheck
+        from ..mocker.engine import MockEngine, MockEngineArgs
+
+        eng = MockEngine(MockEngineArgs(
+            num_pages=32, page_size=8, max_num_seqs=1,
+            max_prefill_tokens=64, max_model_len=512, speedup_ratio=1.0,
+            overload_queue_depth=2, overload_headroom_pages=10**6,
+            batch_deadline_s=30.0,
+        ))
+
+        def mreq(priority, max_tokens):
+            return {"token_ids": [7, 11, 13, 17, 19, 23],
+                    "priority": priority,
+                    "sampling_options": {"temperature": 0.0},
+                    "stop_conditions": {"max_tokens": max_tokens,
+                                        "ignore_eos": True}}
+
+        async def consume(gen, sink):
+            async for d in gen:
+                sink.append(d)
+
+        async def until(cond, what, timeout_s=15.0):
+            deadline = asyncio.get_running_loop().time() + timeout_s
+            while not cond():
+                assert asyncio.get_running_loop().time() < deadline, (
+                    f"timed out waiting for {what}"
+                )
+                await asyncio.sleep(0.005)
+
+        outs = {k: [] for k in ("b1", "b2", "b3", "i1")}
+        b1 = asyncio.create_task(
+            consume(eng.generate(mreq("batch", 64)), outs["b1"]))
+        inproc_tasks.append(b1)
+        await until(
+            lambda: sum(len(d.get("token_ids", []))
+                        for d in outs["b1"]) >= 2,
+            "the park victim to reach mid-decode")
+        for k in ("b2", "b3"):
+            inproc_tasks.append(asyncio.create_task(
+                consume(eng.generate(mreq("batch", 4)), outs[k])))
+        await until(lambda: len(eng.scheduler.waiting) >= 2,
+                    "the batch backlog to queue")
+        i1 = asyncio.create_task(
+            consume(eng.generate(mreq("interactive", 64)), outs["i1"]))
+        inproc_tasks.append(i1)
+        await until(lambda: len(eng.parking) == 1,
+                    "the interactive head to park the victim")
+        # abort WHILE PARKED: the client vanishes; the scheduler's
+        # release path must discard the parked entry (credit the ledger)
+        b1.cancel()
+        await asyncio.gather(b1, return_exceptions=True)
+        assert len(eng.parking) == 0 and eng.parking.pages_held == 0, (
+            eng.parking.stats()
+        )
+        assert eng.parking.discarded_total == 1, eng.parking.stats()
+        # admission shed at the knee: queue ≥ depth → a new batch
+        # request is refused with the structured overloaded error (and
+        # touches no pool or parking state)
+        shed_out: list = []
+        await consume(eng.generate(mreq("batch", 4)), shed_out)
+        err = shed_out[-1]
+        assert (err.get("finish_reason") == "error"
+                and isinstance(err.get("error"), dict)
+                and err["error"].get("code") == "overloaded"), shed_out
+        await asyncio.gather(*inproc_tasks[1:])
+        for k, want in (("i1", 64), ("b2", 4), ("b3", 4)):
+            got = sum(len(d.get("token_ids", [])) for d in outs[k])
+            assert got == want and (
+                outs[k][-1].get("finish_reason") == "length"), (k, outs[k])
+        lot = eng.parking
+        assert len(lot) == 0 and lot.pages_held == 0, lot.stats()
+        result.telemetry = {
+            **{f"kill_{k}": v for k, v in kill_info.items()},
+            "inproc_parked_total": lot.parked_total,
+            "inproc_discarded_total": lot.discarded_total,
+            "inproc_shed_total": eng.scheduler.shed_total,
+            "inproc_queued_total": eng.scheduler.queued_total,
+        }
+        # the shutdown gate re-asserts ledger balance under leakcheck
+        await eng.shutdown()
+        result.passed = True
+    except (AssertionError, TimeoutError, asyncio.TimeoutError) as e:
+        result.failure = str(e) or repr(e)
+    finally:
+        for t in inproc_tasks:
+            if not t.done():
+                t.cancel()
+        if inproc_tasks:
+            await asyncio.gather(*inproc_tasks, return_exceptions=True)
+        if eng is not None and not eng._closed:  # noqa: SLF001
+            try:
+                await eng.shutdown()
+            except AssertionError:
+                logger.exception(
+                    "preempt_resume_storm: ledger gate failed in teardown")
+        await stack.stop()
+    return result
+
+
+def preempt_resume_storm() -> Scenario:
+    return Scenario(
+        name="preempt_resume_storm",
+        description="overload wave forcing decode preemptions, then a "
+                    "worker SIGKILLed mid-park; streams token-identical "
+                    "to the no-preemption oracle, parked pages balanced",
+        graph="", traffic=TrafficSpec(), plan=FaultPlan(),
+        custom=_run_preempt_resume_storm,
+    )
+
+
 SCENARIOS = {
     "worker_kill_midstream": worker_kill_midstream,
     "multinode_rank_death": multinode_rank_death,
@@ -695,6 +1018,7 @@ SCENARIOS = {
     "wedged_engine_eviction": wedged_engine_eviction,
     "telemetry_staleness": telemetry_staleness,
     "kvbm_eviction_race": kvbm_eviction_race,
+    "preempt_resume_storm": preempt_resume_storm,
 }
 
 

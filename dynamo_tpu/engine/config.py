@@ -152,6 +152,28 @@ class EngineConfig:
 
     table_width_buckets: Optional[Sequence[int]] = None
 
+    # -- overload control (docs/overload_control.md) ----------------------- #
+    # class a request gets when it carries no explicit `priority`:
+    # "interactive" (SLO-protected; may claim the watermark reserve and
+    # preempt batch decodes) or "batch" (absorbs overload: queued with a
+    # deadline, shed past the pressure threshold, parked mid-decode)
+    default_priority: str = "interactive"
+    # pressure threshold for batch admission shedding: shed NEW batch
+    # requests when the waiting queue is at least this deep AND the live
+    # watermark headroom is at or under `overload_headroom_pages`.
+    # 0 disables shedding (default — overload control is opt-in)
+    overload_queue_depth: int = 0
+    # watermark-headroom floor (pages) below which the queue-depth
+    # threshold above counts as pressure
+    overload_headroom_pages: int = 0
+    # a batch request queued longer than this without ever being admitted
+    # is shed (never accepted-then-starved); 0 disables the deadline
+    batch_deadline_s: float = 0.0
+    # cap on pages the preemption parking lot may hold host-side at once;
+    # at budget the scheduler stops parking (victims keep running).
+    # 0 = unbounded
+    park_max_pages: int = 0
+
     def __post_init__(self):
         if self.mixed_prefill_tokens is None:
             self.mixed_prefill_tokens = self.max_prefill_tokens
@@ -160,6 +182,29 @@ class EngineConfig:
         self.mixed_prefill_tokens = min(
             self.mixed_prefill_tokens, self.max_prefill_tokens
         )
+        if self.default_priority not in ("interactive", "batch"):
+            raise ValueError(
+                f"default_priority must be interactive|batch, got "
+                f"{self.default_priority!r}"
+            )
+        if self.overload_queue_depth < 0:
+            raise ValueError(
+                f"overload_queue_depth must be >= 0, got "
+                f"{self.overload_queue_depth}"
+            )
+        if self.overload_headroom_pages < 0:
+            raise ValueError(
+                f"overload_headroom_pages must be >= 0, got "
+                f"{self.overload_headroom_pages}"
+            )
+        if self.batch_deadline_s < 0:
+            raise ValueError(
+                f"batch_deadline_s must be >= 0, got {self.batch_deadline_s}"
+            )
+        if self.park_max_pages < 0:
+            raise ValueError(
+                f"park_max_pages must be >= 0, got {self.park_max_pages}"
+            )
         if self.quantization not in ("none", "int8"):
             raise ValueError(
                 f"quantization must be none|int8, got {self.quantization!r}"

@@ -104,6 +104,16 @@ class ForwardPassMetrics:
     # watermark (summed across ranks — aggregate headroom is capacity)
     batch_occupancy: float = 0.0
     kv_watermark_headroom_pages: int = 0
+    # overload control (docs/overload_control.md): lifetime counts of
+    # batch-class sheds (intake + deadline), batch adds that had to
+    # queue, mid-decode preemptions parked to host, and parked
+    # sequences resumed — plus the parking lot's live page footprint
+    shed_total: int = 0
+    queued_total: int = 0
+    preempted_total: int = 0
+    resumed_total: int = 0
+    parked_seqs: int = 0
+    parked_pages: int = 0
 
 
 # static top-k width for OpenAI `top_logprobs` responses (API max is 20)
@@ -1551,6 +1561,18 @@ class JaxEngine:
             self._extra_event_sinks.append(event_sink)
         self.pool = self._make_pool()
         self.scheduler = Scheduler(self.cfg, self.pool)
+        # preemption parking lot (overload control): batch-class victims
+        # preempted mid-decode export byte-exact KV here and resume
+        # through ordinary admission — docs/overload_control.md.  The
+        # ledger owner matches shutdown's assert_balanced owner, so KV
+        # pinned past shutdown fails tier-1 loudly.
+        from ..kvbm.park import ParkingLot
+
+        self.parking = ParkingLot(self.cfg.park_max_pages,
+                                  owner=f"engine:{id(self):x}")
+        self.scheduler.park_fn = self._park_seq
+        self.scheduler.resume_fn = self._resume_parked
+        self.scheduler.unpark_fn = self._unpark_seq
         # step variants compiled lazily: (penalized, with_top) for decode,
         # with_top for prefill
         self._prefill_steps: Dict[bool, Callable] = {}
@@ -1770,6 +1792,106 @@ class JaxEngine:
         for (h, parent, _, _), page in zip(blocks, pages):
             self.pool.commit(page, h, parent)
         return pages
+
+    # -- preemption park/resume (overload control) --------------------------- #
+
+    @affine("step", "loop")
+    def _park_seq(self, seq: Sequence) -> bool:
+        """Scheduler park hook: export the victim's live KV pages —
+        including the partial tail page — device→host byte-exact into
+        the parking lot.  Byte-exact restore (not recompute) is what
+        makes the preempt→park→resume round trip token-identical: the
+        resumed decode sees the same KV bytes at the same positions,
+        the same ``output_tokens[-1]`` input, and PRNG counters derived
+        from ``len(output_tokens)``.  Returns False (victim keeps
+        running) when the lot is at budget."""
+        from ..kvbm.park import ParkedSeq
+        from ..runtime.tracing import export_span
+
+        ps = self.cfg.page_size
+        n_used = -(-seq.num_computed // ps)
+        if n_used <= 0 or n_used > len(seq.pages):
+            return False
+        if not self.parking.can_park(n_used):
+            return False
+        t0 = time.time_ns()
+        pages = seq.pages[:n_used]
+        k, v = self._export_dev(pages)
+        # parking IS a synchronous device→host export: the victim's pages
+        # are freed the moment park_fn returns, so the fetch cannot move
+        # to the drain side — one batched transfer for both planes
+        k, v = jax.device_get((k, v))  # lint: allow(device-get): park must complete the export before the pages are freed; single batched fetch
+        k = np.asarray(k)[:, :n_used]
+        v = np.asarray(v)[:, :n_used]
+        ok = self.parking.park(ParkedSeq(
+            request_id=seq.request_id, k=k, v=v, n_pages=n_used,
+            num_computed=seq.num_computed, kv_rank=seq.kv_rank,
+            block_hashes=list(seq.block_hashes),
+        ))
+        if ok:
+            export_span(
+                "engine.park", seq.trace, t0, time.time_ns(),
+                pages=n_used, tokens=seq.num_computed,
+            )
+        return ok
+
+    @affine("step", "loop")
+    def _resume_parked(self, seq: Sequence) -> None:
+        """Scheduler resume hook (admission time): restore a parked
+        sequence's KV into fresh pages — device prefix-cache hits first
+        (full blocks committed at park time may still be cached), the
+        remainder imported from the lot's host bytes.  Full blocks
+        re-commit to the prefix cache; the partial tail page stays
+        uncommitted (its block is incomplete).  Raises on a missing
+        entry or allocation failure — the scheduler errors the request
+        (a silent recompute here would break token identity)."""
+        from ..runtime.tracing import export_span
+
+        entry = self.parking.take(seq.request_id)
+        if entry is None:
+            raise KeyError(f"no parked KV for {seq.request_id}")
+        t0 = time.time_ns()
+        full = len(entry.block_hashes)
+        hit: List[int] = []
+        if self.cfg.enable_prefix_caching and entry.block_hashes:
+            hit = self.pool.lookup_on(seq.kv_rank, entry.block_hashes)
+        rest = entry.n_pages - len(hit)
+        try:
+            fresh = (self.pool.allocate_on(seq.kv_rank, rest)
+                     if rest else [])
+        except NoPagesError:
+            self.pool.free(hit)
+            raise
+        if fresh:
+            width = self._pow2_width(rest)
+            k0 = entry.k
+            kpad = np.zeros((k0.shape[0], width, *k0.shape[2:]), k0.dtype)
+            vpad = np.zeros_like(kpad)
+            for j, idx in enumerate(range(len(hit), entry.n_pages)):
+                kpad[:, j] = entry.k[:, idx]
+                vpad[:, j] = entry.v[:, idx]
+            self._import_dev(fresh, kpad, vpad)
+            if self.cfg.enable_prefix_caching:
+                for off, page in enumerate(fresh):
+                    idx = len(hit) + off
+                    if idx >= full:
+                        break  # partial tail page — never committed
+                    parent = (entry.block_hashes[idx - 1] if idx > 0
+                              else None)
+                    self.pool.commit(page, entry.block_hashes[idx], parent)
+        seq.pages = list(hit) + list(fresh)
+        seq.committed_pages = full
+        seq.num_computed = entry.num_computed
+        seq.block_hashes = list(entry.block_hashes)
+        export_span(
+            "engine.resume", seq.trace, t0, time.time_ns(),
+            pages=entry.n_pages, cached=len(hit), tokens=entry.num_computed,
+        )
+
+    def _unpark_seq(self, seq: Sequence) -> None:
+        """Scheduler unpark hook: a parked request was aborted/shed —
+        drop its lot entry (credits the ledger's parked_pages)."""
+        self.parking.discard(seq.request_id)
 
     # -- sharding helpers ---------------------------------------------------- #
 
@@ -2043,6 +2165,12 @@ class JaxEngine:
                 0, self.pool.available_pages
                 - self.scheduler._watermark_pages() * self.pool.ranks  # noqa: SLF001
             ),
+            shed_total=self.scheduler.shed_total,
+            queued_total=self.scheduler.queued_total,
+            preempted_total=self.scheduler.preempted_total,
+            resumed_total=self.scheduler.resumed_total,
+            parked_seqs=len(self.parking),
+            parked_pages=self.parking.pages_held,
         )
         # chosen-rung histogram (block ladder): one dynamic counter attr
         # per rung — bounded by the ladder size, picked up by vars()
@@ -2108,7 +2236,38 @@ class JaxEngine:
         if opts.max_tokens <= 0:
             yield {"token_ids": [], "finish_reason": "length"}
             return
+        priority = request.get("priority") or self.cfg.default_priority
+        if priority not in ("interactive", "batch"):
+            yield {
+                "token_ids": [],
+                "finish_reason": "error",
+                "error": f"unknown priority class {priority!r}",
+            }
+            return
+        if priority == "batch" and self.scheduler.overloaded():
+            # admission shed at intake: past the pressure knee, batch work
+            # is rejected up front (429 at the frontend) rather than
+            # accepted-then-starved.  The structured error dict passes
+            # verbatim through postprocess_stream to the HTTP layer.
+            self.scheduler.shed_total += 1
+            if self.scheduler.events is not None:
+                self.scheduler.events.record(
+                    "shed", rid=context.id, reason="intake")
+            retry = max(1, int(self.cfg.batch_deadline_s) or 1)
+            yield {
+                "token_ids": [],
+                "finish_reason": "error",
+                "error": {
+                    "code": "overloaded",
+                    "message": "batch admission shed: engine past the "
+                               "overload knee (queue depth + watermark "
+                               "headroom); retry later",
+                    "retry_after_s": retry,
+                },
+            }
+            return
         seq = Sequence(context.id, prompt, opts)
+        seq.priority = priority
         seq.t_arrival = time.monotonic()
         seq.seed = opts.seed if opts.seed is not None else self._py_rng.getrandbits(31)
         seq.hold_pages = bool(request.get("_hold_pages"))
@@ -2306,6 +2465,18 @@ class JaxEngine:
             plan = self._plan_step()
             for seq in self.scheduler.drain_errored():
                 self._deliver(seq, [], "error")
+            for seq in self.scheduler.drain_shed():
+                # queued-with-deadline batch work that expired: same
+                # structured overload error as the intake shed, so the
+                # frontend's 429 path is uniform
+                retry = max(1, int(self.cfg.batch_deadline_s) or 1)
+                self._deliver(seq, [], "error", error={
+                    "code": "overloaded",
+                    "message": "batch request shed after "
+                               f"{self.cfg.batch_deadline_s:g}s queued "
+                               "without admission; retry later",
+                    "retry_after_s": retry,
+                })
             if plan.kind == "idle":
                 if not (self.scheduler.has_work or self._pending_adds
                         or self._pending_aborts):
@@ -3665,6 +3836,13 @@ class JaxEngine:
         if (not splice and self.scheduler.waiting
                 and self.scheduler.admission_ready()):
             return "admit"
+        if self.scheduler.preempt_ready():
+            # an interactive prompt is starved behind batch decodes:
+            # fall out so the pump can park a victim and admit it —
+            # parking (device→host export) only happens at plan time,
+            # never mid-chain (splice is a chunk-row feed, resume is a
+            # device KV import)
+            return "preempted"
         if any(s.status != "running" for s in seqs):
             return "stop"
         if self.tiered is not None and self.tiered.pending_offloads:
@@ -3712,6 +3890,10 @@ class JaxEngine:
         spliced: List[int] = []
         while self.scheduler.waiting and self.scheduler.admission_ready():
             head = self.scheduler.waiting[0]
+            if head.parked:
+                # resuming needs a device KV import at plan time — it
+                # cannot ride the chain as a chunk-row splice
+                return spliced, "admit"
             so = head.opts
             if ((greedy and so.temperature > 0)
                     or (not penalized and so.penalized)
@@ -4828,6 +5010,7 @@ class JaxEngine:
         finish_reason: Optional[str],
         logprob: Optional[float] = None,
         tops=None,
+        error: Any = None,
     ) -> None:
         queue = self._queues.get(seq.request_id)
         if queue is None:
@@ -4836,6 +5019,8 @@ class JaxEngine:
             "token_ids": tokens,
             "finish_reason": finish_reason,
         }
+        if error is not None:
+            out["error"] = error
         if logprob is not None and seq.opts.logprobs:
             out["log_probs"] = [logprob]
         if tops is not None:

@@ -63,6 +63,15 @@ class Sequence:
         self.opts = opts
         self.seed = 0  # per-request sampling seed (engine assigns)
         self.hold_pages = False  # finish() keeps pages (disagg KV export)
+        # overload-control class: "interactive" rides ahead of "batch" in
+        # the waiting queue and may claim the watermark reserve; "batch"
+        # absorbs overload (queued with a deadline, shed, or preempted
+        # mid-decode with its KV parked)
+        self.priority = "interactive"
+        # True while this sequence's KV lives in the engine's parking lot
+        # (preempted mid-decode); num_computed / output_tokens /
+        # block_hashes are preserved so resume is byte-exact
+        self.parked = False
         # multimodal: processed pixels arrive with the request; the engine
         # encodes them at first prefill.  cache_salt isolates the prefix
         # cache per image content — image placeholder tokens are identical
@@ -165,6 +174,26 @@ class Scheduler:
         # across the hook call (set/cleared by _apply_prefix_cache)
         self.onboard_fn = None
         self.onboard_trace = None
+        # overload-control hooks (engine-set; all None on the mock path,
+        # which falls back to recompute preemption):
+        #   park_fn(seq) -> bool    exports the victim's live KV pages into
+        #                           the parking lot (False = lot full)
+        #   resume_fn(seq)          restores parked KV into fresh pages at
+        #                           admission time (raises on failure)
+        #   unpark_fn(seq)          releases a parked entry without resuming
+        #                           (abort / shutdown while parked)
+        self.park_fn = None
+        self.resume_fn = None
+        self.unpark_fn = None
+        # batch-class sequences shed from the waiting queue (deadline
+        # expiry under pressure) — the engine drains and notifies with a
+        # structured `overloaded` error
+        self.shed: List[Sequence] = []
+        # overload counters (exported as dynamo_engine_*_total)
+        self.preempted_total = 0
+        self.resumed_total = 0
+        self.shed_total = 0
+        self.queued_total = 0
         # block-ladder ramp position: 0 = shortest rung.  Reset whenever
         # prompts are pending; climbs one rung per quiet dispatch so the
         # engine eases back into full blocks instead of jumping (a burst
@@ -189,18 +218,52 @@ class Scheduler:
             seq.opts.max_tokens = max(0, self.cfg.max_model_len - seq.prompt_len)
         if seq.t_seen is None:
             seq.t_seen = time.monotonic()
-        self.waiting.append(seq)
+        if seq.priority == "batch" and (
+            self.waiting or len(self.running) >= self.cfg.max_num_seqs
+        ):
+            # a batch request enqueued behind existing work (the
+            # "queued" arm of the shed-or-queue policy)
+            self.queued_total += 1
+        self._enqueue(seq)
+
+    def _class_rank(self, seq: Sequence) -> int:
+        return 0 if seq.priority == "interactive" else 1
+
+    def _enqueue(self, seq: Sequence, front: bool = False) -> None:
+        """Class-ordered queue insert: interactive rides ahead of batch,
+        FIFO within a class.  `front` inserts at the head of the
+        sequence's OWN class region (preemption victims re-admit before
+        later arrivals of the same class — the anti-starvation property
+        the old `appendleft` provided, now class-scoped)."""
+        rank = self._class_rank(seq)
+        idx = len(self.waiting)
+        for i, s in enumerate(self.waiting):
+            r = self._class_rank(s)
+            if (r >= rank) if front else (r > rank):
+                idx = i
+                break
+        self.waiting.insert(idx, seq)
 
     @affine("step", "loop")
     def abort(self, request_id: str) -> None:
         for seq in list(self.waiting):
             if seq.request_id == request_id:
                 self.waiting.remove(seq)
+                self._release_parked(seq)
                 seq.status = "finished"
                 seq.finish_reason = "cancelled"
         for seq in self.running:
             if seq.request_id == request_id:
                 self._finish(seq, "cancelled")
+
+    def _release_parked(self, seq: Sequence) -> None:
+        """Credit the parking lot for a parked sequence that will never
+        resume (abort / shed / shutdown) — parked KV must never outlive
+        its request (the leak ledger's `parked_pages` account)."""
+        if seq.parked:
+            if self.unpark_fn is not None:
+                self.unpark_fn(seq)
+            seq.parked = False
 
     @property
     def has_work(self) -> bool:
@@ -218,9 +281,20 @@ class Scheduler:
         """(admissible, rank): the non-mutating capacity half of
         admission — the single source of truth shared by `_try_admit`
         and `prompts_pending`, so the block-ladder policy can never
-        desynchronize from real admissibility."""
-        first_chunk = min(seq.prompt_len, self.cfg.max_prefill_tokens)
-        need = seq.pages_needed(first_chunk, self.cfg.page_size)
+        desynchronize from real admissibility.
+
+        Class-aware (overload control): an interactive request may claim
+        the watermark reserve when batch-class work is present to absorb
+        the resulting pressure (the reserve's churn-prevention role is
+        taken over by batch preemption); batch requests always respect
+        the full reserve.  A parked sequence's need is its restore
+        footprint (the parked pages plus the next decode position), not
+        a first prefill chunk."""
+        if seq.parked:
+            need = seq.pages_needed(seq.num_computed + 1, self.cfg.page_size)
+        else:
+            first_chunk = min(seq.prompt_len, self.cfg.max_prefill_tokens)
+            need = seq.pages_needed(first_chunk, self.cfg.page_size)
         if seq.num_computed > 0 or self.pool.ranks == 1:
             # imported KV keeps the rank its pages live on; single
             # pools skip partition scoring entirely
@@ -229,18 +303,58 @@ class Scheduler:
             # pick the pool partition: longest cached prefix wins,
             # ties spread by availability
             rank, _ = self.pool.best_rank(self._seq_hashes(seq))
-        ok = self.pool.available_on(rank) >= need + self._watermark_pages()
+        ok = self.pool.available_on(rank) >= need + self._reserve_pages(seq)
         return ok, rank
 
+    def _reserve_pages(self, seq: Sequence) -> int:
+        """Admission reserve this sequence must leave untouched."""
+        wm = self._watermark_pages()
+        if wm and seq.priority == "interactive" and self._batch_present():
+            return 0
+        return wm
+
+    def _batch_present(self) -> bool:
+        return any(s.priority == "batch" for s in self.running) or any(
+            s.priority == "batch" for s in self.waiting
+        )
+
+    def overloaded(self) -> bool:
+        """Past the configured pressure threshold: the waiting queue is
+        at least `overload_queue_depth` deep AND the live watermark
+        headroom (the PR 7 capacity gauge) is at or under
+        `overload_headroom_pages`.  Scheduler-side source of truth for
+        batch admission shedding; 0 depth disables shedding."""
+        depth = self.cfg.overload_queue_depth
+        if depth <= 0 or len(self.waiting) < depth:
+            return False
+        headroom = (self.pool.available_pages
+                    - self._watermark_pages() * self.pool.ranks)
+        return headroom <= self.cfg.overload_headroom_pages
+
     def _try_admit(self) -> None:
-        while self.waiting and len(self.running) < self.cfg.max_num_seqs:
+        self._shed_expired()
+        while self.waiting:
             seq = self.waiting[0]
-            ok, rank = self._admit_check(seq)
+            if len(self.running) >= self.cfg.max_num_seqs:
+                ok, rank = False, seq.kv_rank
+            else:
+                ok, rank = self._admit_check(seq)
             if not ok:
-                break
+                # an interactive head may evict batch-class decodes
+                # (park, not recompute) to make room for itself
+                if not self._preempt_for_head(seq):
+                    break
+                if len(self.running) >= self.cfg.max_num_seqs:
+                    break
+                ok, rank = self._admit_check(seq)
+                if not ok:
+                    break
             seq.kv_rank = rank
             self.waiting.popleft()
-            if self.cfg.enable_prefix_caching:
+            if seq.parked:
+                if not self._resume(seq):
+                    continue  # errored out; next head may still admit
+            elif self.cfg.enable_prefix_caching:
                 self._apply_prefix_cache(seq)
             seq.status = "running"
             if seq.t_admitted is None:  # keep the FIRST admission:
@@ -253,6 +367,29 @@ class Scheduler:
                     prompt_len=seq.prompt_len, cached=seq.num_cached,
                 )
 
+    def _resume(self, seq: Sequence) -> bool:
+        """Restore a parked sequence's KV through the engine hook; on
+        failure the request errors out (never silently recomputed — a
+        recompute here would break token identity)."""
+        try:
+            self.resume_fn(seq)
+        except Exception:  # noqa: BLE001 — surfaced as a request error
+            logger.exception("park/resume restore failed for %s",
+                             seq.request_id)
+            self._release_parked(seq)
+            seq.status = "finished"
+            seq.finish_reason = "error"
+            self.errored.append(seq)
+            return False
+        seq.parked = False
+        self.resumed_total += 1
+        if self.events is not None:
+            self.events.record(
+                "preempt_resume", rid=seq.request_id, rank=seq.kv_rank,
+                tokens=seq.num_computed,
+            )
+        return True
+
     @affine("step", "loop")
     def splice_admit(self) -> Optional[Sequence]:
         """Admit the head-of-queue prompt WITHOUT the pump: the
@@ -264,8 +401,12 @@ class Scheduler:
         same prefix-cache application, same admit event (tagged
         ``spliced``) — so splice admission and pump admission can never
         diverge.  Returns the admitted sequence, or None when the head
-        is not admissible right now."""
+        is not admissible right now.  A parked head never splices: its
+        resume is a device KV import, not a chunk-row feed — the chain
+        falls out (``admit``) and the pump resumes it."""
         if not self._head_admissible():
+            return None
+        if self.waiting[0].parked:
             return None
         seq = self.waiting[0]
         ok, rank = self._admit_check(seq)
@@ -563,7 +704,10 @@ class Scheduler:
                     self._finish(seq, "error")
                     self.errored.append(seq)
                     return False
-                self._preempt(victim)
+                # park mid-decode victims (byte-exact resume) when the
+                # engine provides a lot; recompute-preempt otherwise
+                if not self.preempt_park(victim):
+                    self._preempt(victim)
 
     @affine("step", "loop")
     def try_extend_pages(self, seq: Sequence, upto_tokens: int,
@@ -593,11 +737,128 @@ class Scheduler:
 
     def _pick_victim(self, exclude: Sequence, rank: int = 0) -> Optional[Sequence]:
         """Youngest running sequence on the SAME pool partition (evicting
-        another rank's pages cannot unblock this allocation)."""
-        for seq in reversed(self.running):  # youngest first
-            if seq is not exclude and seq.kv_rank == rank:
+        another rank's pages cannot unblock this allocation); batch-class
+        victims are preferred over interactive ones."""
+        for want_batch in (True, False):
+            for seq in reversed(self.running):  # youngest first
+                if (seq is not exclude and seq.kv_rank == rank
+                        and (seq.priority == "batch") == want_batch):
+                    return seq
+        return None
+
+    def _park_candidate(self, rank: int) -> Optional[Sequence]:
+        """Youngest batch-class mid-decode sequence on `rank` — the only
+        legal park victims (a mid-prefill victim has no output KV worth
+        preserving; recompute preemption handles it)."""
+        for seq in reversed(self.running):
+            if (seq.priority == "batch" and seq.kv_rank == rank
+                    and seq.prefill_done and seq.output_tokens):
                 return seq
         return None
+
+    @affine("step", "loop")
+    def preempt_park(self, seq: Sequence) -> bool:
+        """Preempt `seq` mid-decode by PARKING its KV (byte-exact resume)
+        instead of recomputing: commit full blocks to the device cache
+        (feeding the tier offload pump), export the live pages through the
+        engine's park hook, free them, and requeue at the head of the
+        victim's class region.  Returns False (no state change) when the
+        hook is absent, the victim is not mid-decode, or the lot refuses
+        (budget) — callers fall back to recompute preemption."""
+        if (self.park_fn is None or not seq.prefill_done
+                or not seq.output_tokens or seq.hold_pages):
+            return False
+        self.commit_full_pages(seq)
+        if not self.park_fn(seq):
+            return False
+        logger.info("parking %s (%d tokens)", seq.request_id,
+                    seq.num_computed)
+        self.pool.free(seq.pages)
+        seq.pages = []
+        seq.committed_pages = 0
+        seq.parked = True
+        seq.status = "waiting"
+        seq.preemptions += 1
+        self.preempted_total += 1
+        if seq in self.running:
+            self.running.remove(seq)
+        self._enqueue(seq, front=True)
+        if self.events is not None:
+            self.events.record(
+                "preempt_park", rid=seq.request_id, rank=seq.kv_rank,
+                tokens=seq.num_computed, outputs=len(seq.output_tokens),
+            )
+        return True
+
+    def _rank_for(self, seq: Sequence) -> int:
+        if seq.num_computed > 0 or self.pool.ranks == 1:
+            return seq.kv_rank
+        return self.pool.best_rank(self._seq_hashes(seq))[0]
+
+    def _preempt_for_head(self, seq: Sequence) -> bool:
+        """Park batch-class victims until the interactive head `seq`
+        becomes admissible (pages or a slot).  Returns True if at least
+        one victim was parked; never touches interactive victims and
+        never recomputes (a recompute preemption of a mid-decode victim
+        is not token-safe on the real engine)."""
+        if self.park_fn is None or seq.priority != "interactive":
+            return False
+        rank = self._rank_for(seq)
+        parked_any = False
+        for _ in range(len(self.running)):
+            if (len(self.running) < self.cfg.max_num_seqs
+                    and self._admit_check(seq)[0]):
+                break
+            victim = self._park_candidate(rank)
+            if victim is None or not self.preempt_park(victim):
+                break
+            parked_any = True
+        return parked_any
+
+    def preempt_ready(self) -> bool:
+        """True when an interactive head could be admitted if a batch
+        victim were parked — the continuous decode chain's preemption
+        fall-out signal (reason ``preempted``): the chain exits, the pump
+        replans, `_try_admit` parks the victim and admits the head."""
+        if self.park_fn is None or not self.waiting:
+            return False
+        head = self.waiting[0]
+        if head.priority != "interactive":
+            return False
+        if len(self.running) < self.cfg.max_num_seqs:
+            if self._admit_check(head)[0]:
+                return False  # ordinary admission handles it
+        return self._park_candidate(self._rank_for(head)) is not None
+
+    def _shed_expired(self) -> None:
+        """Deadline shed: a batch-class request that has waited past
+        `batch_deadline_s` without ever being admitted is shed (the
+        queued-with-a-deadline half of the admission policy — never
+        accepted-then-starved).  Parked sequences and sequences that
+        already produced tokens are exempt: the client has state."""
+        deadline = self.cfg.batch_deadline_s
+        if deadline <= 0 or not self.waiting:
+            return
+        now = time.monotonic()
+        for seq in list(self.waiting):
+            if (seq.priority == "batch" and not seq.parked
+                    and not seq.output_tokens and seq.t_seen is not None
+                    and now - seq.t_seen > deadline):
+                self.waiting.remove(seq)
+                seq.status = "finished"
+                seq.finish_reason = "shed"
+                self.shed_total += 1
+                self.shed.append(seq)
+                if self.events is not None:
+                    self.events.record(
+                        "shed", rid=seq.request_id,
+                        waited_s=round(now - seq.t_seen, 3),
+                    )
+
+    @affine("step", "loop")
+    def drain_shed(self) -> List[Sequence]:
+        out, self.shed = self.shed, []
+        return out
 
     def _preempt(self, seq: Sequence) -> None:
         logger.info("preempting %s", seq.request_id)
@@ -611,7 +872,7 @@ class Scheduler:
         seq.preemptions += 1
         if seq in self.running:
             self.running.remove(seq)
-        self.waiting.appendleft(seq)
+        self._enqueue(seq, front=True)
 
     # -- completion ---------------------------------------------------------- #
 

@@ -126,6 +126,16 @@ class FrontendMetrics:
             ["model"],
             registry=self.registry,
         )
+        # overload control (docs/overload_control.md): batch-class
+        # requests the engine shed (intake 429 or queued-deadline expiry)
+        # — these count in offered_rps but are excluded from SLO-window
+        # failure scoring; the client got a clean 429+Retry-After
+        self.shed = Counter(
+            "dynamo_frontend_requests_shed_total",
+            "Requests shed by overload control (HTTP 429)",
+            ["model", "priority"],
+            registry=self.registry,
+        )
         self.endpoint_health = Gauge(
             "dynamo_frontend_endpoint_healthy",
             "Worker-reported endpoint health (1 healthy, 0 unhealthy)",

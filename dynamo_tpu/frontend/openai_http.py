@@ -53,6 +53,13 @@ _MAX_BURST = 256
 # time-since-last-write deadline passes (never a real delta tuple)
 _KEEPALIVE = object()
 
+# how long a BATCH-class stream peeks at the engine queue for an intake
+# shed before committing the 200/SSE preamble: an engine shed is its
+# very first yield, so this resolves in one scheduler hop normally; the
+# timeout only bites when the first delta is slower than the probe, in
+# which case the stream proceeds as usual (interactive never probes)
+_SHED_PROBE_S = 0.25
+
 
 class _ChoiceParsers:
     """Per-choice output parsing: reasoning split first, then tool-call
@@ -527,7 +534,11 @@ class HttpService:
         streaming = bool(body.get("stream", False))
         if self.audit is not None:
             self.audit.request(rid, model_name, kind, body)
-        self.metrics.slo.observe_start(model_name)
+        # shed 429s count toward offered load (observe_start) but are
+        # never scored as window failures — overload control refusing
+        # work cleanly is not a latency breach (docs/overload_control.md)
+        self.metrics.slo.observe_start(
+            model_name, priority=preprocessed.get("priority"))
         self.metrics.inflight.labels(model_name).inc()
         try:
             if streaming:
@@ -546,10 +557,13 @@ class HttpService:
         never SLO-met (infinite latency), delivered tokens attained-only.
         The requests clients saw fail are the ones that must drag
         slo_met down during incidents — shared by every error path so
-        the failure scoring can't drift between them."""
+        the failure scoring can't drift between them.  Overload SHEDS do
+        not come through here: a clean 429 is load control working, not
+        a latency breach (docs/overload_control.md)."""
         self.metrics.slo.observe(
             model_name, float("inf"), float("inf"), output_tokens,
             prompt_tokens=len(preprocessed.get("token_ids") or []),
+            priority=preprocessed.get("priority"),
         )
 
     def _choice_requests(self, preprocessed, n):
@@ -570,16 +584,6 @@ class HttpService:
     async def _stream_response(
         self, request, entry, preprocessed, n, rid, kind, model_name, t0
     ) -> web.StreamResponse:
-        resp = web.StreamResponse(
-            status=200,
-            headers={
-                "Content-Type": "text/event-stream",
-                "Cache-Control": "no-cache",
-                "Connection": "keep-alive",
-            },
-        )
-        await resp.prepare(request)
-        created = int(time.time())
         ntokens = 0
         t_first = t_last_tok = None
         status = "200"
@@ -607,6 +611,56 @@ class HttpService:
                 zip(self._choice_requests(preprocessed, n), contexts)
             )
         ]
+        # Batch-class shed probe (docs/overload_control.md): an intake
+        # shed is the FIRST thing the engine yields, so peek at the
+        # queue before committing the 200/SSE preamble — a shed batch
+        # stream becomes a real HTTP 429 + Retry-After instead of a
+        # status-200 SSE error frame.  Interactive streams skip the
+        # probe entirely (zero added latency); a probe that surfaces a
+        # normal first delta just hands it to the drain loop below.
+        first_item = None
+        try:
+            if preprocessed.get("priority") == "batch":
+                try:
+                    first_item = await asyncio.wait_for(
+                        queue.get(), _SHED_PROBE_S)
+                except asyncio.TimeoutError:
+                    first_item = None
+                shed = (first_item is not None
+                        and first_item[1] is not None
+                        and first_item[1].get("finish_reason") == "error"
+                        and _shed_error(first_item[1].get("error")))
+                if shed:
+                    for ctx in contexts:
+                        ctx.kill()
+                    for t in tasks:
+                        t.cancel()
+                    await asyncio.gather(*tasks, return_exceptions=True)
+                    self.metrics.requests.labels(
+                        model_name, kind, "429").inc()
+                    self.metrics.shed.labels(model_name, "batch").inc()
+                    if self.audit is not None:
+                        self.audit.response(rid, model_name, kind, "429")
+                    return _shed_response(shed)
+            resp = web.StreamResponse(
+                status=200,
+                headers={
+                    "Content-Type": "text/event-stream",
+                    "Cache-Control": "no-cache",
+                    "Connection": "keep-alive",
+                },
+            )
+            await resp.prepare(request)
+        except BaseException:
+            # prepare/probe failed with pumps already running: settle
+            # them before propagating (leak-ledger task invariant)
+            for ctx in contexts:
+                ctx.kill()
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+            raise
+        created = int(time.time())
         # egress writer (frontend/egress.py): frame building + write
         # batching live there; this loop does queue drain + IO only.
         # The legacy arm reproduces the pre-optimization writer (one
@@ -633,9 +687,19 @@ class HttpService:
                 live -= 1
                 return
             if out.get("finish_reason") == "error":
-                status = "500"
-                eg.add_obj(_sse_error_chunk(rid, out.get("error",
-                                                         "engine error")))
+                err = out.get("error", "engine error")
+                if _shed_error(err):
+                    # a deadline shed landing after the SSE preamble
+                    # (queued batch stream expired): too late for a real
+                    # 429 status line, but account it as a shed, not a
+                    # server error
+                    status = "429"
+                    self.metrics.shed.labels(
+                        model_name, preprocessed.get("priority") or "batch"
+                    ).inc()
+                else:
+                    status = "500"
+                eg.add_obj(_sse_error_chunk(rid, err))
                 return
             now = time.monotonic()
             stamps.append(now)
@@ -706,6 +770,9 @@ class HttpService:
 
         ka_handle = loop.call_later(SSE_KEEPALIVE_S, rearm_keepalive)
         try:
+            if first_item is not None:  # delta the shed probe pulled
+                process(first_item)
+                await eg.flush()
             while live:
                 item = await queue.get()
                 if item is _KEEPALIVE:
@@ -767,11 +834,13 @@ class HttpService:
         # (bench.poisson_goodput's per-request TTFT + mean-ITL predicate,
         # applied post-hoc in slo.observe_stream — never on the delivery
         # loop). A stream the client saw FAIL can never be SLO-met.
-        self.metrics.slo.observe_stream(
-            model_name, t0=t0, t_first=t_first, t_last_tok=t_last_tok,
-            ntokens=ntokens, n_choices=n, errored=status != "200",
-            prompt_tokens=len(preprocessed.get("token_ids") or []),
-        )
+        if status != "429":  # sheds are offered-only, never window failures
+            self.metrics.slo.observe_stream(
+                model_name, t0=t0, t_first=t_first, t_last_tok=t_last_tok,
+                ntokens=ntokens, n_choices=n, errored=status != "200",
+                prompt_tokens=len(preprocessed.get("token_ids") or []),
+                priority=preprocessed.get("priority"),
+            )
         for spec in spec_seen:
             if spec:  # a stop string may cut the stream before the
                 self.metrics.observe_spec(model_name, spec)  # final delta
@@ -852,10 +921,23 @@ class HttpService:
             return _error_response(int(status), str(e))
         for r in results:
             if r.get("error"):
-                self.metrics.requests.labels(model_name, kind, "500").inc()
-                self._observe_slo_failure(model_name, preprocessed)
+                shed = _shed_error(r["error"])
+                status = "429" if shed else "500"
+                self.metrics.requests.labels(model_name, kind, status).inc()
+                if shed:
+                    # shed hygiene: counted in offered load (observe_start
+                    # already ran) and on its own counter, but NOT scored
+                    # as an SLO-window failure — the 429 is load control
+                    # working, not a breach
+                    self.metrics.shed.labels(
+                        model_name, preprocessed.get("priority") or "batch"
+                    ).inc()
+                else:
+                    self._observe_slo_failure(model_name, preprocessed)
                 if self.audit is not None:
-                    self.audit.response(rid, model_name, kind, "500")
+                    self.audit.response(rid, model_name, kind, status)
+                if shed:
+                    return _shed_response(shed)
                 return _error_response(500, r["error"])
         created = int(time.time())
         prompt_tokens = len(preprocessed.get("token_ids", []))
@@ -934,6 +1016,7 @@ class HttpService:
                     if token_count else float("inf")),
             output_tokens=token_count,
             prompt_tokens=prompt_tokens,
+            priority=preprocessed.get("priority"),
         )
         self.metrics.requests.labels(model_name, kind, "200").inc()
         self.metrics.output_tokens.labels(model_name).inc(token_count)
@@ -1058,4 +1141,27 @@ def _error_response(status: int, message: str, code: str = "invalid_request_erro
     return web.json_response(
         {"error": {"message": message, "type": code, "code": status}},
         status=status,
+    )
+
+
+def _shed_error(err):
+    """The structured overload-shed dict the engine attaches to a shed
+    stream ({code: "overloaded", message, retry_after_s} — engine intake
+    shed or queued-deadline expiry, docs/overload_control.md), else None."""
+    if isinstance(err, dict) and err.get("code") == "overloaded":
+        return err
+    return None
+
+
+def _shed_response(err: dict) -> web.Response:
+    """HTTP 429 for an overload shed: Retry-After header plus the same
+    hint in the structured body so clients can back off without parsing
+    headers."""
+    retry = max(1, int(err.get("retry_after_s") or 1))
+    return web.json_response(
+        {"error": {"message": err.get("message", "overloaded"),
+                   "type": "overloaded", "code": 429,
+                   "retry_after_s": retry}},
+        status=429,
+        headers={"Retry-After": str(retry)},
     )

@@ -290,6 +290,10 @@ class SLOAccountant:
         self.default = SLOTargets.from_env(default)
         self.targets: Dict[str, SLOTargets] = {}
         self.windows: Dict[str, SlidingWindow] = {}
+        # per-(model, priority-class) windows (overload control): same
+        # definitions as the model window, split so the interactive
+        # class's slo_met is visible while batch absorbs overload loss
+        self.class_windows: Dict[tuple, SlidingWindow] = {}
 
     def set_targets(self, model: str, targets: SLOTargets) -> None:
         self.targets[model] = targets
@@ -304,24 +308,43 @@ class SLOAccountant:
                                                       self.slots)
         return win
 
-    def observe_start(self, model: str, now: Optional[float] = None) -> None:
+    def class_window(self, model: str, priority: str) -> SlidingWindow:
+        key = (model, priority)
+        win = self.class_windows.get(key)
+        if win is None:
+            win = self.class_windows[key] = SlidingWindow(self.window_s,
+                                                          self.slots)
+        return win
+
+    def observe_start(self, model: str, now: Optional[float] = None,
+                      priority: Optional[str] = None) -> None:
         self.window(model).record_start(now)
+        if priority:
+            self.class_window(model, priority).record_start(now)
 
     def observe(self, model: str, ttft_ms: float, itl_ms: float,
                 output_tokens: int, prompt_tokens: int = 0,
-                now: Optional[float] = None) -> bool:
+                now: Optional[float] = None,
+                priority: Optional[str] = None) -> bool:
         """Account one COMPLETED request; returns whether it met its SLO
-        (bench.poisson_goodput's predicate, applied live)."""
+        (bench.poisson_goodput's predicate, applied live).  When a
+        `priority` class is given the request ALSO lands in that class's
+        window — the model window keeps scoring every request, so the
+        existing surfaces don't change."""
         ok = self.targets_for(model).met(ttft_ms, itl_ms)
         self.window(model).record(ttft_ms, itl_ms, output_tokens, ok,
                                   prompt_tokens, now)
+        if priority:
+            self.class_window(model, priority).record(
+                ttft_ms, itl_ms, output_tokens, ok, prompt_tokens, now)
         return ok
 
     def observe_stream(self, model: str, *, t0: float,
                        t_first: Optional[float],
                        t_last_tok: Optional[float], ntokens: int,
                        n_choices: int, errored: bool,
-                       prompt_tokens: int = 0) -> bool:
+                       prompt_tokens: int = 0,
+                       priority: Optional[str] = None) -> bool:
         """Score one streamed HTTP request from its raw timestamps —
         the post-hoc half of the delivery loop's accounting (the loop
         only collects monotonic stamps; the TTFT/ITL math happens here,
@@ -343,6 +366,7 @@ class SLOAccountant:
                     / max(ntokens / max(n_choices, 1) - 1, 1) * 1e3),
             output_tokens=ntokens,
             prompt_tokens=prompt_tokens,
+            priority=priority,
         )
 
     def snapshot(self, now: Optional[float] = None) -> Dict[str, dict]:
@@ -353,6 +377,10 @@ class SLOAccountant:
                 **win.snapshot(now),
                 "slo": {"ttft_ms": slo.ttft_ms, "itl_ms": slo.itl_ms},
             }
+        for (model, priority), win in self.class_windows.items():
+            if model in out:
+                out[model].setdefault("classes", {})[priority] = \
+                    win.snapshot(now)
         return out
 
 
@@ -394,6 +422,25 @@ class SLOWindowCollector:
             "dynamo_frontend_window_itl_seconds",
             "Windowed mean-ITL quantiles (live log-bucket window)",
             labels=["model", "quantile"])
+        # per-priority-class split of the same window definitions
+        # (overload control) — NEW families, so the unlabeled per-model
+        # ones above never change shape
+        c_slo = GaugeMetricFamily(
+            "dynamo_frontend_class_slo_met_ratio",
+            "Per-priority-class fraction of windowed requests meeting SLO",
+            labels=["model", "priority"])
+        c_goodput = GaugeMetricFamily(
+            "dynamo_frontend_class_goodput_tokens_per_second",
+            "Per-priority-class windowed output tok/s from SLO-met requests",
+            labels=["model", "priority"])
+        c_attained = GaugeMetricFamily(
+            "dynamo_frontend_class_attained_tokens_per_second",
+            "Per-priority-class windowed output tok/s from all requests",
+            labels=["model", "priority"])
+        c_offered = GaugeMetricFamily(
+            "dynamo_frontend_class_offered_requests_per_second",
+            "Per-priority-class windowed request arrival rate",
+            labels=["model", "priority"])
         try:
             snap = self.accountant.snapshot()
         except Exception:  # noqa: BLE001 — a scrape must not break /metrics
@@ -409,4 +456,11 @@ class SLOWindowCollector:
                     ttft.add_metric([model, q], s["ttft"][key] / 1e3)
                 if s["itl"][key] is not None:
                     itl.add_metric([model, q], s["itl"][key] / 1e3)
-        return [slo_met, goodput, attained, offered, ttft, itl]
+            for priority, cs in (s.get("classes") or {}).items():
+                if cs["slo_met"] is not None:
+                    c_slo.add_metric([model, priority], cs["slo_met"])
+                c_goodput.add_metric([model, priority], cs["goodput_tok_s"])
+                c_attained.add_metric([model, priority], cs["attained_tok_s"])
+                c_offered.add_metric([model, priority], cs["offered_rps"])
+        return [slo_met, goodput, attained, offered, ttft, itl,
+                c_slo, c_goodput, c_attained, c_offered]

@@ -6,6 +6,7 @@ from .disk import DiskTier
 from .distributed import KvbmConfig, KvbmLeader, KvbmWorker
 from .host_pool import HostBlock, HostBlockPool
 from .offload import TieredKvCache
+from .park import ParkedSeq, ParkingLot
 from .remote import ObjectStoreTier
 from .summary import TierSummaryPublisher, summary_key, summary_prefix
 
@@ -17,6 +18,8 @@ __all__ = [
     "KvbmLeader",
     "KvbmWorker",
     "ObjectStoreTier",
+    "ParkedSeq",
+    "ParkingLot",
     "TieredKvCache",
     "TierSummaryPublisher",
     "summary_key",

@@ -49,6 +49,10 @@ class ModelDeploymentCard:
     # win at the frontend; 0 = use the frontend default class)
     slo_ttft_ms: float = 0.0
     slo_itl_ms: float = 0.0
+    # default priority class for requests that don't set one
+    # ("interactive" | "batch"; worker CLI --priority-class sets it,
+    # per-request `priority` / `nvext.priority` overrides win)
+    priority_class: str = "interactive"
     # tokenization (None → frontend loads from checkpoint_path)
     checkpoint_path: Optional[str] = None
     tokenizer_json: Optional[str] = None  # inline tokenizer.json contents

@@ -291,8 +291,17 @@ class OpenAIPreprocessor:
             want_logprobs = True
             top_logprobs = int(logprobs)
         nvext = request.get("nvext", {}) or {}
+        # priority class: body field wins over nvext, model card default
+        # fills the rest (docs/overload_control.md)
+        priority = (request.get("priority") or nvext.get("priority")
+                    or self.mdc.priority_class or "interactive")
+        if priority not in ("interactive", "batch"):
+            raise RequestError(
+                "'priority' must be 'interactive' or 'batch'"
+            )
         return {
             "token_ids": token_ids,
+            "priority": priority,
             "sampling_options": {
                 "temperature": request.get("temperature"),
                 "top_p": request.get("top_p"),

@@ -58,6 +58,14 @@ class MockEngineArgs:
     vocab_size: int = 32000
     eos_token_id: int = 2
     eos_probability: float = 0.0  # chance a generated token is EOS
+    # overload control (docs/overload_control.md) — same semantics as
+    # the real engine's knobs; the mock reuses the real Scheduler so the
+    # class-aware admission/shed/preemption logic is exercised verbatim
+    default_priority: str = "interactive"
+    overload_queue_depth: int = 0
+    overload_headroom_pages: int = 0
+    batch_deadline_s: float = 0.0
+    park_max_pages: int = 0
 
     def engine_config(self) -> EngineConfig:
         return EngineConfig(
@@ -68,6 +76,11 @@ class MockEngineArgs:
             max_model_len=self.max_model_len,
             enable_prefix_caching=self.enable_prefix_caching,
             watermark=self.watermark,
+            default_priority=self.default_priority,
+            overload_queue_depth=self.overload_queue_depth,
+            overload_headroom_pages=self.overload_headroom_pages,
+            batch_deadline_s=self.batch_deadline_s,
+            park_max_pages=self.park_max_pages,
         )
 
 
@@ -95,6 +108,20 @@ class MockEngine:
             self.cfg.num_pages, self.cfg.page_size, event_sink=self._emit
         )
         self.scheduler = Scheduler(self.cfg, self.pool)
+        # decode preemption park/resume: the mock holds no KV bytes, so
+        # parking is pure page accounting through a real ParkingLot
+        # (leak-ledger `parked_pages` account included) — generated
+        # tokens are position-keyed, so a resume is token-identical by
+        # construction and only the page bookkeeping needs restoring
+        from ..kvbm.park import ParkingLot
+
+        self.parking = ParkingLot(max_pages=self.cfg.park_max_pages,
+                                  owner=f"mock-engine:{id(self):x}")
+        self.scheduler.park_fn = self._park_seq
+        self.scheduler.resume_fn = self._resume_parked
+        self.scheduler.unpark_fn = (
+            lambda seq: self.parking.discard(seq.request_id)
+        )
         self._queues: Dict[str, asyncio.Queue] = {}
         self._contexts: Dict[str, Context] = {}
         self._wake = asyncio.Event()
@@ -127,10 +154,41 @@ class MockEngine:
                 0, self.pool.available_pages
                 - self.scheduler._watermark_pages()  # noqa: SLF001
             ),
+            shed_total=self.scheduler.shed_total,
+            queued_total=self.scheduler.queued_total,
+            preempted_total=self.scheduler.preempted_total,
+            resumed_total=self.scheduler.resumed_total,
+            parked_seqs=len(self.parking),
+            parked_pages=self.parking.pages_held,
         )
 
     def clear_kv_blocks(self) -> int:
         return self.pool.clear_cache()
+
+    # -- park/resume hooks (overload control) -------------------------------- #
+
+    def _park_seq(self, seq: Sequence) -> bool:
+        from ..kvbm.park import ParkedSeq
+
+        n = -(-seq.num_computed // self.cfg.page_size)
+        if n <= 0 or n > len(seq.pages):
+            return False
+        return self.parking.park(ParkedSeq(
+            request_id=seq.request_id, k=None, v=None, n_pages=n,
+            num_computed=seq.num_computed, kv_rank=seq.kv_rank,
+            block_hashes=list(seq.block_hashes),
+        ))
+
+    def _resume_parked(self, seq: Sequence) -> None:
+        entry = self.parking.take(seq.request_id)
+        if entry is None:
+            raise KeyError(f"{seq.request_id} has no parked entry")
+        seq.pages = self.pool.allocate_on(entry.kv_rank, entry.n_pages)
+        # re-commit the hash chain from scratch on the fresh pages (the
+        # real engine re-imports bytes; here only accounting matters)
+        seq.committed_pages = 0
+        seq.block_hashes = seq.block_hashes[:0]
+        seq.num_computed = entry.num_computed
 
     # -- AsyncEngine --------------------------------------------------------- #
 
@@ -154,7 +212,26 @@ class MockEngine:
         if opts.max_tokens <= 0:
             yield {"token_ids": [], "finish_reason": "length"}
             return
+        priority = request.get("priority") or self.cfg.default_priority
+        if priority not in ("interactive", "batch"):
+            yield {"token_ids": [], "finish_reason": "error",
+                   "error": f"priority must be interactive|batch, "
+                            f"got {priority!r}"}
+            return
+        if priority == "batch" and self.scheduler.overloaded():
+            # admission shed at the knee — same structured error the
+            # real engine emits (the frontend turns it into a 429)
+            self.scheduler.shed_total += 1
+            retry = max(1, int(self.cfg.batch_deadline_s) or 1)
+            yield {"token_ids": [], "finish_reason": "error",
+                   "error": {"code": "overloaded",
+                             "message": "batch admission shed: engine "
+                                        "past the overload knee; retry "
+                                        "later",
+                             "retry_after_s": retry}}
+            return
         seq = Sequence(context.id, prompt, opts)
+        seq.priority = priority
         seq.seed = opts.seed if opts.seed is not None else (
             struct.unpack("<Q", hashlib.blake2b(
                 context.id.encode(), digest_size=8).digest())[0]
@@ -197,6 +274,17 @@ class MockEngine:
         self._wake.set()
         if self._pump_task:
             await asyncio.gather(self._pump_task, return_exceptions=True)
+        # same shutdown contract as JaxEngine: reap everything still
+        # scheduled (aborting a parked waiter credits the parking lot via
+        # unpark_fn) and hold the leak-ledger gate — a preemption
+        # bookkeeping bug fails here loudly instead of pinning pages
+        for seq in list(self.scheduler.running):
+            self.scheduler.abort(seq.request_id)
+        for seq in list(self.scheduler.waiting):
+            self.scheduler.abort(seq.request_id)
+        from ..analysis import leak_ledger
+
+        leak_ledger.assert_balanced(self.parking.owner)
 
     # -- pump ---------------------------------------------------------------- #
 
@@ -211,6 +299,19 @@ class MockEngine:
                     q.put_nowait(
                         {"token_ids": [], "finish_reason": "error",
                          "error": "out of kv capacity"}
+                    )
+            for seq in self.scheduler.drain_shed():
+                q = self._queues.get(seq.request_id)
+                if q is not None:
+                    retry = max(1, int(self.cfg.batch_deadline_s) or 1)
+                    q.put_nowait(
+                        {"token_ids": [], "finish_reason": "error",
+                         "error": {"code": "overloaded",
+                                   "message": "batch request shed after "
+                                              "queueing past the deadline "
+                                              "without admission; retry "
+                                              "later",
+                                   "retry_after_s": retry}}
                     )
             if plan.kind == "idle":
                 if not self.scheduler.has_work:

@@ -35,6 +35,13 @@ class Client:
         self._watch_task: asyncio.Task | None = None
         self._synced = asyncio.Event()
         self._rr = 0
+        # Instances that just refused a connection, kept out of the pick
+        # until the deadline.  A crashed worker lingers in `_instances`
+        # for up to the lease TTL; migration retries are much faster than
+        # that and would otherwise burn the whole retry budget on the
+        # corpse.  Routing hint only: never turns into a 503 on its own.
+        self._cooldown: dict[int, float] = {}
+        self.cooldown_s = 3.0
         if static_instances is not None:
             self._synced.set()
 
@@ -107,6 +114,17 @@ class Client:
                 )
         if not insts:
             raise ServiceUnavailable(f"no instances for {self.endpoint.wire_name}")
+        if self._cooldown:
+            now = asyncio.get_running_loop().time()
+            warm = [
+                i for i in insts
+                if self._cooldown.get(i.instance_id, 0.0) <= now
+            ]
+            # All candidates cooling down means we have nowhere better to
+            # send the request — fall through to the full list rather than
+            # fabricating a 503 out of a routing hint.
+            if warm:
+                insts = warm
         return insts
 
     def _pick_random(self, allowed=None) -> Instance:
@@ -140,10 +158,24 @@ class Client:
                 raise ServiceUnavailable(str(e)) from e
         inst = pick()
         svc = self.runtime.service_client
-        async for item in svc.call_stream(
-            inst.address, inst.service_endpoint, request, context
-        ):
-            yield item
+        try:
+            async for item in svc.call_stream(
+                inst.address, inst.service_endpoint, request, context
+            ):
+                yield item
+        except ServiceUnavailable as e:
+            # Couldn't reach (or lost) this instance: cool it down so the
+            # caller's migration retries pick someone else while discovery
+            # catches up and expires the lease.  Overloaded is deliberate
+            # shedding from a healthy worker — no cooldown, the admission
+            # layer owns that signal.
+            from .transport.service import Overloaded
+
+            if not isinstance(e, Overloaded):
+                self._cooldown[inst.instance_id] = (
+                    asyncio.get_running_loop().time() + self.cooldown_s
+                )
+            raise
 
     def direct(self, request: Any, instance_id: int,
                context: Context | None = None) -> AsyncIterator[Any]:

@@ -170,6 +170,27 @@ def build_parser() -> argparse.ArgumentParser:
                     help="mean-ITL SLO target carried on the model card "
                          "(0 = frontend default class, "
                          "DYN_TPU_SLO_ITL_MS overrides)")
+    # overload control (docs/overload_control.md): priority classes +
+    # the shed / queue-deadline / preemption-parking knobs
+    ap.add_argument("--priority-class", default="interactive",
+                    choices=["interactive", "batch"],
+                    help="default priority class for requests that don't "
+                         "set one (carried on the model card; per-request "
+                         "`priority` / `nvext.priority` win)")
+    ap.add_argument("--overload-queue-depth", type=int, default=0,
+                    help="shed NEW batch-class requests once the waiting "
+                         "queue is this deep AND watermark headroom is at "
+                         "or under --overload-headroom-pages (0 disables)")
+    ap.add_argument("--overload-headroom-pages", type=int, default=0,
+                    help="watermark-headroom floor (pages) below which "
+                         "the queue-depth threshold counts as pressure")
+    ap.add_argument("--batch-deadline-s", type=float, default=0.0,
+                    help="shed a batch request queued this long without "
+                         "ever being admitted (never accepted-then-"
+                         "starved; 0 disables)")
+    ap.add_argument("--park-max-pages", type=int, default=0,
+                    help="cap on KV pages the decode-preemption parking "
+                         "lot may hold host-side (0 = unbounded)")
     # serving mesh: dp*tp*sp devices (all local devices by default); on a
     # multihost group this spans the GLOBAL device set
     ap.add_argument("--dp", type=int, default=1, help="data-parallel degree")
@@ -283,6 +304,11 @@ def engine_config_from_args(args):
         kv_partition=args.kv_partition,
         enable_prefix_caching=not args.no_prefix_caching,
         fuse_projections=args.fuse_projections,
+        default_priority=getattr(args, "priority_class", "interactive"),
+        overload_queue_depth=getattr(args, "overload_queue_depth", 0),
+        overload_headroom_pages=getattr(args, "overload_headroom_pages", 0),
+        batch_deadline_s=getattr(args, "batch_deadline_s", 0.0),
+        park_max_pages=getattr(args, "park_max_pages", 0),
     )
 
 
@@ -616,6 +642,14 @@ def _build_engine(args):
             # emitting whatever id is designated eos
             vocab_size=tok.vocab_size,
             eos_token_id=list(tok.eos_token_ids)[0],
+            # overload control rides the real scheduler inside the mock,
+            # so graph-deployed mock workers (chaos scenarios) honor the
+            # same class/shed/park knobs as real ones
+            default_priority=args.priority_class,
+            overload_queue_depth=args.overload_queue_depth,
+            overload_headroom_pages=args.overload_headroom_pages,
+            batch_deadline_s=args.batch_deadline_s,
+            park_max_pages=args.park_max_pages,
         )
         engine = MockEngine(margs)
         mdc = ModelDeploymentCard(
@@ -628,6 +662,7 @@ def _build_engine(args):
             tool_call_parser=args.tool_call_parser,
             slo_ttft_ms=args.slo_ttft_ms,
             slo_itl_ms=args.slo_itl_ms,
+            priority_class=args.priority_class,
         )
         return engine, mdc
 
@@ -766,6 +801,7 @@ def _build_engine(args):
         tool_call_parser=args.tool_call_parser,
         slo_ttft_ms=args.slo_ttft_ms,
         slo_itl_ms=args.slo_itl_ms,
+        priority_class=args.priority_class,
         **mm_fields,
     )
     return engine, mdc

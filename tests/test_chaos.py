@@ -99,6 +99,23 @@ async def test_scenario_kvbm_eviction_race(tmp_path):
 
 
 @pytest.mark.timeout(240)
+async def test_scenario_preempt_resume_storm(tmp_path):
+    """Overload wave forcing decode preemptions (batch victims parked)
+    while a worker is SIGKILLed mid-park: zero client-visible errors,
+    resumed streams token-identical to the classless oracle, and the
+    in-process phase proves abort-while-parked credits the leak ledger
+    and batch intake sheds with a structured overloaded error."""
+    result = await _run("preempt_resume_storm", tmp_path)
+    assert result.migrations_total >= 1
+    # kill landed mid-park: every interactive stream live, no batch done
+    assert result.telemetry.get("kill_interactive_live_at_kill") == 2
+    assert result.telemetry.get("kill_batch_done_at_kill") == 0
+    # abort-while-parked discarded the parked pages (ledger credited)
+    assert result.telemetry.get("inproc_discarded_total") == 1
+    assert result.telemetry.get("inproc_shed_total", 0) >= 1
+
+
+@pytest.mark.timeout(240)
 async def test_scenario_wedged_engine_eviction(tmp_path):
     """A wedged engine (alive process, dead request path) is caught only
     by the health check, publishes unhealthy, self-evicts; streams

@@ -244,6 +244,140 @@ async def test_e2e_spec_decode_metrics(model_setup):
         await control.stop()
 
 
+async def test_e2e_overload_batch_shed_and_queue(model_setup):
+    """Overload control end to end (docs/overload_control.md): with the
+    engine past the knee a NEW batch-class request gets a clean HTTP 429
+    + Retry-After with a structured body, a batch request QUEUED within
+    the depth threshold completes once pressure drains (never
+    accepted-then-starved), and interactive requests keep being
+    accepted throughout.  Shed accounting lands on
+    dynamo_frontend_requests_shed_total and the per-class SLO windows
+    show both priority classes."""
+    tok, cfg, params = model_setup
+    control = await ControlPlaneServer().start()
+    worker_rt = await DistributedRuntime.connect(control.address)
+    engine = JaxEngine(
+        cfg, params,
+        EngineConfig(page_size=8, num_pages=128, max_num_seqs=1,
+                     max_prefill_tokens=64, max_model_len=256,
+                     # knee at queue depth 1; the headroom floor is set
+                     # above the whole pool so depth alone is the signal
+                     overload_queue_depth=1,
+                     overload_headroom_pages=10**6),
+        eos_token_ids=list(tok.eos_token_ids), kv_dtype=jnp.float32,
+    )
+    mdc = ModelDeploymentCard(
+        name="tiny-overload", tokenizer_json=tok.to_json_str(),
+        eos_token_ids=list(tok.eos_token_ids),
+    )
+    await serve_engine(worker_rt, engine, mdc)
+    front_rt = await DistributedRuntime.connect(control.address)
+    manager = ModelManager()
+    watcher = await ModelWatcher(front_rt, manager).start()
+    await watcher.wait_for_model("tiny-overload")
+    http = await HttpService(manager, host="127.0.0.1", port=0).start()
+    base = f"http://127.0.0.1:{http.port}"
+    try:
+        async with aiohttp.ClientSession() as session:
+            # 1) a long interactive stream occupies the single decode slot
+            stream_req = {
+                "model": "tiny-overload",
+                "messages": [{"role": "user", "content": "hold the slot"}],
+                "max_tokens": 220, "temperature": 0, "stream": True,
+                "nvext": {"ignore_eos": True},
+            }
+            stream_resp = await session.post(
+                f"{base}/v1/chat/completions", json=stream_req)
+            assert stream_resp.status == 200
+            await stream_resp.content.readline()  # first bytes → running
+
+            # 2) a batch request arrives while the slot is busy → queued
+            #    (within the depth threshold), completing later
+            b1_req = {
+                "model": "tiny-overload", "priority": "batch",
+                "messages": [{"role": "user", "content": "queued work"}],
+                "max_tokens": 4, "temperature": 0,
+                "nvext": {"ignore_eos": True},
+            }
+            b1 = asyncio.ensure_future(
+                session.post(f"{base}/v1/chat/completions", json=b1_req))
+            deadline = asyncio.get_running_loop().time() + 10
+            while not engine.scheduler.waiting:
+                assert asyncio.get_running_loop().time() < deadline, \
+                    "batch request never queued"
+                await asyncio.sleep(0.01)
+
+            # 3) past the knee: the NEXT batch request sheds with 429
+            async with session.post(
+                f"{base}/v1/chat/completions",
+                json={**b1_req,
+                      "messages": [{"role": "user", "content": "shed me"}]},
+            ) as r:
+                assert r.status == 429, await r.text()
+                retry_hdr = r.headers.get("Retry-After")
+                body = await r.json()
+            assert body["error"]["type"] == "overloaded"
+            assert body["error"]["retry_after_s"] >= 1
+            assert retry_hdr == str(body["error"]["retry_after_s"])
+
+            # ... and a STREAMING batch request sheds as a real HTTP 429
+            # too (the pre-SSE probe), not a status-200 error frame
+            async with session.post(
+                f"{base}/v1/chat/completions",
+                json={**b1_req, "stream": True,
+                      "messages": [{"role": "user", "content": "shed 2"}]},
+            ) as r:
+                assert r.status == 429, await r.text()
+                assert r.headers.get("Retry-After")
+                sbody = await r.json()
+            assert sbody["error"]["type"] == "overloaded"
+
+            # 4) interactive is still accepted under the same pressure
+            #    (class-ordered ahead of the queued batch request)
+            async with session.post(
+                f"{base}/v1/chat/completions",
+                json={"model": "tiny-overload",
+                      "messages": [{"role": "user", "content": "vip"}],
+                      "max_tokens": 2, "temperature": 0,
+                      "nvext": {"ignore_eos": True}},
+            ) as r:
+                assert r.status == 200, await r.text()
+
+            # 5) drain the slot-holder; the queued batch request completes
+            async for _ in stream_resp.content:
+                pass
+            stream_resp.close()
+            async with await b1 as r:
+                assert r.status == 200, await r.text()
+                out = await r.json()
+            assert out["usage"]["completion_tokens"] == 4
+
+            m = engine.metrics()
+            assert m.shed_total >= 1
+            assert m.queued_total >= 1
+
+            async with session.get(f"{base}/metrics") as r:
+                body = await r.text()
+            shed_line = next(
+                ln for ln in body.splitlines()
+                if ln.startswith("dynamo_frontend_requests_shed_total")
+                and 'priority="batch"' in ln
+            )
+            assert float(shed_line.rsplit(" ", 1)[1]) >= 1
+            # per-class SLO windows materialized for both classes
+            assert ('dynamo_frontend_class_offered_requests_per_second'
+                    '{model="tiny-overload",priority="batch"}') in body
+            assert ('dynamo_frontend_class_slo_met_ratio'
+                    '{model="tiny-overload",priority="interactive"}') in body
+    finally:
+        await http.stop()
+        await watcher.stop()
+        await engine.shutdown()
+        await front_rt.shutdown(graceful=False)
+        await worker_rt.shutdown(graceful=False)
+        await control.stop()
+
+
 async def test_e2e_worker_removal(model_setup):
     """Killing the worker's lease must remove the model from the frontend."""
     control, worker_rt, front_rt, engine, watcher, http = await start_stack(model_setup)

@@ -676,3 +676,155 @@ async def test_fused_prefill_decode_matches_unfused():
                                     kv_dtype=jnp.float32))
     assert fused == plain
     assert len(fused[1]) == 2 and len(fused[0]) == 11
+
+
+# --------------------------------------------------------------------------- #
+# Overload control: decode preemption with KV park/resume + class-aware
+# admission (docs/overload_control.md)
+# --------------------------------------------------------------------------- #
+
+
+async def _wait_for(cond, timeout=30.0, what=""):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while not cond():
+        assert asyncio.get_event_loop().time() < deadline, f"timeout: {what}"
+        await asyncio.sleep(0.01)
+
+
+@pytest.mark.parametrize("variant", ["greedy", "seeded", "penalized"])
+async def test_park_resume_token_identity(engine_setup, variant):
+    """A batch victim preempted mid-decode (KV parked host-side, pages
+    freed) and resumed through ordinary admission must emit exactly the
+    tokens of an uncontended oracle run — greedy, seeded, and with a
+    penalized interactive co-resident (penalty state rides the victim's
+    own token history, not its slot)."""
+
+    def victim_req():
+        r = req([3, 1, 4, 1, 5, 9, 2, 6], max_tokens=12,
+                temperature=0.0 if variant == "greedy" else 0.9)
+        if variant != "greedy":
+            r["sampling_options"]["seed"] = 7
+        r["priority"] = "batch"
+        return r
+
+    # oracle: same request, no contention, no preemption
+    oracle_engine = make_engine(engine_setup, max_num_seqs=1)
+    oracle, oracle_reason = await collect(oracle_engine, victim_req())
+    assert oracle_engine.scheduler.preempted_total == 0
+    await oracle_engine.shutdown()
+
+    # storm: one decode slot, so an interactive arrival can only be
+    # admitted by parking the running batch victim
+    engine = make_engine(engine_setup, max_num_seqs=1)
+    got: list = []
+    reason: list = []
+
+    async def run_victim():
+        async for delta in engine.generate(victim_req()):
+            got.extend(delta["token_ids"])
+            reason.append(delta["finish_reason"])
+
+    vt = asyncio.create_task(run_victim())
+    await _wait_for(lambda: len(got) >= 2, what="victim mid-decode")
+
+    inter = req([8, 8, 8], max_tokens=4, temperature=0.0)
+    if variant == "penalized":
+        inter["sampling_options"]["frequency_penalty"] = 2.0
+    it = asyncio.create_task(collect(engine, inter))
+    await _wait_for(lambda: engine.scheduler.preempted_total >= 1,
+                    what="victim parked")
+    # the victim's KV is host-side while the interactive runs
+    assert len(engine.parking) <= 1  # resumed entries leave the lot
+    await it
+    await vt
+
+    assert got == oracle, (variant, got, oracle)
+    assert reason[-1] == oracle_reason == "length"
+    sched = engine.scheduler
+    assert sched.preempted_total == sched.resumed_total >= 1
+    assert len(engine.parking) == 0 and engine.parking.pages_held == 0
+    await engine.shutdown()
+
+
+def _mkseq(rid, priority="interactive", prompt_len=8, parked=False):
+    from dynamo_tpu.engine.scheduler import SamplingOptions, Sequence
+
+    seq = Sequence(rid, list(range(1, prompt_len + 1)), SamplingOptions())
+    seq.priority = priority
+    seq.parked = parked
+    return seq
+
+
+def test_enqueue_class_order():
+    """Interactive rides ahead of batch; FIFO within a class; front=True
+    inserts at the head of the sequence's OWN class region."""
+    from dynamo_tpu.engine.scheduler import Scheduler
+
+    cfg = EngineConfig(page_size=8, num_pages=16, max_num_seqs=4,
+                       max_prefill_tokens=32, max_model_len=256)
+    sched = Scheduler(cfg, PagePool(16, 8))
+    for rid, prio in [("b1", "batch"), ("i1", "interactive"),
+                      ("b2", "batch"), ("i2", "interactive")]:
+        sched.add(_mkseq(rid, prio))
+    assert [s.request_id for s in sched.waiting] == ["i1", "i2", "b1", "b2"]
+    # a preemption victim re-admits before later arrivals of its class
+    # but never jumps the other class
+    sched._enqueue(_mkseq("b0", "batch"), front=True)
+    sched._enqueue(_mkseq("i0", "interactive"), front=True)
+    assert [s.request_id for s in sched.waiting] == [
+        "i0", "i1", "i2", "b0", "b1", "b2"]
+    # only b2 arrived behind existing work (b1 found an empty queue);
+    # direct _enqueue calls (preemption re-inserts) never count
+    assert sched.queued_total == 1
+
+
+def test_admit_check_interactive_claims_reserve():
+    """The watermark reserve is waived for interactive admission only
+    while batch work is present; batch always respects the reserve."""
+    from dynamo_tpu.engine.scheduler import Scheduler
+
+    cfg = EngineConfig(page_size=8, num_pages=16, max_num_seqs=4,
+                       max_prefill_tokens=32, max_model_len=256,
+                       watermark=0.5)  # reserve = 7 of 15 usable pages
+    pool = PagePool(16, 8)
+    sched = Scheduler(cfg, pool)
+    held = pool.allocate(8)  # 7 free: covers need(1) but not need+reserve
+    seq_i = _mkseq("i", "interactive")
+    seq_b = _mkseq("b", "batch")
+    # no batch present: interactive respects the reserve like anyone
+    ok, _ = sched._admit_check(seq_i)
+    assert not ok
+    # batch present (waiting): interactive may claim the reserve...
+    sched.add(seq_b)
+    ok, _ = sched._admit_check(seq_i)
+    assert ok
+    # ...but batch itself still cannot
+    ok, _ = sched._admit_check(seq_b)
+    assert not ok
+    pool.free(held)
+
+
+def test_overloaded_needs_depth_and_headroom():
+    """overloaded() trips only when BOTH the queue is deep enough and
+    the watermark headroom is exhausted; depth 0 disables it."""
+    from dynamo_tpu.engine.scheduler import Scheduler
+
+    def make(depth, headroom):
+        cfg = EngineConfig(page_size=8, num_pages=16, max_num_seqs=4,
+                           max_prefill_tokens=32, max_model_len=256,
+                           watermark=0.0, overload_queue_depth=depth,
+                           overload_headroom_pages=headroom)
+        return Scheduler(cfg, PagePool(16, 8)), cfg
+
+    sched, _ = make(depth=2, headroom=4)
+    assert not sched.overloaded()  # queue empty
+    sched.add(_mkseq("a", "batch"))
+    sched.add(_mkseq("b", "batch"))
+    assert not sched.overloaded()  # deep enough, but 15 pages headroom
+    held = sched.pool.allocate(12)  # headroom 3 <= 4
+    assert sched.overloaded()
+    sched.pool.free(held)
+
+    sched0, _ = make(depth=0, headroom=10**6)
+    sched0.add(_mkseq("a", "batch"))
+    assert not sched0.overloaded()  # depth 0 = shedding disabled

@@ -214,6 +214,49 @@ def test_check_page_pool_balanced_is_silent(armed):
     leak_ledger.assert_balanced("engine:t")
 
 
+def test_parked_pages_account_balances_on_abort_while_parked(armed):
+    """The preemption parking lot's `parked_pages` account: park debits,
+    resume (take) and abort-while-parked (discard) credit, and KV left
+    parked past shutdown fails assert_balanced — the overload-control
+    extension of the PR 13 page gate."""
+    from dynamo_tpu.kvbm.park import ParkedSeq, ParkingLot
+
+    lot = ParkingLot(owner="engine:park-test")
+    lot.park(ParkedSeq("r1", None, None, n_pages=3, num_computed=20,
+                       kv_rank=0))
+    lot.park(ParkedSeq("r2", None, None, n_pages=2, num_computed=12,
+                       kv_rank=0))
+    assert leak_ledger.imbalances("engine:park-test") == {"parked_pages": 5}
+    # orphaned parked KV is a loud failure, not a silent pin
+    with pytest.raises(AssertionError, match="parked_pages"):
+        leak_ledger.assert_balanced("engine:park-test")
+    # resume credits its pages back
+    assert lot.take("r1").n_pages == 3
+    assert leak_ledger.imbalances("engine:park-test") == {"parked_pages": 2}
+    # abort-while-parked (client cancelled a parked victim) credits too
+    assert lot.discard("r2")
+    assert leak_ledger.imbalances("engine:park-test") == {}
+    leak_ledger.assert_balanced("engine:park-test")
+    # double-discard stays balanced (abort raced shutdown's clear)
+    assert not lot.discard("r2")
+    assert lot.clear() == 0
+    leak_ledger.assert_balanced("engine:park-test")
+
+
+def test_parked_pages_clear_credits_everything(armed):
+    """Shutdown's clear() credits all parked pages in one release."""
+    from dynamo_tpu.kvbm.park import ParkedSeq, ParkingLot
+
+    lot = ParkingLot(owner="engine:park-clear")
+    lot.park(ParkedSeq("a", None, None, n_pages=4, num_computed=32,
+                       kv_rank=0))
+    lot.park(ParkedSeq("b", None, None, n_pages=1, num_computed=8,
+                       kv_rank=0))
+    assert lot.clear() == 2
+    assert lot.pages_held == 0 and len(lot) == 0
+    leak_ledger.assert_balanced("engine:park-clear")
+
+
 def test_leaked_threads_sees_repo_named_thread(armed):
     release = threading.Event()
     t = threading.Thread(target=release.wait, name="kvbm-offload_unit")
