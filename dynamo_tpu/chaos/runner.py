@@ -143,6 +143,9 @@ class ChaosStack:
         self._saved_env: Dict[str, Optional[str]] = {}
         self._log_file = None
         self.spec: Optional[GraphSpec] = None
+        # pids the fault plan SIGKILLed, in execution order — the flight
+        # recorder rider locates each victim's black-box segments by pid
+        self.killed_pids: List[int] = []
 
     @property
     def namespace(self) -> str:
@@ -390,6 +393,7 @@ class ChaosStack:
             victim = live[idx % len(live)]
             logger.warning("chaos: SIGKILL %s replica pid %d",
                            spec.component, victim.pid)
+            self.killed_pids.append(victim.pid)
             victim.send_signal(signal.SIGKILL)
         elif spec.kind == KILL_RANK:
             groups = self.controller.actuator._groups.get(  # noqa: SLF001
@@ -403,6 +407,7 @@ class ChaosStack:
             victim = group[rank % len(group)]
             logger.warning("chaos: SIGKILL %s rank %d pid %d",
                            spec.component, rank, victim.pid)
+            self.killed_pids.append(victim.pid)
             victim.send_signal(signal.SIGKILL)
         elif spec.target == "local":
             FaultGate.install().arm(
@@ -451,11 +456,15 @@ class ScenarioRunner:
         self.scenario = scenario
         self.log_dir = log_dir
         self.timeline_dir = timeline_dir
+        self.flight_dir = ""  # per-run black-box spill dir (set by run())
         self.stack: Optional[ChaosStack] = None
         self.baseline: List[StreamOutcome] = []
         self.outcomes: List[StreamOutcome] = []
 
     async def run(self) -> ScenarioResult:
+        import dataclasses as _dc
+        import tempfile
+
         s = self.scenario
         if s.custom is not None:
             return await s.custom()
@@ -472,10 +481,21 @@ class ScenarioRunner:
             )
             # drop any cached exporter so the in-process frontend re-reads
             # the scenario's DYN_OTEL_FILE; graph processes inherit it
-            import dataclasses as _dc
-
             tracing.close_exporter()
             s = _dc.replace(s, env={**s.env, "DYN_OTEL_FILE": spans_path})
+        # every graph scenario flies with the black box armed: workers
+        # inherit DYN_TPU_FLIGHT_DIR and spill their step events to mmap
+        # segments a SIGKILL cannot take with it — extra_checks read a
+        # victim's final moments via runner.flight_dir + stack.killed_pids
+        if self.timeline_dir:
+            self.flight_dir = os.path.join(
+                self.timeline_dir, f"chaos_{s.name}_flight")
+        else:
+            # lint: allow(blocking-in-async): chaos harness setup/teardown, not the serving loop
+            self.flight_dir = tempfile.mkdtemp(
+                prefix=f"chaos_{s.name}_flight_")
+        s = _dc.replace(s, env={**s.env,
+                                "DYN_TPU_FLIGHT_DIR": self.flight_dir})
         self.stack = ChaosStack(s.graph, s.env, log_path)
         result = ScenarioResult(name=s.name, passed=False,
                                 streams=s.traffic.requests)
@@ -534,6 +554,13 @@ class ScenarioRunner:
                 result.telemetry["timeline"] = self._attach_timeline(
                     s.name, spans_path
                 )
+            if self.flight_dir and not self.timeline_dir:
+                import shutil
+
+                # ephemeral black box: with no artifact dir asked for,
+                # the segments have served their purpose (extra_checks
+                # already read them)
+                shutil.rmtree(self.flight_dir, ignore_errors=True)
         return result
 
     def _attach_timeline(self, name: str, spans_path: str) -> str:

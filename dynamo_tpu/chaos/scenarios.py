@@ -115,7 +115,49 @@ async def _check_migrated(runner) -> dict:
         None,
     )
     assert line is not None and float(line.rsplit(" ", 1)[1]) >= 1, body[-800:]
-    return {"migrations_total": migrations}
+    return {"migrations_total": migrations,
+            **await _check_black_box(runner)}
+
+
+async def _check_black_box(runner) -> dict:
+    """Flight-recorder rider: the SIGKILLed victim left readable mmap
+    segments behind, its final decode activity is in them, and
+    scripts/postmortem.py merges them into a valid Perfetto timeline."""
+    import os
+    import sys
+
+    from ..runtime.events import load_flight_dir
+
+    assert runner.stack.killed_pids, "no SIGKILL executed — rider miswired"
+    victim_pid = runner.stack.killed_pids[0]
+    dumps = load_flight_dir(runner.flight_dir, pid=victim_pid)
+    assert dumps, (
+        f"no flight segments recovered for SIGKILLed pid {victim_pid} in "
+        f"{runner.flight_dir}: {sorted(os.listdir(runner.flight_dir))}"  # lint: allow(blocking-in-async): assert-failure diagnostics; the chaos stack is torn down, nothing else shares this loop
+    )
+    dump = dumps[0]
+    kinds = {e.get("kind") for e in dump["events"]}
+    assert "decode_block" in kinds, (
+        f"victim's black box holds no decode_block — it died serving, so "
+        f"its final decode steps must be there (kinds={sorted(kinds)})"
+    )
+    # the whole dump tree (victim + survivor + respawn) must merge into a
+    # schema-valid Perfetto timeline through the postmortem tool itself
+    scripts_dir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))), "scripts")
+    if scripts_dir not in sys.path:
+        sys.path.insert(0, scripts_dir)
+    import postmortem
+
+    summary, _report = postmortem.run(runner.flight_dir)
+    assert summary["ok"] and summary["timeline_violations"] == 0, summary
+    assert summary["processes"] >= 1 and summary["flight_events"] > 0, summary
+    return {
+        "victim_pid": victim_pid,
+        "victim_flight_events": len(dump["events"]),
+        "victim_flight_segments": dump.get("segments", 0),
+        "postmortem_processes": summary["processes"],
+    }
 
 
 def worker_kill_midstream() -> Scenario:

@@ -3022,6 +3022,11 @@ class JaxEngine:
             # one-shot TTFT attribution (see _deliver)
             out["ttft"] = seq.ttft_attr
             seq.ttft_attr = None
+        if seq.incidents:
+            # forensics: engine-side stalls (preempt park/resume, KV
+            # onboard) ride the next delta for the frontend's waterfall
+            out["incidents"] = seq.incidents
+            seq.incidents = []
         if finish_reason:
             self._close_decode_span(seq, finish_reason)
         self._post_threadsafe(queue, out)
@@ -5039,6 +5044,11 @@ class JaxEngine:
             # one-shot TTFT attribution on the first-token delta
             out["ttft"] = seq.ttft_attr
             seq.ttft_attr = None
+        if seq.incidents:
+            # forensics: engine-side stalls (preempt park/resume, KV
+            # onboard) ride the next delta for the frontend's waterfall
+            out["incidents"] = seq.incidents
+            seq.incidents = []
         if finish_reason:
             self._close_decode_span(seq, finish_reason)
         # may be called from the executor thread — hop back to the loop

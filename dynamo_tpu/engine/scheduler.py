@@ -115,6 +115,12 @@ class Sequence:
         self.t_admitted: Optional[float] = None
         self.t_first_token: Optional[float] = None
         self.ttft_attr: Optional[dict] = None
+        # forensics: mid-stream incidents (preemption park/resume, prefix
+        # onboard) accumulated here and attached to the next delivered
+        # delta, so the frontend's per-request waterfall sees stalls that
+        # happened inside the engine (attach-and-clear in _deliver)
+        self.incidents: List[dict] = []
+        self.t_parked: Optional[float] = None  # preempt_park stamp
         # the request's TraceContext, captured at generate() where the
         # transport's contextvar is still live — the pump thread exports
         # per-request milestone spans (block-wait/queue-wait/prefill/
@@ -383,6 +389,13 @@ class Scheduler:
             return False
         seq.parked = False
         self.resumed_total += 1
+        if seq.t_parked is not None:
+            # forensics: the park→resume stall rides the next delivered
+            # delta so the frontend's waterfall can blame `preempt`
+            stall_ms = (time.monotonic() - seq.t_parked) * 1e3
+            seq.incidents.append(
+                {"kind": "preempt", "stall_ms": round(stall_ms, 3)})
+            seq.t_parked = None
         if self.events is not None:
             self.events.record(
                 "preempt_resume", rid=seq.request_id, rank=seq.kv_rank,
@@ -469,10 +482,20 @@ class Scheduler:
             # tests spy on) so the engine can export a kvbm.onboard span
             # under it.
             self.onboard_trace = seq.trace
+            t_onboard = time.monotonic()
             try:
-                hit_pages.extend(
-                    self.onboard_fn(hashes[len(hit_pages):], seq.kv_rank)
-                )
+                onboarded = self.onboard_fn(
+                    hashes[len(hit_pages):], seq.kv_rank)
+                if onboarded:
+                    # forensics: host→device KV onboarding stalled this
+                    # request's admission; ride the first delta
+                    seq.incidents.append({
+                        "kind": "onboard",
+                        "pages": len(onboarded),
+                        "stall_ms": round(
+                            (time.monotonic() - t_onboard) * 1e3, 3),
+                    })
+                hit_pages.extend(onboarded)
             finally:
                 # a raising hook must not leave the dead request's trace
                 # attached — the next admission's span would join it
@@ -779,6 +802,7 @@ class Scheduler:
         seq.parked = True
         seq.status = "waiting"
         seq.preemptions += 1
+        seq.t_parked = time.monotonic()  # forensics: resume stamps stall
         self.preempted_total += 1
         if seq in self.running:
             self.running.remove(seq)

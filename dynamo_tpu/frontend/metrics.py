@@ -200,7 +200,7 @@ class FrontendMetrics:
         # scrape time and published to the fleet telemetry plane
         from .slo import SLOAccountant, SLOWindowCollector
 
-        self.slo = SLOAccountant()
+        self.slo = SLOAccountant(exemplars=True)
         self.registry.register(SLOWindowCollector(self.slo))
         # process-level CPU/fd/RSS (runtime/metrics.py): the saturation
         # story needs frontend CPU per token to be attributable against
@@ -277,5 +277,14 @@ class FrontendMetrics:
             sum(a for _, a in win) / total if total else 0.0
         )
 
-    def exposition(self) -> bytes:
+    def exposition(self, openmetrics: bool = False) -> bytes:
+        """Render the registry; OpenMetrics format (content-negotiated
+        by the /metrics handler) carries the histogram exemplars that
+        the classic text format silently drops."""
+        if openmetrics:
+            from prometheus_client.openmetrics.exposition import (
+                generate_latest as om_latest,
+            )
+
+            return om_latest(self.registry)
         return generate_latest(self.registry)

@@ -196,6 +196,7 @@ class HttpService:
             web.get("/live", self.live),
             web.get("/metrics", self.prometheus),
             web.get("/fleet.json", self.fleet_json),
+            web.get("/debug/tail.json", self.tail_json),
             web.get("/events.json", self.events_json),
             web.get("/openapi.json", self.openapi),
             web.post("/clear_kv_blocks", self.clear_kv_blocks),
@@ -258,6 +259,8 @@ class HttpService:
             ("/live", "liveness"),
             ("/metrics", "Prometheus exposition"),
             ("/fleet.json", "live SLO windows + fleet capacity snapshots"),
+            ("/debug/tail.json", "N worst windowed requests with trace "
+                                 "ids + bottleneck classes"),
             ("/events.json", "egress step-event ring dump"),
             ("/openapi.json", "this document"),
         ]:
@@ -281,10 +284,41 @@ class HttpService:
     async def events_json(self, request: web.Request) -> web.Response:
         """Egress step-event ring: one `egress_stream` event per served
         stream (frames/deltas/coalesced/bytes), same dump schema as the
-        worker's engine ring (docs/observability.md)."""
-        return web.json_response(self.events.dump())
+        worker's engine ring (docs/observability.md).  `?since_ns=` (the
+        `watermark_ns` of a previous dump) returns only newer events —
+        pollers fetch deltas instead of the whole ring each scrape."""
+        since = request.query.get("since_ns")
+        try:
+            since_ns = int(since) if since is not None else None
+        except ValueError:
+            return _error_response(400, f"bad since_ns {since!r}")
+        return web.json_response(self.events.dump(since_ns=since_ns))
+
+    async def tail_json(self, request: web.Request) -> web.Response:
+        """Tail forensics: per-model N worst requests in the live SLO
+        window, each a waterfall summary with `trace_id` + `bottleneck`
+        (docs/observability.md "Tail forensics" documents the schema)."""
+        try:
+            n = max(1, min(int(request.query.get("n", 10)), 100))
+        except ValueError:
+            return _error_response(400,
+                                   f"bad n {request.query.get('n')!r}")
+        return web.json_response({
+            "ts": time.time(),
+            "window_s": self.metrics.slo.window_s,
+            "models": self.metrics.slo.tail(n),
+        })
 
     async def prometheus(self, request: web.Request) -> web.Response:
+        # content negotiation: OpenMetrics carries histogram exemplars
+        # (`# {trace_id=...}`); the classic text format stays the
+        # default so existing scrapers see an unchanged surface
+        accept = request.headers.get("Accept", "")
+        if "openmetrics" in accept:
+            return web.Response(
+                body=self.metrics.exposition(openmetrics=True),
+                content_type="application/openmetrics-text",
+            )
         return web.Response(
             body=self.metrics.exposition(),
             content_type="text/plain",
@@ -672,6 +706,7 @@ class HttpService:
         templates: dict = {}  # choice index -> ChunkTemplate
         stamps: list = []     # delta arrival times (batch-observed later)
         ttft_attrs: list = []  # engine TTFT attributions (ditto)
+        incidents: list = []   # engine/migration stalls riding deltas
 
         def process(item):
             """One queue item → frames/bookkeeping. No awaits: delivery
@@ -718,6 +753,9 @@ class HttpService:
             attr = out.get("ttft")
             if attr:  # one-shot, first-token delta only
                 ttft_attrs.append(attr)
+            inc = out.get("incidents")
+            if inc:  # preempt/onboard/migration stalls (waterfall input)
+                incidents.extend(inc)
             finish = out.get("finish_reason")
             if parsers is not None:
                 if finish:
@@ -812,12 +850,28 @@ class HttpService:
             # observes, TTFT attribution, egress counters and the ring
             # event all land here in one post-stream batch (runs on the
             # disconnect path too, so partial streams still count)
+            from ..runtime.tracing import current_trace
+
+            _tr = current_trace()
+            trace_id = _tr.trace_id if _tr is not None else ""
+            ex = {"trace_id": trace_id[:64]} if trace_id else None
             if stamps:
-                self.metrics.ttft.labels(model_name).observe(stamps[0] - t0)
+                self.metrics.ttft.labels(model_name).observe(
+                    stamps[0] - t0, ex)
                 observe_itl = self.metrics.itl.labels(model_name).observe
                 prev = stamps[0]
+                # one ITL exemplar per stream, on its LARGEST gap — the
+                # observation a tail bucket would surface anyway
+                worst_gap = max((b - a for a, b in zip(stamps, stamps[1:])),
+                                default=None)
+                tagged = False
                 for t_delta in stamps[1:]:
-                    observe_itl(t_delta - prev)
+                    gap = t_delta - prev
+                    if not tagged and gap == worst_gap:
+                        observe_itl(gap, ex)
+                        tagged = True
+                    else:
+                        observe_itl(gap)
                     prev = t_delta
             for attr in ttft_attrs:
                 self.metrics.observe_ttft_attr(model_name, attr)
@@ -829,7 +883,19 @@ class HttpService:
             )
         self.metrics.requests.labels(model_name, kind, status).inc()
         self.metrics.output_tokens.labels(model_name).inc(ntokens)
-        self.metrics.duration.labels(model_name).observe(time.monotonic() - t0)
+        t_end = time.monotonic()
+        self.metrics.duration.labels(model_name).observe(t_end - t0)
+        # tail forensics: assemble the request's stage waterfall (post-
+        # stream, off the delivery path) — it becomes the SLO window's
+        # exemplar so /debug/tail.json can answer "why was this slow"
+        from .waterfall import build_waterfall
+
+        waterfall = build_waterfall(
+            trace_id=trace_id, model=model_name, t0=t0, t_end=t_end,
+            t_first=t_first, t_last_tok=t_last_tok,
+            ttft_attr=ttft_attrs[0] if ttft_attrs else None,
+            incidents=incidents, ntokens=ntokens, status=int(status),
+        )
         # live SLO window: the whole HTTP request is one accounting unit
         # (bench.poisson_goodput's per-request TTFT + mean-ITL predicate,
         # applied post-hoc in slo.observe_stream — never on the delivery
@@ -840,6 +906,7 @@ class HttpService:
                 ntokens=ntokens, n_choices=n, errored=status != "200",
                 prompt_tokens=len(preprocessed.get("token_ids") or []),
                 priority=preprocessed.get("priority"),
+                exemplar=waterfall,
             )
         for spec in spec_seen:
             if spec:  # a stop string may cut the stream before the
@@ -861,6 +928,7 @@ class HttpService:
         finish_reason = None
         spec = None
         ttft = None
+        incidents: list = []
         async for out in entry.generate(preq, context):
             if out.get("finish_reason") == "error":
                 return {"error": out.get("error", "engine error")}
@@ -870,6 +938,9 @@ class HttpService:
             tops.extend(out.get("top_logprobs", []))
             spec = out.get("spec") or spec
             ttft = out.get("ttft") or ttft
+            inc = out.get("incidents")
+            if inc:
+                incidents.extend(inc)
             finish_reason = out.get("finish_reason") or finish_reason
         return {
             "text": "".join(text_parts),
@@ -880,6 +951,7 @@ class HttpService:
             "finish_reason": finish_reason or "stop",
             "spec": spec,
             "ttft": ttft,
+            "incidents": incidents,
         }
 
     async def _unary_response(
@@ -1003,11 +1075,28 @@ class HttpService:
         # rode the stream and the remainder amortizes as per-STREAM ITL
         # (choices run concurrently — divide by one choice's share of
         # the tokens, same as the streaming path)
-        dur_ms = (time.monotonic() - t0) * 1e3
+        t_end = time.monotonic()
+        dur_ms = (t_end - t0) * 1e3
         ttft_attr = next((r["ttft"] for r in results if r.get("ttft")), None)
         ttft_ms = (sum(v for v in ttft_attr.values()
                        if isinstance(v, (int, float)))
                    if ttft_attr else dur_ms)
+        from ..runtime.tracing import current_trace
+
+        from .waterfall import build_waterfall
+
+        _tr = current_trace()
+        trace_id = _tr.trace_id if _tr is not None else ""
+        waterfall = build_waterfall(
+            trace_id=trace_id, model=model_name, t0=t0, t_end=t_end,
+            t_first=(t0 + min(ttft_ms, dur_ms) / 1e3
+                     if token_count else None),
+            t_last_tok=t_end if token_count else None,
+            ttft_attr=ttft_attr,
+            incidents=[i for r in results
+                       for i in (r.get("incidents") or [])],
+            ntokens=token_count, status=200,
+        )
         self.metrics.slo.observe(
             model_name,
             ttft_ms=min(ttft_ms, dur_ms),
@@ -1017,6 +1106,7 @@ class HttpService:
             output_tokens=token_count,
             prompt_tokens=prompt_tokens,
             priority=preprocessed.get("priority"),
+            exemplar=waterfall,
         )
         self.metrics.requests.labels(model_name, kind, "200").inc()
         self.metrics.output_tokens.labels(model_name).inc(token_count)

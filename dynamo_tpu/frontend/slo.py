@@ -96,15 +96,21 @@ class LogBucketHistogram:
     geometric midpoint — so any quantile is exact to within half a bucket
     ratio (~±9%), which the oracle test pins."""
 
-    __slots__ = ("counts", "n", "n_finite", "total_ms")
+    __slots__ = ("counts", "n", "n_finite", "total_ms", "exemplars")
 
-    def __init__(self):
+    def __init__(self, exemplars: bool = False):
         self.counts: List[int] = [0] * _NBUCKETS
         self.n = 0
         self.n_finite = 0
         self.total_ms = 0.0
+        # forensics: one exemplar slot per occupied bucket — the WORST
+        # sample's (value, summary) so tail quantiles keep an identity
+        # to pivot on (trace id + waterfall).  None when unarmed: the
+        # bare record() path stays allocation-free.
+        self.exemplars: Optional[Dict[int, tuple]] = (
+            {} if exemplars else None)
 
-    def record(self, v_ms: float) -> None:
+    def record(self, v_ms: float, exemplar: Optional[dict] = None) -> None:
         if not v_ms > 0.0:  # 0, negative, NaN → first bucket
             idx = 0
         elif v_ms == float("inf"):
@@ -120,6 +126,10 @@ class LogBucketHistogram:
         if v_ms == v_ms and v_ms != float("inf") and v_ms > 0:
             self.n_finite += 1
             self.total_ms += v_ms
+        if exemplar is not None and self.exemplars is not None:
+            cur = self.exemplars.get(idx)
+            if cur is None or v_ms > cur[0]:
+                self.exemplars[idx] = (v_ms, exemplar)
 
     def merge(self, other: "LogBucketHistogram") -> None:
         for i, c in enumerate(other.counts):
@@ -127,6 +137,20 @@ class LogBucketHistogram:
         self.n += other.n
         self.n_finite += other.n_finite
         self.total_ms += other.total_ms
+        if other.exemplars:
+            if self.exemplars is None:
+                self.exemplars = {}
+            for idx, pair in other.exemplars.items():
+                cur = self.exemplars.get(idx)
+                if cur is None or pair[0] > cur[0]:
+                    self.exemplars[idx] = pair
+
+    def worst_exemplars(self, n: int) -> List[tuple]:
+        """Up to `n` (value_ms, summary) pairs, worst value first."""
+        if not self.exemplars:
+            return []
+        pairs = sorted(self.exemplars.values(), key=lambda p: -p[0])
+        return pairs[:n]
 
     @staticmethod
     def bucket_mid_ms(idx: int) -> float:
@@ -154,9 +178,11 @@ class _Slot:
     """One sub-window of the ring."""
 
     __slots__ = ("epoch", "started", "completed", "slo_ok", "tokens",
-                 "tokens_ok", "prompt_tokens", "t_first", "ttft", "itl")
+                 "tokens_ok", "prompt_tokens", "t_first", "ttft", "itl",
+                 "armed")
 
-    def __init__(self):
+    def __init__(self, armed: bool = False):
+        self.armed = armed
         self.reset(-1)
 
     def reset(self, epoch: int) -> None:
@@ -168,8 +194,8 @@ class _Slot:
         self.tokens_ok = 0
         self.prompt_tokens = 0
         self.t_first: Optional[float] = None
-        self.ttft = LogBucketHistogram()
-        self.itl = LogBucketHistogram()
+        self.ttft = LogBucketHistogram(exemplars=self.armed)
+        self.itl = LogBucketHistogram(exemplars=self.armed)
 
 
 class SlidingWindow:
@@ -178,12 +204,14 @@ class SlidingWindow:
     allocates and never scans.  Single-writer (the event loop thread) —
     no lock on the hot path."""
 
-    def __init__(self, window_s: float = 60.0, slots: int = 12):
+    def __init__(self, window_s: float = 60.0, slots: int = 12,
+                 exemplars: bool = False):
         if slots < 2:
             raise ValueError("SlidingWindow needs at least 2 slots")
         self.window_s = float(window_s)
         self.sub_s = self.window_s / slots
-        self._ring = [_Slot() for _ in range(slots)]
+        self.exemplars = exemplars
+        self._ring = [_Slot(armed=exemplars) for _ in range(slots)]
 
     def _slot(self, now: float) -> _Slot:
         epoch = int(now / self.sub_s)
@@ -214,7 +242,8 @@ class SlidingWindow:
     @affine("loop")
     def record(self, ttft_ms: float, itl_ms: float, output_tokens: int,
                slo_ok: bool, prompt_tokens: int = 0,
-               now: Optional[float] = None) -> None:
+               now: Optional[float] = None,
+               exemplar: Optional[dict] = None) -> None:
         now = time.monotonic() if now is None else now
         slot = self._slot(now)
         if slot.t_first is None:
@@ -225,8 +254,8 @@ class SlidingWindow:
         if slo_ok:
             slot.slo_ok += 1
             slot.tokens_ok += output_tokens
-        slot.ttft.record(ttft_ms)
-        slot.itl.record(itl_ms)
+        slot.ttft.record(ttft_ms, exemplar)
+        slot.itl.record(itl_ms, exemplar)
 
     def snapshot(self, now: Optional[float] = None) -> dict:
         """Merge the still-valid slots into one window summary.  Rates
@@ -262,7 +291,7 @@ class SlidingWindow:
                 "mean_ms": h.mean(),
             }
 
-        return {
+        out = {
             "window_s": round(duration, 3),
             "requests_started": started,
             "requests_completed": completed,
@@ -275,6 +304,43 @@ class SlidingWindow:
             "ttft": dist(ttft),
             "itl": dist(itl),
         }
+        if self.exemplars:
+            # tail forensics: the worst windowed requests WITH identity
+            # (trace id + waterfall summary), so a p99 number pivots to
+            # a concrete request instead of staying anonymous
+            out["tail"] = self._tail_from(ttft, itl, 3)
+        return out
+
+    @staticmethod
+    def _tail_from(ttft: LogBucketHistogram, itl: LogBucketHistogram,
+                   n: int) -> List[dict]:
+        """N worst exemplar summaries across the merged ttft+itl bucket
+        slots, deduped by trace id, ranked by end-to-end duration (falls
+        back to the observed value for summaries without one)."""
+        best: Dict[str, tuple] = {}
+        for v, ex in (ttft.worst_exemplars(4 * n)
+                      + itl.worst_exemplars(4 * n)):
+            key = str(ex.get("trace_id", id(ex)))
+            rank = float(ex.get("total_ms") or v)
+            cur = best.get(key)
+            if cur is None or rank > cur[0]:
+                best[key] = (rank, ex)
+        ranked = sorted(best.values(), key=lambda p: -p[0])
+        return [ex for _, ex in ranked[:n]]
+
+    def tail(self, n: int = 10, now: Optional[float] = None) -> List[dict]:
+        """The window's N worst requests (exemplar summaries)."""
+        if not self.exemplars:
+            return []
+        now = time.monotonic() if now is None else now
+        cur = int(now / self.sub_s)
+        lo = cur - len(self._ring) + 1
+        ttft, itl = LogBucketHistogram(True), LogBucketHistogram(True)
+        for slot in self._ring:
+            if lo <= slot.epoch <= cur:
+                ttft.merge(slot.ttft)
+                itl.merge(slot.itl)
+        return self._tail_from(ttft, itl, n)
 
 
 class SLOAccountant:
@@ -284,10 +350,14 @@ class SLOAccountant:
     publisher)."""
 
     def __init__(self, window_s: float = 60.0, slots: int = 12,
-                 default: Optional[SLOTargets] = None):
+                 default: Optional[SLOTargets] = None,
+                 exemplars: bool = False):
         self.window_s = window_s
         self.slots = slots
         self.default = SLOTargets.from_env(default)
+        # arm per-model windows with exemplar slots (tail forensics);
+        # class windows stay bare — the tail surface is per-model
+        self.exemplars = exemplars
         self.targets: Dict[str, SLOTargets] = {}
         self.windows: Dict[str, SlidingWindow] = {}
         # per-(model, priority-class) windows (overload control): same
@@ -304,8 +374,8 @@ class SLOAccountant:
     def window(self, model: str) -> SlidingWindow:
         win = self.windows.get(model)
         if win is None:
-            win = self.windows[model] = SlidingWindow(self.window_s,
-                                                      self.slots)
+            win = self.windows[model] = SlidingWindow(
+                self.window_s, self.slots, exemplars=self.exemplars)
         return win
 
     def class_window(self, model: str, priority: str) -> SlidingWindow:
@@ -325,26 +395,37 @@ class SLOAccountant:
     def observe(self, model: str, ttft_ms: float, itl_ms: float,
                 output_tokens: int, prompt_tokens: int = 0,
                 now: Optional[float] = None,
-                priority: Optional[str] = None) -> bool:
+                priority: Optional[str] = None,
+                exemplar: Optional[dict] = None) -> bool:
         """Account one COMPLETED request; returns whether it met its SLO
         (bench.poisson_goodput's predicate, applied live).  When a
         `priority` class is given the request ALSO lands in that class's
         window — the model window keeps scoring every request, so the
-        existing surfaces don't change."""
+        existing surfaces don't change.  `exemplar` (a waterfall summary
+        with a trace id) lands in the model window's bucket slots for
+        the tail-forensics surfaces."""
         ok = self.targets_for(model).met(ttft_ms, itl_ms)
         self.window(model).record(ttft_ms, itl_ms, output_tokens, ok,
-                                  prompt_tokens, now)
+                                  prompt_tokens, now, exemplar=exemplar)
         if priority:
             self.class_window(model, priority).record(
                 ttft_ms, itl_ms, output_tokens, ok, prompt_tokens, now)
         return ok
+
+    def tail(self, n: int = 10,
+             now: Optional[float] = None) -> Dict[str, List[dict]]:
+        """Per-model N worst windowed requests (exemplar summaries) —
+        the `/debug/tail.json` payload."""
+        return {model: win.tail(n, now)
+                for model, win in self.windows.items()}
 
     def observe_stream(self, model: str, *, t0: float,
                        t_first: Optional[float],
                        t_last_tok: Optional[float], ntokens: int,
                        n_choices: int, errored: bool,
                        prompt_tokens: int = 0,
-                       priority: Optional[str] = None) -> bool:
+                       priority: Optional[str] = None,
+                       exemplar: Optional[dict] = None) -> bool:
         """Score one streamed HTTP request from its raw timestamps —
         the post-hoc half of the delivery loop's accounting (the loop
         only collects monotonic stamps; the TTFT/ITL math happens here,
@@ -367,6 +448,7 @@ class SLOAccountant:
             output_tokens=ntokens,
             prompt_tokens=prompt_tokens,
             priority=priority,
+            exemplar=exemplar,
         )
 
     def snapshot(self, now: Optional[float] = None) -> Dict[str, dict]:

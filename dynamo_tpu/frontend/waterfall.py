@@ -1,0 +1,126 @@
+"""Per-request stage waterfalls — where did THIS request's time go.
+
+The frontend assembles, POST-stream and off the delivery path (the
+PR 16 contract: the no-await `process()` hook only collects stamps and
+metadata), a compact stage decomposition per request:
+
+- queue / block / prefill from the engine's TTFT attribution dict (the
+  one-shot ``ttft`` payload the first delta carries);
+- decode from the delta stamps (first token → last token);
+- egress as the residual (transport + SSE write + any TTFT time the
+  engine could not attribute);
+- migration / preemption / onboard stalls from the ``incidents`` list
+  riding the stream metadata (engine park/resume, KV onboarding, and
+  the migration layer's worker-hop stall).
+
+The dominant stage becomes a ``bottleneck`` class
+(``prefill|queue|decode|egress|migration|preempt``) so the tail
+surfaces (`/debug/tail.json`, `/fleet.json` windows, OpenMetrics
+exemplars) answer "why was this request slow" in one word, with the
+full decomposition one level deeper.  Schema documented in
+docs/observability.md ("Tail forensics")."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+__all__ = ["build_waterfall"]
+
+# classification order breaks exact ties deterministically: blame the
+# engine-side stage before the residual
+_STAGE_ORDER = ("prefill", "queue", "decode", "migration", "preempt",
+                "egress")
+
+
+def build_waterfall(
+    *,
+    trace_id: str,
+    model: str,
+    t0: float,
+    t_end: float,
+    t_first: Optional[float] = None,
+    t_last_tok: Optional[float] = None,
+    ttft_attr: Optional[Dict[str, Any]] = None,
+    incidents: Optional[List[dict]] = None,
+    ntokens: int = 0,
+    status: int = 200,
+) -> Dict[str, Any]:
+    """Assemble one request's waterfall summary (plain floats + strings,
+    JSON-able, small enough to live in an exemplar slot).
+
+    Timestamps are ``time.monotonic()`` seconds from the serving path:
+    `t0` request accepted, `t_first` first token-bearing delta,
+    `t_last_tok` last token-bearing delta, `t_end` stream closed."""
+    attr = ttft_attr or {}
+    incidents = incidents or []
+    total_ms = max(t_end - t0, 0.0) * 1e3
+    ttft_ms = ((t_first - t0) * 1e3 if t_first is not None else total_ms)
+
+    block_ms = float(attr.get("block_wait_ms") or 0.0)
+    queue_ms = float(attr.get("queue_wait_ms") or 0.0)
+    prefill_ms = float(attr.get("prefill_ms") or 0.0)
+    decode_ms = (max(t_last_tok - t_first, 0.0) * 1e3
+                 if t_first is not None and t_last_tok is not None else 0.0)
+
+    migration_ms = preempt_ms = onboard_ms = 0.0
+    for inc in incidents:
+        stall = float(inc.get("stall_ms") or 0.0)
+        kind = inc.get("kind")
+        if kind == "migration":
+            migration_ms += stall
+        elif kind == "preempt":
+            preempt_ms += stall
+        elif kind == "onboard":
+            onboard_ms += stall
+
+    # shed: the frontend knows it turned an overload rejection into a
+    # 429 — record the incident even though no engine metadata arrived
+    if status == 429 and not any(i.get("kind") == "shed"
+                                 for i in incidents):
+        incidents = incidents + [{"kind": "shed"}]
+
+    # egress residual: total minus everything attributed.  Covers the
+    # transport/SSE-write share AND any TTFT gap the engine could not
+    # attribute; clamped — attribution overlap must not go negative.
+    attributed = (block_ms + queue_ms + prefill_ms + decode_ms
+                  + migration_ms)
+    egress_ms = max(total_ms - attributed, 0.0)
+
+    # incident stalls happen INSIDE the decode (or queue) interval;
+    # compete them as their own stages so a preempted request blames
+    # `preempt`, not an inflated `decode`
+    stages = {
+        "prefill": prefill_ms,
+        "queue": queue_ms + block_ms + onboard_ms,
+        "decode": max(decode_ms - migration_ms - preempt_ms, 0.0),
+        "migration": migration_ms,
+        "preempt": preempt_ms,
+        "egress": egress_ms,
+    }
+    if status == 429:
+        bottleneck = "queue"  # shed before any stage ran
+    else:
+        bottleneck = max(_STAGE_ORDER, key=lambda s: stages[s])
+
+    out: Dict[str, Any] = {
+        "trace_id": trace_id,
+        "model": model,
+        "bottleneck": bottleneck,
+        "ttft_ms": round(ttft_ms, 3),
+        "total_ms": round(total_ms, 3),
+        "tokens": int(ntokens),
+        "status": int(status),
+        "stages": {
+            "queue_ms": round(queue_ms, 3),
+            "block_ms": round(block_ms, 3),
+            "prefill_ms": round(prefill_ms, 3),
+            "decode_ms": round(decode_ms, 3),
+            "egress_ms": round(egress_ms, 3),
+            "migration_ms": round(migration_ms, 3),
+            "preempt_ms": round(preempt_ms, 3),
+            "onboard_ms": round(onboard_ms, 3),
+        },
+    }
+    if incidents:
+        out["incidents"] = incidents
+    return out

@@ -21,6 +21,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import random
+import time
 from typing import Any, AsyncIterator, Callable, Dict, Optional
 
 from ..runtime import Context
@@ -65,6 +66,7 @@ async def migrating_stream(
     generated: list[int] = []
     budget = (request.get("stop_conditions") or {}).get("max_tokens")
     attempts = 0
+    t_migrated: Optional[float] = None  # forensics: reissue → next delta
     while True:
         attempt_request = request
         if generated:
@@ -87,6 +89,18 @@ async def migrating_stream(
                 toks = out.get("token_ids") or []
                 generated.extend(toks)
                 progressed = progressed or bool(toks)
+                if t_migrated is not None:
+                    # forensics: the worker-hop stall rides the first
+                    # delta of the re-issued stream, so the frontend's
+                    # per-request waterfall can blame `migration`
+                    out = dict(out)
+                    out["incidents"] = list(out.get("incidents") or []) + [{
+                        "kind": "migration",
+                        "attempt": attempts,
+                        "stall_ms": round(
+                            (time.monotonic() - t_migrated) * 1e3, 3),
+                    }]
+                    t_migrated = None
                 yield out
                 if out.get("finish_reason"):
                     return
@@ -121,6 +135,7 @@ async def migrating_stream(
             )
             if on_migration is not None:
                 on_migration(MIGRATED)
+            t_migrated = time.monotonic()
             # the re-issue is a trace milestone: an instant span under the
             # request's trace, so a migrated stream's timeline shows WHERE
             # the worker hop happened — and because the retry runs in this

@@ -33,6 +33,7 @@ from ..engine.engine import ForwardPassMetrics, _opts_from_request
 from ..engine.page_pool import KvEvent, PagePool
 from ..engine.scheduler import PrefillItem, Scheduler, Sequence
 from ..runtime.engine import Context
+from ..runtime.events import StepEventRecorder
 
 logger = logging.getLogger(__name__)
 
@@ -108,6 +109,12 @@ class MockEngine:
             self.cfg.num_pages, self.cfg.page_size, event_sink=self._emit
         )
         self.scheduler = Scheduler(self.cfg, self.pool)
+        # same step-event surface as the real engine (admit/preempt from
+        # the shared Scheduler; prefill_chunk/decode_block recorded by
+        # the mock pump) — so chaos workers running the mock leave the
+        # same black box (`DYN_TPU_FLIGHT_DIR`) a real worker would
+        self.events = StepEventRecorder.from_env()
+        self.scheduler.events = self.events
         # decode preemption park/resume: the mock holds no KV bytes, so
         # parking is pure page accounting through a real ParkingLot
         # (leak-ledger `parked_pages` account included) — generated
@@ -342,7 +349,10 @@ class MockEngine:
             + a.prefill_per_token * total
             + a.prefill_quadratic * total * ctx_tokens
         ) / a.speedup_ratio
+        t0_ev = self.events.now()
         await asyncio.sleep(t)
+        self.events.record("prefill_chunk", t0_ns=t0_ev, batch=len(items),
+                           tokens=total, fused_blocks=0)
         for it in items:
             s = it.seq
             if s.status != "running":
@@ -358,7 +368,10 @@ class MockEngine:
     async def _run_decode(self, seqs: List[Sequence]) -> None:
         a = self.args
         t = (a.decode_base + a.decode_per_seq * len(seqs)) / a.speedup_ratio
+        t0_ev = self.events.now()
         await asyncio.sleep(t)
+        self.events.record("decode_block", t0_ns=t0_ev, rung=1,
+                           batch=len(seqs), chain=1)
         for s in seqs:
             if s.status != "running":
                 continue
@@ -377,4 +390,11 @@ class MockEngine:
             self.scheduler.finish(seq, reason)
         queue = self._queues.get(seq.request_id)
         if queue is not None:
-            queue.put_nowait({"token_ids": [token], "finish_reason": reason})
+            out: Dict[str, Any] = {"token_ids": [token],
+                                   "finish_reason": reason}
+            if seq.incidents:
+                # forensics: engine-side stalls ride the next delta
+                # (same attach-and-clear contract as the real engine)
+                out["incidents"] = seq.incidents
+                seq.incidents = []
+            queue.put_nowait(out)

@@ -20,7 +20,7 @@ class SystemStatusServer:
         metrics: MetricsScope | None = None,
         health_fn: Callable[[], Awaitable[dict]] | None = None,
         stats_fn: Callable[[], dict] | None = None,
-        events_fn: Callable[[], dict] | None = None,
+        events_fn: Callable[..., dict] | None = None,
         host: str = "0.0.0.0",
         port: int = 0,
     ):
@@ -79,8 +79,28 @@ class SystemStatusServer:
     async def _events_json(self, request: web.Request) -> web.Response:
         """Engine step-event ring dump (runtime.events.StepEventRecorder
         — the worker debug endpoint `scripts/trace_stack.py` and the
-        timeline merger read; {} when no recorder is wired)."""
-        body = self.events_fn() if self.events_fn else {}
+        timeline merger read; {} when no recorder is wired).
+        `?since_ns=` (the previous dump's `watermark_ns`) returns only
+        newer events so pollers fetch deltas, not the whole ring."""
+        since = request.query.get("since_ns")
+        try:
+            since_ns = int(since) if since is not None else None
+        except ValueError:
+            return web.Response(
+                text=json.dumps({"error": f"bad since_ns {since!r}"}),
+                status=400, content_type="application/json",
+            )
+        body = {}
+        if self.events_fn:
+            if since_ns is None:
+                body = self.events_fn()
+            else:
+                try:
+                    body = self.events_fn(since_ns)
+                except TypeError:
+                    # cursor-unaware events_fn (older wiring): serve the
+                    # full dump rather than failing the poller
+                    body = self.events_fn()
         return web.Response(
             text=json.dumps(body), content_type="application/json"
         )

@@ -16,6 +16,7 @@ from __future__ import annotations
 import contextvars
 import json
 import logging
+import os
 import sys
 import time
 import uuid
@@ -166,16 +167,38 @@ def _otlp_envelope(service_name: str, spans: list) -> dict:
 
 
 class SpanFileExporter:
-    def __init__(self, path: str, service_name: str = "dynamo_tpu"):
+    """Append-only OTLP/JSON-lines sink, with optional size rotation.
+
+    `DYN_OTEL_FILE_MAX_MB` > 0 arms rotation: when the sink passes the
+    cap it is renamed to `<path>.1` (older generations shift up, at most
+    `DYN_OTEL_FILE_KEEP` kept) and a fresh file is opened.  Rotation is
+    multi-process-safe for the shared-sink case (chaos runs point every
+    process at one file): rename is atomic, writes are whole O_APPEND
+    lines, and a process that LOST the rotation race keeps appending to
+    the renamed inode (no lost lines) until its next rotation check
+    notices the path moved and reopens the new sink."""
+
+    def __init__(self, path: str, service_name: str = "dynamo_tpu",
+                 max_bytes: Optional[int] = None,
+                 keep: Optional[int] = None):
+        from .config import env_int
+
         self.path = path
         self.service_name = service_name
         self.sent = 0
         self.dropped = 0
+        self.rotations = 0
+        self.max_bytes = (env_int("DYN_OTEL_FILE_MAX_MB", 0) * 1024 * 1024
+                          if max_bytes is None else max_bytes)
+        self.keep = (max(1, env_int("DYN_OTEL_FILE_KEEP", 3))
+                     if keep is None else max(1, keep))
         # spans export from BOTH the event loop and the engine's executor
         # thread (per-request milestone spans) — serialize writes so two
         # threads can't tear one line
         self._lock = _make_lock("tracing.file_exporter._lock")
         self._f = open(path, "a", buffering=1)
+        self._size = os.fstat(self._f.fileno()).st_size  # guarded-by: _lock
+        self._writes = 0  # guarded-by: _lock
 
     def export(self, name: str, ctx: TraceContext, parent_span: str,
                start_ns: int, end_ns: int, attrs: dict) -> None:
@@ -187,8 +210,52 @@ class SpanFileExporter:
             with self._lock:
                 self._f.write(line + "\n")
                 self.sent += 1
+                self._size += len(line) + 1
+                self._writes += 1
+                if self.max_bytes and (self._size >= self.max_bytes
+                                       or self._writes % 64 == 0):
+                    # lint: allow(blocking-under-lock): rotation must be atomic with the write stream; one stat+rename at most every 64 writes
+                    self._maybe_rotate_locked()
         except (OSError, ValueError):
             self.dropped += 1
+
+    def _maybe_rotate_locked(self) -> None:
+        """Rotate (or follow another process's rotation); lock held."""
+        st_f = os.fstat(self._f.fileno())
+        try:
+            st_path = os.stat(self.path)
+        except FileNotFoundError:
+            st_path = None
+        if (st_path is None
+                or (st_path.st_ino, st_path.st_dev)
+                != (st_f.st_ino, st_f.st_dev)):
+            # another process rotated under us: our lines landed in the
+            # renamed inode (whole, via O_APPEND) — just follow
+            self._reopen_locked()
+            return
+        if st_path.st_size < self.max_bytes:
+            self._size = st_path.st_size  # other writers' shares counted
+            return
+        for i in range(self.keep - 1, 0, -1):
+            src, dst = f"{self.path}.{i}", f"{self.path}.{i + 1}"
+            try:
+                os.replace(src, dst)
+            except OSError:
+                pass
+        try:
+            os.replace(self.path, f"{self.path}.1")
+        except OSError:
+            pass  # lost the rename race — the winner already rotated
+        self.rotations += 1
+        self._reopen_locked()
+
+    def _reopen_locked(self) -> None:
+        try:
+            self._f.close()
+        except OSError:
+            pass
+        self._f = open(self.path, "a", buffering=1)
+        self._size = os.fstat(self._f.fileno()).st_size
 
     def close(self) -> None:
         try:

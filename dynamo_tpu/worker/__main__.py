@@ -512,22 +512,29 @@ async def _run(args) -> None:
         # lifecycle ledger: pending/orphaned tasks + resource-account
         # imbalances (absent unless DYN_TPU_LEAKCHECK=1)
         scope.registry.register(LeakLedgerCollector())
+        # process-level CPU/fd/RSS — the same dynamo_process_* families
+        # the frontend exports, so fleet dashboards see worker host
+        # pressure from the worker's own /metrics
+        from ..runtime.metrics import ProcessStatsCollector
 
-        def _events():
+        scope.registry.register(ProcessStatsCollector())
+
+        def _events(since_ns=None):
             """Step-event ring dump(s) for /events.json — the engine(s)
             behind this endpoint, keyed so the timeline merger can place
-            each ring on its own track (dp ranks dump separately)."""
+            each ring on its own track (dp ranks dump separately).
+            `since_ns` is the poller's cursor (dump watermark_ns)."""
             inner = engine
             while not hasattr(inner, "events") and hasattr(inner, "engine"):
                 inner = inner.engine  # unwrap disagg/encode handlers
             if hasattr(inner, "engines"):  # DpRankEngine
                 return {
-                    f"rank{r}": e.events.dump()
+                    f"rank{r}": e.events.dump(since_ns=since_ns)
                     for r, e in enumerate(inner.engines)
                     if hasattr(e, "events")
                 }
             if hasattr(inner, "events"):
-                return {"engine": inner.events.dump()}
+                return {"engine": inner.events.dump(since_ns=since_ns)}
             return {}
 
         status = await SystemStatusServer(

@@ -175,20 +175,80 @@ def test_accountant_matches_bench_offline_computation():
 
 def test_observe_under_20us_per_request():
     """The acceptance micro-benchmark: per-request SLO accounting must
-    cost < 20 µs (it runs once per request on the streaming path)."""
-    acc = SLOAccountant()
+    cost < 20 µs (it runs once per request on the streaming path) — WITH
+    exemplar slots armed, the production frontend configuration."""
+    acc = SLOAccountant(exemplars=True)
     rng = random.Random(11)
     samples = [(rng.uniform(1, 2000), rng.uniform(0.5, 80),
                 rng.randrange(1, 200)) for _ in range(512)]
     # warm the window + interpreter caches off the clock
     for ttft, itl, toks in samples[:64]:
         acc.observe_start("bench")
-        acc.observe("bench", ttft, itl, toks, prompt_tokens=128)
+        acc.observe("bench", ttft, itl, toks, prompt_tokens=128,
+                    exemplar={"trace_id": "t", "total_ms": ttft})
     n = 20_000
     t0 = time.perf_counter()
     for i in range(n):
         ttft, itl, toks = samples[i % len(samples)]
         acc.observe_start("bench")
-        acc.observe("bench", ttft, itl, toks, prompt_tokens=128)
+        acc.observe("bench", ttft, itl, toks, prompt_tokens=128,
+                    exemplar={"trace_id": f"t{i}", "total_ms": ttft})
     per_request = (time.perf_counter() - t0) / n
     assert per_request < 20e-6, f"{per_request * 1e6:.2f}µs/request"
+
+
+# -- exemplar slots + windowed tail ----------------------------------------- #
+
+
+def test_histogram_exemplars_keep_worst_per_bucket():
+    h = LogBucketHistogram(exemplars=True)
+    h.record(100.0, exemplar={"trace_id": "a"})
+    h.record(105.0, exemplar={"trace_id": "b"})   # same bucket, worse
+    h.record(102.0, exemplar={"trace_id": "c"})   # same bucket, not worse
+    h.record(8000.0, exemplar={"trace_id": "d"})  # far bucket
+    worst = h.worst_exemplars(2)
+    assert [ex["trace_id"] for _v, ex in worst] == ["d", "b"]
+    # merge propagates the per-bucket worst
+    h2 = LogBucketHistogram(exemplars=True)
+    h2.record(106.0, exemplar={"trace_id": "e"})
+    h.merge(h2)
+    worst = h.worst_exemplars(2)
+    assert [ex["trace_id"] for _v, ex in worst] == ["d", "e"]
+    # a bare histogram records fine without exemplars and merge from an
+    # exemplar-less peer is a no-op on the slots
+    h3 = LogBucketHistogram()
+    h3.record(1.0)
+    h.merge(h3)
+    assert h.worst_exemplars(1)[0][1]["trace_id"] == "d"
+
+
+def test_window_tail_names_worst_requests():
+    win = SlidingWindow(window_s=60.0, slots=6, exemplars=True)
+    t0 = 9000.0
+    for i, ttft in enumerate((50.0, 900.0, 200.0)):
+        win.record(ttft_ms=ttft, itl_ms=5.0, output_tokens=8, slo_ok=True,
+                   now=t0 + i * 0.1,
+                   exemplar={"trace_id": f"r{i}", "total_ms": ttft + 100,
+                             "bottleneck": "prefill"})
+    tail = win.tail(2, now=t0 + 1.0)
+    assert [ex["trace_id"] for ex in tail] == ["r1", "r2"]
+    assert tail[0]["bottleneck"] == "prefill"
+    # snapshot carries the tail only when armed
+    assert "tail" in win.snapshot(now=t0 + 1.0)
+    assert "tail" not in SlidingWindow(window_s=60.0).snapshot(now=t0)
+    # aged-out exemplars leave the tail with the rotation
+    assert win.tail(2, now=t0 + 120.0) == []
+
+
+def test_accountant_tail_per_model():
+    acc = SLOAccountant(exemplars=True)
+    t = 300.0
+    acc.observe("m1", ttft_ms=700, itl_ms=5, output_tokens=4, now=t,
+                exemplar={"trace_id": "slow", "total_ms": 800,
+                          "bottleneck": "queue"})
+    acc.observe("m1", ttft_ms=10, itl_ms=2, output_tokens=4, now=t,
+                exemplar={"trace_id": "fast", "total_ms": 20,
+                          "bottleneck": "decode"})
+    tail = acc.tail(1, now=t + 1.0)
+    assert [ex["trace_id"] for ex in tail["m1"]] == ["slow"]
+    assert tail["m1"][0]["bottleneck"] == "queue"

@@ -309,3 +309,59 @@ async def test_host_gap_measured_from_continuous_engine():
                               for e in chains)
     finally:
         await engine.shutdown()
+
+
+def test_merge_tolerates_truncated_and_empty_ring_dumps(tmp_path):
+    """A postmortem merges whatever survived: empty dumps, dumps missing
+    anchors/counters (truncated mid-serialization), and events missing
+    fields must produce a schema-valid document, never a crash."""
+    dumps = {
+        "empty": {"wall_ns": 0, "mono_ns": 0, "events": []},
+        "no-anchors": {"events": [{"t_ns": 5000, "dur_ns": 10,
+                                   "kind": "decode_block"}]},
+        "bare-events": {"wall_ns": 10, "mono_ns": 3,
+                        "events": [{}, {"kind": "x"}]},
+        "not-even-events": {},
+    }
+    doc = tl.merge_timeline([], ring_dumps=dumps,
+                            out_path=str(tmp_path / "t.json"))
+    assert tl.validate_chrome_trace(doc) == []
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert "decode_block" in names
+
+
+def test_merge_flight_dump_torn_segment(tmp_path):
+    """Flight segments from a SIGKILLed process — including a torn final
+    record — load as ring-dump-shaped dicts that merge_timeline accepts
+    directly (the postmortem path end to end)."""
+    from dynamo_tpu.runtime.events import (
+        FLIGHT_HEADER_SIZE,
+        FLIGHT_RECORD_SIZE,
+        FlightRecorder,
+        StepEventRecorder,
+        load_flight_dir,
+    )
+
+    fdir = tmp_path / "flight"
+    rec = StepEventRecorder(
+        capacity=32,
+        flight=FlightRecorder(str(fdir), service="victim",
+                              segment_slots=32),
+    )
+    for i in range(8):
+        t0 = rec.now()
+        rec.record("decode_block", t0_ns=t0, rung=4, batch=2, chain=1)
+    # tear the segment mid-record-6, as a SIGKILL mid-write would
+    (seg,) = fdir.iterdir()
+    with open(seg, "r+b") as f:
+        f.truncate(FLIGHT_HEADER_SIZE + 5 * FLIGHT_RECORD_SIZE + 40)
+    (dump,) = load_flight_dir(str(fdir))
+    assert len(dump["events"]) == 5
+    doc = tl.merge_timeline(
+        [], ring_dumps={f"{dump['service']}:{dump['pid']}": dump},
+        out_path=str(tmp_path / "t.json"),
+    )
+    assert tl.validate_chrome_trace(doc) == []
+    slices = [e for e in doc["traceEvents"]
+              if e.get("ph") == "X" and e["name"] == "decode_block"]
+    assert len(slices) == 5 and all(e["args"]["rung"] == 4 for e in slices)

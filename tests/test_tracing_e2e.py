@@ -193,3 +193,90 @@ def test_trace_stack_crosses_processes(tmp_path):
     assert summary["orphan_spans"] == 0
     assert summary["schema_errors"] == 0
     assert summary["decode_slices_with_rung"] >= 1
+
+
+# -- SpanFileExporter size rotation ----------------------------------------- #
+
+
+def _count_spans(*paths):
+    n = 0
+    for p in paths:
+        try:
+            with open(p) as f:
+                lines = [ln for ln in f.read().splitlines() if ln.strip()]
+        except OSError:
+            continue
+        for ln in lines:
+            doc = json.loads(ln)  # every surviving line must be WHOLE
+            n += sum(len(sc.get("spans", []))
+                     for rs in doc.get("resourceSpans", [])
+                     for sc in rs.get("scopeSpans", []))
+    return n
+
+
+def test_span_file_exporter_rotates_by_size(tmp_path):
+    """Past the size cap the sink renames to .1 (generations shift up,
+    keep-N retained) and a fresh file opens; every exported span lands
+    whole in exactly one surviving generation until keep overflows."""
+    path = str(tmp_path / "spans.jsonl")
+    exp = tracing.SpanFileExporter(path, service_name="svc",
+                                   max_bytes=4096, keep=2)
+    ctx = tracing.new_trace()
+    for i in range(40):  # ~500 B/line → several rotations
+        exp.export(f"span{i}", ctx.child(), "", 1000, 2000, {"i": str(i)})
+    exp.close()
+    assert exp.rotations >= 2 and exp.dropped == 0
+    names = sorted(p.name for p in tmp_path.iterdir())
+    assert names == ["spans.jsonl", "spans.jsonl.1", "spans.jsonl.2"]
+    # no torn lines anywhere; survivors are a suffix of what was sent
+    survivors = _count_spans(path, path + ".1", path + ".2")
+    assert 0 < survivors <= exp.sent
+    # the newest generation always holds the newest spans
+    spans = tl.load_otlp_spans([path, path + ".1"])
+    assert any(s["name"] == "span39" for s in spans)
+
+
+def test_span_file_exporter_follows_foreign_rotation(tmp_path):
+    """Two exporters share one sink (the chaos multi-process setup, in
+    one process): when A rotates, B's buffered appends land whole in the
+    renamed inode, and B's next rotation check reopens the new sink —
+    no line is ever lost or torn."""
+    import os
+
+    path = str(tmp_path / "spans.jsonl")
+    a = tracing.SpanFileExporter(path, service_name="a",
+                                 max_bytes=10 << 20, keep=3)
+    b = tracing.SpanFileExporter(path, service_name="b",
+                                 max_bytes=10 << 20, keep=3)
+    ctx = tracing.new_trace()
+    a.export("a0", ctx.child(), "", 1000, 2000, {})
+    b.export("b0", ctx.child(), "", 1000, 2000, {})
+    # a foreign process rotates the shared sink out from under both
+    os.replace(path, path + ".1")
+    # B keeps appending: its lines land in the RENAMED inode (O_APPEND)
+    b.export("b1", ctx.child(), "", 1000, 2000, {})
+    # ... until its next rotation check notices the path moved
+    for i in range(70):  # crosses the every-64-writes check
+        b.export(f"b{i + 2}", ctx.child(), "", 1000, 2000, {})
+    a.close()
+    b.close()
+    total = _count_spans(path, path + ".1")
+    assert total == a.sent + b.sent, (total, a.sent, b.sent)
+    # post-check lines landed in the NEW sink at the original path
+    new_names = {s["name"] for s in tl.load_otlp_spans([path])}
+    assert "b71" in new_names
+    old_names = {s["name"] for s in tl.load_otlp_spans([path + ".1"])}
+    assert {"a0", "b0", "b1"} <= old_names
+
+
+def test_span_file_exporter_rotation_off_by_default(tmp_path, monkeypatch):
+    monkeypatch.delenv("DYN_OTEL_FILE_MAX_MB", raising=False)
+    path = str(tmp_path / "spans.jsonl")
+    exp = tracing.SpanFileExporter(path, service_name="svc")
+    ctx = tracing.new_trace()
+    for i in range(100):
+        exp.export(f"s{i}", ctx.child(), "", 1000, 2000, {})
+    exp.close()
+    assert exp.max_bytes == 0 and exp.rotations == 0
+    assert [p.name for p in tmp_path.iterdir()] == ["spans.jsonl"]
+    assert _count_spans(path) == 100
